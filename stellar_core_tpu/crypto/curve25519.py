@@ -1,0 +1,43 @@
+"""X25519 ECDH for peer session keys.
+
+Role parity: reference `src/crypto/Curve25519.{h,cpp}:47-71` — random scalar,
+derive public, ECDH → HKDF shared key; used by overlay PeerAuth.
+"""
+
+from __future__ import annotations
+
+import os
+
+from cryptography.hazmat.primitives import serialization
+from cryptography.hazmat.primitives.asymmetric.x25519 import (
+    X25519PrivateKey, X25519PublicKey,
+)
+
+from .hashing import hkdf_expand, hkdf_extract
+
+
+def curve25519_random_secret() -> bytes:
+    sk = X25519PrivateKey.generate()
+    return sk.private_bytes(serialization.Encoding.Raw,
+                            serialization.PrivateFormat.Raw,
+                            serialization.NoEncryption())
+
+
+def curve25519_derive_public(secret32: bytes) -> bytes:
+    sk = X25519PrivateKey.from_private_bytes(secret32)
+    return sk.public_key().public_bytes(serialization.Encoding.Raw,
+                                        serialization.PublicFormat.Raw)
+
+
+def curve25519_derive_shared(local_secret32: bytes, remote_public32: bytes,
+                             public_a: bytes, public_b: bytes) -> bytes:
+    """ECDH then HKDF-extract over (shared ‖ publicA ‖ publicB) — the caller
+    fixes the A/B ordering so both sides derive the same key
+    (reference Curve25519.cpp:47-71)."""
+    sk = X25519PrivateKey.from_private_bytes(local_secret32)
+    shared = sk.exchange(X25519PublicKey.from_public_bytes(remote_public32))
+    return hkdf_extract(shared + public_a + public_b)
+
+
+def hkdf_expand_key(key32: bytes, info: bytes) -> bytes:
+    return hkdf_expand(key32, info, 32)

@@ -1,0 +1,160 @@
+"""Key management and the synchronous signature boundary.
+
+Role parity: reference `src/crypto/SecretKey.{h,cpp}`:
+- SecretKey::sign (SecretKey.cpp:123), random/from-seed/pseudo keys
+- PubKeyUtils::verifySig (SecretKey.cpp:310) with the global verify-result
+  cache (SecretKey.cpp:27-51,320-337)
+- KeyUtils strkey round-trips
+
+CPU crypto is OpenSSL via the `cryptography` package (the libsodium stand-in:
+RFC 8032 semantics — cofactorless verify, rejects non-canonical S and
+non-canonical point encodings). The TPU batch path (crypto/batch_verifier.py)
+implements the SAME accept/reject semantics so backends are interchangeable.
+"""
+
+from __future__ import annotations
+
+import hashlib
+import threading
+from typing import Optional
+
+from cryptography.exceptions import InvalidSignature
+from cryptography.hazmat.primitives import serialization
+from cryptography.hazmat.primitives.asymmetric import ed25519 as _ed
+
+from ..util.cache import RandomEvictionCache
+from ..xdr import PublicKey, SignatureHint
+from . import strkey
+from .hashing import sha256
+
+VERIFY_CACHE_SIZE = 0xFFFF
+
+_cache_lock = threading.Lock()
+_verify_cache: RandomEvictionCache = RandomEvictionCache(VERIFY_CACHE_SIZE)
+
+
+def _cache_key(key32: bytes, sig: bytes, msg: bytes) -> bytes:
+    h = hashlib.sha256()
+    h.update(key32)
+    h.update(sig)
+    h.update(msg)
+    return h.digest()
+
+
+def verify_cache_stats() -> dict:
+    with _cache_lock:
+        return {"hits": _verify_cache.hits, "misses": _verify_cache.misses,
+                "size": len(_verify_cache)}
+
+
+def flush_verify_cache() -> None:
+    with _cache_lock:
+        _verify_cache.clear()
+        _verify_cache.hits = 0
+        _verify_cache.misses = 0
+
+
+def raw_verify(key32: bytes, sig: bytes, msg: bytes) -> bool:
+    """Uncached single ed25519 verify (OpenSSL)."""
+    if len(sig) != 64:
+        return False
+    try:
+        pk = _ed.Ed25519PublicKey.from_public_bytes(key32)
+        pk.verify(sig, msg)
+        return True
+    except (InvalidSignature, ValueError):
+        return False
+
+
+class PubKeyUtils:
+    @staticmethod
+    def verify_sig(key: PublicKey, sig: bytes, msg: bytes) -> bool:
+        """Cached verify — the L0 in front of any batch backend
+        (reference SecretKey.cpp:310-337)."""
+        ck = _cache_key(key.key_bytes, sig, msg)
+        with _cache_lock:
+            got = _verify_cache.maybe_get(ck)
+        if got is not None:
+            return got
+        ok = raw_verify(key.key_bytes, sig, msg)
+        with _cache_lock:
+            _verify_cache.put(ck, ok)
+        return ok
+
+    @staticmethod
+    def get_hint(key: PublicKey) -> bytes:
+        """Last 4 bytes of the key (reference getHint)."""
+        return key.key_bytes[-4:]
+
+
+class SecretKey:
+    """Ed25519 secret key (seed form)."""
+
+    def __init__(self, seed32: bytes) -> None:
+        assert len(seed32) == 32
+        self._seed = seed32
+        self._sk = _ed.Ed25519PrivateKey.from_private_bytes(seed32)
+        pub = self._sk.public_key().public_bytes(
+            serialization.Encoding.Raw, serialization.PublicFormat.Raw)
+        self._pub = PublicKey.ed25519(pub)
+
+    # -- constructors -------------------------------------------------------
+    @classmethod
+    def random(cls) -> "SecretKey":
+        import os
+        return cls(os.urandom(32))
+
+    @classmethod
+    def from_seed(cls, seed32: bytes) -> "SecretKey":
+        return cls(seed32)
+
+    @classmethod
+    def pseudo_random_for_testing(cls, rng=None) -> "SecretKey":
+        from ..util import rnd
+        r = rng or rnd.g_random
+        return cls(bytes(r.getrandbits(8) for _ in range(32)))
+
+    @classmethod
+    def from_strkey_seed(cls, s: str) -> "SecretKey":
+        return cls(strkey.decode_seed(s))
+
+    # -- accessors ----------------------------------------------------------
+    @property
+    def public_key(self) -> PublicKey:
+        return self._pub
+
+    @property
+    def seed(self) -> bytes:
+        return self._seed
+
+    def strkey_seed(self) -> str:
+        return strkey.encode_seed(self._seed)
+
+    def strkey_public(self) -> str:
+        return strkey.encode_public_key(self._pub.key_bytes)
+
+    # -- signing ------------------------------------------------------------
+    def sign(self, msg: bytes) -> bytes:
+        return self._sk.sign(msg)
+
+    def sign_decorated(self, msg: bytes):
+        from ..xdr import DecoratedSignature
+        return DecoratedSignature(hint=PubKeyUtils.get_hint(self._pub),
+                                  signature=self.sign(msg))
+
+    def __repr__(self) -> str:
+        return "SecretKey(%s)" % self.strkey_public()
+
+
+class KeyUtils:
+    @staticmethod
+    def to_strkey(key: PublicKey) -> str:
+        return strkey.encode_public_key(key.key_bytes)
+
+    @staticmethod
+    def from_strkey(s: str) -> PublicKey:
+        return PublicKey.ed25519(strkey.decode_public_key(s))
+
+    @staticmethod
+    def short_name(key: PublicKey) -> str:
+        return KeyUtils.to_strkey(key)[:5]

@@ -1,0 +1,199 @@
+"""GF(2^255-19) arithmetic in int32 limbs, designed for the TPU VPU.
+
+TPU-first design notes (this is the compute plane of the batched ed25519
+verifier; see SURVEY.md §2.2 "batch-verify service"):
+
+- No 64-bit integers: TPUs have no native s64, so a field element is 20
+  limbs of radix 2^13 held in int32 (shape (..., 20)). 13-bit limbs keep
+  every product < 2^26 and every 20-term column sum < 2^31, so schoolbook
+  multiplication accumulates safely in int32.
+- Multiplication lowers to: one broadcast outer product (..., 20, 20), a
+  static gather that re-indexes b into a shifted (20, 39) matrix, and one
+  reduction — three fused vector ops instead of 400 scalar MACs, which is
+  what XLA tiles well.
+- Carries are PARALLEL, not sequential: k rounds of (mask, shift, add)
+  bound limbs at 2^13 + eps rather than fully normalizing. The invariant
+  maintained between ops is limbs <= LIMB_BOUND (9500); a full sequential
+  normalization (`fe_freeze`) happens only at equality checks.
+- The wrap at 2^260: limb 20 would carry weight 2^260 ≡ 19·2^5 = 608
+  (mod p), so high columns fold back with a multiply by 608.
+
+Everything is shape-static and jit/vmap-friendly; batch dims broadcast.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+
+NLIMBS = 20
+LIMB_BITS = 13
+LIMB_MASK = (1 << LIMB_BITS) - 1
+FOLD = 19 * 32  # 2^260 ≡ 19·2^5 (mod p)
+LIMB_BOUND = 9500  # loose per-limb bound maintained between ops
+
+P = 2**255 - 19
+
+# 64·p as a limb vector: every limb exceeds LIMB_BOUND, so a + _K64P - b is
+# non-negative limb-wise whenever b's limbs are within bound.
+# 32p = 2^260 - 608 = [8192-608, 8191, ..., 8191]; doubled below.
+_K64P_NP = np.array([2 * (8192 - 608)] + [2 * 8191] * 19, np.int32)
+
+# index matrix for the shifted-b gather: SHIFT_IDX[i, k] = k - i (clipped),
+# SHIFT_MASK[i, k] = 1 iff 0 <= k - i < 20.
+_idx = np.arange(39)[None, :] - np.arange(NLIMBS)[:, None]
+SHIFT_MASK_NP = ((_idx >= 0) & (_idx < NLIMBS)).astype(np.int32)
+SHIFT_IDX_NP = np.clip(_idx, 0, NLIMBS - 1).astype(np.int32)
+
+
+def limbs_from_int(x: int) -> np.ndarray:
+    out = np.zeros(NLIMBS, np.int32)
+    for i in range(NLIMBS):
+        out[i] = (x >> (LIMB_BITS * i)) & LIMB_MASK
+    return out
+
+
+def int_from_limbs(a) -> int:
+    a = np.asarray(a)
+    return sum(int(a[..., i]) << (LIMB_BITS * i) for i in range(NLIMBS))
+
+
+def _carry_round_20(c: jnp.ndarray) -> jnp.ndarray:
+    """One parallel carry round over 20 limbs with top fold (2^260 wrap)."""
+    lo = c & LIMB_MASK
+    hi = c >> LIMB_BITS
+    wrapped = jnp.concatenate(
+        [hi[..., 19:20] * FOLD, hi[..., :19]], axis=-1)
+    return lo + wrapped
+
+
+def fe_carry(c: jnp.ndarray, rounds: int = 2) -> jnp.ndarray:
+    for _ in range(rounds):
+        c = _carry_round_20(c)
+    return c
+
+
+def fe_add(a: jnp.ndarray, b: jnp.ndarray) -> jnp.ndarray:
+    return fe_carry(a + b, rounds=1)
+
+
+def fe_sub(a: jnp.ndarray, b: jnp.ndarray) -> jnp.ndarray:
+    k = jnp.asarray(_K64P_NP)
+    return fe_carry(a + k - b, rounds=2)
+
+
+def fe_neg(a: jnp.ndarray) -> jnp.ndarray:
+    k = jnp.asarray(_K64P_NP)
+    return fe_carry(k - a, rounds=2)
+
+
+def fe_mul_small(a: jnp.ndarray, c: int) -> jnp.ndarray:
+    """Multiply by a small constant (c·LIMB_BOUND must stay < 2^31)."""
+    return fe_carry(a * c, rounds=2)
+
+
+def _columns(a: jnp.ndarray, b: jnp.ndarray) -> jnp.ndarray:
+    """Polynomial product columns: (..., 39) with col k = Σ_{i+j=k} a_i·b_j."""
+    idx = jnp.asarray(SHIFT_IDX_NP)
+    mask = jnp.asarray(SHIFT_MASK_NP)
+    bmat = b[..., idx] * mask          # (..., 20, 39)
+    return jnp.sum(a[..., :, None] * bmat, axis=-2)
+
+
+def fe_mul(a: jnp.ndarray, b: jnp.ndarray) -> jnp.ndarray:
+    c = _columns(a, b)                                     # (..., 39) < 2^31
+    c = jnp.concatenate([c, jnp.zeros_like(c[..., :1])], axis=-1)  # 40 wide
+    # two parallel carry rounds over the 40 columns (carry i -> i+1)
+    for _ in range(2):
+        lo = c & LIMB_MASK
+        hi = c >> LIMB_BITS
+        c = lo + jnp.concatenate([jnp.zeros_like(hi[..., :1]),
+                                  hi[..., :39]], axis=-1)
+    # fold the high 20 columns: 2^(260+13j) ≡ 608·2^13j (mod p)
+    low = c[..., :NLIMBS] + FOLD * c[..., NLIMBS:]
+    return fe_carry(low, rounds=2)
+
+
+def fe_sq(a: jnp.ndarray) -> jnp.ndarray:
+    return fe_mul(a, a)
+
+
+def fe_one(batch_shape=()) -> jnp.ndarray:
+    one = np.zeros(NLIMBS, np.int32)
+    one[0] = 1
+    return jnp.broadcast_to(jnp.asarray(one), (*batch_shape, NLIMBS))
+
+
+def fe_zero(batch_shape=()) -> jnp.ndarray:
+    return jnp.zeros((*batch_shape, NLIMBS), jnp.int32)
+
+
+def fe_pow(x: jnp.ndarray, exp_bits_msb_first) -> jnp.ndarray:
+    """x^e via square-and-multiply inside a fori_loop (compiles once,
+    no 250-deep unrolled trace). exp_bits is a static 0/1 numpy array."""
+    bits = jnp.asarray(np.asarray(exp_bits_msb_first, np.int32))
+    n = bits.shape[0]
+
+    def body(i, r):
+        r = fe_sq(r)
+        rx = fe_mul(r, x)
+        return jnp.where(bits[i] != 0, rx, r)
+
+    # start from x for the leading 1 bit
+    return jax.lax.fori_loop(1, n, body, x)
+
+
+_P58_BITS = np.array([int(b) for b in bin(2**252 - 3)[2:]], np.int32)
+
+
+def fe_pow_p58(x: jnp.ndarray) -> jnp.ndarray:
+    """x^((p-5)/8), the exponent used in square-root decompression."""
+    return fe_pow(x, _P58_BITS)
+
+
+def fe_freeze(a: jnp.ndarray) -> jnp.ndarray:
+    """Full canonical reduction to the unique representative in [0, p),
+    with exact 13-bit limbs. Sequential carries — used only for equality
+    tests and output encoding, a handful of times per verify."""
+    # 1) exact sequential carry over 20 limbs, folding the top twice
+    def seq_carry(v):
+        limbs = []
+        carry = jnp.zeros_like(v[..., 0])
+        for i in range(NLIMBS):
+            t = v[..., i] + carry
+            limbs.append(t & LIMB_MASK)
+            carry = t >> LIMB_BITS
+        return jnp.stack(limbs, axis=-1), carry
+
+    v, c = seq_carry(a)
+    v = v.at[..., 0].add(c * FOLD)
+    v, c = seq_carry(v)  # c == 0 now; value < 2^260
+    # 2) fold bits 255..259: hi = limb19 >> 8, v mod 2^255 + 19*hi
+    for _ in range(2):
+        hi = v[..., 19] >> 8
+        v = v.at[..., 19].set(v[..., 19] & 0xFF)
+        v = v.at[..., 0].add(19 * hi)
+        v, _ = seq_carry(v)
+    # 3) value < 2^255 + eps; conditional subtract p via the +19 trick:
+    #    v >= p  <=>  v + 19 >= 2^255
+    t = v.at[..., 0].add(19)
+    t, _ = seq_carry(t)
+    ge = (t[..., 19] >> 8) > 0
+    t = t.at[..., 19].set(t[..., 19] & 0xFF)
+    return jnp.where(ge[..., None], t, v)
+
+
+def fe_eq(a: jnp.ndarray, b: jnp.ndarray) -> jnp.ndarray:
+    """Constant-shape equality over the canonical forms: (...,) bool."""
+    return jnp.all(fe_freeze(a) == fe_freeze(b), axis=-1)
+
+
+def fe_is_zero(a: jnp.ndarray) -> jnp.ndarray:
+    return jnp.all(fe_freeze(a) == 0, axis=-1)
+
+
+def fe_parity(a: jnp.ndarray) -> jnp.ndarray:
+    """Low bit of the canonical representative."""
+    return fe_freeze(a)[..., 0] & 1

@@ -1,0 +1,155 @@
+"""Metrics registry: counters, meters, timers, histograms.
+
+Role parity: reference libmedida (`src/main/Application.h:182-194`,
+docs/metrics.md) — per-app registry, exported as JSON via the HTTP admin
+`/metrics` endpoint. Rates are computed from a sliding window rather than
+EWMA; percentiles from a bounded reservoir.
+"""
+
+from __future__ import annotations
+
+import bisect
+import time
+from typing import Callable, Dict, List
+
+
+class Counter:
+    def __init__(self) -> None:
+        self.count = 0
+
+    def inc(self, n: int = 1) -> None:
+        self.count += n
+
+    def dec(self, n: int = 1) -> None:
+        self.count -= n
+
+    def set_count(self, n: int) -> None:
+        self.count = n
+
+    def to_json(self) -> dict:
+        return {"type": "counter", "count": self.count}
+
+
+class Meter:
+    def __init__(self, now_fn: Callable[[], float]) -> None:
+        self._now = now_fn
+        self.count = 0
+        self._events: List[tuple[float, int]] = []
+
+    def mark(self, n: int = 1) -> None:
+        self.count += n
+        t = self._now()
+        self._events.append((t, n))
+        cutoff = t - 900.0
+        while self._events and self._events[0][0] < cutoff:
+            self._events.pop(0)
+
+    def rate(self, window: float) -> float:
+        t = self._now()
+        total = sum(n for (ts, n) in self._events if ts >= t - window)
+        return total / window if window > 0 else 0.0
+
+    def one_minute_rate(self) -> float:
+        return self.rate(60.0)
+
+    def to_json(self) -> dict:
+        return {"type": "meter", "count": self.count,
+                "1_min_rate": self.one_minute_rate(),
+                "5_min_rate": self.rate(300.0),
+                "15_min_rate": self.rate(900.0)}
+
+
+class Histogram:
+    MAX_SAMPLES = 1028
+
+    def __init__(self) -> None:
+        self.count = 0
+        self.total = 0.0
+        self.min = None
+        self.max = None
+        self._samples: List[float] = []
+        self._i = 0
+
+    def update(self, v: float) -> None:
+        self.count += 1
+        self.total += v
+        self.min = v if self.min is None else min(self.min, v)
+        self.max = v if self.max is None else max(self.max, v)
+        if len(self._samples) < self.MAX_SAMPLES:
+            self._samples.append(v)
+        else:
+            # deterministic ring replacement keeps a recent-biased reservoir
+            self._samples[self._i % self.MAX_SAMPLES] = v
+            self._i += 1
+
+    def percentile(self, q: float) -> float:
+        if not self._samples:
+            return 0.0
+        s = sorted(self._samples)
+        idx = min(int(q * len(s)), len(s) - 1)
+        return s[idx]
+
+    def mean(self) -> float:
+        return self.total / self.count if self.count else 0.0
+
+    def to_json(self) -> dict:
+        return {"type": "histogram", "count": self.count, "mean": self.mean(),
+                "min": self.min or 0.0, "max": self.max or 0.0,
+                "median": self.percentile(0.5), "p75": self.percentile(0.75),
+                "p99": self.percentile(0.99)}
+
+
+class Timer(Histogram):
+    """Histogram of durations (seconds) + a context-manager helper."""
+
+    def __init__(self, now_fn: Callable[[], float]) -> None:
+        super().__init__()
+        self._now = now_fn
+
+    class _Ctx:
+        def __init__(self, t: "Timer") -> None:
+            self._t = t
+
+        def __enter__(self):
+            self._start = time.perf_counter()
+            return self
+
+        def __exit__(self, *exc):
+            self._t.update(time.perf_counter() - self._start)
+            return False
+
+    def time(self) -> "Timer._Ctx":
+        return Timer._Ctx(self)
+
+    def to_json(self) -> dict:
+        d = super().to_json()
+        d["type"] = "timer"
+        return d
+
+
+class MetricsRegistry:
+    def __init__(self, now_fn: Callable[[], float] | None = None) -> None:
+        self._now = now_fn or time.monotonic
+        self._metrics: Dict[str, object] = {}
+
+    def _get(self, name: str, factory):
+        m = self._metrics.get(name)
+        if m is None:
+            m = factory()
+            self._metrics[name] = m
+        return m
+
+    def new_counter(self, name: str) -> Counter:
+        return self._get(name, Counter)
+
+    def new_meter(self, name: str) -> Meter:
+        return self._get(name, lambda: Meter(self._now))
+
+    def new_timer(self, name: str) -> Timer:
+        return self._get(name, lambda: Timer(self._now))
+
+    def new_histogram(self, name: str) -> Histogram:
+        return self._get(name, Histogram)
+
+    def to_json(self) -> dict:
+        return {name: m.to_json() for name, m in sorted(self._metrics.items())}
