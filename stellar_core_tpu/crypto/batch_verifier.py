@@ -1,0 +1,252 @@
+"""BatchSigVerifier: the config-gated crypto backend boundary.
+
+North-star parity (BASELINE.json / SURVEY.md intro): the reference calls
+libsodium synchronously one signature at a time
+(/root/reference/src/crypto/SecretKey.cpp:310-337). Here the boundary is a
+batch-oriented service from day one:
+
+    enqueue(key, sig, msg) -> VerifyFuture     (accumulate)
+    flush()                                    (dispatch one device batch)
+    verify_many(triples) -> [bool]             (whole-ledger/checkpoint drain)
+
+Backends:
+- CpuSigVerifier — synchronous OpenSSL; the default (reference's libsodium
+  role).
+- TpuSigVerifier — ships accumulated triples to the JAX ed25519 kernel in
+  one padded, fixed-shape device call (no recompiles); scales batch size
+  from a few envelopes (live SCP) to whole checkpoints (catchup replay).
+- ThreadedBatchVerifier — wraps either backend so dispatch happens off the
+  main thread and futures complete on the VirtualClock main loop, keeping
+  the single-threaded consensus invariant (docs/architecture.md:23-26).
+
+The global verify-result cache (keys.py) sits in front of every backend;
+cache hits never enqueue.
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import Callable, List, Optional, Sequence, Tuple
+
+from ..util.log import get_logger
+from ..xdr import PublicKey
+from . import keys as _keys
+
+log = get_logger("Perf")
+
+Triple = Tuple[bytes, bytes, bytes]  # (key32, sig, msg)
+
+
+class VerifyFuture:
+    """Completion handle for one enqueued verify."""
+
+    __slots__ = ("_done", "_result", "_callbacks")
+
+    def __init__(self) -> None:
+        self._done = False
+        self._result = False
+        self._callbacks: List[Callable[[bool], None]] = []
+
+    def done(self) -> bool:
+        return self._done
+
+    def result(self) -> bool:
+        assert self._done, "verify future not completed; call flush()"
+        return self._result
+
+    def add_done_callback(self, cb: Callable[[bool], None]) -> None:
+        if self._done:
+            cb(self._result)
+        else:
+            self._callbacks.append(cb)
+
+    def _complete(self, ok: bool) -> None:
+        self._done = True
+        self._result = ok
+        cbs, self._callbacks = self._callbacks, []
+        for cb in cbs:
+            cb(ok)
+
+
+class BatchSigVerifier:
+    """Abstract backend; see module docstring."""
+
+    name = "abstract"
+
+    def enqueue(self, key: PublicKey, sig: bytes, msg: bytes) -> VerifyFuture:
+        raise NotImplementedError
+
+    def flush(self) -> None:
+        raise NotImplementedError
+
+    def verify_many(self, triples: Sequence[Triple]) -> List[bool]:
+        raise NotImplementedError
+
+    def pending(self) -> int:
+        return 0
+
+
+class CpuSigVerifier(BatchSigVerifier):
+    """Synchronous OpenSSL backend (libsodium role)."""
+
+    name = "cpu"
+
+    def enqueue(self, key: PublicKey, sig: bytes, msg: bytes) -> VerifyFuture:
+        f = VerifyFuture()
+        f._complete(_keys.PubKeyUtils.verify_sig(key, sig, msg))
+        return f
+
+    def flush(self) -> None:
+        pass
+
+    def verify_many(self, triples: Sequence[Triple]) -> List[bool]:
+        return [_keys.raw_verify(k, s, m) for (k, s, m) in triples]
+
+
+class TpuSigVerifier(BatchSigVerifier):
+    """JAX/TPU batched backend.
+
+    Batches are padded up to fixed bucket sizes so the kernel compiles once
+    per bucket; oversized batches are split. Correctness contract: identical
+    accept/reject decisions to CpuSigVerifier (RFC 8032 cofactorless).
+    """
+
+    name = "tpu"
+    BUCKETS = (128, 512, 2048, 8192)
+
+    def __init__(self, max_pending: int = 8192) -> None:
+        self._pending: List[Tuple[Triple, VerifyFuture]] = []
+        self._max_pending = max_pending
+        self.batches_dispatched = 0
+        self.sigs_verified = 0
+
+    def enqueue(self, key: PublicKey, sig: bytes, msg: bytes) -> VerifyFuture:
+        # L0: result cache
+        ck = _keys._cache_key(key.key_bytes, sig, msg)
+        with _keys._cache_lock:
+            hit = _keys._verify_cache.maybe_get(ck)
+        f = VerifyFuture()
+        if hit is not None:
+            f._complete(hit)
+            return f
+        self._pending.append(((key.key_bytes, sig, msg), f))
+        if len(self._pending) >= self._max_pending:
+            self.flush()
+        return f
+
+    def pending(self) -> int:
+        return len(self._pending)
+
+    def flush(self) -> None:
+        if not self._pending:
+            return
+        batch, self._pending = self._pending, []
+        triples = [t for (t, _f) in batch]
+        results = self.verify_many(triples)
+        for ((k, s, m), f), ok in zip(batch, results):
+            with _keys._cache_lock:
+                _keys._verify_cache.put(_keys._cache_key(k, s, m), ok)
+            f._complete(ok)
+
+    def _bucket(self, n: int) -> int:
+        for b in self.BUCKETS:
+            if n <= b:
+                return b
+        return self.BUCKETS[-1]
+
+    def verify_many(self, triples: Sequence[Triple]) -> List[bool]:
+        from ..ops import ed25519 as _e
+        import numpy as np
+        import jax.numpy as jnp
+
+        out: List[bool] = []
+        i = 0
+        while i < len(triples):
+            chunk = triples[i:i + self.BUCKETS[-1]]
+            n = len(chunk)
+            b = self._bucket(n)
+            pubs = [t[0] for t in chunk] + [b"\x00" * 32] * (b - n)
+            sigs = [t[1] for t in chunk] + [b"\x00" * 64] * (b - n)
+            msgs = [t[2] for t in chunk] + [b""] * (b - n)
+            prep = _e.prepare_batch(pubs, sigs, msgs)
+            ok = np.asarray(_e.verify_batch_jit(
+                jnp.asarray(prep["ay"]), jnp.asarray(prep["a_sign"]),
+                jnp.asarray(prep["ry"]), jnp.asarray(prep["r_sign"]),
+                jnp.asarray(prep["s_nibs"]), jnp.asarray(prep["k_nibs"])))
+            ok = ok & prep["pre_ok"]
+            out.extend(bool(x) for x in ok[:n])
+            self.batches_dispatched += 1
+            self.sigs_verified += n
+            i += n
+        return out
+
+
+class ThreadedBatchVerifier(BatchSigVerifier):
+    """Async wrapper: dispatch runs on a worker thread, futures complete on
+    the main loop via clock.post_to_main — the enqueue-and-continue protocol
+    SURVEY.md §7 requires at the verifyEnvelope/checkValid boundary."""
+
+    name = "threaded"
+
+    def __init__(self, inner: BatchSigVerifier, clock) -> None:
+        self._inner = inner
+        self._clock = clock
+        self._lock = threading.Lock()
+        self._pending: List[Tuple[Triple, VerifyFuture]] = []
+        self._inflight = False
+
+    def enqueue(self, key: PublicKey, sig: bytes, msg: bytes) -> VerifyFuture:
+        ck = _keys._cache_key(key.key_bytes, sig, msg)
+        with _keys._cache_lock:
+            hit = _keys._verify_cache.maybe_get(ck)
+        f = VerifyFuture()
+        if hit is not None:
+            f._complete(hit)
+            return f
+        with self._lock:
+            self._pending.append(((key.key_bytes, sig, msg), f))
+        return f
+
+    def pending(self) -> int:
+        with self._lock:
+            return len(self._pending)
+
+    def flush(self) -> None:
+        with self._lock:
+            if not self._pending or self._inflight:
+                return
+            batch, self._pending = self._pending, []
+            self._inflight = True
+
+        def work() -> None:
+            triples = [t for (t, _f) in batch]
+            results = self._inner.verify_many(triples)
+
+            def complete() -> None:
+                for ((k, s, m), f), ok in zip(batch, results):
+                    with _keys._cache_lock:
+                        _keys._verify_cache.put(_keys._cache_key(k, s, m), ok)
+                    f._complete(ok)
+                with self._lock:
+                    self._inflight = False
+
+            self._clock.post_to_main(complete)
+
+        threading.Thread(target=work, daemon=True).start()
+
+    def verify_many(self, triples: Sequence[Triple]) -> List[bool]:
+        return self._inner.verify_many(triples)
+
+
+def make_verifier(backend: str = "cpu", clock=None,
+                  max_pending: int = 8192) -> BatchSigVerifier:
+    """Config-gated backend selection (Config.SIG_VERIFY_BACKEND)."""
+    if backend == "cpu":
+        return CpuSigVerifier()
+    if backend == "tpu":
+        return TpuSigVerifier(max_pending=max_pending)
+    if backend == "tpu-async":
+        assert clock is not None
+        return ThreadedBatchVerifier(TpuSigVerifier(max_pending=max_pending),
+                                     clock)
+    raise ValueError("unknown sig verify backend %r" % backend)

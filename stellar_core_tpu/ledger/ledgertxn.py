@@ -1,0 +1,484 @@
+"""LedgerTxn: nested in-memory copy-on-write ledger state transactions.
+
+Role parity: reference `src/ledger/LedgerTxn*` (LedgerTxn.h:18-165): a tree
+of transactions over (LedgerKey → LedgerEntry), root backed by SQL with an
+entry cache and bulk commits; children see parent state copy-on-write;
+commit merges down, rollback discards. Entry-type-specific SQL backends
+(LedgerTxnAccountSQL.cpp etc.) correspond to the per-table writers here.
+
+Simplifications vs reference: Python object mutability replaces the
+"activeness" discipline — load() snapshots the pre-image for delta/meta
+generation, and entries are owned by the innermost open txn.
+"""
+
+from __future__ import annotations
+
+from typing import Dict, Iterator, List, Optional, Tuple
+
+from ..database.database import Database
+from ..util.cache import RandomEvictionCache
+from ..xdr import (
+    Asset, LedgerEntry, LedgerEntryType, LedgerHeader, LedgerKey, OfferEntry,
+    ledger_entry_key,
+)
+from ..crypto import strkey
+
+
+def _kb(key: LedgerKey) -> bytes:
+    return key.to_xdr()
+
+
+def _copy_entry(e: LedgerEntry) -> LedgerEntry:
+    return LedgerEntry.from_xdr(e.to_xdr())
+
+
+def _copy_header(h: LedgerHeader) -> LedgerHeader:
+    return LedgerHeader.from_xdr(h.to_xdr())
+
+
+def _acc_str(account_id) -> str:
+    return strkey.encode_public_key(account_id.key_bytes)
+
+
+def _asset_str(asset: Asset) -> str:
+    import base64
+    return base64.b64encode(asset.to_xdr()).decode()
+
+
+def price_less(a_offer: OfferEntry, b_offer: OfferEntry) -> bool:
+    """Exact fraction compare a.price < b.price, tie-break by offerID
+    (reference isBetterOffer, LedgerTxn.cpp role)."""
+    lhs = a_offer.price.n * b_offer.price.d
+    rhs = b_offer.price.n * a_offer.price.d
+    if lhs != rhs:
+        return lhs < rhs
+    return a_offer.offerID < b_offer.offerID
+
+
+class AbstractLedgerTxnParent:
+    def get_entry(self, key: LedgerKey) -> Optional[LedgerEntry]:
+        raise NotImplementedError
+
+    def get_header(self) -> LedgerHeader:
+        raise NotImplementedError
+
+    def _all_offers_for_book(self, selling: Asset,
+                             buying: Asset) -> Dict[bytes, LedgerEntry]:
+        raise NotImplementedError
+
+    def _offers_by_account(self, account_id) -> Dict[bytes, LedgerEntry]:
+        raise NotImplementedError
+
+    def commit_child(self, changes: Dict[bytes, Optional[LedgerEntry]],
+                     header: LedgerHeader) -> None:
+        raise NotImplementedError
+
+
+class LedgerTxn(AbstractLedgerTxnParent):
+    """A nested transaction. Exactly one child may be open at a time."""
+
+    def __init__(self, parent: AbstractLedgerTxnParent) -> None:
+        self._parent = parent
+        self._changes: Dict[bytes, Optional[LedgerEntry]] = {}
+        self._previous: Dict[bytes, Optional[bytes]] = {}  # pre-images (xdr)
+        self._header = _copy_header(parent.get_header())
+        self._open = True
+        self._child: Optional["LedgerTxn"] = None
+        if isinstance(parent, LedgerTxn):
+            assert parent._child is None, "parent already has an open child"
+            parent._child = self
+
+    # -- header -------------------------------------------------------------
+    def load_header(self) -> LedgerHeader:
+        self._assert_open()
+        return self._header
+
+    def get_header(self) -> LedgerHeader:
+        return self._header
+
+    # -- entry access -------------------------------------------------------
+    def _assert_open(self) -> None:
+        assert self._open, "LedgerTxn is closed"
+        assert self._child is None, "child transaction is open"
+
+    def get_entry(self, key: LedgerKey) -> Optional[LedgerEntry]:
+        kb = _kb(key)
+        if kb in self._changes:
+            return self._changes[kb]
+        return self._parent.get_entry(key)
+
+    def load(self, key: LedgerKey) -> Optional[LedgerEntry]:
+        """Load for update: snapshots the pre-image, returns a mutable entry
+        owned by this txn (None if absent)."""
+        self._assert_open()
+        kb = _kb(key)
+        if kb in self._changes:
+            cur = self._changes[kb]
+            return cur
+        base = self._parent.get_entry(key)
+        if base is None:
+            return None
+        mine = _copy_entry(base)
+        self._previous.setdefault(kb, base.to_xdr())
+        self._changes[kb] = mine
+        return mine
+
+    def load_without_record(self, key: LedgerKey) -> Optional[LedgerEntry]:
+        """Read-only peek (reference loadWithoutRecord): no delta recorded."""
+        self._assert_open()
+        e = self.get_entry(key)
+        return _copy_entry(e) if e is not None else None
+
+    def create(self, entry: LedgerEntry) -> LedgerEntry:
+        self._assert_open()
+        key = ledger_entry_key(entry)
+        kb = _kb(key)
+        assert self.get_entry(key) is None, "entry already exists"
+        mine = _copy_entry(entry)
+        self._previous.setdefault(kb, None)
+        self._changes[kb] = mine
+        return mine
+
+    def erase(self, key: LedgerKey) -> None:
+        self._assert_open()
+        kb = _kb(key)
+        existing = self.get_entry(key)
+        assert existing is not None, "erasing missing entry"
+        if kb not in self._previous:
+            self._previous[kb] = existing.to_xdr()
+        self._changes[kb] = None
+
+    # -- order book ---------------------------------------------------------
+    def _all_offers_for_book(self, selling: Asset,
+                             buying: Asset) -> Dict[bytes, LedgerEntry]:
+        out = self._parent._all_offers_for_book(selling, buying)
+        sb = (selling.to_xdr(), buying.to_xdr())
+        for kb, e in self._changes.items():
+            if LedgerKey.from_xdr(kb).disc != LedgerEntryType.OFFER:
+                continue
+            if e is None:
+                out.pop(kb, None)
+            else:
+                o = e.data.value
+                if (o.selling.to_xdr(), o.buying.to_xdr()) == sb:
+                    out[kb] = e
+                else:
+                    out.pop(kb, None)
+        return out
+
+    def best_offer(self, selling: Asset, buying: Asset,
+                   exclude: Optional[set] = None) -> Optional[LedgerEntry]:
+        """Best (lowest-price) offer in the book, excluding offer ids in
+        `exclude`."""
+        self._assert_open()
+        offers = self._all_offers_for_book(selling, buying)
+        best: Optional[LedgerEntry] = None
+        for e in offers.values():
+            o = e.data.value
+            if exclude and o.offerID in exclude:
+                continue
+            if best is None or price_less(o, best.data.value):
+                best = e
+        return best
+
+    def _offers_by_account(self, account_id) -> Dict[bytes, LedgerEntry]:
+        out = self._parent._offers_by_account(account_id)
+        acc = account_id.to_xdr()
+        for kb, e in self._changes.items():
+            if LedgerKey.from_xdr(kb).disc != LedgerEntryType.OFFER:
+                continue
+            if e is None:
+                out.pop(kb, None)
+            elif e.data.value.sellerID.to_xdr() == acc:
+                out[kb] = e
+            else:
+                out.pop(kb, None)
+        return out
+
+    def load_offers_by_account(self, account_id) -> List[LedgerEntry]:
+        self._assert_open()
+        res = []
+        for kb in list(self._offers_by_account(account_id)):
+            e = self.load(LedgerKey.from_xdr(kb))
+            if e is not None:
+                res.append(e)
+        return res
+
+    # -- lifecycle ----------------------------------------------------------
+    def commit(self) -> None:
+        self._assert_open()
+        self._open = False
+        # serialize entries at the commit boundary so later mutations of the
+        # (now dead) child objects can't alias parent state
+        self._parent.commit_child(self._changes, self._header)
+        if isinstance(self._parent, LedgerTxn):
+            self._parent._child = None
+
+    def rollback(self) -> None:
+        assert self._open
+        if self._child is not None:
+            self._child.rollback()
+        self._open = False
+        self._changes.clear()
+        if isinstance(self._parent, LedgerTxn):
+            self._parent._child = None
+
+    def commit_child(self, changes: Dict[bytes, Optional[LedgerEntry]],
+                     header: LedgerHeader) -> None:
+        for kb, e in changes.items():
+            if kb not in self._previous:
+                cur = self._parent.get_entry(LedgerKey.from_xdr(kb))
+                self._previous[kb] = cur.to_xdr() if cur is not None else None
+            self._changes[kb] = e
+        self._header = header
+
+    # -- delta (meta + invariants) ------------------------------------------
+    def get_delta(self) -> List[Tuple[LedgerKey, Optional[LedgerEntry],
+                                      Optional[LedgerEntry]]]:
+        """[(key, previous, current)] for every touched-and-changed entry."""
+        out = []
+        for kb, cur in self._changes.items():
+            prev_b = self._previous.get(kb)
+            prev = LedgerEntry.from_xdr(prev_b) if prev_b else None
+            cur_b = cur.to_xdr() if cur is not None else None
+            if prev_b == cur_b:
+                continue  # touched but unchanged
+            out.append((LedgerKey.from_xdr(kb), prev, cur))
+        return out
+
+    def has_changes(self) -> bool:
+        return bool(self._changes)
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, et, ev, tb):
+        if self._open:
+            if et is None:
+                self.commit()
+            else:
+                self.rollback()
+        return False
+
+
+class InMemoryLedgerTxnRoot(AbstractLedgerTxnParent):
+    """Dict-backed root (reference InMemoryLedgerTxnRoot.h role; used by
+    standalone/test mode)."""
+
+    def __init__(self, header: Optional[LedgerHeader] = None) -> None:
+        self._entries: Dict[bytes, bytes] = {}
+        self._header = header
+
+    def set_header(self, header: LedgerHeader) -> None:
+        self._header = header
+
+    def get_header(self) -> LedgerHeader:
+        assert self._header is not None
+        return self._header
+
+    def get_entry(self, key: LedgerKey) -> Optional[LedgerEntry]:
+        b = self._entries.get(_kb(key))
+        return LedgerEntry.from_xdr(b) if b is not None else None
+
+    def _all_offers_for_book(self, selling, buying):
+        out: Dict[bytes, LedgerEntry] = {}
+        sb = (selling.to_xdr(), buying.to_xdr())
+        for kb, eb in self._entries.items():
+            if LedgerKey.from_xdr(kb).disc != LedgerEntryType.OFFER:
+                continue
+            e = LedgerEntry.from_xdr(eb)
+            o = e.data.value
+            if (o.selling.to_xdr(), o.buying.to_xdr()) == sb:
+                out[kb] = e
+        return out
+
+    def _offers_by_account(self, account_id):
+        out: Dict[bytes, LedgerEntry] = {}
+        acc = account_id.to_xdr()
+        for kb, eb in self._entries.items():
+            if LedgerKey.from_xdr(kb).disc != LedgerEntryType.OFFER:
+                continue
+            e = LedgerEntry.from_xdr(eb)
+            if e.data.value.sellerID.to_xdr() == acc:
+                out[kb] = e
+        return out
+
+    def commit_child(self, changes, header) -> None:
+        for kb, e in changes.items():
+            if e is None:
+                self._entries.pop(kb, None)
+            else:
+                self._entries[kb] = e.to_xdr()
+        self._header = header
+
+    def count_entries(self) -> int:
+        return len(self._entries)
+
+    def all_entries(self) -> Iterator[LedgerEntry]:
+        for eb in self._entries.values():
+            yield LedgerEntry.from_xdr(eb)
+
+
+class LedgerTxnRoot(AbstractLedgerTxnParent):
+    """SQL-backed root with an entry cache and per-type bulk writers
+    (reference LedgerTxnRoot + LedgerTxn{Account,Offer,TrustLine,Data}SQL)."""
+
+    ENTRY_CACHE_SIZE = 4096
+
+    def __init__(self, db: Database,
+                 header: Optional[LedgerHeader] = None) -> None:
+        self._db = db
+        self._header = header
+        self._cache: RandomEvictionCache = RandomEvictionCache(
+            self.ENTRY_CACHE_SIZE)
+
+    def set_header(self, header: LedgerHeader) -> None:
+        self._header = header
+
+    def get_header(self) -> LedgerHeader:
+        assert self._header is not None
+        return self._header
+
+    # -- reads --------------------------------------------------------------
+    def get_entry(self, key: LedgerKey) -> Optional[LedgerEntry]:
+        kb = _kb(key)
+        hit = self._cache.maybe_get(kb)
+        if hit is not None:
+            blob = hit
+        else:
+            blob = self._select_blob(key)
+            self._cache.put(kb, blob if blob is not None else b"")
+        if not blob:
+            return None
+        return LedgerEntry.from_xdr(blob)
+
+    def _select_blob(self, key: LedgerKey) -> Optional[bytes]:
+        t = key.disc
+        v = key.value
+        if t == LedgerEntryType.ACCOUNT:
+            cur = self._db.execute(
+                "SELECT entry FROM accounts WHERE accountid=?",
+                (_acc_str(v.accountID),))
+        elif t == LedgerEntryType.TRUSTLINE:
+            cur = self._db.execute(
+                "SELECT entry FROM trustlines WHERE accountid=? AND asset=?",
+                (_acc_str(v.accountID), _asset_str(v.asset)))
+        elif t == LedgerEntryType.OFFER:
+            cur = self._db.execute(
+                "SELECT entry FROM offers WHERE offerid=?", (v.offerID,))
+        elif t == LedgerEntryType.DATA:
+            cur = self._db.execute(
+                "SELECT entry FROM accountdata WHERE accountid=? AND "
+                "dataname=?", (_acc_str(v.accountID), v.dataName))
+        else:
+            raise ValueError("bad key type %d" % t)
+        row = cur.fetchone()
+        return row[0] if row else None
+
+    def _all_offers_for_book(self, selling, buying):
+        out: Dict[bytes, LedgerEntry] = {}
+        cur = self._db.execute(
+            "SELECT entry FROM offers WHERE selling=? AND buying=?",
+            (_asset_str(selling), _asset_str(buying)))
+        for (blob,) in cur.fetchall():
+            e = LedgerEntry.from_xdr(blob)
+            out[_kb(ledger_entry_key(e))] = e
+        return out
+
+    def _offers_by_account(self, account_id):
+        out: Dict[bytes, LedgerEntry] = {}
+        cur = self._db.execute(
+            "SELECT entry FROM offers WHERE sellerid=?",
+            (_acc_str(account_id),))
+        for (blob,) in cur.fetchall():
+            e = LedgerEntry.from_xdr(blob)
+            out[_kb(ledger_entry_key(e))] = e
+        return out
+
+    # -- commit -------------------------------------------------------------
+    def commit_child(self, changes, header) -> None:
+        with self._db.transaction():
+            for kb, e in changes.items():
+                key = LedgerKey.from_xdr(kb)
+                if e is None:
+                    self._delete(key)
+                    self._cache.put(kb, b"")
+                else:
+                    self._upsert(key, e)
+                    self._cache.put(kb, e.to_xdr())
+            self._header = header
+
+    def _delete(self, key: LedgerKey) -> None:
+        t, v = key.disc, key.value
+        if t == LedgerEntryType.ACCOUNT:
+            self._db.execute("DELETE FROM accounts WHERE accountid=?",
+                             (_acc_str(v.accountID),))
+        elif t == LedgerEntryType.TRUSTLINE:
+            self._db.execute(
+                "DELETE FROM trustlines WHERE accountid=? AND asset=?",
+                (_acc_str(v.accountID), _asset_str(v.asset)))
+        elif t == LedgerEntryType.OFFER:
+            self._db.execute("DELETE FROM offers WHERE offerid=?",
+                             (v.offerID,))
+        elif t == LedgerEntryType.DATA:
+            self._db.execute(
+                "DELETE FROM accountdata WHERE accountid=? AND dataname=?",
+                (_acc_str(v.accountID), v.dataName))
+
+    def _upsert(self, key: LedgerKey, e: LedgerEntry) -> None:
+        t = key.disc
+        blob = e.to_xdr()
+        lm = e.lastModifiedLedgerSeq
+        d = e.data.value
+        if t == LedgerEntryType.ACCOUNT:
+            self._db.execute(
+                "INSERT INTO accounts (accountid,balance,seqnum,"
+                "numsubentries,flags,lastmodified,entry) VALUES (?,?,?,?,?,?,?)"
+                " ON CONFLICT(accountid) DO UPDATE SET balance=excluded."
+                "balance,seqnum=excluded.seqnum,numsubentries=excluded."
+                "numsubentries,flags=excluded.flags,lastmodified=excluded."
+                "lastmodified,entry=excluded.entry",
+                (_acc_str(d.accountID), d.balance, d.seqNum, d.numSubEntries,
+                 d.flags, lm, blob))
+        elif t == LedgerEntryType.TRUSTLINE:
+            self._db.execute(
+                "INSERT INTO trustlines (accountid,asset,balance,flags,"
+                "lastmodified,entry) VALUES (?,?,?,?,?,?)"
+                " ON CONFLICT(accountid,asset) DO UPDATE SET balance="
+                "excluded.balance,flags=excluded.flags,lastmodified="
+                "excluded.lastmodified,entry=excluded.entry",
+                (_acc_str(d.accountID), _asset_str(d.asset), d.balance,
+                 d.flags, lm, blob))
+        elif t == LedgerEntryType.OFFER:
+            self._db.execute(
+                "INSERT INTO offers (sellerid,offerid,selling,buying,amount,"
+                "pricen,priced,price,flags,lastmodified,entry) "
+                "VALUES (?,?,?,?,?,?,?,?,?,?,?)"
+                " ON CONFLICT(offerid) DO UPDATE SET sellerid=excluded."
+                "sellerid,selling=excluded.selling,buying=excluded.buying,"
+                "amount=excluded.amount,pricen=excluded.pricen,priced="
+                "excluded.priced,price=excluded.price,flags=excluded.flags,"
+                "lastmodified=excluded.lastmodified,entry=excluded.entry",
+                (_acc_str(d.sellerID), d.offerID, _asset_str(d.selling),
+                 _asset_str(d.buying), d.amount, d.price.n, d.price.d,
+                 d.price.n / d.price.d, d.flags, lm, blob))
+        elif t == LedgerEntryType.DATA:
+            self._db.execute(
+                "INSERT INTO accountdata (accountid,dataname,lastmodified,"
+                "entry) VALUES (?,?,?,?)"
+                " ON CONFLICT(accountid,dataname) DO UPDATE SET lastmodified"
+                "=excluded.lastmodified,entry=excluded.entry",
+                (_acc_str(d.accountID), d.dataName, lm, blob))
+
+    def count_entries(self) -> int:
+        n = 0
+        for table in ("accounts", "trustlines", "offers", "accountdata"):
+            n += self._db.execute(
+                "SELECT COUNT(*) FROM %s" % table).fetchone()[0]
+        return n
+
+    def all_entries(self) -> Iterator[LedgerEntry]:
+        for table in ("accounts", "trustlines", "offers", "accountdata"):
+            for (blob,) in self._db.execute(
+                    "SELECT entry FROM %s" % table).fetchall():
+                yield LedgerEntry.from_xdr(blob)

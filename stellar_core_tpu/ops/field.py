@@ -105,11 +105,14 @@ def _columns(a: jnp.ndarray, b: jnp.ndarray) -> jnp.ndarray:
 def fe_mul(a: jnp.ndarray, b: jnp.ndarray) -> jnp.ndarray:
     c = _columns(a, b)                                     # (..., 39) < 2^31
     c = jnp.concatenate([c, jnp.zeros_like(c[..., :1])], axis=-1)  # 40 wide
-    # two parallel carry rounds over the 40 columns (carry i -> i+1)
+    # two parallel carry rounds over the 40 columns (carry i -> i+1); the
+    # carry out of column 39 has weight 2^520 ≡ 608² (mod p) and folds to
+    # column 0 — dropping it corrupts ~1.5% of products (both top limbs
+    # large), so it is wrapped explicitly.
     for _ in range(2):
         lo = c & LIMB_MASK
         hi = c >> LIMB_BITS
-        c = lo + jnp.concatenate([jnp.zeros_like(hi[..., :1]),
+        c = lo + jnp.concatenate([hi[..., 39:40] * (FOLD * FOLD),
                                   hi[..., :39]], axis=-1)
     # fold the high 20 columns: 2^(260+13j) ≡ 608·2^13j (mod p)
     low = c[..., :NLIMBS] + FOLD * c[..., NLIMBS:]

@@ -1,0 +1,132 @@
+"""Account-state helpers shared by operations.
+
+Role parity: reference `src/transactions/TransactionUtils.{h,cpp}` (load*,
+addBalance, getAvailableBalance, reserve math) and
+`src/ledger/LedgerTxnHeader` utilities.
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+from ..xdr import (
+    AccountEntry, AccountFlags, Asset, LedgerEntry, LedgerEntryData,
+    LedgerEntryType, LedgerHeader, LedgerKey, TrustLineEntry, TrustLineFlags,
+    _Ext,
+)
+
+INT64_MAX = 2**63 - 1
+MAX_SUBENTRIES = 1000
+
+
+def first_ledger_seq_for_account(header: LedgerHeader) -> int:
+    return header.ledgerSeq
+
+
+def starting_sequence_number(header: LedgerHeader) -> int:
+    """New accounts start at ledgerSeq << 32 (reference
+    getStartingSequenceNumber)."""
+    return header.ledgerSeq << 32
+
+
+def base_reserve(header: LedgerHeader) -> int:
+    return header.baseReserve
+
+
+def min_balance(header: LedgerHeader, num_subentries: int) -> int:
+    """(2 + numSubEntries) * baseReserve (reference getMinBalance for
+    protocol >= 9)."""
+    return (2 + num_subentries) * header.baseReserve
+
+
+def load_account(ltx, account_id) -> Optional[LedgerEntry]:
+    return ltx.load(LedgerKey.account(account_id))
+
+
+def load_account_entry(ltx, account_id) -> Optional[AccountEntry]:
+    e = load_account(ltx, account_id)
+    return e.data.value if e is not None else None
+
+
+def load_trustline(ltx, account_id, asset: Asset) -> Optional[LedgerEntry]:
+    return ltx.load(LedgerKey.trustline(account_id, asset))
+
+
+def account_available_balance(header: LedgerHeader,
+                              acc: AccountEntry) -> int:
+    return max(0, acc.balance - min_balance(header, acc.numSubEntries))
+
+
+def add_balance(header: LedgerHeader, entry: LedgerEntry,
+                delta: int) -> bool:
+    """Adjust native balance respecting reserve floor and INT64 ceiling
+    (reference addBalance, TransactionUtils.cpp)."""
+    acc = entry.data.value
+    new = acc.balance + delta
+    if new < 0 or new > INT64_MAX:
+        return False
+    if delta < 0 and new < min_balance(header, acc.numSubEntries):
+        return False
+    acc.balance = new
+    return True
+
+
+def add_trust_balance(tl: TrustLineEntry, delta: int) -> bool:
+    if not (tl.flags & TrustLineFlags.AUTHORIZED_FLAG):
+        return False
+    new = tl.balance + delta
+    if new < 0 or new > tl.limit:
+        return False
+    tl.balance = new
+    return True
+
+
+def trustline_authorized(tl: TrustLineEntry) -> bool:
+    return bool(tl.flags & TrustLineFlags.AUTHORIZED_FLAG)
+
+
+def change_subentries(header: LedgerHeader, entry: LedgerEntry,
+                      delta: int) -> bool:
+    """Add/remove subentries, enforcing reserve on add (reference
+    addNumEntries)."""
+    acc = entry.data.value
+    new_count = acc.numSubEntries + delta
+    if new_count < 0 or new_count > MAX_SUBENTRIES:
+        return False
+    if delta > 0 and acc.balance < min_balance(header, new_count):
+        return False
+    acc.numSubEntries = new_count
+    return True
+
+
+def make_account_entry(account_id, balance: int, seq_num: int,
+                       last_modified: int = 0) -> LedgerEntry:
+    acc = AccountEntry(
+        accountID=account_id, balance=balance, seqNum=seq_num,
+        numSubEntries=0, inflationDest=None, flags=0, homeDomain="",
+        thresholds=bytes([1, 0, 0, 0]), signers=[], ext=_Ext.v0())
+    return LedgerEntry(
+        lastModifiedLedgerSeq=last_modified,
+        data=LedgerEntryData(LedgerEntryType.ACCOUNT, acc), ext=_Ext.v0())
+
+
+class ThresholdLevel:
+    LOW = 0
+    MEDIUM = 1
+    HIGH = 2
+
+
+def account_threshold(acc: AccountEntry, level: int) -> int:
+    return acc.thresholds[1 + level]
+
+
+def account_master_weight(acc: AccountEntry) -> int:
+    return acc.thresholds[0]
+
+
+def is_auth_required(acc: AccountEntry) -> bool:
+    return bool(acc.flags & AccountFlags.AUTH_REQUIRED_FLAG)
+
+
+def is_immutable_auth(acc: AccountEntry) -> bool:
+    return bool(acc.flags & AccountFlags.AUTH_IMMUTABLE_FLAG)

@@ -1,0 +1,100 @@
+"""SignatureChecker: multisig weight/threshold accounting over a tx's
+signatures.
+
+Role parity: reference `src/transactions/SignatureChecker.{h,cpp}:18-120`:
+greedy weight accumulation over ed25519 / pre-auth-tx / hash-x signers, hint
+pre-filter, "all signatures used" discipline; and
+`src/transactions/SignatureUtils.cpp:27-36` (hint filter + verifySig).
+
+The verify call goes through the injected BatchSigVerifier, so this is a
+TPU-batch call site in batch mode; in synchronous mode futures complete
+immediately.
+"""
+
+from __future__ import annotations
+
+import hashlib
+from typing import List, Optional, Sequence
+
+from ..crypto.batch_verifier import BatchSigVerifier, CpuSigVerifier
+from ..xdr import (
+    DecoratedSignature, PublicKey, Signer, SignerKey, SignerKeyType,
+)
+
+_FUZZING_MODE = False  # reference SignatureChecker.cpp:33-35 parity hook
+
+
+def set_fuzzing_mode(on: bool) -> None:
+    global _FUZZING_MODE
+    _FUZZING_MODE = on
+
+
+def _hint_of(b32: bytes) -> bytes:
+    return b32[-4:]
+
+
+class SignatureChecker:
+    def __init__(self, network_hash_contents: bytes,
+                 signatures: Sequence[DecoratedSignature],
+                 verifier: Optional[BatchSigVerifier] = None) -> None:
+        self._contents_hash = network_hash_contents
+        self._sigs = list(signatures)
+        self._used = [False] * len(self._sigs)
+        self._verifier = verifier or CpuSigVerifier()
+
+    def check_signature(self, signers: List[Signer],
+                        needed_weight: int) -> bool:
+        """Greedy accumulation: for each unused signature matching a signer's
+        hint, verify; add weight (capped 255); success when total >=
+        needed_weight (0 means any valid signer)."""
+        if _FUZZING_MODE:
+            return True
+        total = 0
+        # pre-auth-tx and hash-x signers are checked without sig verify
+        for signer in signers:
+            k = signer.key
+            if k.disc == SignerKeyType.SIGNER_KEY_TYPE_PRE_AUTH_TX:
+                if k.value == self._contents_hash:
+                    total += min(signer.weight, 255)
+            elif k.disc == SignerKeyType.SIGNER_KEY_TYPE_HASH_X:
+                for i, ds in enumerate(self._sigs):
+                    if self._used[i]:
+                        continue
+                    if hashlib.sha256(ds.signature).digest() == k.value:
+                        self._used[i] = True
+                        total += min(signer.weight, 255)
+                        break
+        # ed25519 signers: hint filter then verify (batched)
+        pending = []
+        for signer in signers:
+            k = signer.key
+            if k.disc != SignerKeyType.SIGNER_KEY_TYPE_ED25519:
+                continue
+            hint = _hint_of(k.value)
+            for i, ds in enumerate(self._sigs):
+                if self._used[i] or ds.hint != hint:
+                    continue
+                fut = self._verifier.enqueue(
+                    PublicKey.ed25519(k.value), ds.signature,
+                    self._contents_hash)
+                pending.append((i, signer, fut))
+        if pending:
+            self._verifier.flush()
+        seen_signers = set()
+        for i, signer, fut in pending:
+            if self._used[i] or id(signer) in seen_signers:
+                continue
+            if fut.result():
+                self._used[i] = True
+                seen_signers.add(id(signer))
+                total += min(signer.weight, 255)
+        if needed_weight == 0:
+            return total > 0
+        return total >= needed_weight
+
+    def check_all_signatures_used(self) -> bool:
+        """Reference: any unused signature makes the tx invalid
+        (txBAD_AUTH_EXTRA)."""
+        if _FUZZING_MODE:
+            return True
+        return all(self._used)

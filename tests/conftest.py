@@ -1,0 +1,49 @@
+"""Test harness configuration.
+
+- Forces JAX onto a virtual 8-device CPU mesh (multi-chip sharding tests run
+  without TPU hardware), per the project build contract.
+- Re-execs pytest under a cleaned environment when the ambient axon/TPU
+  plugin is active: the TPU is a single-tenant device behind a loopback
+  relay, and test runs must never contend with (or hang on) it.
+- Reseeds the deterministic global RNG before every test, mirroring the
+  reference's Catch listener (src/test/test.cpp:47-68).
+"""
+
+import os
+import sys
+
+_CLEAN_FLAG = "SCT_TESTS_CLEAN_ENV"
+
+if os.environ.get(_CLEAN_FLAG) != "1" and os.environ.get(
+        "PALLAS_AXON_POOL_IPS"):
+    env = dict(os.environ)
+    env[_CLEAN_FLAG] = "1"
+    env.pop("PALLAS_AXON_POOL_IPS", None)
+    env["JAX_PLATFORMS"] = "cpu"
+    env["XLA_FLAGS"] = (env.get("XLA_FLAGS", "") +
+                        " --xla_force_host_platform_device_count=8").strip()
+    # drop the axon sitecustomize injection
+    pp = [p for p in env.get("PYTHONPATH", "").split(os.pathsep)
+          if p and "axon" not in p]
+    if pp:
+        env["PYTHONPATH"] = os.pathsep.join(pp)
+    else:
+        env.pop("PYTHONPATH", None)
+    os.execve(sys.executable,
+              [sys.executable, "-m", "pytest"] + sys.argv[1:], env)
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+if "--xla_force_host_platform_device_count" not in os.environ.get(
+        "XLA_FLAGS", ""):
+    os.environ["XLA_FLAGS"] = (
+        os.environ.get("XLA_FLAGS", "") +
+        " --xla_force_host_platform_device_count=8").strip()
+
+import pytest  # noqa: E402
+
+
+@pytest.fixture(autouse=True)
+def _reseed_rng():
+    from stellar_core_tpu.util import rnd
+    rnd.reseed(0xFEEDFACE)
+    yield

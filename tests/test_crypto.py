@@ -1,0 +1,153 @@
+"""Crypto boundary tests.
+
+Role parity: reference `src/crypto/test/CryptoTests.cpp:30-258` — hash
+vectors, strkey round trips, sign/verify, verify cache behavior — plus the
+batch-verifier semantics contract (CPU vs TPU-kernel equivalence).
+"""
+
+import hashlib
+import os
+
+import pytest
+
+from stellar_core_tpu.crypto import strkey
+from stellar_core_tpu.crypto.batch_verifier import (
+    CpuSigVerifier, TpuSigVerifier, make_verifier,
+)
+from stellar_core_tpu.crypto.curve25519 import (
+    curve25519_derive_public, curve25519_derive_shared,
+    curve25519_random_secret,
+)
+from stellar_core_tpu.crypto.hashing import (
+    SHA256, hkdf_expand, hkdf_extract, hmac_sha256, hmac_sha256_verify,
+    sha256, siphash24,
+)
+from stellar_core_tpu.crypto.keys import (
+    KeyUtils, PubKeyUtils, SecretKey, flush_verify_cache, raw_verify,
+    verify_cache_stats,
+)
+
+
+def test_sha256_vectors():
+    assert sha256(b"").hex() == (
+        "e3b0c44298fc1c149afbf4c8996fb92427ae41e4649b934ca495991b7852b855")
+    assert sha256(b"abc").hex() == (
+        "ba7816bf8f01cfea414140de5dae2223b00361a396177a9cb410ff61f20015ad")
+    assert SHA256().add(b"a").add(b"bc").finish() == sha256(b"abc")
+
+
+def test_hmac_hkdf():
+    # RFC 4231 test case 2
+    mac = hmac_sha256(b"Jefe", b"what do ya want for nothing?")
+    assert mac.hex() == ("5bdcc146bf60754e6a042426089575c7"
+                         "5a003f089d2739839dec58b964ec3843")
+    assert hmac_sha256_verify(b"Jefe", b"what do ya want for nothing?", mac)
+    prk = hkdf_extract(bytes.fromhex("0b" * 22),
+                       salt=bytes.fromhex("000102030405060708090a0b0c"))
+    okm = hkdf_expand(prk, bytes.fromhex("f0f1f2f3f4f5f6f7f8f9"), 42)
+    assert okm.hex().startswith("3cb25f25faacd57a90434f64d0362f2a")
+
+
+def test_siphash_reference_vector():
+    # SipHash-2-4 official test vector: key 000102..0f, input 00 01 .. 3e
+    key = bytes(range(16))
+    msg = bytes(range(15))
+    assert siphash24(key, msg) == 0xA129CA6149BE45E5
+
+
+def test_strkey_roundtrip():
+    raw = os.urandom(32)
+    s = strkey.encode_public_key(raw)
+    assert s[0] == "G"
+    assert strkey.decode_public_key(s) == raw
+    seed = strkey.encode_seed(raw)
+    assert seed[0] == "S"
+    assert strkey.decode_seed(seed) == raw
+    with pytest.raises(ValueError):
+        strkey.decode_public_key(seed)
+    # checksum corruption
+    bad = s[:-1] + ("A" if s[-1] != "A" else "B")
+    with pytest.raises(Exception):
+        strkey.decode_public_key(bad)
+
+
+def test_sign_verify_and_cache():
+    flush_verify_cache()
+    sk = SecretKey.pseudo_random_for_testing()
+    msg = b"hello consensus"
+    sig = sk.sign(msg)
+    assert PubKeyUtils.verify_sig(sk.public_key, sig, msg)
+    st0 = verify_cache_stats()
+    assert PubKeyUtils.verify_sig(sk.public_key, sig, msg)
+    st1 = verify_cache_stats()
+    assert st1["hits"] == st0["hits"] + 1
+    assert not PubKeyUtils.verify_sig(sk.public_key, sig, msg + b"!")
+    bad = bytearray(sig)
+    bad[3] ^= 0xFF
+    assert not PubKeyUtils.verify_sig(sk.public_key, bytes(bad), msg)
+
+
+def test_secret_key_strkey_roundtrip():
+    sk = SecretKey.pseudo_random_for_testing()
+    sk2 = SecretKey.from_strkey_seed(sk.strkey_seed())
+    assert sk2.public_key == sk.public_key
+    assert KeyUtils.from_strkey(sk.strkey_public()) == sk.public_key
+
+
+def test_decorated_signature_hint():
+    sk = SecretKey.pseudo_random_for_testing()
+    ds = sk.sign_decorated(b"m")
+    assert ds.hint == sk.public_key.key_bytes[-4:]
+
+
+def test_x25519_ecdh_agreement():
+    a = curve25519_random_secret()
+    b = curve25519_random_secret()
+    pa, pb = curve25519_derive_public(a), curve25519_derive_public(b)
+    k1 = curve25519_derive_shared(a, pb, pa, pb)
+    k2 = curve25519_derive_shared(b, pa, pa, pb)
+    assert k1 == k2 and len(k1) == 32
+
+
+def test_cpu_batch_verifier():
+    v = make_verifier("cpu")
+    sk = SecretKey.pseudo_random_for_testing()
+    f = v.enqueue(sk.public_key, sk.sign(b"x"), b"x")
+    v.flush()
+    assert f.result() is True
+    trips = [(sk.public_key.key_bytes, sk.sign(b"m%d" % i, ), b"m%d" % i)
+             for i in range(4)]
+    trips.append((sk.public_key.key_bytes, b"\x00" * 64, b"nope"))
+    assert v.verify_many(trips) == [True] * 4 + [False]
+
+
+@pytest.mark.slow
+def test_tpu_kernel_matches_cpu_semantics():
+    """The contract: identical accept/reject decisions to OpenSSL, including
+    corrupted sigs, wrong messages, non-canonical S, bad point encodings."""
+    flush_verify_cache()
+    v = TpuSigVerifier()
+    v.BUCKETS = (32,)
+    sks = [SecretKey.pseudo_random_for_testing() for _ in range(8)]
+    pubs, sigs, msgs = [], [], []
+    for i in range(24):
+        sk = sks[i % 8]
+        m = b"msg-%d" % i
+        s = bytearray(sk.sign(m))
+        if i % 5 == 1:
+            s[i % 64] ^= 1 << (i % 8)      # corrupt sig
+        if i % 7 == 2:
+            m = m + b"-tampered"           # wrong msg
+        if i == 9:
+            s[32:] = (2**252 + 27742317777372353535851937790883648493
+                      ).to_bytes(32, "little")  # S == L (non-canonical)
+        pubs.append(sk.public_key.key_bytes)
+        sigs.append(bytes(s))
+        msgs.append(m)
+    # add a bad pubkey encoding (y >= p)
+    pubs.append(b"\xff" * 32)
+    sigs.append(sks[0].sign(b"z"))
+    msgs.append(b"z")
+    want = [raw_verify(p, s, m) for p, s, m in zip(pubs, sigs, msgs)]
+    got = v.verify_many(list(zip(pubs, sigs, msgs)))
+    assert got == want

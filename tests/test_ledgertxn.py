@@ -1,0 +1,162 @@
+"""LedgerTxn semantics tests (reference src/ledger/test/LedgerTxnTests.cpp
+role): nesting, commit/rollback, delta generation, order book views, SQL
+root round trips."""
+
+import pytest
+
+import stellar_core_tpu.xdr as X
+from stellar_core_tpu.database.database import Database
+from stellar_core_tpu.ledger.ledgertxn import (
+    InMemoryLedgerTxnRoot, LedgerTxn, LedgerTxnRoot,
+)
+from stellar_core_tpu.transactions.account_helpers import make_account_entry
+
+
+def acc(i: int) -> X.PublicKey:
+    return X.PublicKey.ed25519(bytes([i] * 32))
+
+
+def make_header(seq=1) -> X.LedgerHeader:
+    return X.LedgerHeader(
+        ledgerVersion=13, previousLedgerHash=b"\x00" * 32,
+        scpValue=X.StellarValue(txSetHash=b"\x00" * 32, closeTime=0,
+                                upgrades=[],
+                                ext=X.StellarValueExt(0, None)),
+        txSetResultHash=b"\x00" * 32, bucketListHash=b"\x00" * 32,
+        ledgerSeq=seq, totalCoins=10**17, feePool=0, inflationSeq=0,
+        idPool=0, baseFee=100, baseReserve=5 * 10**6, maxTxSetSize=100,
+        skipList=[b"\x00" * 32] * 4, ext=X._Ext.v0())
+
+
+def make_offer(seller, offer_id, selling, buying, amount, n, d):
+    o = X.OfferEntry(sellerID=seller, offerID=offer_id, selling=selling,
+                     buying=buying, amount=amount,
+                     price=X.Price(n=n, d=d), flags=0, ext=X._Ext.v0())
+    return X.LedgerEntry(lastModifiedLedgerSeq=1,
+                         data=X.LedgerEntryData(X.LedgerEntryType.OFFER, o),
+                         ext=X._Ext.v0())
+
+
+@pytest.fixture(params=["memory", "sql"])
+def root(request):
+    if request.param == "memory":
+        return InMemoryLedgerTxnRoot(make_header())
+    return LedgerTxnRoot(Database(":memory:"), make_header())
+
+
+def test_create_load_erase_commit(root):
+    ltx = LedgerTxn(root)
+    e = make_account_entry(acc(1), 1000, 5)
+    ltx.create(e)
+    assert ltx.load(X.LedgerKey.account(acc(1))).data.value.balance == 1000
+    ltx.commit()
+
+    assert root.get_entry(X.LedgerKey.account(acc(1))) is not None
+
+    ltx2 = LedgerTxn(root)
+    ltx2.erase(X.LedgerKey.account(acc(1)))
+    assert ltx2.load(X.LedgerKey.account(acc(1))) is None
+    ltx2.commit()
+    assert root.get_entry(X.LedgerKey.account(acc(1))) is None
+
+
+def test_nested_commit_and_rollback(root):
+    outer = LedgerTxn(root)
+    outer.create(make_account_entry(acc(1), 100, 1))
+
+    inner = LedgerTxn(outer)
+    a = inner.load(X.LedgerKey.account(acc(1)))
+    a.data.value.balance = 50
+    inner.commit()
+    assert outer.load(
+        X.LedgerKey.account(acc(1))).data.value.balance == 50
+
+    inner2 = LedgerTxn(outer)
+    b = inner2.load(X.LedgerKey.account(acc(1)))
+    b.data.value.balance = 7
+    inner2.rollback()
+    assert outer.load(
+        X.LedgerKey.account(acc(1))).data.value.balance == 50
+    outer.commit()
+    assert root.get_entry(
+        X.LedgerKey.account(acc(1))).data.value.balance == 50
+
+
+def test_one_child_at_a_time(root):
+    outer = LedgerTxn(root)
+    inner = LedgerTxn(outer)
+    with pytest.raises(AssertionError):
+        outer.load(X.LedgerKey.account(acc(1)))
+    inner.rollback()
+    outer.rollback()
+
+
+def test_delta_tracks_pre_images(root):
+    setup = LedgerTxn(root)
+    setup.create(make_account_entry(acc(1), 100, 1))
+    setup.commit()
+
+    ltx = LedgerTxn(root)
+    a = ltx.load(X.LedgerKey.account(acc(1)))
+    a.data.value.balance = 42
+    ltx.create(make_account_entry(acc(2), 7, 1))
+    delta = ltx.get_delta()
+    by_key = {k.to_xdr(): (p, c) for k, p, c in delta}
+    p1, c1 = by_key[X.LedgerKey.account(acc(1)).to_xdr()]
+    assert p1.data.value.balance == 100 and c1.data.value.balance == 42
+    p2, c2 = by_key[X.LedgerKey.account(acc(2)).to_xdr()]
+    assert p2 is None and c2.data.value.balance == 7
+
+
+def test_header_propagates(root):
+    ltx = LedgerTxn(root)
+    h = ltx.load_header()
+    h.ledgerSeq = 9
+    ltx.commit()
+    assert root.get_header().ledgerSeq == 9
+
+
+def test_best_offer_with_overlay(root):
+    native = X.Asset.native()
+    usd = X.Asset.credit("USD", acc(9))
+    setup = LedgerTxn(root)
+    setup.create(make_offer(acc(1), 1, native, usd, 10, 2, 1))   # price 2.0
+    setup.create(make_offer(acc(2), 2, native, usd, 10, 3, 2))   # price 1.5
+    setup.create(make_offer(acc(3), 3, usd, native, 10, 1, 1))   # other book
+    setup.commit()
+
+    ltx = LedgerTxn(root)
+    best = ltx.best_offer(native, usd)
+    assert best.data.value.offerID == 2
+    # local better offer wins
+    ltx.create(make_offer(acc(4), 4, native, usd, 10, 1, 1))     # price 1.0
+    assert ltx.best_offer(native, usd).data.value.offerID == 4
+    # erase it; falls back
+    ltx.erase(X.LedgerKey.offer(acc(4), 4))
+    assert ltx.best_offer(native, usd).data.value.offerID == 2
+    # exclusion set
+    assert ltx.best_offer(native, usd,
+                          exclude={2}).data.value.offerID == 1
+    ltx.rollback()
+
+
+def test_price_tie_breaks_by_offer_id(root):
+    native = X.Asset.native()
+    usd = X.Asset.credit("USD", acc(9))
+    ltx = LedgerTxn(root)
+    ltx.create(make_offer(acc(1), 5, native, usd, 10, 1, 2))
+    ltx.create(make_offer(acc(1), 4, native, usd, 10, 2, 4))  # same price
+    assert ltx.best_offer(native, usd).data.value.offerID == 4
+    ltx.rollback()
+
+
+def test_offers_by_account(root):
+    native = X.Asset.native()
+    usd = X.Asset.credit("USD", acc(9))
+    ltx = LedgerTxn(root)
+    ltx.create(make_offer(acc(1), 1, native, usd, 10, 1, 1))
+    ltx.create(make_offer(acc(1), 2, usd, native, 10, 1, 1))
+    ltx.create(make_offer(acc(2), 3, native, usd, 10, 1, 1))
+    offers = ltx.load_offers_by_account(acc(1))
+    assert sorted(o.data.value.offerID for o in offers) == [1, 2]
+    ltx.rollback()

@@ -1,0 +1,103 @@
+"""Util layer tests: virtual clock/timers, cache, metrics, xdr streams
+(reference src/util tests role)."""
+
+import os
+
+from stellar_core_tpu.util.cache import RandomEvictionCache
+from stellar_core_tpu.util.metrics import MetricsRegistry
+from stellar_core_tpu.util.timer import ClockMode, VirtualClock, VirtualTimer
+from stellar_core_tpu.util.tmpdir import TmpDir
+from stellar_core_tpu.util.xdrstream import (
+    XDRInputFileStream, XDROutputFileStream,
+)
+import stellar_core_tpu.xdr as X
+
+
+def test_virtual_clock_ordering():
+    clock = VirtualClock(ClockMode.VIRTUAL_TIME)
+    fired = []
+    t1 = VirtualTimer(clock)
+    t1.expires_from_now(5.0)
+    t1.async_wait(lambda: fired.append("t1"))
+    t2 = VirtualTimer(clock)
+    t2.expires_from_now(1.0)
+    t2.async_wait(lambda: fired.append("t2"))
+    clock.post(lambda: fired.append("action"))
+    while clock.crank():
+        pass
+    assert fired == ["action", "t2", "t1"]
+    assert clock.now() == 5.0
+
+
+def test_virtual_timer_cancel():
+    clock = VirtualClock(ClockMode.VIRTUAL_TIME)
+    fired, cancelled = [], []
+    t = VirtualTimer(clock)
+    t.expires_from_now(1.0)
+    t.async_wait(lambda: fired.append(1), lambda: cancelled.append(1))
+    t.cancel()
+    while clock.crank():
+        pass
+    assert fired == [] and cancelled == [1]
+
+
+def test_timer_reschedule_chain():
+    clock = VirtualClock(ClockMode.VIRTUAL_TIME)
+    count = []
+    t = VirtualTimer(clock)
+
+    def fire():
+        count.append(clock.now())
+        if len(count) < 3:
+            t.expires_from_now(2.0)
+            t.async_wait(fire)
+
+    t.expires_from_now(2.0)
+    t.async_wait(fire)
+    for _ in range(20):
+        clock.crank()
+    assert count == [2.0, 4.0, 6.0]
+
+
+def test_cross_thread_post():
+    clock = VirtualClock(ClockMode.VIRTUAL_TIME)
+    got = []
+    clock.post_to_main(lambda: got.append(1))
+    clock.crank()
+    assert got == [1]
+
+
+def test_random_eviction_cache():
+    c = RandomEvictionCache(4)
+    for i in range(10):
+        c.put(i, i * 10)
+    assert len(c) == 4
+    assert c.evictions == 6
+    # surviving keys still map correctly
+    for k in list(c._map):
+        assert c.get(k) == k * 10
+    assert c.maybe_get("nope") is None
+
+
+def test_metrics_registry():
+    m = MetricsRegistry(now_fn=lambda: 0.0)
+    m.new_counter("a.b").inc(3)
+    m.new_meter("c.d").mark(2)
+    with m.new_timer("e.f").time():
+        pass
+    j = m.to_json()
+    assert j["a.b"]["count"] == 3
+    assert j["c.d"]["count"] == 2
+    assert j["e.f"]["count"] == 1
+
+
+def test_xdr_stream_roundtrip():
+    with TmpDir("xdrs") as d:
+        path = d.join("hdrs.xdr")
+        vals = [X.SCPBallot(counter=i, value=bytes([i])) for i in range(5)]
+        with XDROutputFileStream(path) as out:
+            for v in vals:
+                out.write_one(X.SCPBallot, v)
+        with XDRInputFileStream(path) as inp:
+            got = list(inp.read_all(X.SCPBallot))
+        assert got == vals

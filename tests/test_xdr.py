@@ -1,0 +1,113 @@
+"""XDR codec tests (reference: xdrpp round-trip behavior, canonical bytes)."""
+
+import pytest
+
+import stellar_core_tpu.xdr as X
+from stellar_core_tpu.xdr.codec import Packer, Unpacker, XdrError
+
+
+def acc(i: int) -> X.PublicKey:
+    return X.PublicKey.ed25519(bytes([i] * 32))
+
+
+def test_int_roundtrip_and_padding():
+    p = Packer()
+    X.Uint32.pack(p, 7)
+    X.Int64.pack(p, -1)
+    b = p.bytes()
+    assert len(b) == 12
+    u = Unpacker(b)
+    assert X.Uint32.unpack(u) == 7
+    assert X.Int64.unpack(u) == -1
+    u.assert_done()
+
+
+def test_opaque_padding_canonical():
+    o = X.VarOpaque(10)
+    p = Packer()
+    o.pack(p, b"abc")
+    assert p.bytes() == b"\x00\x00\x00\x03abc\x00"
+    # nonzero padding must be rejected (canonical form requirement)
+    with pytest.raises(XdrError):
+        o.unpack(Unpacker(b"\x00\x00\x00\x03abcX"))
+
+
+def test_string_limits():
+    s = X.XdrString(4)
+    p = Packer()
+    with pytest.raises(XdrError):
+        s.pack(p, "hello")
+
+
+def test_struct_union_roundtrip():
+    a = X.Asset.credit("USD", acc(1))
+    assert X.Asset.from_xdr(a.to_xdr()) == a
+    n = X.Asset.native()
+    assert n.is_native and X.Asset.from_xdr(n.to_xdr()) == n
+    assert a != n
+
+    e = X.LedgerEntry(
+        lastModifiedLedgerSeq=3,
+        data=X.LedgerEntryData(
+            X.LedgerEntryType.ACCOUNT,
+            X.AccountEntry(accountID=acc(2), balance=100, seqNum=1,
+                           numSubEntries=0, inflationDest=None, flags=0,
+                           homeDomain="x", thresholds=bytes(4), signers=[],
+                           ext=X._Ext.v0())),
+        ext=X._Ext.v0())
+    assert X.LedgerEntry.from_xdr(e.to_xdr()) == e
+    assert X.ledger_entry_key(e) == X.LedgerKey.account(acc(2))
+
+
+def test_union_bad_discriminant():
+    with pytest.raises(XdrError):
+        X.Asset.from_xdr(b"\x00\x00\x00\x09")
+
+
+def test_optional():
+    t = X.TimeBounds(minTime=1, maxTime=2)
+    tx_with = X.OptionalT(X.TimeBounds)
+    p = Packer()
+    tx_with.pack(p, t)
+    p2 = Packer()
+    tx_with.pack(p2, None)
+    assert len(p.bytes()) == 4 + 16 and p2.bytes() == b"\x00\x00\x00\x00"
+
+
+def test_recursive_qset():
+    q = X.SCPQuorumSet(
+        threshold=2, validators=[acc(1), acc(2)],
+        innerSets=[X.SCPQuorumSet(threshold=1, validators=[acc(3)],
+                                  innerSets=[])])
+    assert X.SCPQuorumSet.from_xdr(q.to_xdr()) == q
+
+
+def test_transaction_envelope_roundtrip():
+    tx = X.Transaction(
+        sourceAccount=X.MuxedAccount.from_account_id(acc(1)),
+        fee=100, seqNum=7, timeBounds=None, memo=X.Memo.none(),
+        operations=[X.Operation(
+            sourceAccount=None,
+            body=X.OperationBody(
+                X.OperationType.PAYMENT,
+                X.PaymentOp(destination=X.MuxedAccount.from_account_id(acc(2)),
+                            asset=X.Asset.native(), amount=5)))],
+        ext=X._Ext.v0())
+    env = X.TransactionEnvelope.for_tx(tx)
+    assert X.TransactionEnvelope.from_xdr(env.to_xdr()) == env
+    # canonical bytes are stable
+    assert env.to_xdr() == X.TransactionEnvelope.from_xdr(env.to_xdr()).to_xdr()
+
+
+def test_stellar_message_roundtrip():
+    m = X.StellarMessage(X.MessageType.GET_TX_SET, b"\x07" * 32)
+    assert X.StellarMessage.from_xdr(m.to_xdr()) == m
+    err = X.StellarMessage(
+        X.MessageType.ERROR_MSG, X.Error(code=X.ErrorCode.ERR_AUTH, msg="no"))
+    assert X.StellarMessage.from_xdr(err.to_xdr()) == err
+
+
+def test_trailing_bytes_rejected():
+    a = X.Asset.native()
+    with pytest.raises(XdrError):
+        X.Asset.from_xdr(a.to_xdr() + b"\x00\x00\x00\x00")
