@@ -1,0 +1,203 @@
+"""OfferExchange: the order-book crossing engine.
+
+Role parity: reference `src/transactions/OfferExchange.cpp` (exchangeV10,
+crossOfferV10, convertWithOffers) and `util/numeric.cpp` (128-bit rounding).
+Python integers are arbitrary precision, so the exchange math here is exact
+rational arithmetic with explicit rounding direction instead of 128-bit
+intrinsics.
+
+Vocabulary (as in the reference): the resting offer sells WHEAT and buys
+SHEEP at price n/d = sheep per wheat. The taker receives wheat and sends
+sheep.
+
+Rounding contract: the resting offer owner never receives less than the
+price implies — sheep is rounded UP for a given wheat, or wheat rounded
+DOWN for a given sheep budget. Zero-amount trades are rejected.
+"""
+
+from __future__ import annotations
+
+from typing import List, Optional, Tuple
+
+from ..xdr import (
+    Asset, ClaimOfferAtom, LedgerEntry, LedgerKey, OfferEntryFlags,
+    TrustLineFlags, ledger_entry_key,
+)
+from .account_helpers import (
+    INT64_MAX, add_balance, change_subentries, load_account, load_trustline,
+    min_balance,
+)
+
+
+def _ceil_div(a: int, b: int) -> int:
+    return -(-a // b)
+
+
+def exchange(offer_amount: int, n: int, d: int, max_wheat_receive: int,
+             max_sheep_send: int) -> Tuple[int, int]:
+    """Exact crossing amounts: returns (wheat_received, sheep_sent)."""
+    wheat = min(offer_amount, max_wheat_receive)
+    if wheat <= 0 or max_sheep_send <= 0:
+        return 0, 0
+    sheep = _ceil_div(wheat * n, d)
+    if sheep > max_sheep_send:
+        wheat = (max_sheep_send * d) // n
+        wheat = min(wheat, offer_amount, max_wheat_receive)
+        sheep = _ceil_div(wheat * n, d)
+    if wheat <= 0 or sheep <= 0 or sheep > max_sheep_send:
+        return 0, 0
+    return wheat, sheep
+
+
+def _available_to_sell(ltx, account_id, asset: Asset) -> int:
+    """How much of `asset` the account can actually deliver."""
+    header = ltx.get_header()
+    if asset.is_native:
+        acc_e = ltx.load_without_record(LedgerKey.account(account_id))
+        if acc_e is None:
+            return 0
+        acc = acc_e.data.value
+        return max(0, acc.balance - min_balance(header, acc.numSubEntries))
+    if account_id == asset.issuer:
+        return INT64_MAX
+    tl_e = ltx.load_without_record(LedgerKey.trustline(account_id, asset))
+    if tl_e is None or not (tl_e.data.value.flags &
+                            TrustLineFlags.AUTHORIZED_FLAG):
+        return 0
+    return max(0, tl_e.data.value.balance)
+
+
+def _available_to_receive(ltx, account_id, asset: Asset) -> int:
+    if asset.is_native:
+        acc_e = ltx.load_without_record(LedgerKey.account(account_id))
+        if acc_e is None:
+            return 0
+        return INT64_MAX - acc_e.data.value.balance
+    if account_id == asset.issuer:
+        return INT64_MAX
+    tl_e = ltx.load_without_record(LedgerKey.trustline(account_id, asset))
+    if tl_e is None or not (tl_e.data.value.flags &
+                            TrustLineFlags.AUTHORIZED_FLAG):
+        return 0
+    tl = tl_e.data.value
+    return max(0, tl.limit - tl.balance)
+
+
+def _credit(ltx, account_id, asset: Asset, amount: int) -> bool:
+    if amount == 0:
+        return True
+    header = ltx.get_header()
+    if asset.is_native:
+        e = load_account(ltx, account_id)
+        return e is not None and add_balance(header, e, amount)
+    if account_id == asset.issuer:
+        return True  # issuer receiving its own asset burns it
+    e = load_trustline(ltx, account_id, asset)
+    if e is None:
+        return False
+    tl = e.data.value
+    if tl.balance + amount > tl.limit:
+        return False
+    tl.balance += amount
+    return True
+
+
+def _debit(ltx, account_id, asset: Asset, amount: int) -> bool:
+    if amount == 0:
+        return True
+    header = ltx.get_header()
+    if asset.is_native:
+        e = load_account(ltx, account_id)
+        return e is not None and add_balance(header, e, -amount)
+    if account_id == asset.issuer:
+        return True  # issuer paying its own asset mints it
+    e = load_trustline(ltx, account_id, asset)
+    if e is None or e.data.value.balance < amount:
+        return False
+    e.data.value.balance -= amount
+    return True
+
+
+class CrossResult:
+    SUCCESS = 0
+    PARTIAL = 1          # book exhausted before filling
+    CROSSED_SELF = 2
+    BAD_PRICE_LIMIT = 3  # remaining book worse than limit (manage offer)
+
+
+def cross_offers(ltx, taker_id, sell_asset: Asset, buy_asset: Asset,
+                 max_buy: int, max_sell: int,
+                 price_limit: Optional[Tuple[int, int]] = None,
+                 passive_taker: bool = False
+                 ) -> Tuple[int, int, int, List[ClaimOfferAtom]]:
+    """Cross the (selling=buy_asset, buying=sell_asset) book until the taker
+    has bought max_buy, spent max_sell, hit the price limit, or emptied the
+    book.
+
+    price_limit (n, d): the taker's own price (sell per buy). Resting offers
+    with sheep-per-wheat price strictly greater than d/n don't cross; at
+    exactly d/n, a passive taker doesn't cross.
+
+    Returns (code, bought, sold, claims). Offer owners' balances are
+    adjusted in place; the taker's are NOT (caller settles net amounts).
+    """
+    bought = 0
+    sold = 0
+    claims: List[ClaimOfferAtom] = []
+    while bought < max_buy and sold < max_sell:
+        best = ltx.best_offer(buy_asset, sell_asset)
+        if best is None:
+            return CrossResult.PARTIAL, bought, sold, claims
+        offer = best.data.value
+        n, d = offer.price.n, offer.price.d
+        if price_limit is not None:
+            ln, ld = price_limit
+            # offer price (sheep/wheat) vs taker reciprocal limit (ld/ln)
+            lhs = n * ln
+            rhs = d * ld
+            if lhs > rhs:
+                return CrossResult.BAD_PRICE_LIMIT, bought, sold, claims
+            if lhs == rhs and (passive_taker or
+                               (offer.flags & OfferEntryFlags.PASSIVE_FLAG)):
+                return CrossResult.BAD_PRICE_LIMIT, bought, sold, claims
+        if offer.sellerID == taker_id:
+            return CrossResult.CROSSED_SELF, bought, sold, claims
+
+        owner = offer.sellerID
+        key = ledger_entry_key(best)
+        wheat_cap = min(offer.amount,
+                        _available_to_sell(ltx, owner, buy_asset))
+        recv_cap = _available_to_receive(ltx, owner, sell_asset)
+        if recv_cap < INT64_MAX:
+            wheat_cap = min(wheat_cap, (recv_cap * d) // n)
+        if wheat_cap <= 0:
+            # unfunded/unreceivable offer: garbage-collect it
+            _erase_offer(ltx, key, owner)
+            continue
+        wheat, sheep = exchange(wheat_cap, n, d, max_buy - bought,
+                                max_sell - sold)
+        if wheat == 0:
+            return CrossResult.SUCCESS, bought, sold, claims
+        # settle the owner's side
+        ok1 = _debit(ltx, owner, buy_asset, wheat)
+        ok2 = _credit(ltx, owner, sell_asset, sheep)
+        assert ok1 and ok2, "owner settlement failed after capacity check"
+        live = ltx.load(key)
+        o = live.data.value
+        o.amount -= wheat
+        if o.amount <= 0 or wheat == wheat_cap and wheat < offer.amount:
+            # fully taken, or residual is unfunded
+            _erase_offer(ltx, key, owner)
+        bought += wheat
+        sold += sheep
+        claims.append(ClaimOfferAtom(
+            sellerID=owner, offerID=offer.offerID, assetSold=buy_asset,
+            amountSold=wheat, assetBought=sell_asset, amountBought=sheep))
+    return CrossResult.SUCCESS, bought, sold, claims
+
+
+def _erase_offer(ltx, key: LedgerKey, owner) -> None:
+    ltx.erase(key)
+    acc = load_account(ltx, owner)
+    if acc is not None:
+        change_subentries(ltx.get_header(), acc, -1)

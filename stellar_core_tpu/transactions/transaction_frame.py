@@ -1,0 +1,447 @@
+"""TransactionFrame: validity checking, fee/sequence processing, apply.
+
+Role parity: reference `src/transactions/TransactionFrame.cpp`:
+- checkValid (:594-629) / commonValid (:443-502): time bounds, seq number,
+  fee floor, source existence, low-threshold signature check, fee balance.
+- processFeeSeqNum (:505): charge fee into the fee pool, consume seq num.
+- apply (:778-835): SignatureChecker over the contents hash, processSignatures
+  (op-level sig checks up front), then per-op nested LedgerTxn apply with
+  all-or-nothing rollback.
+Plus FeeBumpTransactionFrame (reference FeeBumpTransactionFrame.cpp).
+
+The SignatureChecker receives the injected BatchSigVerifier: under the TPU
+backend every checkValid/apply becomes a batched device call site
+(SURVEY.md hot callers #2/#3).
+"""
+
+from __future__ import annotations
+
+from typing import List, Optional, Tuple
+
+from ..crypto.hashing import sha256
+from ..crypto.batch_verifier import BatchSigVerifier, CpuSigVerifier
+from ..xdr import (
+    EnvelopeType, FeeBumpTransactionEnvelope, LedgerKey, OperationResult,
+    OperationResultCode, PublicKey, Transaction, TransactionEnvelope,
+    TransactionResult, TransactionResultCode, TransactionResultPair,
+    TransactionSignaturePayload, TransactionV1Envelope, _Ext,
+)
+from ..xdr.transaction import _TaggedTransaction, _TxResultResult
+from .account_helpers import (
+    ThresholdLevel, account_threshold, account_master_weight, load_account,
+)
+from .operation_frame import make_operation_frame
+from .signature_checker import SignatureChecker
+from . import operations as _ops  # noqa: F401  (populates the op registry)
+from . import offers as _offers   # noqa: F401
+
+
+def _make_result(fee_charged: int, code: int,
+                 op_results: Optional[List[OperationResult]] = None
+                 ) -> TransactionResult:
+    if code in (TransactionResultCode.txSUCCESS,
+                TransactionResultCode.txFAILED):
+        rr = _TxResultResult(code, op_results or [])
+    else:
+        rr = _TxResultResult(code, None)
+    return TransactionResult(feeCharged=fee_charged, result=rr,
+                             ext=_Ext.v0())
+
+
+class TransactionFrame:
+    def __init__(self, network_id: bytes,
+                 envelope: TransactionEnvelope) -> None:
+        assert envelope.disc == EnvelopeType.ENVELOPE_TYPE_TX
+        self.network_id = network_id
+        self.envelope = envelope
+        self.tx: Transaction = envelope.value.tx
+        self.signatures = envelope.value.signatures
+        self.op_frames = [make_operation_frame(op, self)
+                          for op in self.tx.operations]
+        self.result: TransactionResult = _make_result(
+            0, TransactionResultCode.txSUCCESS,
+            [None] * len(self.op_frames))
+        self._contents_hash: Optional[bytes] = None
+
+    # -- identity -----------------------------------------------------------
+    @classmethod
+    def make_from_wire(cls, network_id: bytes, env: TransactionEnvelope):
+        if env.disc == EnvelopeType.ENVELOPE_TYPE_TX_FEE_BUMP:
+            return FeeBumpTransactionFrame(network_id, env)
+        return cls(network_id, env)
+
+    def source_account_id(self) -> PublicKey:
+        return self.tx.sourceAccount.account_id
+
+    @property
+    def seq_num(self) -> int:
+        return self.tx.seqNum
+
+    @property
+    def fee_bid(self) -> int:
+        return self.tx.fee
+
+    def num_operations(self) -> int:
+        return len(self.tx.operations)
+
+    def signature_payload(self) -> bytes:
+        p = TransactionSignaturePayload(
+            networkId=self.network_id,
+            taggedTransaction=_TaggedTransaction(
+                EnvelopeType.ENVELOPE_TYPE_TX, self.tx))
+        return p.to_xdr()
+
+    def contents_hash(self) -> bytes:
+        if self._contents_hash is None:
+            self._contents_hash = sha256(self.signature_payload())
+        return self._contents_hash
+
+    def full_hash(self) -> bytes:
+        """Hash of the whole signed envelope (identity in txsets)."""
+        return sha256(self.envelope.to_xdr())
+
+    def add_signature(self, secret_key) -> None:
+        """Sign the CONTENTS HASH (reference SignatureUtils::sign signs
+        sha256(signature payload), not the raw payload)."""
+        self.signatures.append(
+            secret_key.sign_decorated(self.contents_hash()))
+
+    # -- fees ---------------------------------------------------------------
+    def min_fee(self, header) -> int:
+        return header.baseFee * max(1, self.num_operations())
+
+    def fee_charged(self, header, base_fee: Optional[int] = None) -> int:
+        """Effective fee: bid capped by per-op base fee (protocol >= 11
+        semantics: charge baseFee per op, never more than bid)."""
+        eff_base = base_fee if base_fee is not None else header.baseFee
+        return min(self.fee_bid, eff_base * max(1, self.num_operations()))
+
+    # -- validity -----------------------------------------------------------
+    def _common_valid(self, checker: SignatureChecker, ltx,
+                      current_seq: int, applying: bool) -> int:
+        header = ltx.load_header()
+        tb = self.tx.timeBounds
+        if tb is not None:
+            close_time = header.scpValue.closeTime
+            if tb.minTime and close_time < tb.minTime:
+                return TransactionResultCode.txTOO_EARLY
+            if tb.maxTime and close_time > tb.maxTime:
+                return TransactionResultCode.txTOO_LATE
+        if not self.tx.operations:
+            return TransactionResultCode.txMISSING_OPERATION
+        if self.fee_bid < self.min_fee(header):
+            return TransactionResultCode.txINSUFFICIENT_FEE
+        src = load_account(ltx, self.source_account_id())
+        if src is None:
+            return TransactionResultCode.txNO_ACCOUNT
+        acc = src.data.value
+        seq = current_seq if current_seq != 0 else acc.seqNum
+        if self.tx.seqNum != seq + 1:
+            return TransactionResultCode.txBAD_SEQ
+        if not self._check_signature(checker, acc, ThresholdLevel.LOW):
+            return TransactionResultCode.txBAD_AUTH
+        if not applying and acc.balance < self.fee_charged(header):
+            return TransactionResultCode.txINSUFFICIENT_BALANCE
+        return TransactionResultCode.txSUCCESS
+
+    def _check_signature(self, checker: SignatureChecker, acc,
+                         level: int) -> bool:
+        from ..xdr import Signer, SignerKey
+        signers = list(acc.signers)
+        mw = account_master_weight(acc)
+        if mw > 0:
+            signers.append(Signer(
+                key=SignerKey.ed25519(acc.accountID.key_bytes), weight=mw))
+        return checker.check_signature(signers,
+                                       account_threshold(acc, level))
+
+    def check_valid(self, ltx_parent, current_seq: int = 0,
+                    verifier: Optional[BatchSigVerifier] = None) -> bool:
+        """Full validity check against (a temporary child of) ltx_parent.
+        Never mutates state. Reference TransactionFrame::checkValid:594."""
+        from ..ledger.ledgertxn import LedgerTxn
+        verifier = verifier or CpuSigVerifier()
+        checker = SignatureChecker(self.contents_hash(), self.signatures,
+                                   verifier)
+        ltx = LedgerTxn(ltx_parent)
+        try:
+            code = self._common_valid(checker, ltx, current_seq, False)
+            if code != TransactionResultCode.txSUCCESS:
+                self.result = _make_result(0, code)
+                return False
+            ok = True
+            op_results = []
+            for f in self.op_frames:
+                if not f.check_valid(ltx):
+                    ok = False
+                op_results.append(f.result)
+            if not ok:
+                self.result = _make_result(
+                    self.fee_charged(ltx.load_header()),
+                    TransactionResultCode.txFAILED, op_results)
+                return False
+            if not checker.check_all_signatures_used():
+                self.result = _make_result(
+                    0, TransactionResultCode.txBAD_AUTH_EXTRA)
+                return False
+            self.result = _make_result(
+                self.fee_charged(ltx.load_header()),
+                TransactionResultCode.txSUCCESS, op_results)
+            return True
+        finally:
+            ltx.rollback()
+
+    # -- fee & seq processing ------------------------------------------------
+    def process_fee_seq_num(self, ltx, base_fee: Optional[int]) -> None:
+        """Charge the fee and consume the sequence number (reference
+        processFeeSeqNum:505). Runs for every tx in the set before any
+        apply."""
+        header = ltx.load_header()
+        fee = self.fee_charged(header, base_fee)
+        src = load_account(ltx, self.source_account_id())
+        assert src is not None, "fee processing on missing account"
+        acc = src.data.value
+        fee = min(fee, max(0, acc.balance))
+        acc.balance -= fee
+        acc.seqNum = self.tx.seqNum
+        header.feePool += fee
+        self.result = _make_result(fee, TransactionResultCode.txSUCCESS,
+                                   [None] * len(self.op_frames))
+
+    # -- apply --------------------------------------------------------------
+    def process_signatures(self, checker: SignatureChecker, ltx) -> bool:
+        """Protocol >= 10: check every op's signatures before applying any
+        (reference processSignatures:384)."""
+        ok = True
+        for f in self.op_frames:
+            if not f.check_signature(ltx, checker):
+                f.set_code(OperationResultCode.opBAD_AUTH)
+                ok = False
+        if ok and not checker.check_all_signatures_used():
+            self.result = _make_result(
+                self.result.feeCharged,
+                TransactionResultCode.txBAD_AUTH_EXTRA)
+            return False
+        if not ok:
+            self.result = _make_result(
+                self.result.feeCharged, TransactionResultCode.txFAILED,
+                [f.result for f in self.op_frames])
+        return ok
+
+    def apply(self, ltx_parent,
+              verifier: Optional[BatchSigVerifier] = None) -> bool:
+        """Apply under a child txn of ltx_parent; on any op failure roll back
+        every op's effects (fees/seqnums were already consumed).
+        Reference apply:778-835 / applyOperations:676."""
+        from ..ledger.ledgertxn import LedgerTxn
+        verifier = verifier or CpuSigVerifier()
+        checker = SignatureChecker(self.contents_hash(), self.signatures,
+                                   verifier)
+        fee = self.result.feeCharged
+        ltx = LedgerTxn(ltx_parent)
+        try:
+            # re-verify seq/auth at apply time (state may have changed since
+            # nomination; reference commonValid(applying=true) path)
+            src = load_account(ltx, self.source_account_id())
+            if src is None:
+                self.result = _make_result(
+                    fee, TransactionResultCode.txNO_ACCOUNT)
+                ltx.rollback()
+                return False
+            if not self.process_signatures(checker, ltx):
+                ltx.rollback()
+                return False
+            # apply every op (even after a failure) inside nested txns; the
+            # outer txn rolls back wholesale if any failed — reference
+            # applyOperations semantics
+            ok = True
+            op_results = []
+            for f in self.op_frames:
+                op_ltx = LedgerTxn(ltx)
+                try:
+                    if f.apply(op_ltx):
+                        op_ltx.commit()
+                    else:
+                        ok = False
+                        op_ltx.rollback()
+                except Exception:
+                    op_ltx.rollback()
+                    raise
+                op_results.append(f.result)
+            if ok:
+                self.result = _make_result(
+                    fee, TransactionResultCode.txSUCCESS, op_results)
+                ltx.commit()
+                return True
+            self.result = _make_result(
+                fee, TransactionResultCode.txFAILED, op_results)
+            ltx.rollback()
+            return False
+        except Exception:
+            self.result = _make_result(
+                fee, TransactionResultCode.txINTERNAL_ERROR)
+            return False
+
+    def result_pair(self) -> TransactionResultPair:
+        return TransactionResultPair(transactionHash=self.contents_hash(),
+                                     result=self.result)
+
+
+class FeeBumpTransactionFrame:
+    """Outer fee-bump envelope wrapping an inner v1 transaction
+    (reference FeeBumpTransactionFrame.cpp)."""
+
+    def __init__(self, network_id: bytes,
+                 envelope: TransactionEnvelope) -> None:
+        assert envelope.disc == EnvelopeType.ENVELOPE_TYPE_TX_FEE_BUMP
+        self.network_id = network_id
+        self.envelope = envelope
+        fb = envelope.value.tx
+        self.fee_bump = fb
+        self.signatures = envelope.value.signatures
+        inner_env = TransactionEnvelope(
+            EnvelopeType.ENVELOPE_TYPE_TX, fb.innerTx.value)
+        self.inner = TransactionFrame(network_id, inner_env)
+        self.result: TransactionResult = _make_result(
+            0, TransactionResultCode.txFEE_BUMP_INNER_SUCCESS)
+        self._contents_hash: Optional[bytes] = None
+
+    def source_account_id(self) -> PublicKey:
+        return self.fee_bump.feeSource.account_id
+
+    @property
+    def seq_num(self) -> int:
+        return self.inner.seq_num
+
+    @property
+    def fee_bid(self) -> int:
+        return self.fee_bump.fee
+
+    def num_operations(self) -> int:
+        return self.inner.num_operations() + 1
+
+    def signature_payload(self) -> bytes:
+        p = TransactionSignaturePayload(
+            networkId=self.network_id,
+            taggedTransaction=_TaggedTransaction(
+                EnvelopeType.ENVELOPE_TYPE_TX_FEE_BUMP, self.fee_bump))
+        return p.to_xdr()
+
+    def contents_hash(self) -> bytes:
+        if self._contents_hash is None:
+            self._contents_hash = sha256(self.signature_payload())
+        return self._contents_hash
+
+    def full_hash(self) -> bytes:
+        return sha256(self.envelope.to_xdr())
+
+    def add_signature(self, secret_key) -> None:
+        self.signatures.append(
+            secret_key.sign_decorated(self.contents_hash()))
+
+    def min_fee(self, header) -> int:
+        return header.baseFee * self.num_operations()
+
+    def fee_charged(self, header, base_fee: Optional[int] = None) -> int:
+        eff_base = base_fee if base_fee is not None else header.baseFee
+        return min(self.fee_bid, eff_base * self.num_operations())
+
+    def _inner_pair(self):
+        from ..xdr import InnerTransactionResultPair
+        return InnerTransactionResultPair(
+            transactionHash=self.inner.contents_hash(),
+            result=self.inner.result)
+
+    def check_valid(self, ltx_parent, current_seq: int = 0,
+                    verifier=None) -> bool:
+        from ..ledger.ledgertxn import LedgerTxn
+        verifier = verifier or CpuSigVerifier()
+        ltx = LedgerTxn(ltx_parent)
+        try:
+            header = ltx.load_header()
+            if self.fee_bid < self.min_fee(header) or \
+                    self.fee_bid < self.inner.fee_bid:
+                self.result = _make_result(
+                    0, TransactionResultCode.txINSUFFICIENT_FEE)
+                return False
+            src = load_account(ltx, self.source_account_id())
+            if src is None:
+                self.result = _make_result(
+                    0, TransactionResultCode.txNO_ACCOUNT)
+                return False
+            checker = SignatureChecker(self.contents_hash(),
+                                       self.signatures, verifier)
+            acc = src.data.value
+            from ..xdr import Signer, SignerKey
+            signers = list(acc.signers)
+            mw = account_master_weight(acc)
+            if mw > 0:
+                signers.append(Signer(
+                    key=SignerKey.ed25519(acc.accountID.key_bytes),
+                    weight=mw))
+            if not checker.check_signature(
+                    signers, account_threshold(acc, ThresholdLevel.LOW)):
+                self.result = _make_result(
+                    0, TransactionResultCode.txBAD_AUTH)
+                return False
+            if not checker.check_all_signatures_used():
+                self.result = _make_result(
+                    0, TransactionResultCode.txBAD_AUTH_EXTRA)
+                return False
+            if acc.balance < self.fee_charged(header):
+                self.result = _make_result(
+                    0, TransactionResultCode.txINSUFFICIENT_BALANCE)
+                return False
+        finally:
+            ltx.rollback()
+        if not self.inner.check_valid(ltx_parent, current_seq, verifier):
+            self.result = _make_result(
+                0, TransactionResultCode.txFEE_BUMP_INNER_FAILED)
+            self.result.result = _TxResultResult(
+                TransactionResultCode.txFEE_BUMP_INNER_FAILED,
+                self._inner_pair())
+            return False
+        self.result = TransactionResult(
+            feeCharged=0,
+            result=_TxResultResult(
+                TransactionResultCode.txFEE_BUMP_INNER_SUCCESS,
+                self._inner_pair()),
+            ext=_Ext.v0())
+        return True
+
+    def process_fee_seq_num(self, ltx, base_fee: Optional[int]) -> None:
+        header = ltx.load_header()
+        fee = self.fee_charged(header, base_fee)
+        src = load_account(ltx, self.source_account_id())
+        assert src is not None
+        acc = src.data.value
+        fee = min(fee, max(0, acc.balance))
+        acc.balance -= fee
+        header.feePool += fee
+        # inner seq num is consumed too
+        inner_src = load_account(ltx, self.inner.source_account_id())
+        if inner_src is not None:
+            inner_src.data.value.seqNum = self.inner.seq_num
+        self.result = TransactionResult(
+            feeCharged=fee,
+            result=_TxResultResult(
+                TransactionResultCode.txFEE_BUMP_INNER_SUCCESS,
+                self._inner_pair()),
+            ext=_Ext.v0())
+
+    def apply(self, ltx_parent, verifier=None) -> bool:
+        self.inner.result = _make_result(
+            0, TransactionResultCode.txSUCCESS,
+            [None] * len(self.inner.op_frames))
+        ok = self.inner.apply(ltx_parent, verifier)
+        code = (TransactionResultCode.txFEE_BUMP_INNER_SUCCESS if ok
+                else TransactionResultCode.txFEE_BUMP_INNER_FAILED)
+        self.result = TransactionResult(
+            feeCharged=self.result.feeCharged,
+            result=_TxResultResult(code, self._inner_pair()),
+            ext=_Ext.v0())
+        return ok
+
+    def result_pair(self) -> TransactionResultPair:
+        return TransactionResultPair(transactionHash=self.contents_hash(),
+                                     result=self.result)

@@ -1,0 +1,428 @@
+"""Transaction engine tests.
+
+Role parity: reference `src/transactions/test/*Tests.cpp` (16 files across
+every op type) — condensed to the behavioral core: validity codes, fees,
+sequence numbers, multisig thresholds, each op's happy/failure paths, offer
+crossing, path payments, fee bumps.
+"""
+
+import pytest
+
+import stellar_core_tpu.xdr as X
+from stellar_core_tpu.crypto.keys import SecretKey
+from stellar_core_tpu.ledger.ledgertxn import LedgerTxn
+from stellar_core_tpu.testing import TestAccount, TestLedger
+from stellar_core_tpu.transactions.operations import (
+    AccountMergeResultCode, AllowTrustResultCode, ChangeTrustResultCode,
+    CreateAccountResultCode, ManageDataResultCode, PaymentResultCode,
+    SetOptionsResultCode,
+)
+from stellar_core_tpu.transactions.offers import (
+    ManageOfferResultCode, PathPaymentResultCode,
+)
+from stellar_core_tpu.xdr import (
+    Asset, OperationBody, OperationType, Price, TimeBounds,
+    TransactionResultCode,
+)
+
+
+@pytest.fixture
+def ledger():
+    return TestLedger()
+
+
+@pytest.fixture
+def root(ledger):
+    return ledger.root_account
+
+
+XLM = Asset.native()
+
+
+def inner_code(frame, op_index=0):
+    return frame.result.op_results[op_index].value.value.disc
+
+
+def test_create_account_and_payment(ledger, root):
+    a = root.create(10**9)
+    b = root.create(10**9)
+    assert ledger.balance(a.account_id) == 10**9
+    assert a.pay(b, 10**6)
+    assert ledger.balance(b.account_id) == 10**9 + 10**6
+    # fee charged
+    assert ledger.balance(a.account_id) == 10**9 - 10**6 - 100
+
+
+def test_create_account_failures(ledger, root):
+    a = root.create(10**9)
+    # below reserve
+    sk = SecretKey.pseudo_random_for_testing()
+    f = a.tx([a.op_create_account(sk.public_key, 1)])
+    assert not ledger.apply_frame(f)
+    assert inner_code(f) == CreateAccountResultCode.LOW_RESERVE
+    # already exists
+    f = a.tx([a.op_create_account(root.account_id, 10**8)])
+    assert not ledger.apply_frame(f)
+    assert inner_code(f) == CreateAccountResultCode.ALREADY_EXIST
+    # underfunded
+    f = a.tx([a.op_create_account(sk.public_key, 10**10)])
+    assert not ledger.apply_frame(f)
+    assert inner_code(f) == CreateAccountResultCode.UNDERFUNDED
+
+
+def test_payment_failures(ledger, root):
+    a = root.create(10**9)
+    ghost = SecretKey.pseudo_random_for_testing()
+    f = a.tx([a.op_payment(ghost.public_key, 100)])
+    assert not ledger.apply_frame(f)
+    assert inner_code(f) == PaymentResultCode.NO_DESTINATION
+    # underfunded native (reserve floor)
+    f = a.tx([a.op_payment(root.account_id, 10**9)])
+    assert not ledger.apply_frame(f)
+    assert inner_code(f) == PaymentResultCode.UNDERFUNDED
+
+
+def test_bad_seq_and_fees(ledger, root):
+    a = root.create(10**9)
+    f = a.tx([a.op_payment(root.account_id, 1)], seq=a.next_seq() + 5)
+    assert not ledger.apply_frame(f)
+    assert f.result.code == TransactionResultCode.txBAD_SEQ
+    f = a.tx([a.op_payment(root.account_id, 1)], fee=1)
+    assert not ledger.apply_frame(f)
+    assert f.result.code == TransactionResultCode.txINSUFFICIENT_FEE
+    # failed apply still consumes fee + seq
+    before = a.balance()
+    seq_before = ledger.seq_num(a.account_id)
+    f = a.tx([a.op_payment(root.account_id, 10**18)])  # will fail UNDERFUNDED
+    assert not ledger.apply_frame(f)
+    assert a.balance() == before - 100
+    assert ledger.seq_num(a.account_id) == seq_before + 1
+
+
+def test_time_bounds(ledger, root):
+    a = root.create(10**9)
+    # header closeTime == 1
+    f = a.tx([a.op_payment(root.account_id, 1)],
+             time_bounds=TimeBounds(minTime=100, maxTime=0))
+    assert not ledger.apply_frame(f)
+    assert f.result.code == TransactionResultCode.txTOO_EARLY
+    f = a.tx([a.op_payment(root.account_id, 1)],
+             time_bounds=TimeBounds(minTime=0, maxTime=0))
+    assert ledger.apply_frame(f)
+
+
+def test_bad_auth(ledger, root):
+    a = root.create(10**9)
+    stranger = SecretKey.pseudo_random_for_testing()
+    t = a.tx([a.op_payment(root.account_id, 1)])
+    t.signatures.clear()
+    t.add_signature(stranger)
+    assert not ledger.apply_frame(t)
+    assert t.result.code in (TransactionResultCode.txBAD_AUTH,
+                             TransactionResultCode.txBAD_AUTH_EXTRA)
+
+
+def test_multisig_thresholds(ledger, root):
+    a = root.create(10**9)
+    s2 = SecretKey.pseudo_random_for_testing()
+    # add signer weight 1, raise med threshold to 2
+    from stellar_core_tpu.xdr import SetOptionsOp, Signer, SignerKey
+    setop = a.op(OperationBody(
+        OperationType.SET_OPTIONS,
+        SetOptionsOp(inflationDest=None, clearFlags=None, setFlags=None,
+                     masterWeight=None, lowThreshold=None, medThreshold=2,
+                     highThreshold=2, homeDomain=None,
+                     signer=Signer(
+                         key=SignerKey.ed25519(s2.public_key.key_bytes),
+                         weight=1))))
+    assert ledger.apply_frame(a.tx([setop]))
+    # payment (med) now needs master(1)+signer(1)
+    f = a.tx([a.op_payment(root.account_id, 1)])
+    assert not ledger.apply_frame(f)
+    assert f.result.code == TransactionResultCode.txFAILED  # opBAD_AUTH
+    f = a.tx([a.op_payment(root.account_id, 1)], extra_signers=[s2])
+    assert ledger.apply_frame(f)
+
+
+def test_trust_and_credit_payments(ledger, root):
+    issuer = root.create(10**9)
+    alice = root.create(10**9)
+    bob = root.create(10**9)
+    usd = Asset.credit("USD", issuer.account_id)
+    assert alice.change_trust(usd, 10**12)
+    assert bob.change_trust(usd, 10**12)
+    # issuer mints to alice
+    assert issuer.pay(alice, 1000, usd)
+    assert ledger.trust_balance(alice.account_id, usd) == 1000
+    # alice pays bob
+    assert alice.pay(bob, 400, usd)
+    assert ledger.trust_balance(bob.account_id, usd) == 400
+    # bob pays issuer (burn)
+    assert bob.pay(issuer, 100, usd)
+    assert ledger.trust_balance(bob.account_id, usd) == 300
+    # no trust: charlie
+    charlie = root.create(10**9)
+    f = alice.tx([alice.op_payment(charlie.account_id, 10, usd)])
+    assert not ledger.apply_frame(f)
+    assert inner_code(f) == PaymentResultCode.NO_TRUST
+    # line full
+    assert charlie.change_trust(usd, 50)
+    f = alice.tx([alice.op_payment(charlie.account_id, 100, usd)])
+    assert not ledger.apply_frame(f)
+    assert inner_code(f) == PaymentResultCode.LINE_FULL
+
+
+def test_allow_trust_auth_required(ledger, root):
+    from stellar_core_tpu.xdr import (
+        AccountFlags, AllowTrustAsset, AllowTrustOp, SetOptionsOp,
+    )
+    issuer = root.create(10**9)
+    alice = root.create(10**9)
+    usd = Asset.credit("USD", issuer.account_id)
+    # set AUTH_REQUIRED on issuer
+    setop = issuer.op(OperationBody(
+        OperationType.SET_OPTIONS,
+        SetOptionsOp(inflationDest=None, clearFlags=None,
+                     setFlags=AccountFlags.AUTH_REQUIRED_FLAG |
+                     AccountFlags.AUTH_REVOCABLE_FLAG,
+                     masterWeight=None, lowThreshold=None, medThreshold=None,
+                     highThreshold=None, homeDomain=None, signer=None)))
+    assert ledger.apply_frame(issuer.tx([setop]))
+    assert alice.change_trust(usd, 10**12)
+    # unauthorized: issuer cannot pay yet
+    f = issuer.tx([issuer.op_payment(alice.account_id, 10, usd)])
+    assert not ledger.apply_frame(f)
+    assert inner_code(f) == PaymentResultCode.NOT_AUTHORIZED
+    # authorize
+    allow = issuer.op(OperationBody(
+        OperationType.ALLOW_TRUST,
+        AllowTrustOp(trustor=alice.account_id,
+                     asset=AllowTrustAsset(1, b"USD\x00"), authorize=1)))
+    assert ledger.apply_frame(issuer.tx([allow]))
+    assert issuer.pay(alice, 10, usd)
+    # revoke
+    revoke = issuer.op(OperationBody(
+        OperationType.ALLOW_TRUST,
+        AllowTrustOp(trustor=alice.account_id,
+                     asset=AllowTrustAsset(1, b"USD\x00"), authorize=0)))
+    assert ledger.apply_frame(issuer.tx([revoke]))
+    f = issuer.tx([issuer.op_payment(alice.account_id, 10, usd)])
+    assert not ledger.apply_frame(f)
+
+
+def test_manage_data(ledger, root):
+    a = root.create(10**9)
+    assert ledger.apply_frame(a.tx([a.op_manage_data("k1", b"v1")]))
+    e = ledger.root.get_entry(X.LedgerKey.data(a.account_id, "k1"))
+    assert e.data.value.dataValue == b"v1"
+    assert ledger.apply_frame(a.tx([a.op_manage_data("k1", b"v2")]))
+    assert ledger.apply_frame(a.tx([a.op_manage_data("k1", None)]))
+    assert ledger.root.get_entry(
+        X.LedgerKey.data(a.account_id, "k1")) is None
+    f = a.tx([a.op_manage_data("nope", None)])
+    assert not ledger.apply_frame(f)
+    assert inner_code(f) == ManageDataResultCode.NAME_NOT_FOUND
+
+
+def test_bump_sequence(ledger, root):
+    from stellar_core_tpu.xdr import BumpSequenceOp
+    a = root.create(10**9)
+    cur = ledger.seq_num(a.account_id)
+    bump = a.op(OperationBody(OperationType.BUMP_SEQUENCE,
+                              BumpSequenceOp(bumpTo=cur + 100)))
+    assert ledger.apply_frame(a.tx([bump]))
+    assert ledger.seq_num(a.account_id) == cur + 100
+
+
+def test_account_merge(ledger, root):
+    a = root.create(10**9)
+    b = root.create(10**9)
+    bal_a = ledger.balance(a.account_id)
+    bal_b = ledger.balance(b.account_id)
+    merge = a.op(OperationBody(OperationType.ACCOUNT_MERGE, b.muxed))
+    f = a.tx([merge])
+    assert ledger.apply_frame(f), f.result
+    assert not ledger.account_exists(a.account_id)
+    assert ledger.balance(b.account_id) == bal_b + bal_a - 100
+    # merge into missing account
+    c = root.create(10**9)
+    ghost = SecretKey.pseudo_random_for_testing()
+    from stellar_core_tpu.xdr import MuxedAccount
+    merge2 = c.op(OperationBody(
+        OperationType.ACCOUNT_MERGE,
+        MuxedAccount.from_account_id(ghost.public_key)))
+    f = c.tx([merge2])
+    assert not ledger.apply_frame(f)
+    assert inner_code(f) == AccountMergeResultCode.NO_ACCOUNT
+
+
+def test_failed_op_rolls_back_whole_tx(ledger, root):
+    a = root.create(10**9)
+    b = root.create(10**9)
+    ghost = SecretKey.pseudo_random_for_testing()
+    f = a.tx([a.op_payment(b.account_id, 1000),
+              a.op_payment(ghost.public_key, 1)])  # 2nd fails
+    bal = ledger.balance(b.account_id)
+    assert not ledger.apply_frame(f)
+    assert f.result.code == TransactionResultCode.txFAILED
+    assert ledger.balance(b.account_id) == bal  # first op rolled back
+
+
+def test_manage_offer_create_update_delete(ledger, root):
+    issuer = root.create(10**10)
+    alice = root.create(10**10)
+    usd = Asset.credit("USD", issuer.account_id)
+    assert alice.change_trust(usd, 10**12)
+    # create offer: sell 1000 XLM for USD at 2 USD/XLM
+    f = alice.tx([alice.op_manage_sell_offer(XLM, usd, 1000, 2, 1)])
+    assert ledger.apply_frame(f), f.result
+    succ = f.result.op_results[0].value.value.value
+    assert succ.offer.disc == 0  # created
+    oid = succ.offer.value.offerID
+    # update amount
+    f = alice.tx([alice.op_manage_sell_offer(XLM, usd, 500, 2, 1, oid)])
+    assert ledger.apply_frame(f)
+    succ = f.result.op_results[0].value.value.value
+    assert succ.offer.disc == 1 and succ.offer.value.amount == 500
+    # delete
+    f = alice.tx([alice.op_manage_sell_offer(XLM, usd, 0, 2, 1, oid)])
+    assert ledger.apply_frame(f)
+    assert ledger.root.get_entry(
+        X.LedgerKey.offer(alice.account_id, oid)) is None
+    # delete missing
+    f = alice.tx([alice.op_manage_sell_offer(XLM, usd, 0, 2, 1, 999)])
+    assert not ledger.apply_frame(f)
+    assert inner_code(f) == ManageOfferResultCode.NOT_FOUND
+
+
+def test_offer_crossing(ledger, root):
+    issuer = root.create(10**10)
+    seller = root.create(10**10)
+    buyer = root.create(10**10)
+    usd = Asset.credit("USD", issuer.account_id)
+    for acct in (seller, buyer):
+        assert acct.change_trust(usd, 10**12)
+    assert issuer.pay(buyer, 10**6, usd)
+
+    # seller: sell 1000 XLM @ 2 USD/XLM
+    f = seller.tx([seller.op_manage_sell_offer(XLM, usd, 1000, 2, 1)])
+    assert ledger.apply_frame(f)
+    # buyer: sell 600 USD for XLM @ 0.5 XLM/USD — crosses
+    f = buyer.tx([buyer.op_manage_sell_offer(usd, XLM, 600, 1, 2)])
+    assert ledger.apply_frame(f), f.result
+    succ = f.result.op_results[0].value.value.value
+    assert len(succ.offersClaimed) == 1
+    atom = succ.offersClaimed[0]
+    assert atom.amountSold == 300 and atom.amountBought == 600
+    # seller got 600 USD, buyer got 300 XLM
+    assert ledger.trust_balance(seller.account_id, usd) == 600
+    assert ledger.trust_balance(buyer.account_id, usd) == 10**6 - 600
+    # seller's offer reduced to 700
+    rem = ledger.root.get_entry(X.LedgerKey.offer(seller.account_id, 1))
+    assert rem.data.value.amount == 700
+    # buyer's offer fully consumed: no residual entry
+    assert succ.offer.disc == 2
+
+
+def test_offer_price_limit_no_cross(ledger, root):
+    issuer = root.create(10**10)
+    a = root.create(10**10)
+    b = root.create(10**10)
+    usd = Asset.credit("USD", issuer.account_id)
+    for acct in (a, b):
+        assert acct.change_trust(usd, 10**12)
+    assert issuer.pay(b, 10**6, usd)
+    # a sells XLM at 2 USD; b bids only 1 USD/XLM — no cross, both rest
+    assert ledger.apply_frame(
+        a.tx([a.op_manage_sell_offer(XLM, usd, 1000, 2, 1)]))
+    f = b.tx([b.op_manage_sell_offer(usd, XLM, 100, 1, 1)])
+    assert ledger.apply_frame(f)
+    succ = f.result.op_results[0].value.value.value
+    assert len(succ.offersClaimed) == 0 and succ.offer.disc == 0
+
+
+def test_path_payment_strict_receive(ledger, root):
+    issuer = root.create(10**10)
+    mm = root.create(10**10)       # market maker
+    src = root.create(10**10)
+    dst = root.create(10**10)
+    usd = Asset.credit("USD", issuer.account_id)
+    for acct in (mm, dst):
+        assert acct.change_trust(usd, 10**12)
+    assert issuer.pay(mm, 10**6, usd)
+    # mm sells USD for XLM at 1 USD per 2 XLM (price 2 XLM/USD)
+    assert ledger.apply_frame(
+        mm.tx([mm.op_manage_sell_offer(usd, XLM, 10**6, 2, 1)]))
+    # src sends XLM, dst receives 100 USD
+    from stellar_core_tpu.xdr import PathPaymentStrictReceiveOp
+    op = src.op(OperationBody(
+        OperationType.PATH_PAYMENT_STRICT_RECEIVE,
+        PathPaymentStrictReceiveOp(
+            sendAsset=XLM, sendMax=1000, destination=dst.muxed,
+            destAsset=usd, destAmount=100, path=[])))
+    f = src.tx([op])
+    assert ledger.apply_frame(f), f.result
+    assert ledger.trust_balance(dst.account_id, usd) == 100
+    succ = f.result.op_results[0].value.value.value
+    assert succ.last.amount == 100
+    # over sendmax
+    op2 = src.op(OperationBody(
+        OperationType.PATH_PAYMENT_STRICT_RECEIVE,
+        PathPaymentStrictReceiveOp(
+            sendAsset=XLM, sendMax=10, destination=dst.muxed,
+            destAsset=usd, destAmount=100, path=[])))
+    f = src.tx([op2])
+    assert not ledger.apply_frame(f)
+    assert inner_code(f) == PathPaymentResultCode.OVER_SENDMAX
+
+
+def test_path_payment_strict_send(ledger, root):
+    issuer = root.create(10**10)
+    mm = root.create(10**10)
+    src = root.create(10**10)
+    dst = root.create(10**10)
+    usd = Asset.credit("USD", issuer.account_id)
+    for acct in (mm, dst):
+        assert acct.change_trust(usd, 10**12)
+    assert issuer.pay(mm, 10**6, usd)
+    assert ledger.apply_frame(
+        mm.tx([mm.op_manage_sell_offer(usd, XLM, 10**6, 2, 1)]))
+    from stellar_core_tpu.xdr import PathPaymentStrictSendOp
+    op = src.op(OperationBody(
+        OperationType.PATH_PAYMENT_STRICT_SEND,
+        PathPaymentStrictSendOp(
+            sendAsset=XLM, sendAmount=200, destination=dst.muxed,
+            destAsset=usd, destMin=90, path=[])))
+    f = src.tx([op])
+    assert ledger.apply_frame(f), f.result
+    assert ledger.trust_balance(dst.account_id, usd) == 100
+
+
+def test_fee_bump(ledger, root):
+    from stellar_core_tpu.transactions.transaction_frame import (
+        FeeBumpTransactionFrame,
+    )
+    from stellar_core_tpu.xdr import (
+        EnvelopeType, FeeBumpTransaction, FeeBumpTransactionEnvelope,
+        TransactionEnvelope, _Ext,
+    )
+    from stellar_core_tpu.xdr.transaction import _InnerTxEnvelope
+    a = root.create(10**9)
+    sponsor = root.create(10**9)
+    inner = a.tx([a.op_payment(root.account_id, 1)], fee=100)
+    fb = FeeBumpTransaction(
+        feeSource=sponsor.muxed, fee=1000,
+        innerTx=_InnerTxEnvelope(EnvelopeType.ENVELOPE_TYPE_TX,
+                                 inner.envelope.value),
+        ext=_Ext.v0())
+    env = TransactionEnvelope(
+        EnvelopeType.ENVELOPE_TYPE_TX_FEE_BUMP,
+        FeeBumpTransactionEnvelope(tx=fb, signatures=[]))
+    frame = FeeBumpTransactionFrame(ledger.network_id, env)
+    frame.add_signature(sponsor.sk)
+    bal_sponsor = sponsor.balance()
+    bal_a = a.balance()
+    assert ledger.apply_frame(frame), frame.result
+    # sponsor paid the fee, not a
+    assert sponsor.balance() < bal_sponsor
+    assert ledger.balance(a.account_id) == bal_a - 1
