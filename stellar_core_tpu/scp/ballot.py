@@ -1,0 +1,711 @@
+"""BallotProtocol: PREPARE → CONFIRM → EXTERNALIZE federated voting.
+
+Role parity: reference `src/scp/BallotProtocol.{h,cpp}` (2,244 lines; state
+machine entry points attemptAcceptPrepared / attemptConfirmPrepared /
+attemptAcceptCommit / attemptConfirmCommit, BallotProtocol.h:183-200).
+Implemented from the SCP internet-draft semantics:
+
+- a ballot is (counter, value); ballots totally ordered lexicographically,
+  "compatible" = same value.
+- PREPARE statement (b, p, p', nC, nH): votes prepare(b); accepts
+  prepare(p) and prepare(p'); votes commit(counters [nC, nH], b.value)
+  when nC > 0.
+- CONFIRM statement (b, nPrepared, nCommit, nH): accepts
+  prepare((nPrepared, b.value)); votes commit([nCommit, ∞), b.value);
+  accepts commit([nCommit, nH], b.value).
+- EXTERNALIZE statement (commit, nH): accepts commit([commit.counter, ∞)),
+  accepts prepare((∞, commit.value)).
+
+federated-accept(stmt-votes, stmt-accepts) = v-blocking set accepts, OR a
+quorum votes-or-accepts. federated-ratify = quorum accepts.
+"""
+
+from __future__ import annotations
+
+from typing import Callable, Dict, List, Optional, Set, Tuple
+
+from ..xdr import (
+    SCPBallot, SCPConfirm, SCPEnvelope, SCPExternalize, SCPPledges,
+    SCPPrepare, SCPStatement, SCPStatementType, Value,
+)
+from .local_node import LocalNode
+
+UINT32_MAX = 2**32 - 1
+
+Ballot = Tuple[int, bytes]  # (counter, value)
+
+
+def _bt(b: SCPBallot) -> Ballot:
+    return (b.counter, b.value)
+
+
+def _mk(b: Ballot) -> SCPBallot:
+    return SCPBallot(counter=b[0], value=b[1])
+
+
+def compatible(a: Ballot, b: Ballot) -> bool:
+    return a[1] == b[1]
+
+
+def less_and_compatible(a: Ballot, b: Ballot) -> bool:
+    return a <= b and compatible(a, b)
+
+
+def less_and_incompatible(a: Ballot, b: Ballot) -> bool:
+    return a <= b and not compatible(a, b)
+
+
+class SCPPhase:
+    PREPARE = 0
+    CONFIRM = 1
+    EXTERNALIZE = 2
+
+
+class BallotProtocol:
+    def __init__(self, slot) -> None:
+        self.slot = slot
+        self.phase = SCPPhase.PREPARE
+        self.b: Optional[Ballot] = None          # current ballot
+        self.p: Optional[Ballot] = None          # prepared
+        self.pp: Optional[Ballot] = None         # prepared prime
+        self.c: Optional[Ballot] = None          # commit (low)
+        self.h: Optional[Ballot] = None          # high
+        self.value_override: Optional[bytes] = None
+        self.latest_envelopes: Dict[bytes, SCPEnvelope] = {}
+        self.last_stmt_xdr: Optional[bytes] = None
+        self.heard_from_quorum = False
+        self.current_message_level = 0
+        self.timer_counter = 0
+
+    # ------------------------------------------------------------------ util
+    def _driver(self):
+        return self.slot.scp.driver
+
+    def _local(self) -> LocalNode:
+        return self.slot.scp.local_node
+
+    def _qset_of(self, st: SCPStatement):
+        return self.slot.get_quorum_set_from_statement(st)
+
+    # -------------------------------------------------- statement predicates
+    @staticmethod
+    def statement_ballot_counter(st: SCPStatement) -> int:
+        t = st.pledges.disc
+        if t == SCPStatementType.SCP_ST_PREPARE:
+            return st.pledges.value.ballot.counter
+        if t == SCPStatementType.SCP_ST_CONFIRM:
+            return st.pledges.value.ballot.counter
+        return UINT32_MAX  # EXTERNALIZE
+
+    @staticmethod
+    def is_statement_sane(st: SCPStatement, is_self: bool) -> bool:
+        t = st.pledges.disc
+        if t == SCPStatementType.SCP_ST_PREPARE:
+            p = st.pledges.value
+            b, pr, ppr = _bt(p.ballot), p.prepared, p.preparedPrime
+            if not (is_self or b[0] > 0):
+                return False
+            if pr is not None and ppr is not None:
+                if not (_bt(ppr) < _bt(pr) and
+                        not compatible(_bt(ppr), _bt(pr))):
+                    return False
+            if ppr is not None and pr is None:
+                return False
+            if p.nH > 0 and (pr is None or p.nH > pr.counter):
+                return False
+            if p.nC > 0 and not (p.nH > 0 and b[0] >= p.nH >= p.nC):
+                return False
+            return True
+        if t == SCPStatementType.SCP_ST_CONFIRM:
+            c = st.pledges.value
+            b = _bt(c.ballot)
+            return (b[0] > 0 and c.nH <= c.nPrepared and
+                    0 < c.nCommit <= c.nH <= b[0])
+        if t == SCPStatementType.SCP_ST_EXTERNALIZE:
+            e = st.pledges.value
+            return e.commit.counter > 0 and e.nH >= e.commit.counter
+        return False
+
+    # "st accepts prepare(ballot)"
+    @staticmethod
+    def has_prepared_ballot(ballot: Ballot, st: SCPStatement) -> bool:
+        t = st.pledges.disc
+        if t == SCPStatementType.SCP_ST_PREPARE:
+            p = st.pledges.value
+            return ((p.prepared is not None and
+                     less_and_compatible(ballot, _bt(p.prepared))) or
+                    (p.preparedPrime is not None and
+                     less_and_compatible(ballot, _bt(p.preparedPrime))))
+        if t == SCPStatementType.SCP_ST_CONFIRM:
+            c = st.pledges.value
+            prepared = (c.nPrepared, c.ballot.value)
+            return less_and_compatible(ballot, prepared)
+        e = st.pledges.value
+        return compatible(ballot, (0, e.commit.value))
+
+    # "st votes prepare(ballot)" (vote-or-accept)
+    @staticmethod
+    def votes_prepared(ballot: Ballot, st: SCPStatement) -> bool:
+        t = st.pledges.disc
+        if t == SCPStatementType.SCP_ST_PREPARE:
+            p = st.pledges.value
+            return (less_and_compatible(ballot, _bt(p.ballot)) or
+                    BallotProtocol.has_prepared_ballot(ballot, st))
+        if t == SCPStatementType.SCP_ST_CONFIRM:
+            c = st.pledges.value
+            return compatible(ballot, (0, c.ballot.value))
+        e = st.pledges.value
+        return compatible(ballot, (0, e.commit.value))
+
+    # commit interval predicates for value v over [lo, hi]
+    @staticmethod
+    def accepts_commit(v: bytes, lo: int, hi: int,
+                       st: SCPStatement) -> bool:
+        t = st.pledges.disc
+        if t == SCPStatementType.SCP_ST_CONFIRM:
+            c = st.pledges.value
+            return (c.ballot.value == v and
+                    c.nCommit <= lo and hi <= c.nH)
+        if t == SCPStatementType.SCP_ST_EXTERNALIZE:
+            e = st.pledges.value
+            return e.commit.value == v and e.commit.counter <= lo
+        return False
+
+    @staticmethod
+    def votes_commit(v: bytes, lo: int, hi: int, st: SCPStatement) -> bool:
+        t = st.pledges.disc
+        if t == SCPStatementType.SCP_ST_PREPARE:
+            p = st.pledges.value
+            return (p.ballot.value == v and p.nC > 0 and
+                    p.nC <= lo and hi <= p.nH)
+        if t == SCPStatementType.SCP_ST_CONFIRM:
+            c = st.pledges.value
+            return c.ballot.value == v and c.nCommit <= lo
+        e = st.pledges.value
+        return e.commit.value == v and e.commit.counter <= lo
+
+    # ------------------------------------------------------ federated voting
+    def _federated_accept(self, votes_pred: Callable, accepted_pred) -> bool:
+        local = self._local()
+        if LocalNode.is_v_blocking_filter(
+                local.qset, self.latest_envelopes.values(), accepted_pred):
+            return True
+
+        def vote_or_accept(st: SCPStatement) -> bool:
+            return votes_pred(st) or accepted_pred(st)
+        return LocalNode.is_quorum(
+            local.qset, self.latest_envelopes, self._qset_of,
+            vote_or_accept)
+
+    def _federated_ratify(self, accepted_pred: Callable) -> bool:
+        return LocalNode.is_quorum(
+            self._local().qset, self.latest_envelopes, self._qset_of,
+            accepted_pred)
+
+    # --------------------------------------------------------------- intake
+    class EnvelopeState:
+        INVALID = 0
+        VALID = 1
+
+    def process_envelope(self, envelope: SCPEnvelope, is_self: bool) -> int:
+        st = envelope.statement
+        nb = st.nodeID.key_bytes
+        if not self.is_statement_sane(st, is_self):
+            return self.EnvelopeState.INVALID
+        old = self.latest_envelopes.get(nb)
+        if old is not None and not self._is_newer(st, old.statement):
+            return self.EnvelopeState.INVALID
+        if not is_self and not self._validate_values(st):
+            return self.EnvelopeState.INVALID
+        self.latest_envelopes[nb] = envelope
+        self.advance_slot(st)
+        return self.EnvelopeState.VALID
+
+    @staticmethod
+    def _is_newer(st: SCPStatement, old: SCPStatement) -> bool:
+        tn, to = st.pledges.disc, old.pledges.disc
+        if tn != to:
+            order = {SCPStatementType.SCP_ST_PREPARE: 0,
+                     SCPStatementType.SCP_ST_CONFIRM: 1,
+                     SCPStatementType.SCP_ST_EXTERNALIZE: 2}
+            return order[tn] > order[to]
+        if tn == SCPStatementType.SCP_ST_PREPARE:
+            a, b = st.pledges.value, old.pledges.value
+            key_a = (_bt(a.ballot),
+                     _bt(a.prepared) if a.prepared else (0, b""),
+                     _bt(a.preparedPrime) if a.preparedPrime else (0, b""),
+                     a.nH)
+            key_b = (_bt(b.ballot),
+                     _bt(b.prepared) if b.prepared else (0, b""),
+                     _bt(b.preparedPrime) if b.preparedPrime else (0, b""),
+                     b.nH)
+            return key_a > key_b
+        if tn == SCPStatementType.SCP_ST_CONFIRM:
+            a, b = st.pledges.value, old.pledges.value
+            ka = (_bt(a.ballot), a.nPrepared, a.nCommit, a.nH)
+            kb = (_bt(b.ballot), b.nPrepared, b.nCommit, b.nH)
+            return ka > kb
+        return False  # EXTERNALIZE statements are final
+
+    def _validate_values(self, st: SCPStatement) -> bool:
+        from .driver import ValidationLevel
+        values = set()
+        t = st.pledges.disc
+        if t == SCPStatementType.SCP_ST_PREPARE:
+            p = st.pledges.value
+            if p.ballot.counter:
+                values.add(p.ballot.value)
+            if p.prepared is not None:
+                values.add(p.prepared.value)
+        elif t == SCPStatementType.SCP_ST_CONFIRM:
+            values.add(st.pledges.value.ballot.value)
+        else:
+            values.add(st.pledges.value.commit.value)
+        for v in values:
+            lvl = self._driver().validate_value(self.slot.slot_index, v,
+                                                False)
+            if lvl == ValidationLevel.INVALID:
+                return False
+        return True
+
+    # -------------------------------------------------------------- bumping
+    def bump_state(self, value: bytes, force: bool = True,
+                   counter: Optional[int] = None) -> bool:
+        if not force and self.b is not None:
+            return False
+        if self.phase != SCPPhase.PREPARE and \
+                self.phase != SCPPhase.CONFIRM:
+            return False
+        n = counter if counter is not None else (
+            1 if self.b is None else self.b[0] + 1)
+        if self.phase == SCPPhase.CONFIRM:
+            # value is locked in confirm phase
+            value = self.h[1]
+        target = (n, self.value_override
+                  if self.value_override is not None else
+                  (self.h[1] if self.h is not None else value))
+        if self.phase == SCPPhase.PREPARE and self.h is not None:
+            target = (n, self.h[1])
+        elif self.phase == SCPPhase.PREPARE:
+            target = (n, value)
+        updated = self._update_current_value(target)
+        if updated:
+            self._driver().started_ballot_protocol(
+                self.slot.slot_index, _mk(self.b))
+            self._emit_current_statement()
+            self._check_heard_from_quorum()
+        return updated
+
+    def _update_current_value(self, ballot: Ballot) -> bool:
+        if self.phase not in (SCPPhase.PREPARE, SCPPhase.CONFIRM):
+            return False
+        if self.b is None:
+            ok = True
+        elif self.phase == SCPPhase.CONFIRM and \
+                not compatible(ballot, self.b):
+            return False
+        elif self.b > ballot:
+            return False
+        elif self.b == ballot:
+            return False
+        else:
+            ok = True
+        # commit guard: cannot change value while c is set
+        if self.c is not None and not compatible(ballot, self.c):
+            return False
+        self.b = ballot
+        return ok
+
+    def abandon_ballot(self, n: int = 0) -> bool:
+        """Timer fired or externally poked: move to a higher counter with
+        the best known value (reference abandonBallot)."""
+        v = self.slot.get_latest_composite_candidate()
+        if not v:
+            if self.b is not None:
+                v = self.b[1]
+        if not v:
+            return False
+        if n == 0:
+            return self.bump_state(v, True)
+        return self.bump_state(v, True, n)
+
+    # ------------------------------------------------------- advance engine
+    def advance_slot(self, hint: SCPStatement) -> None:
+        self.current_message_level += 1
+        if self.current_message_level >= 50:
+            raise RuntimeError("maximum number of transitions reached")
+        did = True
+        while did:
+            did = False
+            self._update_current_if_needed(hint)
+            if self.attempt_accept_prepared(hint):
+                did = True
+            if self.attempt_confirm_prepared(hint):
+                did = True
+            if self.attempt_accept_commit(hint):
+                did = True
+            if self.attempt_confirm_commit(hint):
+                did = True
+        if self.current_message_level == 1:
+            # only check bump/quorum at the top of the reentrancy stack
+            self._attempt_bump()
+            self._check_heard_from_quorum()
+        self.current_message_level -= 1
+
+    def _update_current_if_needed(self, hint: SCPStatement) -> None:
+        if self.phase == SCPPhase.PREPARE and self.p is not None:
+            if self.b is None or self.b < self.p:
+                self._update_current_value(self.p)
+
+    # prepare candidates from all statements, descending
+    def _prepare_candidates(self) -> List[Ballot]:
+        out: Set[Ballot] = set()
+        for env in self.latest_envelopes.values():
+            st = env.statement
+            t = st.pledges.disc
+            if t == SCPStatementType.SCP_ST_PREPARE:
+                p = st.pledges.value
+                if p.ballot.counter:
+                    out.add(_bt(p.ballot))
+                if p.prepared is not None:
+                    out.add(_bt(p.prepared))
+                if p.preparedPrime is not None:
+                    out.add(_bt(p.preparedPrime))
+            elif t == SCPStatementType.SCP_ST_CONFIRM:
+                c = st.pledges.value
+                out.add((c.nPrepared, c.ballot.value))
+                out.add((UINT32_MAX, c.ballot.value))
+            else:
+                e = st.pledges.value
+                out.add((UINT32_MAX, e.commit.value))
+        return sorted(out, reverse=True)
+
+    def attempt_accept_prepared(self, hint: SCPStatement) -> bool:
+        if self.phase != SCPPhase.PREPARE and \
+                self.phase != SCPPhase.CONFIRM:
+            return False
+        for cand in self._prepare_candidates():
+            if self.phase == SCPPhase.CONFIRM:
+                # only interested in ballots compatible with commit value
+                if not (self.p is not None and
+                        less_and_compatible(cand, self.p)) and \
+                        not compatible(cand, self.h):
+                    continue
+            if self.p is not None and cand <= self.p:
+                break  # nothing new below current prepared
+            if self.pp is not None and cand <= self.pp:
+                continue
+            accepted = self._federated_accept(
+                lambda st, c=cand: self.votes_prepared(c, st),
+                lambda st, c=cand: self.has_prepared_ballot(c, st))
+            if accepted:
+                return self._set_prepared(cand)
+        return False
+
+    def _set_prepared(self, ballot: Ballot) -> bool:
+        did = False
+        if self.p is None or self.p < ballot:
+            if self.p is not None and not compatible(self.p, ballot):
+                if self.pp is None or self.pp < self.p:
+                    self.pp = self.p
+            self.p = ballot
+            did = True
+        elif self.p > ballot and not compatible(self.p, ballot):
+            if self.pp is None or self.pp < ballot:
+                self.pp = ballot
+                did = True
+        if did:
+            # abort commit if prepared aborts it: p incompatible >= c
+            if self.c is not None and self.h is not None:
+                incompatible = (
+                    (self.p is not None and
+                     less_and_incompatible(self.h, self.p)) or
+                    (self.pp is not None and
+                     less_and_incompatible(self.h, self.pp)))
+                if incompatible:
+                    self.c = None
+            self._driver().accepted_ballot_prepared(self.slot.slot_index,
+                                                    _mk(self.p))
+            self._emit_current_statement()
+        return did
+
+    def attempt_confirm_prepared(self, hint: SCPStatement) -> bool:
+        if self.phase != SCPPhase.PREPARE or self.p is None:
+            return False
+        # find highest ratified prepared ballot → h; then extend down to c
+        new_h = None
+        for cand in self._prepare_candidates():
+            if self.h is not None and cand <= self.h:
+                break
+            if self._federated_ratify(
+                    lambda st, c=cand: self.has_prepared_ballot(c, st)):
+                new_h = cand
+                break
+        if new_h is None:
+            return False
+        did = False
+        if self.h is None or new_h > self.h:
+            self.h = new_h
+            did = True
+            if self.b is not None and new_h > self.b:
+                self._update_current_value(new_h)
+        # compute c: lowest ballot such that the whole range [c, h] is
+        # confirmed prepared and nothing aborts it
+        if did and self.c is None and self.b is not None:
+            if self.p is not None and \
+                    less_and_incompatible(self.h, self.p):
+                pass
+            elif self.pp is not None and \
+                    less_and_incompatible(self.h, self.pp):
+                pass
+            elif self.b <= self.h and compatible(self.b, self.h):
+                new_c = None
+                for cand in sorted(self._prepare_candidates()):
+                    if cand < self.b:
+                        continue
+                    if not less_and_compatible(cand, self.h):
+                        continue
+                    if self._federated_ratify(
+                            lambda st, c=cand: self.has_prepared_ballot(
+                                c, st)):
+                        new_c = cand
+                        break
+                if new_c is not None:
+                    self.c = new_c
+        if did:
+            self._driver().confirmed_ballot_prepared(self.slot.slot_index,
+                                                     _mk(self.h))
+            self._emit_current_statement()
+        return did
+
+    # commit boundaries for a value
+    def _commit_boundaries(self, v: bytes) -> List[int]:
+        out: Set[int] = set()
+        for env in self.latest_envelopes.values():
+            st = env.statement
+            t = st.pledges.disc
+            if t == SCPStatementType.SCP_ST_PREPARE:
+                p = st.pledges.value
+                if p.ballot.value == v and p.nC > 0:
+                    out.add(p.nC)
+                    out.add(p.nH)
+            elif t == SCPStatementType.SCP_ST_CONFIRM:
+                c = st.pledges.value
+                if c.ballot.value == v:
+                    out.add(c.nCommit)
+                    out.add(c.nH)
+            else:
+                e = st.pledges.value
+                if e.commit.value == v:
+                    out.add(e.commit.counter)
+                    out.add(e.nH)
+        return sorted(out)
+
+    def _find_extended_interval(self, v: bytes, pred) -> Optional[
+            Tuple[int, int]]:
+        """Largest [lo, hi] over the boundary grid where pred holds for
+        every (lo, hi) — scanning from the top (reference
+        findExtendedInterval)."""
+        boundaries = self._commit_boundaries(v)
+        best: Optional[Tuple[int, int]] = None
+        cur: Optional[Tuple[int, int]] = None
+        for bval in reversed(boundaries):
+            if cur is None:
+                cand = (bval, bval)
+            else:
+                cand = (bval, cur[1])
+            if pred(cand[0], cand[1]):
+                cur = cand
+                best = cur
+            elif cur is not None:
+                break
+        return best
+
+    def attempt_accept_commit(self, hint: SCPStatement) -> bool:
+        if self.phase not in (SCPPhase.PREPARE, SCPPhase.CONFIRM):
+            return False
+        # work off the hint's ballot value
+        t = hint.pledges.disc
+        if t == SCPStatementType.SCP_ST_PREPARE:
+            p = hint.pledges.value
+            if p.nC == 0:
+                return False
+            ballot = (p.nH, p.ballot.value)
+        elif t == SCPStatementType.SCP_ST_CONFIRM:
+            c = hint.pledges.value
+            ballot = (c.nH, c.ballot.value)
+        else:
+            e = hint.pledges.value
+            ballot = (e.nH, e.commit.value)
+        if self.phase == SCPPhase.CONFIRM and \
+                not compatible(ballot, self.h):
+            return False
+        v = ballot[1]
+
+        def pred(lo: int, hi: int) -> bool:
+            return self._federated_accept(
+                lambda st: self.votes_commit(v, lo, hi, st),
+                lambda st: self.accepts_commit(v, lo, hi, st))
+        interval = self._find_extended_interval(v, pred)
+        if interval is None:
+            return False
+        lo, hi = interval
+        # sanity: don't regress
+        if self.phase == SCPPhase.CONFIRM and self.h is not None and \
+                hi <= self.h[0] and (self.c[0], self.h[0]) == (lo, hi):
+            return False
+        if self.phase == SCPPhase.PREPARE:
+            if self.p is not None and not compatible((0, v), self.p) and \
+                    self.p[0] >= lo:
+                # accepting commit of an aborted value would be unsafe
+                if not less_and_compatible((lo, v), self.p):
+                    pass
+            self.phase = SCPPhase.CONFIRM
+        self.c = (lo, v)
+        self.h = (hi, v)
+        if self.b is None or self.b[0] < hi or self.b[1] != v:
+            self.b = (max(hi, self.b[0] if self.b else 0), v)
+        self.p = (self.p[0], v) if (self.p and self.p[1] == v) else self.p
+        self._driver().accepted_commit(self.slot.slot_index, _mk(self.c))
+        self._emit_current_statement()
+        return True
+
+    def attempt_confirm_commit(self, hint: SCPStatement) -> bool:
+        if self.phase != SCPPhase.CONFIRM or self.c is None:
+            return False
+        v = self.c[1]
+
+        def pred(lo: int, hi: int) -> bool:
+            return self._federated_ratify(
+                lambda st: self.accepts_commit(v, lo, hi, st))
+        interval = self._find_extended_interval(v, pred)
+        if interval is None:
+            return False
+        lo, hi = interval
+        self.c = (lo, v)
+        self.h = (hi, v)
+        self.phase = SCPPhase.EXTERNALIZE
+        self._emit_current_statement()
+        self.slot.stop_nomination()
+        self._driver().value_externalized(self.slot.slot_index, v)
+        return True
+
+    def _attempt_bump(self) -> bool:
+        """v-blocking set is ahead → jump to their lowest counter
+        (repeat)."""
+        if self.phase not in (SCPPhase.PREPARE, SCPPhase.CONFIRM):
+            return False
+        did = False
+        while True:
+            prev_b = self.b
+            local_counter = self.b[0] if self.b is not None else 0
+            counters = sorted({self.statement_ballot_counter(e.statement)
+                               for e in self.latest_envelopes.values()
+                               if self.statement_ballot_counter(e.statement)
+                               > local_counter})
+            target = None
+            for n in counters:
+                if LocalNode.is_v_blocking_filter(
+                        self._local().qset, self.latest_envelopes.values(),
+                        lambda st, n=n:
+                        self.statement_ballot_counter(st) >= n):
+                    target = n
+                    # take the lowest v-blocking counter
+                    break
+            if target is None:
+                return did
+            self.abandon_ballot(target)
+            if self.b == prev_b:
+                return did  # bump had no effect; avoid spinning
+            did = True
+
+    # ------------------------------------------------------ timers / quorum
+    def _check_heard_from_quorum(self) -> None:
+        if self.b is None:
+            return
+        bn = self.b[0]
+
+        def pred(st: SCPStatement) -> bool:
+            return self.statement_ballot_counter(st) >= bn
+        if LocalNode.is_quorum(self._local().qset, self.latest_envelopes,
+                               self._qset_of, pred):
+            was = self.heard_from_quorum
+            self.heard_from_quorum = True
+            if self.phase != SCPPhase.EXTERNALIZE:
+                self._arm_timer()
+            if not was:
+                self._driver().ballot_did_hear_from_quorum(
+                    self.slot.slot_index, _mk(self.b))
+        else:
+            self.heard_from_quorum = False
+
+    def _arm_timer(self) -> None:
+        from .driver import SCPTimerID
+        if self.b is None or self.timer_counter == self.b[0]:
+            return
+        self.timer_counter = self.b[0]
+        timeout = self._driver().compute_timeout(self.b[0])
+        self._driver().setup_timer(
+            self.slot.slot_index, SCPTimerID.BALLOT, timeout,
+            self._on_timeout)
+
+    def _on_timeout(self) -> None:
+        self.timer_counter = 0
+        self.abandon_ballot(0)
+
+    # ------------------------------------------------------------- emission
+    def _make_statement(self) -> SCPStatement:
+        local = self._local()
+        qh = local.qset_hash
+        if self.phase == SCPPhase.PREPARE:
+            pl = SCPPledges(
+                SCPStatementType.SCP_ST_PREPARE,
+                SCPPrepare(
+                    quorumSetHash=qh,
+                    ballot=_mk(self.b) if self.b else SCPBallot(
+                        counter=0, value=b""),
+                    prepared=_mk(self.p) if self.p else None,
+                    preparedPrime=_mk(self.pp) if self.pp else None,
+                    nC=self.c[0] if self.c else 0,
+                    nH=self.h[0] if self.h else 0))
+        elif self.phase == SCPPhase.CONFIRM:
+            pl = SCPPledges(
+                SCPStatementType.SCP_ST_CONFIRM,
+                SCPConfirm(ballot=_mk(self.b),
+                           nPrepared=self.p[0],
+                           nCommit=self.c[0], nH=self.h[0],
+                           quorumSetHash=qh))
+        else:
+            pl = SCPPledges(
+                SCPStatementType.SCP_ST_EXTERNALIZE,
+                SCPExternalize(commit=_mk(self.c), nH=self.h[0],
+                               commitQuorumSetHash=qh))
+        return SCPStatement(nodeID=local.node_id,
+                            slotIndex=self.slot.slot_index, pledges=pl)
+
+    def _emit_current_statement(self) -> None:
+        st = self._make_statement()
+        env = self.slot.create_envelope(st)
+        # process our own statement first; broadcast only if it sticks
+        if self.process_envelope(env, is_self=True) == \
+                self.EnvelopeState.VALID:
+            sx = st.to_xdr()
+            if self.last_stmt_xdr != sx:
+                self.last_stmt_xdr = sx
+                if self._local().is_validator:
+                    self._driver().emit_envelope(env)
+
+    # --------------------------------------------------------------- state
+    def get_json_info(self) -> dict:
+        phase_names = {0: "PREPARE", 1: "CONFIRM", 2: "EXTERNALIZE"}
+        return {
+            "phase": phase_names[self.phase],
+            "ballot": {"counter": self.b[0]} if self.b else None,
+            "prepared": {"counter": self.p[0]} if self.p else None,
+            "heard": self.heard_from_quorum,
+        }
+
+    def externalized_value(self) -> Optional[bytes]:
+        if self.phase == SCPPhase.EXTERNALIZE:
+            return self.c[1]
+        return None

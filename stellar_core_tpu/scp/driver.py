@@ -1,0 +1,110 @@
+"""SCPDriver: the abstract callback surface binding SCP to its host.
+
+Role parity: reference `src/scp/SCPDriver.h:66-236` — value validation and
+combination, quorum-set lookup, envelope signing/verification/emission,
+timers, externalization notification, and hash functions for nomination
+leader election. Herder is the sole production subclass; tests mock it.
+"""
+
+from __future__ import annotations
+
+import struct
+from enum import Enum
+from typing import Optional
+
+from ..crypto.hashing import sha256
+from ..xdr import SCPEnvelope, SCPQuorumSet, NodeID, Value
+
+
+class ValidationLevel(Enum):
+    INVALID = 0
+    FULLY_VALIDATED = 1
+    MAYBE_VALID = 2
+
+
+class SCPTimerID:
+    NOMINATION = 0
+    BALLOT = 1
+
+
+class SCPDriver:
+    # -- values -------------------------------------------------------------
+    def validate_value(self, slot_index: int, value: bytes,
+                       nomination: bool) -> ValidationLevel:
+        return ValidationLevel.MAYBE_VALID
+
+    def extract_valid_value(self, slot_index: int,
+                            value: bytes) -> Optional[bytes]:
+        return None
+
+    def combine_candidates(self, slot_index: int,
+                           candidates: list) -> Optional[bytes]:
+        raise NotImplementedError
+
+    # -- envelopes ----------------------------------------------------------
+    def sign_envelope(self, envelope: SCPEnvelope) -> None:
+        raise NotImplementedError
+
+    def emit_envelope(self, envelope: SCPEnvelope) -> None:
+        raise NotImplementedError
+
+    # -- quorum sets --------------------------------------------------------
+    def get_qset(self, qset_hash: bytes) -> Optional[SCPQuorumSet]:
+        raise NotImplementedError
+
+    # -- timers -------------------------------------------------------------
+    def setup_timer(self, slot_index: int, timer_id: int, timeout: float,
+                    cb) -> None:
+        raise NotImplementedError
+
+    def compute_timeout(self, round_number: int) -> float:
+        """Linear backoff capped (reference computeTimeout: min(round, cap)
+        seconds with cap 30 * 60? — reference uses 1s per round up to
+        MAX_TIMEOUT_SECONDS=30*60)."""
+        return float(min(round_number, 30 * 60))
+
+    # -- notifications (all optional hooks) ---------------------------------
+    def value_externalized(self, slot_index: int, value: bytes) -> None:
+        pass
+
+    def nominating_value(self, slot_index: int, value: bytes) -> None:
+        pass
+
+    def updated_candidate_value(self, slot_index: int,
+                                value: bytes) -> None:
+        pass
+
+    def started_ballot_protocol(self, slot_index: int, ballot) -> None:
+        pass
+
+    def accepted_ballot_prepared(self, slot_index: int, ballot) -> None:
+        pass
+
+    def confirmed_ballot_prepared(self, slot_index: int, ballot) -> None:
+        pass
+
+    def accepted_commit(self, slot_index: int, ballot) -> None:
+        pass
+
+    def ballot_did_hear_from_quorum(self, slot_index: int, ballot) -> None:
+        pass
+
+    # -- hashing for nomination leader election -----------------------------
+    HASH_N = 1
+    HASH_P = 2
+    HASH_K = 3
+
+    def compute_hash_node(self, slot_index: int, prev: bytes,
+                          is_priority: bool, round_number: int,
+                          node_id: NodeID) -> int:
+        h = sha256(struct.pack(">Q", slot_index) + prev +
+                   struct.pack(">II", self.HASH_P if is_priority
+                               else self.HASH_N, round_number) +
+                   node_id.key_bytes)
+        return int.from_bytes(h[:8], "big")
+
+    def compute_value_hash(self, slot_index: int, prev: bytes,
+                           round_number: int, value: bytes) -> int:
+        h = sha256(struct.pack(">Q", slot_index) + prev +
+                   struct.pack(">II", self.HASH_K, round_number) + value)
+        return int.from_bytes(h[:8], "big")

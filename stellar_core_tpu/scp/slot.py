@@ -1,0 +1,98 @@
+"""Slot: one consensus round = nomination + ballot protocol instances.
+
+Role parity: reference `src/scp/Slot.{h,cpp}:121` — envelope dispatch,
+quorum-set resolution from statements, statement-to-envelope signing,
+externalization bookkeeping.
+"""
+
+from __future__ import annotations
+
+from typing import Dict, List, Optional
+
+from ..xdr import (
+    SCPEnvelope, SCPQuorumSet, SCPStatement, SCPStatementType,
+)
+from .ballot import BallotProtocol
+from .nomination import NominationProtocol
+
+
+class Slot:
+    def __init__(self, slot_index: int, scp) -> None:
+        self.slot_index = slot_index
+        self.scp = scp
+        self.nomination = NominationProtocol(self)
+        self.ballot = BallotProtocol(self)
+        self.fully_validated = scp.local_node.is_validator
+        self.got_v_blocking = False
+
+    # -- envelope plumbing --------------------------------------------------
+    def create_envelope(self, st: SCPStatement) -> SCPEnvelope:
+        env = SCPEnvelope(statement=st, signature=b"")
+        self.scp.driver.sign_envelope(env)
+        return env
+
+    def process_envelope(self, envelope: SCPEnvelope,
+                         is_self: bool = False) -> int:
+        st = envelope.statement
+        assert st.slotIndex == self.slot_index
+        if st.pledges.disc == SCPStatementType.SCP_ST_NOMINATE:
+            return self.nomination.process_envelope(envelope)
+        return self.ballot.process_envelope(envelope, is_self)
+
+    # -- quorum sets --------------------------------------------------------
+    def get_quorum_set_from_statement(
+            self, st: SCPStatement) -> Optional[SCPQuorumSet]:
+        t = st.pledges.disc
+        if t == SCPStatementType.SCP_ST_EXTERNALIZE:
+            h = st.pledges.value.commitQuorumSetHash
+        elif t == SCPStatementType.SCP_ST_NOMINATE:
+            h = st.pledges.value.quorumSetHash
+        elif t == SCPStatementType.SCP_ST_PREPARE:
+            h = st.pledges.value.quorumSetHash
+        else:
+            h = st.pledges.value.quorumSetHash
+        return self.scp.driver.get_qset(h)
+
+    # -- actions ------------------------------------------------------------
+    def nominate(self, value: bytes, previous_value: bytes,
+                 timed_out: bool = False) -> bool:
+        return self.nomination.nominate(value, previous_value, timed_out)
+
+    def bump_state(self, value: bytes, force: bool) -> bool:
+        return self.ballot.bump_state(value, force)
+
+    def stop_nomination(self) -> None:
+        self.nomination.stop_nomination()
+
+    def get_latest_composite_candidate(self) -> Optional[bytes]:
+        return self.nomination.latest_composite
+
+    # -- introspection ------------------------------------------------------
+    def get_latest_messages_send(self) -> List[SCPEnvelope]:
+        out = []
+        if self.nomination.last_envelope is not None:
+            out.append(self.nomination.last_envelope)
+        if self.ballot.last_stmt_xdr is not None:
+            # rebuild from latest own envelope
+            nb = self.scp.local_node.node_id.key_bytes
+            own = self.ballot.latest_envelopes.get(nb)
+            if own is not None:
+                out.append(own)
+        return out
+
+    def get_current_state(self) -> List[SCPEnvelope]:
+        """All latest envelopes known for this slot (for SCP state
+        re-broadcast)."""
+        out = list(self.nomination.latest_nominations.values())
+        out.extend(self.ballot.latest_envelopes.values())
+        return out
+
+    def externalized_value(self) -> Optional[bytes]:
+        return self.ballot.externalized_value()
+
+    def get_json_info(self) -> dict:
+        return {
+            "index": self.slot_index,
+            "nomination": self.nomination.get_json_info(),
+            "ballot": self.ballot.get_json_info(),
+        }
