@@ -1,0 +1,408 @@
+"""Herder: binds SCP to the ledger.
+
+Role parity: reference `src/herder/HerderImpl.{h,cpp}` +
+`HerderSCPDriver.{h,cpp}`:
+- slot = ledger sequence, value = XDR StellarValue(txset hash, closeTime,
+  upgrades)
+- envelope signature verify/sign (verifyEnvelope HerderImpl.cpp:1474 —
+  TPU batch hot caller #1, routed through the injected BatchSigVerifier)
+- tracking / not-tracking state machine with a consensus-stuck watchdog
+  (herder/readme.md)
+- triggerNextLedger (HerderImpl.cpp:743-832): queue → txset → trim →
+  surge → nominate
+- valueExternalized: persist SCP history, hand LedgerCloseData to the
+  ledger manager, update the tx queue, re-arm the trigger timer.
+"""
+
+from __future__ import annotations
+
+from typing import Dict, List, Optional, Tuple
+
+from ..crypto.hashing import sha256
+from ..scp.driver import SCPDriver, ValidationLevel
+from ..scp.scp import SCP
+from ..util.log import get_logger
+from ..util.timer import VirtualTimer
+from ..xdr import (
+    EnvelopeType, SCPEnvelope, SCPQuorumSet, StellarValue, StellarValueExt,
+    Uint32, Packer,
+)
+from ..ledger.ledger_manager import LedgerCloseData
+from .pending_envelopes import PendingEnvelopes, statement_qset_hash
+from .tx_queue import TransactionQueue, TxQueueResult
+from .txset import TxSetFrame
+from .upgrades import Upgrades
+
+log = get_logger("Herder")
+
+
+class HerderState:
+    HERDER_SYNCING_STATE = 0
+    HERDER_TRACKING_STATE = 1
+
+
+class HerderSCPDriver(SCPDriver):
+    """SCPDriver bound to a Herder (reference HerderSCPDriver.cpp)."""
+
+    def __init__(self, herder: "Herder") -> None:
+        self.herder = herder
+
+    # -- envelope signing ----------------------------------------------------
+    def _envelope_sign_bytes(self, st) -> bytes:
+        p = Packer()
+        p.put(self.herder.app.config.network_id)
+        Uint32.pack(p, EnvelopeType.ENVELOPE_TYPE_SCP)
+        p.put(st.to_xdr())
+        return sha256(p.bytes())
+
+    def sign_envelope(self, envelope: SCPEnvelope) -> None:
+        sk = self.herder.app.config.NODE_SEED
+        envelope.signature = sk.sign(
+            self._envelope_sign_bytes(envelope.statement))
+
+    def verify_envelope(self, envelope: SCPEnvelope) -> bool:
+        """HOT CALLER #1: one ed25519 verify per envelope."""
+        fut = self.herder.verifier.enqueue(
+            envelope.statement.nodeID, envelope.signature,
+            self._envelope_sign_bytes(envelope.statement))
+        self.herder.verifier.flush()
+        return fut.result()
+
+    def emit_envelope(self, envelope: SCPEnvelope) -> None:
+        self.herder.emit_envelope(envelope)
+
+    # -- values --------------------------------------------------------------
+    def _check_close_time(self, sv: StellarValue, slot_index: int) -> bool:
+        lm = self.herder.app.ledger_manager
+        lcl = lm.lcl_header
+        if slot_index == lcl.ledgerSeq + 1:
+            if sv.closeTime <= lcl.scpValue.closeTime:
+                return False
+        # reject implausible future close times (reference: MAX_TIME_SLIP)
+        now = self.herder.app.clock.system_now()
+        if sv.closeTime > now + 60:
+            return False
+        return True
+
+    def validate_value(self, slot_index: int, value: bytes,
+                       nomination: bool) -> ValidationLevel:
+        try:
+            sv = StellarValue.from_xdr(value)
+        except Exception:
+            return ValidationLevel.INVALID
+        if not self._check_close_time(sv, slot_index):
+            return ValidationLevel.INVALID
+        lm = self.herder.app.ledger_manager
+        if slot_index != lm.lcl_header.ledgerSeq + 1:
+            # not the slot we can fully validate against
+            return ValidationLevel.MAYBE_VALID
+        txset = self.herder.pending.get_tx_set(sv.txSetHash)
+        if txset is None:
+            return ValidationLevel.MAYBE_VALID
+        if nomination:
+            if txset.previous_ledger_hash != lm.lcl_hash:
+                return ValidationLevel.INVALID
+            ltx_root = lm.ltx_root()
+            ok, _removed = txset.check_or_trim(
+                ltx_root, self.herder.verifier, trim=False)
+            if not ok:
+                return ValidationLevel.INVALID
+            for raw in sv.upgrades:
+                if not Upgrades.is_valid_for_apply(raw, lm.lcl_header):
+                    return ValidationLevel.INVALID
+        return ValidationLevel.FULLY_VALIDATED
+
+    def extract_valid_value(self, slot_index: int,
+                            value: bytes) -> Optional[bytes]:
+        try:
+            sv = StellarValue.from_xdr(value)
+        except Exception:
+            return None
+        lm = self.herder.app.ledger_manager
+        # strip invalid upgrades and retry
+        upgrades = [u for u in sv.upgrades
+                    if Upgrades.is_valid_for_apply(u, lm.lcl_header)]
+        sv2 = StellarValue(txSetHash=sv.txSetHash, closeTime=sv.closeTime,
+                           upgrades=upgrades, ext=sv.ext)
+        v2 = sv2.to_xdr()
+        if self.validate_value(slot_index, v2, True) == \
+                ValidationLevel.FULLY_VALIDATED:
+            return v2
+        return None
+
+    def combine_candidates(self, slot_index: int,
+                           candidates: List[bytes]) -> Optional[bytes]:
+        """Best txset by (ops, fees, hash), max closeTime, merged upgrades
+        (reference HerderSCPDriver::combineCandidates:608)."""
+        best_sv: Optional[StellarValue] = None
+        best_key = None
+        max_close = 0
+        merged_upgrades: Dict[int, bytes] = {}
+        from ..xdr import LedgerUpgrade
+        for raw in candidates:
+            try:
+                sv = StellarValue.from_xdr(raw)
+            except Exception:
+                continue
+            max_close = max(max_close, sv.closeTime)
+            for u in sv.upgrades:
+                try:
+                    up = LedgerUpgrade.from_xdr(u)
+                except Exception:
+                    continue
+                cur = merged_upgrades.get(up.disc)
+                if cur is None or u > cur:
+                    merged_upgrades[up.disc] = u
+            txset = self.herder.pending.get_tx_set(sv.txSetHash)
+            ops = txset.size_ops() if txset is not None else 0
+            key = (ops, sv.txSetHash)
+            if best_key is None or key > best_key:
+                best_key = key
+                best_sv = sv
+        if best_sv is None:
+            return None
+        out = StellarValue(
+            txSetHash=best_sv.txSetHash, closeTime=max_close,
+            upgrades=[merged_upgrades[k] for k in sorted(merged_upgrades)],
+            ext=StellarValueExt(0, None))
+        return out.to_xdr()
+
+    # -- infrastructure ------------------------------------------------------
+    def get_qset(self, qset_hash: bytes) -> Optional[SCPQuorumSet]:
+        return self.herder.pending.get_quorum_set(qset_hash)
+
+    def setup_timer(self, slot_index: int, timer_id: int, timeout: float,
+                    cb) -> None:
+        self.herder.setup_scp_timer(slot_index, timer_id, timeout, cb)
+
+    def compute_timeout(self, round_number: int) -> float:
+        return float(min(round_number, 30 * 60))
+
+    def value_externalized(self, slot_index: int, value: bytes) -> None:
+        self.herder.value_externalized(slot_index, value)
+
+    def ballot_did_hear_from_quorum(self, slot_index, ballot) -> None:
+        self.herder.track_heartbeat()
+
+
+class Herder:
+    # how far ahead of the current slot envelopes are accepted
+    LEDGER_VALIDITY_BRACKET = 100
+
+    def __init__(self, app) -> None:
+        self.app = app
+        cfg = app.config
+        self.verifier = app.sig_verifier
+        self.scp_driver = HerderSCPDriver(self)
+        self.scp = SCP(self.scp_driver, cfg.node_id(),
+                       cfg.NODE_IS_VALIDATOR, cfg.QUORUM_SET)
+        self.pending = PendingEnvelopes(self)
+        self.tx_queue = TransactionQueue(
+            app.ledger_manager, cfg.TRANSACTION_QUEUE_PENDING_DEPTH,
+            cfg.TRANSACTION_QUEUE_BAN_DEPTH, cfg.POOL_LEDGER_MULTIPLIER,
+            self.verifier)
+        self.upgrades = Upgrades()
+        self.state = HerderState.HERDER_SYNCING_STATE
+        self.tracking_slot: Optional[int] = None
+        self._scp_timers: Dict[Tuple[int, int], VirtualTimer] = {}
+        self.trigger_timer = VirtualTimer(app.clock)
+        self.stuck_timer = VirtualTimer(app.clock)
+        self.ledger_close_meta = None
+        # register own qset
+        q = cfg.QUORUM_SET
+        self.pending.add_quorum_set(sha256(q.to_xdr()), q)
+
+    # -- state machine -------------------------------------------------------
+    def bootstrap(self) -> None:
+        """FORCE_SCP start (reference Herder::bootstrap)."""
+        cfg = self.app.config
+        assert cfg.FORCE_SCP
+        self.set_tracking(self.app.ledger_manager.last_closed_ledger_num())
+        self.app.ledger_manager.state = 1  # synced
+        if not cfg.MANUAL_CLOSE:
+            self._arm_trigger_timer()
+
+    def set_tracking(self, slot: int) -> None:
+        self.state = HerderState.HERDER_TRACKING_STATE
+        self.tracking_slot = slot
+        self.track_heartbeat()
+
+    def track_heartbeat(self) -> None:
+        cfg = self.app.config
+        self.stuck_timer.expires_from_now(
+            cfg.CONSENSUS_STUCK_TIMEOUT_SECONDS)
+        self.stuck_timer.async_wait(self._lost_sync)
+
+    def _lost_sync(self) -> None:
+        log.warning("lost consensus sync (stuck timer fired)")
+        self.state = HerderState.HERDER_SYNCING_STATE
+        hook = getattr(self.app, "out_of_sync_recovery", None)
+        if hook is not None:
+            hook()
+
+    def current_slot(self) -> int:
+        return self.app.ledger_manager.last_closed_ledger_num() + 1
+
+    # -- transaction intake --------------------------------------------------
+    def recv_transaction(self, frame) -> int:
+        """HOT CALLER #2 via TransactionQueue.try_add → checkValid."""
+        return self.tx_queue.try_add(frame)
+
+    # -- SCP envelope intake -------------------------------------------------
+    def recv_scp_envelope(self, envelope: SCPEnvelope) -> int:
+        st = envelope.statement
+        slot = st.slotIndex
+        cur = self.current_slot()
+        if slot < max(1, cur - 1) or \
+                slot > cur + self.LEDGER_VALIDITY_BRACKET:
+            return SCP.EnvelopeState.INVALID
+        if not self.scp_driver.verify_envelope(envelope):
+            log.debug("bad envelope signature")
+            return SCP.EnvelopeState.INVALID
+        self.pending.recv_scp_envelope(envelope)
+        return SCP.EnvelopeState.VALID
+
+    def envelope_ready(self, envelope: SCPEnvelope) -> None:
+        """Called by PendingEnvelopes when deps are present."""
+        self.scp.receive_envelope(envelope)
+
+    def recv_tx_set(self, h: bytes, txset: TxSetFrame) -> bool:
+        if txset.get_contents_hash() != h:
+            return False
+        self.pending.add_tx_set(h, txset)
+        return True
+
+    def recv_scp_quorum_set(self, h: bytes, qset: SCPQuorumSet) -> bool:
+        if sha256(qset.to_xdr()) != h:
+            return False
+        self.pending.add_quorum_set(h, qset)
+        return True
+
+    def emit_envelope(self, envelope: SCPEnvelope) -> None:
+        self.persist_scp_state(envelope)
+        overlay = getattr(self.app, "overlay_manager", None)
+        if overlay is not None:
+            from ..xdr import MessageType, StellarMessage
+            overlay.broadcast_message(
+                StellarMessage(MessageType.SCP_MESSAGE, envelope), False)
+
+    # -- nomination ----------------------------------------------------------
+    def trigger_next_ledger(self, ledger_seq_to_trigger: int) -> None:
+        lm = self.app.ledger_manager
+        cfg = self.app.config
+        lcl = lm.lcl_header
+        slot = lcl.ledgerSeq + 1
+        if ledger_seq_to_trigger != slot:
+            log.debug("stale trigger for %d (slot %d)",
+                      ledger_seq_to_trigger, slot)
+            return
+        txset = self.tx_queue.to_txset(lm.lcl_hash, cfg.network_id)
+        removed = txset.trim_invalid(lm.ltx_root(), self.verifier)
+        if removed:
+            self.tx_queue.ban([f.full_hash() for f in removed])
+        txset.surge_pricing_filter(lcl)
+        h = txset.get_contents_hash()
+        self.pending.add_tx_set(h, txset)
+
+        close_time = max(self.app.clock.system_now(),
+                         lcl.scpValue.closeTime + 1)
+        upgrades = self.upgrades.create_upgrades_for(lcl, close_time)
+        value = StellarValue(txSetHash=h, closeTime=close_time,
+                             upgrades=upgrades,
+                             ext=StellarValueExt(0, None))
+        prev = lcl.scpValue.to_xdr()
+        self.scp.nominate(slot, value.to_xdr(), prev)
+
+    def _arm_trigger_timer(self) -> None:
+        cfg = self.app.config
+        seconds = 0.001 if cfg.ARTIFICIALLY_ACCELERATE_TIME_FOR_TESTING \
+            else cfg.EXPECTED_LEDGER_CLOSE_TIME
+        slot = self.current_slot()
+        self.trigger_timer.expires_from_now(seconds)
+        self.trigger_timer.async_wait(
+            lambda: self.trigger_next_ledger(slot))
+
+    # -- externalization -----------------------------------------------------
+    def value_externalized(self, slot_index: int, value: bytes) -> None:
+        sv = StellarValue.from_xdr(value)
+        txset = self.pending.get_tx_set(sv.txSetHash)
+        assert txset is not None, "externalized unknown txset"
+        self.set_tracking(slot_index)
+        self.persist_latest_scp_state(slot_index)
+
+        lm = self.app.ledger_manager
+        lcd = LedgerCloseData(slot_index, txset, sv)
+        lm.value_externalized(lcd)
+
+        # tx queue maintenance
+        self.tx_queue.remove_applied(list(txset.frames))
+        self.tx_queue.shift()
+
+        # GC old slots + pending state
+        keep_from = max(1, slot_index -
+                        self.app.config.MAX_SLOTS_TO_REMEMBER + 1)
+        self.scp.purge_slots(keep_from)
+        self.pending.erase_below(keep_from)
+
+        if not self.app.config.MANUAL_CLOSE:
+            self._arm_trigger_timer()
+
+    # -- SCP timers ----------------------------------------------------------
+    def setup_scp_timer(self, slot_index: int, timer_id: int,
+                        timeout: float, cb) -> None:
+        key = (slot_index, timer_id)
+        t = self._scp_timers.get(key)
+        if t is None:
+            t = VirtualTimer(self.app.clock)
+            self._scp_timers[key] = t
+        t.cancel()
+        if cb is None:
+            return
+        t.expires_from_now(timeout)
+        t.async_wait(cb)
+
+    # -- persistence ---------------------------------------------------------
+    def persist_scp_state(self, envelope: SCPEnvelope) -> None:
+        pass  # per-envelope persistence folded into persist_latest_scp_state
+
+    def persist_latest_scp_state(self, slot_index: int) -> None:
+        db = getattr(self.app, "database", None)
+        if db is None:
+            return
+        import base64
+        envs = self.scp.get_latest_messages_send(slot_index)
+        blob = b"".join(len(e.to_xdr()).to_bytes(4, "big") + e.to_xdr()
+                        for e in envs)
+        db.set_state("scphistory", base64.b64encode(blob).decode())
+        db.commit()
+
+    def restore_scp_state(self) -> None:
+        db = getattr(self.app, "database", None)
+        if db is None:
+            return
+        import base64
+        raw = db.get_state("scphistory")
+        if not raw:
+            return
+        blob = base64.b64decode(raw)
+        i = 0
+        while i + 4 <= len(blob):
+            n = int.from_bytes(blob[i:i + 4], "big")
+            i += 4
+            try:
+                env = SCPEnvelope.from_xdr(blob[i:i + n])
+                self.scp.set_state_from_envelope(env)
+            except Exception:
+                pass
+            i += n
+
+    # -- introspection -------------------------------------------------------
+    def get_json_info(self) -> dict:
+        return {
+            "you": self.app.config.NODE_SEED.strkey_public(),
+            "state": ("tracking" if self.state ==
+                      HerderState.HERDER_TRACKING_STATE else "syncing"),
+            "slot": self.tracking_slot,
+            "queue_ops": self.tx_queue.size_ops(),
+            "scp": self.scp.get_json_info(),
+        }

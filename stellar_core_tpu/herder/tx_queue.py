@@ -1,0 +1,161 @@
+"""TransactionQueue: the pending transaction pool.
+
+Role parity: reference `src/herder/TransactionQueue.{h,cpp}:25-227`:
+- per-account chains sorted by sequence number
+- age-based expiry: txs not included within pendingDepth (4) ledgers are
+  dropped and banned for banDepth (10) ledgers
+- replace-by-fee requires >= 10x the old fee (FEE_MULTIPLIER)
+- pool cap: maxTxSetSize * poolLedgerMultiplier ops
+- tryAdd runs full checkValid — TPU batch-verify hot caller #2
+"""
+
+from __future__ import annotations
+
+from typing import Dict, List, Optional, Tuple
+
+from ..ledger.ledgertxn import LedgerTxn
+from ..util.log import get_logger
+from .txset import TxSetFrame
+
+log = get_logger("Herder")
+
+
+class TxQueueResult:
+    ADD_STATUS_PENDING = 0
+    ADD_STATUS_DUPLICATE = 1
+    ADD_STATUS_ERROR = 2
+    ADD_STATUS_TRY_AGAIN_LATER = 3
+    ADD_STATUS_FILTERED = 4
+
+
+class TransactionQueue:
+    FEE_MULTIPLIER = 10
+
+    def __init__(self, ledger_access, pending_depth: int = 4,
+                 ban_depth: int = 10, pool_ledger_multiplier: int = 2,
+                 verifier=None) -> None:
+        """ledger_access: object exposing .ltx_root() and .header()."""
+        self._ledger = ledger_access
+        self.pending_depth = pending_depth
+        self.ban_depth = ban_depth
+        self.pool_multiplier = pool_ledger_multiplier
+        self.verifier = verifier
+        # account -> list[(age, frame)] sorted by seq
+        self._pending: Dict[bytes, List[Tuple[int, object]]] = {}
+        self._known_hashes: Dict[bytes, bytes] = {}  # full hash -> acc
+        self._banned: List[set] = [set() for _ in range(ban_depth)]
+
+    # -- queries ------------------------------------------------------------
+    def size_ops(self) -> int:
+        return sum(f.num_operations() for chain in self._pending.values()
+                   for _, f in chain)
+
+    def is_banned(self, tx_hash: bytes) -> bool:
+        return any(tx_hash in b for b in self._banned)
+
+    def pool_cap_ops(self) -> int:
+        return self._ledger.header().maxTxSetSize * self.pool_multiplier
+
+    # -- add ----------------------------------------------------------------
+    def try_add(self, frame) -> int:
+        h = frame.full_hash()
+        if h in self._known_hashes:
+            return TxQueueResult.ADD_STATUS_DUPLICATE
+        if self.is_banned(h):
+            return TxQueueResult.ADD_STATUS_TRY_AGAIN_LATER
+        if self.size_ops() + frame.num_operations() > self.pool_cap_ops():
+            return TxQueueResult.ADD_STATUS_TRY_AGAIN_LATER
+
+        acc = frame.source_account_id().key_bytes
+        chain = self._pending.get(acc, [])
+        # replace-by-fee: same seqnum present?
+        replace_idx = None
+        for i, (_, f) in enumerate(chain):
+            if f.seq_num == frame.seq_num:
+                if frame.fee_bid < f.fee_bid * self.FEE_MULTIPLIER:
+                    return TxQueueResult.ADD_STATUS_ERROR
+                replace_idx = i
+                break
+        # sequence continuity: must extend the chain (or replace)
+        cur_seq = self._account_seq(acc)
+        expected = cur_seq + 1 + sum(
+            1 for i, (_, f) in enumerate(chain) if i != replace_idx)
+        if replace_idx is None and frame.seq_num != expected:
+            return TxQueueResult.ADD_STATUS_ERROR
+
+        # full validity check against current ledger — hot verify site
+        ltx = LedgerTxn(self._ledger.ltx_root())
+        try:
+            seq_base = frame.seq_num - 1
+            if not frame.check_valid(ltx, seq_base, self.verifier):
+                return TxQueueResult.ADD_STATUS_ERROR
+        finally:
+            ltx.rollback()
+
+        if replace_idx is not None:
+            old = chain[replace_idx][1]
+            del self._known_hashes[old.full_hash()]
+            self.ban([old.full_hash()])
+            chain[replace_idx] = (0, frame)
+        else:
+            chain.append((0, frame))
+            chain.sort(key=lambda t: t[1].seq_num)
+        self._pending[acc] = chain
+        self._known_hashes[h] = acc
+        return TxQueueResult.ADD_STATUS_PENDING
+
+    def _account_seq(self, acc: bytes) -> int:
+        from ..xdr import LedgerKey, PublicKey
+        e = self._ledger.ltx_root().get_entry(
+            LedgerKey.account(PublicKey.ed25519(acc)))
+        return e.data.value.seqNum if e is not None else 0
+
+    # -- ledger-close maintenance -------------------------------------------
+    def remove_applied(self, frames: List) -> None:
+        for f in frames:
+            h = f.full_hash()
+            acc = self._known_hashes.pop(h, None)
+            if acc is None:
+                # also drop any pending tx with same (acc, seq<=applied)
+                acc = f.source_account_id().key_bytes
+            chain = self._pending.get(acc)
+            if not chain:
+                continue
+            new_chain = [(age, g) for age, g in chain
+                         if g.seq_num > f.seq_num]
+            for age, g in chain:
+                if g.seq_num <= f.seq_num and g.full_hash() != h:
+                    self._known_hashes.pop(g.full_hash(), None)
+            if new_chain:
+                self._pending[acc] = new_chain
+            else:
+                self._pending.pop(acc, None)
+
+    def shift(self) -> None:
+        """Age everything one ledger; expire and ban old txs (reference
+        shift + ban)."""
+        self._banned.pop()
+        self._banned.insert(0, set())
+        for acc in list(self._pending):
+            chain = self._pending[acc]
+            new_chain = []
+            for age, f in chain:
+                age += 1
+                if age >= self.pending_depth:
+                    self._banned[0].add(f.full_hash())
+                    self._known_hashes.pop(f.full_hash(), None)
+                else:
+                    new_chain.append((age, f))
+            if new_chain:
+                self._pending[acc] = new_chain
+            else:
+                self._pending.pop(acc, None)
+
+    def ban(self, hashes: List[bytes]) -> None:
+        self._banned[0].update(hashes)
+
+    # -- txset construction ---------------------------------------------------
+    def to_txset(self, lcl_hash: bytes, network_id: bytes) -> TxSetFrame:
+        frames = [f for chain in self._pending.values()
+                  for _, f in chain]
+        return TxSetFrame(network_id, lcl_hash, frames)

@@ -1,0 +1,216 @@
+"""TxSetFrame: the content of a consensus value.
+
+Role parity: reference `src/herder/TxSetFrame.{h,cpp}`:
+- canonical order: sort by full envelope hash (TxSetFrame.cpp:61)
+- apply order: per-account sequence order, accounts interleaved by a
+  hash-XOR shuffle so apply order isn't gameable (TxSetFrame.cpp:101-148)
+- surge pricing: when over capacity, keep the highest fee-per-op txs
+  (TxSetFrame.cpp:150-275)
+- validity: per-tx checkValid + per-account seq chains + fee balance
+  (checkOrTrim, TxSetFrame.cpp:277-359) — a TPU batch-verify hot caller
+- contents hash: SHA256(previousLedgerHash ‖ sorted envelopes)
+  (TxSetFrame.cpp:418-434)
+"""
+
+from __future__ import annotations
+
+from typing import Dict, List, Optional, Tuple
+
+from ..crypto.hashing import SHA256, sha256
+from ..ledger.ledgertxn import LedgerTxn
+from ..transactions.transaction_frame import (
+    FeeBumpTransactionFrame, TransactionFrame,
+)
+from ..xdr import TransactionEnvelope, TransactionSet
+
+AnyFrame = object  # TransactionFrame | FeeBumpTransactionFrame
+
+
+def _xor(a: bytes, b: bytes) -> bytes:
+    return bytes(x ^ y for x, y in zip(a, b))
+
+
+class TxSetFrame:
+    def __init__(self, network_id: bytes, previous_ledger_hash: bytes,
+                 frames: Optional[List[AnyFrame]] = None) -> None:
+        self.network_id = network_id
+        self.previous_ledger_hash = previous_ledger_hash
+        self.frames: List[AnyFrame] = list(frames or [])
+        self._hash: Optional[bytes] = None
+
+    @classmethod
+    def from_wire(cls, network_id: bytes,
+                  xdr_set: TransactionSet) -> "TxSetFrame":
+        frames = [TransactionFrame.make_from_wire(network_id, env)
+                  for env in xdr_set.txs]
+        return cls(network_id, xdr_set.previousLedgerHash, frames)
+
+    def to_wire(self) -> TransactionSet:
+        return TransactionSet(
+            previousLedgerHash=self.previous_ledger_hash,
+            txs=[f.envelope for f in self.sorted_for_hash()])
+
+    # -- ordering -----------------------------------------------------------
+    def sorted_for_hash(self) -> List[AnyFrame]:
+        return sorted(self.frames, key=lambda f: f.full_hash())
+
+    def sort_for_apply(self) -> List[AnyFrame]:
+        """Deterministic shuffled apply order: group per source account in
+        seq order, then round-robin accounts ordered by
+        (account_id XOR set_hash)."""
+        by_acc: Dict[bytes, List[AnyFrame]] = {}
+        for f in self.sorted_for_hash():
+            by_acc.setdefault(f.source_account_id().key_bytes,
+                              []).append(f)
+        for chain in by_acc.values():
+            chain.sort(key=lambda f: f.seq_num)
+        h = self.get_contents_hash()
+        order = sorted(by_acc, key=lambda acc: _xor(acc, h))
+        out: List[AnyFrame] = []
+        queues = {acc: list(chain) for acc, chain in by_acc.items()}
+        while queues:
+            for acc in list(order):
+                chain = queues.get(acc)
+                if not chain:
+                    queues.pop(acc, None)
+                    continue
+                out.append(chain.pop(0))
+        return out
+
+    # -- size / fees --------------------------------------------------------
+    def size_ops(self) -> int:
+        return sum(f.num_operations() for f in self.frames)
+
+    def size_txs(self) -> int:
+        return len(self.frames)
+
+    def base_fee(self, header) -> Optional[int]:
+        """Per-set effective base fee: when surge-priced, the lowest
+        fee-per-op among included txs (reference computeBaseFee
+        TxSetFrame.cpp:466-495)."""
+        if self.size_ops() <= header.maxTxSetSize:
+            return None  # protocol base fee applies
+        lowest = None
+        for f in self.frames:
+            per_op = f.fee_bid // max(1, f.num_operations())
+            if lowest is None or per_op < lowest:
+                lowest = per_op
+        return max(lowest or header.baseFee, header.baseFee)
+
+    def _fee_rate_key(self, f: AnyFrame) -> Tuple:
+        # higher fee per op first; tie-break by full hash
+        ops = max(1, f.num_operations())
+        return (f.fee_bid * 2**32 // ops, f.full_hash())
+
+    def surge_pricing_filter(self, header) -> None:
+        """Trim to maxTxSetSize ops keeping highest fee-per-op, whole
+        account chains at a time (reference surgePricingFilter)."""
+        max_ops = header.maxTxSetSize
+        if self.size_ops() <= max_ops:
+            return
+        by_acc: Dict[bytes, List[AnyFrame]] = {}
+        for f in self.frames:
+            by_acc.setdefault(f.source_account_id().key_bytes,
+                              []).append(f)
+        for chain in by_acc.values():
+            chain.sort(key=lambda f: f.seq_num)
+        # a chain's priority is its lowest fee-rate tx (can't include later
+        # txs without earlier ones)
+        included: List[AnyFrame] = []
+        ops_used = 0
+        chains = list(by_acc.values())
+        # greedy: repeatedly take the head tx with best fee rate
+        heads = [(c, 0) for c in chains]
+        import heapq
+        heap = []
+        for ci, (c, idx) in enumerate(heads):
+            f = c[0]
+            heapq.heappush(heap, (tuple(-x if isinstance(x, int) else x
+                                        for x in self._fee_rate_key(f)[:1]) +
+                                  (f.full_hash(),), ci, 0))
+        heads_idx = [0] * len(chains)
+        while heap:
+            _, ci, idx = heapq.heappop(heap)
+            if idx != heads_idx[ci]:
+                continue
+            f = chains[ci][idx]
+            if ops_used + f.num_operations() > max_ops:
+                break
+            included.append(f)
+            ops_used += f.num_operations()
+            heads_idx[ci] += 1
+            if heads_idx[ci] < len(chains[ci]):
+                nf = chains[ci][heads_idx[ci]]
+                heapq.heappush(
+                    heap,
+                    (tuple(-x if isinstance(x, int) else x
+                           for x in self._fee_rate_key(nf)[:1]) +
+                     (nf.full_hash(),), ci, heads_idx[ci]))
+        self.frames = included
+        self._hash = None
+
+    # -- validity -----------------------------------------------------------
+    def check_or_trim(self, ltx_parent, verifier=None,
+                      trim: bool = False) -> Tuple[bool, List[AnyFrame]]:
+        """Validate every tx (seq chains per account, checkValid, whole-
+        chain fee balance). trim=True removes invalid txs (and their
+        dependents); returns (all_valid, trimmed)."""
+        removed: List[AnyFrame] = []
+        by_acc: Dict[bytes, List[AnyFrame]] = {}
+        for f in self.frames:
+            by_acc.setdefault(f.source_account_id().key_bytes,
+                              []).append(f)
+        keep: List[AnyFrame] = []
+        for acc, chain in sorted(by_acc.items()):
+            chain.sort(key=lambda f: f.seq_num)
+            ltx = LedgerTxn(ltx_parent)
+            try:
+                from ..xdr import LedgerKey, PublicKey
+                acc_entry = ltx.load_without_record(
+                    LedgerKey.account(PublicKey.ed25519(acc)))
+                if acc_entry is None:
+                    removed.extend(chain)
+                    continue
+                cur_seq = acc_entry.data.value.seqNum
+                total_fee = 0
+                chain_ok: List[AnyFrame] = []
+                bad = False
+                for f in chain:
+                    if bad or not f.check_valid(ltx, cur_seq, verifier):
+                        removed.append(f)
+                        bad = True  # later txs have broken seq chain
+                        continue
+                    cur_seq = f.seq_num
+                    total_fee += f.fee_charged(ltx.load_header())
+                    chain_ok.append(f)
+                if chain_ok and \
+                        acc_entry.data.value.balance < total_fee:
+                    removed.extend(chain_ok)
+                    chain_ok = []
+                keep.extend(chain_ok)
+            finally:
+                ltx.rollback()
+        if trim:
+            self.frames = keep
+            self._hash = None
+            return (not removed), removed
+        return (not removed), removed
+
+    def trim_invalid(self, ltx_parent, verifier=None) -> List[AnyFrame]:
+        _, removed = self.check_or_trim(ltx_parent, verifier, trim=True)
+        return removed
+
+    def check_valid(self, ltx_parent, verifier=None) -> bool:
+        lcl_hash = getattr(ltx_parent, "lcl_hash", None)
+        ok, _ = self.check_or_trim(ltx_parent, verifier, trim=False)
+        return ok
+
+    # -- hashing ------------------------------------------------------------
+    def get_contents_hash(self) -> bytes:
+        if self._hash is None:
+            h = SHA256()
+            h.add(self.previous_ledger_hash)
+            for f in self.sorted_for_hash():
+                h.add(f.envelope.to_xdr())
+            self._hash = h.finish()
+        return self._hash

@@ -1,0 +1,108 @@
+"""Upgrades: protocol/fee/size/reserve upgrade voting.
+
+Role parity: reference `src/herder/Upgrades.{h,cpp}` — armed via config or
+the HTTP admin endpoint, nominated inside StellarValue.upgrades, validated
+against scheduled parameters, applied at ledger close (after txs).
+"""
+
+from __future__ import annotations
+
+import time
+from typing import List, Optional
+
+from ..xdr import LedgerHeader, LedgerUpgrade, LedgerUpgradeType
+
+
+class UpgradeParameters:
+    def __init__(self) -> None:
+        self.upgrade_time: int = 0
+        self.protocol_version: Optional[int] = None
+        self.base_fee: Optional[int] = None
+        self.max_tx_set_size: Optional[int] = None
+        self.base_reserve: Optional[int] = None
+
+    def to_json(self) -> dict:
+        return {
+            "time": self.upgrade_time,
+            "version": self.protocol_version,
+            "fee": self.base_fee,
+            "maxtxsize": self.max_tx_set_size,
+            "reserve": self.base_reserve,
+        }
+
+
+class Upgrades:
+    def __init__(self, params: Optional[UpgradeParameters] = None) -> None:
+        self.params = params or UpgradeParameters()
+
+    def set_parameters(self, params: UpgradeParameters) -> None:
+        self.params = params
+
+    def create_upgrades_for(self, header: LedgerHeader,
+                            close_time: int) -> List[bytes]:
+        """Upgrades to nominate, given the current header (reference
+        createUpgradesFor)."""
+        out: List[bytes] = []
+        p = self.params
+        if close_time < p.upgrade_time:
+            return out
+        if p.protocol_version is not None and \
+                p.protocol_version != header.ledgerVersion:
+            out.append(LedgerUpgrade(
+                LedgerUpgradeType.LEDGER_UPGRADE_VERSION,
+                p.protocol_version).to_xdr())
+        if p.base_fee is not None and p.base_fee != header.baseFee:
+            out.append(LedgerUpgrade(
+                LedgerUpgradeType.LEDGER_UPGRADE_BASE_FEE,
+                p.base_fee).to_xdr())
+        if p.max_tx_set_size is not None and \
+                p.max_tx_set_size != header.maxTxSetSize:
+            out.append(LedgerUpgrade(
+                LedgerUpgradeType.LEDGER_UPGRADE_MAX_TX_SET_SIZE,
+                p.max_tx_set_size).to_xdr())
+        if p.base_reserve is not None and \
+                p.base_reserve != header.baseReserve:
+            out.append(LedgerUpgrade(
+                LedgerUpgradeType.LEDGER_UPGRADE_BASE_RESERVE,
+                p.base_reserve).to_xdr())
+        return out
+
+    def is_valid_for_nomination(self, raw: bytes, header: LedgerHeader,
+                                close_time: int) -> bool:
+        """Would we vote for this upgrade? (reference isValid w/ nomination
+        mode)."""
+        try:
+            up = LedgerUpgrade.from_xdr(raw)
+        except Exception:
+            return False
+        p = self.params
+        if close_time < p.upgrade_time:
+            return False
+        t = up.disc
+        if t == LedgerUpgradeType.LEDGER_UPGRADE_VERSION:
+            return up.value == p.protocol_version
+        if t == LedgerUpgradeType.LEDGER_UPGRADE_BASE_FEE:
+            return up.value == p.base_fee
+        if t == LedgerUpgradeType.LEDGER_UPGRADE_MAX_TX_SET_SIZE:
+            return up.value == p.max_tx_set_size
+        if t == LedgerUpgradeType.LEDGER_UPGRADE_BASE_RESERVE:
+            return up.value == p.base_reserve
+        return False
+
+    @staticmethod
+    def is_valid_for_apply(raw: bytes, header: LedgerHeader) -> bool:
+        """Structurally applicable? (applied even if we didn't vote for it,
+        once consensus accepts it)."""
+        try:
+            up = LedgerUpgrade.from_xdr(raw)
+        except Exception:
+            return False
+        if up.disc == LedgerUpgradeType.LEDGER_UPGRADE_VERSION:
+            return up.value >= header.ledgerVersion
+        return up.value > 0
+
+    @staticmethod
+    def remove_upgrades(value_upgrades: List[bytes],
+                        header: LedgerHeader) -> List[bytes]:
+        return [u for u in value_upgrades
+                if Upgrades.is_valid_for_apply(u, header)]

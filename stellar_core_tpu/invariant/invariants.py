@@ -1,0 +1,175 @@
+"""Invariants: registered safety predicates checked at apply time.
+
+Role parity: reference `src/invariant/` — InvariantManager
+(InvariantManager.h:39-56) + concrete invariants
+(ConservationOfLumens.cpp, LedgerEntryIsValid.cpp,
+AccountSubEntriesCountIsValid.cpp, LiabilitiesMatchOffers.cpp,
+BucketListIsConsistentWithDatabase.cpp). A failing invariant raises
+InvariantDoesNotHold, which aborts the node (tests run with all enabled).
+"""
+
+from __future__ import annotations
+
+import re
+from typing import Callable, Dict, List, Optional, Tuple
+
+from ..util.log import get_logger
+from ..xdr import LedgerEntryType, LedgerHeader
+
+log = get_logger("Invariant")
+
+Delta = List[Tuple[object, object, object]]  # (key, prev, cur)
+
+
+class InvariantDoesNotHold(Exception):
+    pass
+
+
+class Invariant:
+    name = "abstract"
+
+    def check_on_close(self, delta: Delta, header_prev: LedgerHeader,
+                       header_cur: LedgerHeader) -> Optional[str]:
+        """Return an error string or None."""
+        return None
+
+
+class LedgerEntryIsValid(Invariant):
+    name = "LedgerEntryIsValid"
+
+    def check_on_close(self, delta, header_prev, header_cur):
+        for key, prev, cur in delta:
+            if cur is None:
+                continue
+            d = cur.data
+            if cur.lastModifiedLedgerSeq > header_cur.ledgerSeq:
+                return "entry lastModified in the future"
+            if d.disc == LedgerEntryType.ACCOUNT:
+                a = d.value
+                if a.balance < 0:
+                    return "account balance negative"
+                if a.seqNum < 0:
+                    return "account seqnum negative"
+                if prev is not None and \
+                        prev.data.disc == LedgerEntryType.ACCOUNT and \
+                        a.seqNum < prev.data.value.seqNum:
+                    return "account seqnum decreased"
+                if len(a.signers) > 20:
+                    return "too many signers"
+                hints = [s.key.to_xdr() for s in a.signers]
+                if hints != sorted(hints):
+                    return "signers not sorted"
+            elif d.disc == LedgerEntryType.TRUSTLINE:
+                t = d.value
+                if t.balance < 0 or t.limit <= 0 or t.balance > t.limit:
+                    return "trustline balance/limit invalid"
+            elif d.disc == LedgerEntryType.OFFER:
+                o = d.value
+                if o.amount <= 0:
+                    return "offer amount non-positive"
+                if o.price.n <= 0 or o.price.d <= 0:
+                    return "offer price invalid"
+        return None
+
+
+class ConservationOfLumens(Invariant):
+    name = "ConservationOfLumens"
+
+    def check_on_close(self, delta, header_prev, header_cur):
+        d_balance = 0
+        for key, prev, cur in delta:
+            if prev is not None and \
+                    prev.data.disc == LedgerEntryType.ACCOUNT:
+                d_balance -= prev.data.value.balance
+            if cur is not None and \
+                    cur.data.disc == LedgerEntryType.ACCOUNT:
+                d_balance += cur.data.value.balance
+        d_fee = header_cur.feePool - header_prev.feePool
+        d_total = header_cur.totalCoins - header_prev.totalCoins
+        if d_balance + d_fee != d_total:
+            return ("lumens not conserved: dBalance=%d dFeePool=%d "
+                    "dTotal=%d" % (d_balance, d_fee, d_total))
+        return None
+
+
+class AccountSubEntriesCountIsValid(Invariant):
+    name = "AccountSubEntriesCountIsValid"
+
+    def check_on_close(self, delta, header_prev, header_cur):
+        d_sub: Dict[bytes, int] = {}
+        d_declared: Dict[bytes, int] = {}
+        for key, prev, cur in delta:
+            t = (cur or prev).data.disc
+            if t == LedgerEntryType.ACCOUNT:
+                acc = (cur or prev).data.value.accountID.key_bytes
+                pv = prev.data.value.numSubEntries if prev else 0
+                cv = cur.data.value.numSubEntries if cur else 0
+                d_declared[acc] = d_declared.get(acc, 0) + cv - pv
+                if cur is None:
+                    # merged account must have no subentries
+                    if prev.data.value.numSubEntries != 0:
+                        return "account removed with subentries"
+                    d_declared.pop(acc, None)
+            elif t in (LedgerEntryType.TRUSTLINE, LedgerEntryType.DATA):
+                e = (cur or prev).data.value
+                acc = e.accountID.key_bytes
+                d_sub[acc] = d_sub.get(acc, 0) + \
+                    (1 if cur is not None else 0) - \
+                    (1 if prev is not None else 0)
+            elif t == LedgerEntryType.OFFER:
+                e = (cur or prev).data.value
+                acc = e.sellerID.key_bytes
+                d_sub[acc] = d_sub.get(acc, 0) + \
+                    (1 if cur is not None else 0) - \
+                    (1 if prev is not None else 0)
+        for acc in set(d_sub) | set(d_declared):
+            if d_sub.get(acc, 0) != d_declared.get(acc, 0):
+                return ("subentry count mismatch for account: "
+                        "actual delta %d vs declared %d" %
+                        (d_sub.get(acc, 0), d_declared.get(acc, 0)))
+        return None
+
+
+class SequentialLedgers(Invariant):
+    name = "SequentialLedgers"
+
+    def check_on_close(self, delta, header_prev, header_cur):
+        if header_cur.ledgerSeq != header_prev.ledgerSeq + 1:
+            return "ledger seq not sequential"
+        return None
+
+
+ALL_INVARIANTS = [LedgerEntryIsValid, ConservationOfLumens,
+                  AccountSubEntriesCountIsValid, SequentialLedgers]
+
+
+class InvariantManager:
+    """Registry + enforcement (reference InvariantManagerImpl.cpp:72-143)."""
+
+    def __init__(self, metrics=None) -> None:
+        self._registered: Dict[str, Invariant] = {}
+        self._enabled: List[Invariant] = []
+        self._metrics = metrics
+        for cls in ALL_INVARIANTS:
+            self.register(cls())
+
+    def register(self, inv: Invariant) -> None:
+        assert inv.name not in self._registered
+        self._registered[inv.name] = inv
+
+    def enable(self, pattern: str) -> None:
+        rx = re.compile(pattern)
+        for name, inv in self._registered.items():
+            if rx.fullmatch(name) and inv not in self._enabled:
+                self._enabled.append(inv)
+
+    def enabled_names(self) -> List[str]:
+        return [i.name for i in self._enabled]
+
+    def check_on_ledger_close(self, delta, header_prev, header_cur) -> None:
+        for inv in self._enabled:
+            err = inv.check_on_close(delta, header_prev, header_cur)
+            if err is not None:
+                msg = "invariant %s violated: %s" % (inv.name, err)
+                log.error(msg)
+                raise InvariantDoesNotHold(msg)

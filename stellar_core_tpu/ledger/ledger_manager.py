@@ -1,0 +1,262 @@
+"""LedgerManager: orders externalized values and closes ledgers.
+
+Role parity: reference `src/ledger/LedgerManagerImpl.cpp`:
+- valueExternalized (:410-490): apply in-order values, route gaps to catchup
+- closeLedger (:522-728): bump seq → hash checks → sortForApply →
+  processFeesSeqNums → applyTransactions → result hash → upgrades →
+  ledgerClosed (bucket batch + header hash) → commit → publish queue
+- startNewLedger / loadLastKnownLedger for genesis and restart.
+
+Design note (TPU): closeLedger takes an optional BatchSigVerifier; during
+catchup replay the caller pre-warms the verify cache with a whole ledger's
+(or checkpoint's) signatures in one device batch, so the per-tx checks here
+become cache hits.
+"""
+
+from __future__ import annotations
+
+import base64
+from typing import List, Optional
+
+from ..crypto.hashing import SHA256, sha256
+from ..database.database import Database
+from ..ledger.ledgertxn import (
+    InMemoryLedgerTxnRoot, LedgerTxn, LedgerTxnRoot,
+)
+from ..transactions.account_helpers import make_account_entry
+from ..util.log import get_logger
+from ..xdr import (
+    LedgerHeader, LedgerUpgrade, LedgerUpgradeType, StellarValue,
+    StellarValueExt, TransactionResultPair, TransactionResultSet,
+    TransactionHistoryEntry, TransactionSet, _Ext,
+)
+
+log = get_logger("Ledger")
+
+GENESIS_LEDGER_SEQ = 1
+
+
+class LedgerManagerState:
+    LM_BOOTING_STATE = 0
+    LM_SYNCED_STATE = 1
+    LM_CATCHING_UP_STATE = 2
+
+
+class LedgerCloseData:
+    """One externalized slot worth of data (reference LedgerCloseData.h)."""
+
+    def __init__(self, ledger_seq: int, tx_set, value: StellarValue) -> None:
+        self.ledger_seq = ledger_seq
+        self.tx_set = tx_set
+        self.value = value
+
+
+class LedgerManager:
+    def __init__(self, app) -> None:
+        self.app = app
+        self.state = LedgerManagerState.LM_BOOTING_STATE
+        cfg = app.config
+        if cfg.DATABASE == "in-memory":
+            self.root = InMemoryLedgerTxnRoot()
+        else:
+            self.root = LedgerTxnRoot(app.database)
+        self.lcl_hash: bytes = b"\x00" * 32
+        self.catchup_trigger = None  # set by CatchupManager wiring
+
+    # -- genesis / restart --------------------------------------------------
+    def start_new_ledger(self) -> None:
+        cfg = self.app.config
+        genesis = LedgerHeader(
+            ledgerVersion=cfg.LEDGER_PROTOCOL_VERSION,
+            previousLedgerHash=b"\x00" * 32,
+            scpValue=StellarValue(txSetHash=b"\x00" * 32, closeTime=0,
+                                  upgrades=[],
+                                  ext=StellarValueExt(0, None)),
+            txSetResultHash=b"\x00" * 32, bucketListHash=b"\x00" * 32,
+            ledgerSeq=GENESIS_LEDGER_SEQ,
+            totalCoins=cfg.GENESIS_TOTAL_COINS, feePool=0, inflationSeq=0,
+            idPool=0, baseFee=cfg.TESTING_UPGRADE_DESIRED_FEE,
+            baseReserve=cfg.TESTING_UPGRADE_RESERVE,
+            maxTxSetSize=cfg.TESTING_UPGRADE_MAX_TX_SET_SIZE,
+            skipList=[b"\x00" * 32] * 4, ext=_Ext.v0())
+        self.root.set_header(genesis)
+        ltx = LedgerTxn(self.root)
+        root_acc = self.app.network_root_key().public_key
+        ltx.create(make_account_entry(
+            root_acc, cfg.GENESIS_TOTAL_COINS, 0, GENESIS_LEDGER_SEQ))
+        ltx.commit()
+        self.lcl_hash = sha256(genesis.to_xdr())
+        self._store_header(genesis)
+        self.state = LedgerManagerState.LM_SYNCED_STATE
+        log.info("started new ledger: genesis %s",
+                 self.lcl_hash.hex()[:8])
+
+    def load_last_known_ledger(self) -> bool:
+        """Restore LCL from the database; returns False if no state."""
+        db = getattr(self.app, "database", None)
+        if db is None or self.app.config.DATABASE == "in-memory":
+            return False
+        row = db.execute(
+            "SELECT ledgerhash, data FROM ledgerheaders ORDER BY "
+            "ledgerseq DESC LIMIT 1").fetchone()
+        if row is None:
+            return False
+        header = LedgerHeader.from_xdr(row[1])
+        self.root.set_header(header)
+        self.lcl_hash = bytes.fromhex(row[0])
+        self.state = LedgerManagerState.LM_SYNCED_STATE
+        return True
+
+    # -- accessors ----------------------------------------------------------
+    @property
+    def lcl_header(self) -> LedgerHeader:
+        return self.root.get_header()
+
+    def last_closed_ledger_num(self) -> int:
+        return self.lcl_header.ledgerSeq
+
+    def ltx_root(self):
+        return self.root
+
+    def header(self) -> LedgerHeader:
+        return self.root.get_header()
+
+    def is_synced(self) -> bool:
+        return self.state == LedgerManagerState.LM_SYNCED_STATE
+
+    # -- externalization ----------------------------------------------------
+    def value_externalized(self, lcd: LedgerCloseData) -> None:
+        lcl = self.last_closed_ledger_num()
+        if lcd.ledger_seq == lcl + 1:
+            self.close_ledger(lcd)
+        elif lcd.ledger_seq <= lcl:
+            log.info("skipping already-applied ledger %d", lcd.ledger_seq)
+        else:
+            log.warning("ledger gap: got %d, lcl %d — catchup needed",
+                        lcd.ledger_seq, lcl)
+            self.state = LedgerManagerState.LM_CATCHING_UP_STATE
+            if self.catchup_trigger is not None:
+                self.catchup_trigger(lcd)
+
+    # -- the close ----------------------------------------------------------
+    def close_ledger(self, lcd: LedgerCloseData) -> None:
+        header_prev = LedgerHeader.from_xdr(self.lcl_header.to_xdr())
+        assert lcd.ledger_seq == header_prev.ledgerSeq + 1, "non-sequential"
+        assert lcd.tx_set.previous_ledger_hash == self.lcl_hash, \
+            "txset based on wrong ledger"
+        assert lcd.value.txSetHash == lcd.tx_set.get_contents_hash(), \
+            "value/txset hash mismatch"
+
+        verifier = getattr(self.app, "sig_verifier", None)
+        ltx = LedgerTxn(self.root)
+        header = ltx.load_header()
+        header.ledgerSeq = lcd.ledger_seq
+        header.previousLedgerHash = self.lcl_hash
+        header.scpValue = lcd.value
+
+        frames = lcd.tx_set.sort_for_apply()
+        base_fee = lcd.tx_set.base_fee(header)
+
+        # phase 1: fees + seq nums for every tx
+        for f in frames:
+            f.process_fee_seq_num(ltx, base_fee)
+        # phase 2: apply, collecting results (+ invariant checks per tx)
+        result_pairs: List[TransactionResultPair] = []
+        for f in frames:
+            f.apply(ltx, verifier)
+            result_pairs.append(f.result_pair())
+        # result hash in apply order
+        rs = TransactionResultSet(results=result_pairs)
+        header.txSetResultHash = sha256(rs.to_xdr())
+
+        # upgrades (after txs; reference LedgerManagerImpl.cpp:617-669)
+        for raw in lcd.value.upgrades:
+            try:
+                up = LedgerUpgrade.from_xdr(raw)
+            except Exception:
+                log.warning("ignoring malformed upgrade")
+                continue
+            self._apply_upgrade(header, up)
+
+        # bucket-list hash over the close's delta (content-addressed chain;
+        # stands in the header exactly where the reference's
+        # BucketList::getHash result goes)
+        delta = ltx.get_delta()
+        bl = self._bucket_manager()
+        if bl is not None:
+            init_entries, live_entries, dead_keys = [], [], []
+            for key, prev, cur in delta:
+                if cur is None:
+                    dead_keys.append(key)
+                elif prev is None:
+                    init_entries.append(cur)
+                else:
+                    live_entries.append(cur)
+            bl.add_batch(header.ledgerSeq, init_entries, live_entries,
+                         dead_keys)
+            header.bucketListHash = bl.get_hash()
+        else:
+            h = SHA256()
+            h.add(header_prev.bucketListHash)
+            for key, prev, cur in sorted(delta,
+                                         key=lambda t: t[0].to_xdr()):
+                h.add(key.to_xdr())
+                h.add(cur.to_xdr() if cur is not None else b"\xff" * 4)
+            header.bucketListHash = h.finish()
+
+        # invariants on the whole close
+        inv = getattr(self.app, "invariant_manager", None)
+        if inv is not None:
+            inv.check_on_ledger_close(delta, header_prev, header)
+
+        ltx.commit()
+        self.lcl_hash = sha256(self.root.get_header().to_xdr())
+        self._store_header(self.root.get_header())
+        self._store_txs(lcd, frames, result_pairs)
+        hm = getattr(self.app, "history_manager", None)
+        if hm is not None:
+            hm.maybe_queue_checkpoint(self)
+        log.debug("closed ledger %d (%d txs) hash %s", lcd.ledger_seq,
+                  len(frames), self.lcl_hash.hex()[:8])
+
+    def _bucket_manager(self):
+        return getattr(self.app, "bucket_manager", None)
+
+    def _apply_upgrade(self, header: LedgerHeader,
+                       up: LedgerUpgrade) -> None:
+        t = up.disc
+        if t == LedgerUpgradeType.LEDGER_UPGRADE_VERSION:
+            header.ledgerVersion = up.value
+        elif t == LedgerUpgradeType.LEDGER_UPGRADE_BASE_FEE:
+            header.baseFee = up.value
+        elif t == LedgerUpgradeType.LEDGER_UPGRADE_MAX_TX_SET_SIZE:
+            header.maxTxSetSize = up.value
+        elif t == LedgerUpgradeType.LEDGER_UPGRADE_BASE_RESERVE:
+            header.baseReserve = up.value
+
+    # -- persistence --------------------------------------------------------
+    def _store_header(self, header: LedgerHeader) -> None:
+        db = getattr(self.app, "database", None)
+        if db is None:
+            return
+        db.execute(
+            "INSERT OR REPLACE INTO ledgerheaders (ledgerhash, prevhash, "
+            "bucketlisthash, ledgerseq, closetime, data) VALUES "
+            "(?,?,?,?,?,?)",
+            (sha256(header.to_xdr()).hex(),
+             header.previousLedgerHash.hex(), header.bucketListHash.hex(),
+             header.ledgerSeq, header.scpValue.closeTime, header.to_xdr()))
+        db.commit()
+
+    def _store_txs(self, lcd: LedgerCloseData, frames,
+                   result_pairs) -> None:
+        db = getattr(self.app, "database", None)
+        if db is None:
+            return
+        for i, (f, rp) in enumerate(zip(frames, result_pairs)):
+            db.execute(
+                "INSERT OR REPLACE INTO txhistory (txid, ledgerseq, "
+                "txindex, txbody, txresult, txmeta) VALUES (?,?,?,?,?,?)",
+                (f.contents_hash().hex(), lcd.ledger_seq, i,
+                 f.envelope.to_xdr(), rp.to_xdr(), b""))
+        db.commit()

@@ -1,0 +1,161 @@
+"""Application: ownership and wiring of every subsystem.
+
+Role parity: reference `src/main/Application.h:127-219` /
+`ApplicationImpl.cpp` — one Application owns one of each manager; the
+managers interact only through the Application facade. start() mirrors
+ApplicationImpl::start (ApplicationImpl.cpp:360-464): load LCL → restore
+herder state → start overlay/maintenance → resume publishes → optional
+FORCE_SCP bootstrap.
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+from ..crypto.batch_verifier import make_verifier
+from ..crypto.hashing import sha256
+from ..crypto.keys import SecretKey
+from ..database.database import Database
+from ..invariant.invariants import InvariantManager
+from ..ledger.ledger_manager import LedgerManager
+from ..util.log import get_logger
+from ..util.metrics import MetricsRegistry
+from ..util.timer import ClockMode, VirtualClock
+from .config import Config
+from .persistent_state import PersistentState
+
+log = get_logger("Ledger")
+
+
+class AppState:
+    APP_CREATED = 0
+    APP_ACQUIRING_CONSENSUS = 1
+    APP_SYNCED = 2
+    APP_STOPPING = 3
+
+
+class Application:
+    def __init__(self, clock: VirtualClock, config: Config) -> None:
+        self.clock = clock
+        self.config = config
+        self.state = AppState.APP_CREATED
+        self.metrics = MetricsRegistry(now_fn=clock.now)
+
+        # database (None in pure in-memory test mode)
+        if config.DATABASE == "in-memory":
+            self.database: Optional[Database] = None
+        elif config.DATABASE.startswith("sqlite3://"):
+            self.database = Database(config.DATABASE[len("sqlite3://"):],
+                                     self.metrics)
+        else:
+            self.database = Database(config.DATABASE, self.metrics)
+        self.persistent_state = (PersistentState(self.database)
+                                 if self.database else None)
+
+        # crypto backend (config-gated; the TPU boundary)
+        self.sig_verifier = make_verifier(
+            config.SIG_VERIFY_BACKEND, clock,
+            config.SIG_VERIFY_MAX_BATCH)
+
+        self.invariant_manager = InvariantManager(self.metrics)
+        for pattern in config.INVARIANT_CHECKS:
+            self.invariant_manager.enable(pattern)
+
+        self.bucket_manager = None   # wired in enable_buckets()
+        self.history_manager = None  # wired by history layer
+        self.catchup_manager = None
+        self.overlay_manager = None  # wired by overlay layer
+        self.ledger_manager = LedgerManager(self)
+
+        from ..herder.herder import Herder
+        if config.QUORUM_SET is None:
+            config.QUORUM_SET = config.self_qset()
+        self.herder = Herder(self)
+
+        from ..work.scheduler import WorkScheduler
+        self.work_scheduler = WorkScheduler(self.clock)
+        from ..process.process_manager import ProcessManager
+        self.process_manager = ProcessManager(
+            self.clock, config.MAX_CONCURRENT_SUBPROCESSES)
+
+    # -- identity ------------------------------------------------------------
+    def network_root_key(self) -> SecretKey:
+        """Deterministic genesis root key derived from the network id."""
+        return SecretKey.from_seed(sha256(self.config.network_id))
+
+    # -- lifecycle -----------------------------------------------------------
+    def start(self) -> None:
+        lm = self.ledger_manager
+        if not lm.load_last_known_ledger():
+            lm.start_new_ledger()
+        self.herder.restore_scp_state()
+        if self.overlay_manager is not None and \
+                not self.config.RUN_STANDALONE:
+            self.overlay_manager.start()
+        force = self.config.FORCE_SCP or (
+            self.persistent_state is not None and
+            self.persistent_state.get_force_scp())
+        if force and self.config.NODE_IS_VALIDATOR:
+            self.herder.bootstrap()
+            self.state = AppState.APP_SYNCED
+        else:
+            self.state = AppState.APP_ACQUIRING_CONSENSUS
+
+    def crank(self, block: bool = False) -> int:
+        return self.clock.crank(block)
+
+    def crank_until(self, pred, max_cranks: int = 100000) -> bool:
+        for _ in range(max_cranks):
+            if pred():
+                return True
+            self.clock.crank(False)
+        return pred()
+
+    def stop(self) -> None:
+        self.state = AppState.APP_STOPPING
+        if self.overlay_manager is not None:
+            self.overlay_manager.shutdown()
+        self.process_manager.shutdown()
+
+    # -- operations ----------------------------------------------------------
+    def manual_close(self) -> None:
+        assert self.config.MANUAL_CLOSE, "manualclose requires MANUAL_CLOSE"
+        self.herder.trigger_next_ledger(
+            self.ledger_manager.last_closed_ledger_num() + 1)
+        # drain the resulting SCP message flow deterministically
+        while self.clock.crank(False):
+            pass
+
+    def submit_transaction(self, frame) -> int:
+        status = self.herder.recv_transaction(frame)
+        if status == 0 and self.overlay_manager is not None:
+            from ..xdr import MessageType, StellarMessage
+            self.overlay_manager.broadcast_message(
+                StellarMessage(MessageType.TRANSACTION, frame.envelope),
+                False)
+        return status
+
+    def enable_buckets(self, bucket_dir: Optional[str] = None) -> None:
+        from ..bucket.bucket_manager import BucketManager
+        self.bucket_manager = BucketManager(
+            bucket_dir or self.config.BUCKET_DIR_PATH)
+
+    # -- info ----------------------------------------------------------------
+    def get_info(self) -> dict:
+        lm = self.ledger_manager
+        return {
+            "build": self.config.VERSION_STR,
+            "network": self.config.NETWORK_PASSPHRASE,
+            "ledger": {
+                "num": lm.last_closed_ledger_num(),
+                "hash": lm.lcl_hash.hex(),
+                "version": lm.lcl_header.ledgerVersion,
+                "baseFee": lm.lcl_header.baseFee,
+                "baseReserve": lm.lcl_header.baseReserve,
+                "maxTxSetSize": lm.lcl_header.maxTxSetSize,
+                "closeTime": lm.lcl_header.scpValue.closeTime,
+            },
+            "state": ("Synced!" if self.state == AppState.APP_SYNCED
+                      else "Catching up"),
+            "quorum": self.herder.get_json_info(),
+        }

@@ -1,0 +1,185 @@
+"""Config: node configuration, parsed from TOML.
+
+Role parity: reference `src/main/Config.{h,cpp}` (~80 knobs; TOML via
+cpptoml with validators/quality levels). Python's stdlib tomllib replaces
+cpptoml. The knob set covers every subsystem built so far plus the
+TPU-specific crypto-backend gate (SIG_VERIFY_BACKEND).
+"""
+
+from __future__ import annotations
+
+import tomllib
+from typing import Dict, List, Optional
+
+from ..crypto.hashing import sha256
+from ..crypto.keys import SecretKey
+from ..xdr import PublicKey, SCPQuorumSet
+
+
+class Config:
+    # protocol
+    LEDGER_PROTOCOL_VERSION = 13
+    OVERLAY_PROTOCOL_VERSION = 12
+    OVERLAY_PROTOCOL_MIN_VERSION = 10
+    VERSION_STR = "stellar-core-tpu 0.1"
+
+    def __init__(self) -> None:
+        # identity / network
+        self.NETWORK_PASSPHRASE = "(sct) testing network"
+        self.NODE_SEED: Optional[SecretKey] = None
+        self.NODE_IS_VALIDATOR = True
+        self.NODE_HOME_DOMAIN = ""
+        self.QUORUM_SET: Optional[SCPQuorumSet] = None
+        self.UNSAFE_QUORUM = False
+        self.FAILURE_SAFETY = -1
+
+        # run modes
+        self.RUN_STANDALONE = False
+        self.MANUAL_CLOSE = False
+        self.FORCE_SCP = False
+        self.CATCHUP_COMPLETE = False
+        self.CATCHUP_RECENT = 0
+
+        # database / storage
+        self.DATABASE = "sqlite3://:memory:"
+        self.BUCKET_DIR_PATH = "buckets"
+        self.TMP_DIR_PATH = "tmp"
+
+        # overlay
+        self.PEER_PORT = 11625
+        self.HTTP_PORT = 11626
+        self.PUBLIC_HTTP_PORT = False
+        self.KNOWN_PEERS: List[str] = []
+        self.PREFERRED_PEERS: List[str] = []
+        self.TARGET_PEER_CONNECTIONS = 8
+        self.MAX_PENDING_CONNECTIONS = 500
+        self.MAX_ADDITIONAL_PEER_CONNECTIONS = -1
+        self.PEER_AUTHENTICATION_TIMEOUT = 2.0
+        self.PEER_TIMEOUT = 30.0
+        self.PEER_STRAGGLER_TIMEOUT = 120.0
+        self.MAX_BATCH_WRITE_COUNT = 1024
+        self.MAX_BATCH_WRITE_BYTES = 1024 * 1024
+
+        # herder
+        self.EXPECTED_LEDGER_CLOSE_TIME = 5.0
+        self.MAX_SLOTS_TO_REMEMBER = 12
+        self.CONSENSUS_STUCK_TIMEOUT_SECONDS = 35.0
+        self.TRANSACTION_QUEUE_PENDING_DEPTH = 4
+        self.TRANSACTION_QUEUE_BAN_DEPTH = 10
+        self.POOL_LEDGER_MULTIPLIER = 2
+
+        # genesis / testing upgrades
+        self.GENESIS_TOTAL_COINS = 10**17
+        self.TESTING_UPGRADE_DESIRED_FEE = 100
+        self.TESTING_UPGRADE_RESERVE = 5_000_000
+        self.TESTING_UPGRADE_MAX_TX_SET_SIZE = 100
+        self.ARTIFICIALLY_ACCELERATE_TIME_FOR_TESTING = False
+        self.ARTIFICIALLY_GENERATE_LOAD_FOR_TESTING = False
+
+        # history
+        self.HISTORY: Dict[str, dict] = {}
+        self.CHECKPOINT_FREQUENCY = 64
+
+        # invariants
+        self.INVARIANT_CHECKS: List[str] = []
+
+        # workers / process
+        self.WORKER_THREADS = 4
+        self.MAX_CONCURRENT_SUBPROCESSES = 16
+
+        # TPU crypto backend gate (this build's headline knob):
+        # "cpu" (default, OpenSSL), "tpu" (JAX batched), "tpu-async"
+        self.SIG_VERIFY_BACKEND = "cpu"
+        self.SIG_VERIFY_MAX_BATCH = 8192
+
+        # maintenance
+        self.AUTOMATIC_MAINTENANCE_PERIOD = 359.0
+        self.AUTOMATIC_MAINTENANCE_COUNT = 50000
+
+    # -- derived ------------------------------------------------------------
+    @property
+    def network_id(self) -> bytes:
+        return sha256(self.NETWORK_PASSPHRASE.encode())
+
+    def node_id(self) -> PublicKey:
+        assert self.NODE_SEED is not None
+        return self.NODE_SEED.public_key
+
+    def self_qset(self) -> SCPQuorumSet:
+        return SCPQuorumSet(threshold=1, validators=[self.node_id()],
+                            innerSets=[])
+
+    # -- loading ------------------------------------------------------------
+    @classmethod
+    def from_toml(cls, path_or_text: str,
+                  is_path: bool = True) -> "Config":
+        if is_path:
+            with open(path_or_text, "rb") as f:
+                data = tomllib.load(f)
+        else:
+            data = tomllib.loads(path_or_text)
+        cfg = cls()
+        simple_keys = [
+            "NETWORK_PASSPHRASE", "NODE_IS_VALIDATOR", "NODE_HOME_DOMAIN",
+            "RUN_STANDALONE", "MANUAL_CLOSE", "FORCE_SCP", "DATABASE",
+            "BUCKET_DIR_PATH", "TMP_DIR_PATH", "PEER_PORT", "HTTP_PORT",
+            "PUBLIC_HTTP_PORT", "KNOWN_PEERS", "PREFERRED_PEERS",
+            "TARGET_PEER_CONNECTIONS", "UNSAFE_QUORUM", "FAILURE_SAFETY",
+            "EXPECTED_LEDGER_CLOSE_TIME", "MAX_SLOTS_TO_REMEMBER",
+            "INVARIANT_CHECKS", "WORKER_THREADS",
+            "MAX_CONCURRENT_SUBPROCESSES", "SIG_VERIFY_BACKEND",
+            "SIG_VERIFY_MAX_BATCH", "CHECKPOINT_FREQUENCY",
+            "CATCHUP_COMPLETE", "CATCHUP_RECENT",
+        ]
+        for k in simple_keys:
+            if k in data:
+                setattr(cfg, k, data[k])
+        if "NODE_SEED" in data:
+            cfg.NODE_SEED = SecretKey.from_strkey_seed(data["NODE_SEED"])
+        if "QUORUM_SET" in data:
+            cfg.QUORUM_SET = cls._parse_qset(data["QUORUM_SET"])
+        if "HISTORY" in data:
+            cfg.HISTORY = data["HISTORY"]
+        cfg.validate()
+        return cfg
+
+    @staticmethod
+    def _parse_qset(d: dict) -> SCPQuorumSet:
+        from ..crypto import strkey
+        validators = [PublicKey.ed25519(strkey.decode_public_key(v))
+                      for v in d.get("VALIDATORS", [])]
+        inner = [Config._parse_qset(i) for i in d.get("INNER_SETS", [])]
+        threshold = d.get("THRESHOLD", (len(validators) + len(inner)))
+        return SCPQuorumSet(threshold=threshold, validators=validators,
+                            innerSets=inner)
+
+    def validate(self) -> None:
+        if self.NODE_IS_VALIDATOR and self.NODE_SEED is None:
+            raise ValueError("validator requires NODE_SEED")
+        if self.QUORUM_SET is not None and not self.UNSAFE_QUORUM:
+            q = self.QUORUM_SET
+            n = len(q.validators) + len(q.innerSets)
+            if n > 0 and q.threshold < (n + 1) // 2:
+                raise ValueError(
+                    "quorum threshold below majority is unsafe; set "
+                    "UNSAFE_QUORUM=true to override")
+
+    @classmethod
+    def test_config(cls, n: int = 0,
+                    backend: str = "cpu") -> "Config":
+        """Per-instance deterministic test config (reference getTestConfig,
+        src/test/test.cpp:80-131)."""
+        cfg = cls()
+        cfg.NODE_SEED = SecretKey.from_seed(
+            sha256(b"test-node-%d" % n))
+        cfg.RUN_STANDALONE = True
+        cfg.MANUAL_CLOSE = True
+        cfg.FORCE_SCP = True
+        cfg.UNSAFE_QUORUM = True
+        cfg.DATABASE = "in-memory"
+        cfg.QUORUM_SET = cfg.self_qset()
+        cfg.INVARIANT_CHECKS = [".*"]
+        cfg.SIG_VERIFY_BACKEND = backend
+        cfg.PEER_PORT = 17000 + n
+        cfg.HTTP_PORT = 18000 + n
+        return cfg

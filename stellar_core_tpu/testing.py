@@ -116,6 +116,52 @@ class TestLedger:
         return results
 
 
+class AppLedgerAdapter:
+    """Adapts a full Application to the TestLedger account-DSL surface:
+    txs are submitted through the Herder and applied by consensus closes
+    (MANUAL_CLOSE)."""
+
+    def __init__(self, app) -> None:
+        self.app = app
+        self.network_id = app.config.network_id
+
+    def header(self) -> LedgerHeader:
+        return self.app.ledger_manager.lcl_header
+
+    def _root(self):
+        return self.app.ledger_manager.ltx_root()
+
+    def balance(self, account_id: PublicKey) -> int:
+        e = self._root().get_entry(LedgerKey.account(account_id))
+        assert e is not None, "no such account"
+        return e.data.value.balance
+
+    def account_exists(self, account_id: PublicKey) -> bool:
+        return self._root().get_entry(
+            LedgerKey.account(account_id)) is not None
+
+    def trust_balance(self, account_id, asset):
+        e = self._root().get_entry(
+            LedgerKey.trustline(account_id, asset))
+        assert e is not None
+        return e.data.value.balance
+
+    def seq_num(self, account_id: PublicKey) -> int:
+        e = self._root().get_entry(LedgerKey.account(account_id))
+        return e.data.value.seqNum if e is not None else 0
+
+    def apply_frame(self, frame) -> bool:
+        status = self.app.submit_transaction(frame)
+        if status != 0:
+            return False
+        self.app.manual_close()
+        from .xdr import TransactionResultCode
+        return frame.result.code == TransactionResultCode.txSUCCESS
+
+    def root_account(self) -> "TestAccount":
+        return TestAccount(self, self.app.network_root_key())
+
+
 class TestAccount:
     def __init__(self, ledger: TestLedger, sk: SecretKey) -> None:
         self.ledger = ledger

@@ -1,0 +1,158 @@
+"""Work trees: Work (children), WorkSequence, BatchWork, ConditionalWork.
+
+Role parity: reference `src/work/Work.{h,cpp}`, `WorkSequence.cpp`,
+`BatchWork.cpp` (bounded-concurrency yieldMoreWork), `ConditionalWork.cpp`.
+"""
+
+from __future__ import annotations
+
+from typing import Callable, Iterator, List, Optional
+
+from .basic_work import FAILURE, RUNNING, SUCCESS, WAITING, BasicWork, State
+
+
+class Work(BasicWork):
+    """A work node with children: runs children to completion (cranking one
+    pending child per step), then does its own do_work."""
+
+    def __init__(self, clock, name, max_retries=5) -> None:
+        super().__init__(clock, name, max_retries)
+        self.children: List[BasicWork] = []
+
+    def add_work(self, w: BasicWork) -> BasicWork:
+        w._parent = self
+        self.children.append(w)
+        if w.state == State.PENDING:
+            w.start()
+        return w
+
+    def on_reset(self) -> None:
+        self.children.clear()
+        self.do_reset()
+
+    def do_reset(self) -> None:
+        pass
+
+    def do_work(self) -> State:
+        return SUCCESS
+
+    def _any_failed(self) -> bool:
+        return any(c.state in (State.FAILURE, State.ABORTED)
+                   for c in self.children)
+
+    def _all_done(self) -> bool:
+        return all(c.is_done() for c in self.children)
+
+    def on_run(self) -> State:
+        progressed = False
+        for c in self.children:
+            if not c.is_done():
+                c.crank_work()
+                progressed = True
+                break
+        if self._any_failed():
+            return FAILURE
+        if not self._all_done():
+            return RUNNING
+        return self.do_work()
+
+
+class WorkSequence(BasicWork):
+    """Children executed strictly in order (reference WorkSequence)."""
+
+    def __init__(self, clock, name, sequence: List[BasicWork],
+                 max_retries=5) -> None:
+        super().__init__(clock, name, max_retries)
+        self.sequence = sequence
+        self._idx = 0
+        for w in sequence:
+            w._parent = self
+
+    def on_reset(self) -> None:
+        self._idx = 0
+
+    def on_run(self) -> State:
+        if self._idx >= len(self.sequence):
+            return SUCCESS
+        cur = self.sequence[self._idx]
+        if cur.state == State.PENDING:
+            cur.start()
+        if not cur.is_done():
+            cur.crank_work()
+            return RUNNING
+        if cur.state != State.SUCCESS:
+            return FAILURE
+        self._idx += 1
+        return RUNNING if self._idx < len(self.sequence) else SUCCESS
+
+
+class BatchWork(Work):
+    """Bounded-concurrency batch: keeps up to `max_concurrent` children
+    running, pulling new ones from yield_more_work (reference BatchWork)."""
+
+    def __init__(self, clock, name, max_concurrent: int = 8,
+                 max_retries=5) -> None:
+        super().__init__(clock, name, max_retries)
+        self.max_concurrent = max_concurrent
+        self._exhausted = False
+
+    def yield_more_work(self) -> Optional[BasicWork]:
+        raise NotImplementedError
+
+    def on_reset(self) -> None:
+        self.children.clear()
+        self._exhausted = False
+        self.do_reset()
+
+    def on_run(self) -> State:
+        # harvest finished, fail fast
+        if self._any_failed():
+            return FAILURE
+        self.children = [c for c in self.children if not c.is_done()]
+        while not self._exhausted and \
+                len(self.children) < self.max_concurrent:
+            w = self.yield_more_work()
+            if w is None:
+                self._exhausted = True
+                break
+            self.add_work(w)
+        for c in self.children:
+            if not c.is_done():
+                c.crank_work()
+        if self.children:
+            return RUNNING
+        return SUCCESS if self._exhausted else RUNNING
+
+
+class ConditionalWork(BasicWork):
+    """Runs inner work once a condition becomes true (reference
+    ConditionalWork)."""
+
+    def __init__(self, clock, name, condition: Callable[[], bool],
+                 inner: BasicWork) -> None:
+        super().__init__(clock, name, 0)
+        self.condition = condition
+        self.inner = inner
+        inner._parent = self
+
+    def on_run(self) -> State:
+        if not self.condition():
+            return RUNNING
+        if self.inner.state == State.PENDING:
+            self.inner.start()
+        if not self.inner.is_done():
+            self.inner.crank_work()
+            return RUNNING
+        return SUCCESS if self.inner.state == State.SUCCESS else FAILURE
+
+
+class FunctionWork(BasicWork):
+    """Small adapter: run a callable once (used by tests and simple steps)."""
+
+    def __init__(self, clock, name, fn: Callable[[], bool],
+                 max_retries=0) -> None:
+        super().__init__(clock, name, max_retries)
+        self.fn = fn
+
+    def on_run(self) -> State:
+        return SUCCESS if self.fn() else FAILURE

@@ -1,0 +1,125 @@
+"""End-to-end standalone-node slice (SURVEY.md §7 step 5):
+
+submit tx → TransactionQueue → self-nominate (FORCE_SCP, 1-of-1 quorum) →
+SCP externalize → LedgerManager.closeLedger → state query.
+
+Role parity: reference herder/test/HerderTests.cpp "standalone" scenarios +
+main/test application-level tests.
+"""
+
+import pytest
+
+import stellar_core_tpu.xdr as X
+from stellar_core_tpu.herder.tx_queue import TxQueueResult
+from stellar_core_tpu.main.application import Application
+from stellar_core_tpu.main.config import Config
+from stellar_core_tpu.testing import AppLedgerAdapter, TestAccount
+from stellar_core_tpu.util.timer import ClockMode, VirtualClock
+
+
+@pytest.fixture
+def app():
+    cfg = Config.test_config(0)
+    clock = VirtualClock(ClockMode.VIRTUAL_TIME)
+    a = Application(clock, cfg)
+    a.start()
+    return a
+
+
+def test_genesis_and_info(app):
+    info = app.get_info()
+    assert info["ledger"]["num"] == 1
+    assert info["state"] == "Synced!"
+    root = app.network_root_key().public_key
+    adapter = AppLedgerAdapter(app)
+    assert adapter.balance(root) == app.config.GENESIS_TOTAL_COINS
+
+
+def test_manual_close_empty_ledger(app):
+    lm = app.ledger_manager
+    h1 = lm.lcl_hash
+    app.manual_close()
+    assert lm.last_closed_ledger_num() == 2
+    assert lm.lcl_header.previousLedgerHash == h1
+    app.manual_close()
+    assert lm.last_closed_ledger_num() == 3
+    # close times strictly increase
+    assert lm.lcl_header.scpValue.closeTime >= 2
+
+
+def test_payment_through_consensus(app):
+    adapter = AppLedgerAdapter(app)
+    root = adapter.root_account()
+    alice = root.create(10**9)
+    assert adapter.balance(alice.account_id) == 10**9
+    assert alice.pay(root, 10**6)
+    assert adapter.balance(alice.account_id) == 10**9 - 10**6 - 100
+    assert app.ledger_manager.last_closed_ledger_num() >= 3
+
+
+def test_queue_rejects_bad_txs(app):
+    adapter = AppLedgerAdapter(app)
+    root = adapter.root_account()
+    alice = root.create(10**9)
+    # bad seq
+    f = alice.tx([alice.op_payment(root.account_id, 1)],
+                 seq=alice.next_seq() + 10)
+    assert app.submit_transaction(f) == TxQueueResult.ADD_STATUS_ERROR
+    # duplicate
+    f2 = alice.tx([alice.op_payment(root.account_id, 1)])
+    assert app.submit_transaction(f2) == TxQueueResult.ADD_STATUS_PENDING
+    assert app.submit_transaction(f2) == TxQueueResult.ADD_STATUS_DUPLICATE
+    app.manual_close()
+    # applied; queue drained
+    assert app.herder.tx_queue.size_ops() == 0
+
+
+def test_multiple_txs_one_ledger(app):
+    adapter = AppLedgerAdapter(app)
+    root = adapter.root_account()
+    alice = root.create(10**9)
+    bob = root.create(10**9)
+    # two chained txs from alice in one close
+    f1 = alice.tx([alice.op_payment(bob.account_id, 100)])
+    f2 = alice.tx([alice.op_payment(bob.account_id, 200)],
+                  seq=alice.next_seq() + 1)
+    assert app.submit_transaction(f1) == TxQueueResult.ADD_STATUS_PENDING
+    assert app.submit_transaction(f2) == TxQueueResult.ADD_STATUS_PENDING
+    app.manual_close()
+    assert adapter.balance(bob.account_id) == 10**9 + 300
+
+
+def test_header_chain_integrity(app):
+    adapter = AppLedgerAdapter(app)
+    root = adapter.root_account()
+    root.create(10**9)
+    app.manual_close()
+    lm = app.ledger_manager
+    from stellar_core_tpu.crypto.hashing import sha256
+    assert lm.lcl_hash == sha256(lm.lcl_header.to_xdr())
+    assert lm.lcl_header.scpValue.txSetHash is not None
+
+
+def test_queue_shift_expires_old_txs(app):
+    adapter = AppLedgerAdapter(app)
+    root = adapter.root_account()
+    alice = root.create(10**9)
+    # stuck tx with a seq gap can't be added; use valid tx, then manually
+    # age it past pending depth without including it: remove from txset by
+    # banning is internal — here we just verify shift() ages/expires.
+    q = app.herder.tx_queue
+    f = alice.tx([alice.op_payment(root.account_id, 1)])
+    assert q.try_add(f) == TxQueueResult.ADD_STATUS_PENDING
+    for _ in range(q.pending_depth):
+        q.shift()
+    assert q.size_ops() == 0
+    assert q.is_banned(f.full_hash())
+
+
+def test_upgrade_via_consensus(app):
+    from stellar_core_tpu.herder.upgrades import UpgradeParameters
+    p = UpgradeParameters()
+    p.base_fee = 200
+    app.herder.upgrades.set_parameters(p)
+    app.manual_close()
+    assert app.ledger_manager.lcl_header.baseFee == 200
