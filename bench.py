@@ -1,0 +1,76 @@
+"""Benchmark: batched ed25519 verify throughput on one TPU chip.
+
+Prints ONE JSON line:
+  {"metric": ..., "value": N, "unit": ..., "vs_baseline": N}
+
+vs_baseline = measured TPU rate / single-core CPU (OpenSSL) rate — the
+reference's implicit baseline is single-call libsodium verify
+(BASELINE.md; reference crypto bench harness src/crypto/test/
+CryptoTests.cpp:235-258). The north-star target is >=100K verifies/s/chip.
+"""
+
+from __future__ import annotations
+
+import json
+import sys
+import time
+
+
+def cpu_baseline_rate(n: int = 2000) -> float:
+    from stellar_core_tpu.crypto.keys import raw_verify
+    from stellar_core_tpu.models.verifier_model import make_example_batch
+    pubs, sigs, msgs = make_example_batch(batch=n, n_keys=32)
+    t0 = time.perf_counter()
+    ok = True
+    for p, s, m in zip(pubs, sigs, msgs):
+        ok &= raw_verify(p, s, m)
+    dt = time.perf_counter() - t0
+    assert ok
+    return n / dt
+
+
+def tpu_rate(batch: int = 4096, iters: int = 5) -> float:
+    import jax.numpy as jnp
+    from stellar_core_tpu.models.verifier_model import (
+        device_args, make_example_batch,
+    )
+    from stellar_core_tpu.ops.ed25519 import verify_batch_jit
+    pubs, sigs, msgs = make_example_batch(batch=batch, n_keys=64)
+    args = device_args(pubs, sigs, msgs)
+    # compile + correctness gate
+    ok = verify_batch_jit(*args)
+    ok.block_until_ready()
+    assert bool(ok.all()), "verify kernel rejected valid signatures"
+    best = 0.0
+    for _ in range(iters):
+        t0 = time.perf_counter()
+        verify_batch_jit(*args).block_until_ready()
+        dt = time.perf_counter() - t0
+        best = max(best, batch / dt)
+    return best
+
+
+def main() -> None:
+    try:
+        import jax
+        platform = jax.devices()[0].platform
+    except Exception as e:
+        print(json.dumps({
+            "metric": "ed25519_verifies_per_sec_per_chip",
+            "value": 0, "unit": "sigs/s", "vs_baseline": 0.0,
+            "error": "device init failed: %s" % type(e).__name__}))
+        return
+    cpu = cpu_baseline_rate()
+    dev = tpu_rate()
+    print(json.dumps({
+        "metric": "ed25519_verifies_per_sec_per_chip",
+        "value": round(dev, 1),
+        "unit": "sigs/s",
+        "vs_baseline": round(dev / cpu, 3),
+        "cpu_openssl_baseline_sigs_per_sec": round(cpu, 1),
+        "platform": platform,
+    }))
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,79 @@
+"""Multi-chip sharding of the verify batch.
+
+TPU-first design (SURVEY.md §2.3): consensus traffic between mutually
+untrusting validators stays on TCP — collectives don't apply there. ICI
+parallelism lives INSIDE the crypto backend: a verify batch is sharded
+pure-data-parallel over the `dp` mesh axis (ed25519 verifies are
+embarrassingly parallel — SURVEY.md §5 "long-context" note), XLA partitions
+the kernel, and the only cross-chip traffic is the result gather.
+
+No tensor/pipeline/sequence/expert axes exist in this domain: the model is
+a fixed-function crypto pipeline per batch element, not a layered network —
+so the mesh is 1-D. This module also provides the multi-chip "training
+step" used by __graft_entry__.dryrun_multichip.
+"""
+
+from __future__ import annotations
+
+from functools import partial
+from typing import Optional, Sequence
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+
+def make_mesh(devices: Optional[Sequence] = None) -> Mesh:
+    devices = list(devices if devices is not None else jax.devices())
+    return Mesh(np.array(devices), ("dp",))
+
+
+def sharded_verify_fn(mesh: Mesh):
+    """jit-compiled batched ed25519 verify with inputs/outputs sharded over
+    the dp axis. Batch size must be a multiple of the mesh size."""
+    from ..ops.ed25519 import verify_kernel
+
+    data = NamedSharding(mesh, P("dp"))
+
+    @partial(jax.jit,
+             in_shardings=(data,) * 6,
+             out_shardings=data)
+    def fn(ay, a_sign, ry, r_sign, s_nibs, k_nibs):
+        return verify_kernel(ay, a_sign, ry, r_sign, s_nibs, k_nibs)
+
+    return fn
+
+
+def pad_batch_to(prep: dict, size: int) -> dict:
+    """Pad host-prepared arrays up to `size` (invalid padding lanes verify
+    False and are masked by pre_ok)."""
+    n = prep["ay"].shape[0]
+    assert size >= n
+    pad = size - n
+    out = {}
+    for k, v in prep.items():
+        if k == "pre_ok":
+            out[k] = np.concatenate([v, np.zeros(pad, bool)])
+        else:
+            out[k] = np.concatenate(
+                [v, np.zeros((pad,) + v.shape[1:], v.dtype)])
+    return out
+
+
+def multichip_verify(pubs, sigs, msgs, mesh: Optional[Mesh] = None):
+    """End-to-end sharded verify: host prep → dp-sharded kernel → gather."""
+    from ..ops.ed25519 import prepare_batch
+    mesh = mesh or make_mesh()
+    ndev = mesh.devices.size
+    prep = prepare_batch(pubs, sigs, msgs)
+    n = prep["ay"].shape[0]
+    padded = -(-n // ndev) * ndev
+    prep = pad_batch_to(prep, padded)
+    fn = sharded_verify_fn(mesh)
+    ok = np.asarray(fn(
+        jnp.asarray(prep["ay"]), jnp.asarray(prep["a_sign"]),
+        jnp.asarray(prep["ry"]), jnp.asarray(prep["r_sign"]),
+        jnp.asarray(prep["s_nibs"]), jnp.asarray(prep["k_nibs"])))
+    return ok[:n] & prep["pre_ok"][:n]
