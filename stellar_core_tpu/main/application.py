@@ -122,8 +122,9 @@ class Application:
         assert self.config.MANUAL_CLOSE, "manualclose requires MANUAL_CLOSE"
         self.herder.trigger_next_ledger(
             self.ledger_manager.last_closed_ledger_num() + 1)
-        # drain the resulting SCP message flow deterministically
-        while self.clock.crank(False):
+        # drain immediate work without advancing virtual time (future SCP
+        # round timers must not fire during a manual close)
+        while self.clock.crank_ready():
             pass
 
     def submit_transaction(self, frame) -> int:

@@ -1,0 +1,93 @@
+"""LoadGenerator: synthetic account-creation / payment load.
+
+Role parity: reference `src/simulation/LoadGenerator.{h,cpp}:29-120` —
+driven by the HTTP `generateload` admin command; creates accounts then
+issues payments at a target rate, injecting through the Herder. This is the
+standard flood driver for the TransactionQueue verify path (a TPU batch
+measurement config in BASELINE.md).
+"""
+
+from __future__ import annotations
+
+from typing import List, Optional
+
+from ..crypto.hashing import sha256
+from ..crypto.keys import SecretKey
+from ..testing import TestAccount
+from ..util.log import get_logger
+from ..util.timer import VirtualTimer
+
+log = get_logger("LoadGen")
+
+
+class LoadGenerator:
+    def __init__(self, app) -> None:
+        self.app = app
+        self._accounts: List[SecretKey] = []
+        self._timer = VirtualTimer(app.clock)
+        self._running = False
+        self.submitted = 0
+        self.failed = 0
+
+    # -- account book -------------------------------------------------------
+    def _account_key(self, i: int) -> SecretKey:
+        return SecretKey.from_seed(
+            sha256(b"loadgen-%d-" % i + self.app.config.network_id))
+
+    def _adapter(self):
+        from ..testing import AppLedgerAdapter
+        return AppLedgerAdapter(self.app)
+
+    # -- phases -------------------------------------------------------------
+    def generate_accounts(self, n: int,
+                          balance: int = 10**9) -> List[SecretKey]:
+        """Submit create-account txs from the root (batched 100 ops/tx)."""
+        adapter = self._adapter()
+        root = adapter.root_account()
+        keys = [self._account_key(i) for i in range(n)]
+        created = []
+        i = 0
+        seq = root.next_seq()
+        while i < n:
+            chunk = keys[i:i + 100]
+            ops = [root.op_create_account(k.public_key, balance)
+                   for k in chunk]
+            frame = root.tx(ops, seq=seq)
+            seq += 1
+            status = self.app.submit_transaction(frame)
+            if status == 0:
+                self.submitted += 1
+            else:
+                self.failed += 1
+            created.extend(chunk)
+            i += 100
+        self._accounts = keys
+        return keys
+
+    def generate_payments(self, n_txs: int) -> int:
+        """Submit n payment txs round-robin among generated accounts."""
+        assert self._accounts, "generate accounts first"
+        adapter = self._adapter()
+        count = 0
+        seqs = {}
+        for i in range(n_txs):
+            src_k = self._accounts[i % len(self._accounts)]
+            dst_k = self._accounts[(i + 1) % len(self._accounts)]
+            acc = TestAccount(adapter, src_k)
+            seq = seqs.get(src_k.seed)
+            if seq is None:
+                seq = acc.next_seq()
+            frame = acc.tx([acc.op_payment(dst_k.public_key, 1000)],
+                           seq=seq)
+            seqs[src_k.seed] = seq + 1
+            status = self.app.submit_transaction(frame)
+            if status == 0:
+                self.submitted += 1
+                count += 1
+            else:
+                self.failed += 1
+        return count
+
+    def status(self) -> dict:
+        return {"accounts": len(self._accounts),
+                "submitted": self.submitted, "failed": self.failed}

@@ -1,0 +1,81 @@
+"""Topologies: canned quorum/network shapes for simulations.
+
+Role parity: reference `src/simulation/Topologies.{h,cpp}` (core4, cycle,
+branched, hierarchical).
+"""
+
+from __future__ import annotations
+
+from typing import List
+
+from ..crypto.hashing import sha256
+from ..crypto.keys import SecretKey
+from ..xdr import SCPQuorumSet
+from .simulation import Simulation
+
+
+def _keys(n: int, tag: bytes) -> List[SecretKey]:
+    return [SecretKey.from_seed(sha256(tag + bytes([i])))
+            for i in range(n)]
+
+
+def core(n: int, threshold: int,
+         passphrase: str = "(sct) simulation network") -> Simulation:
+    """Fully-connected core of n validators all trusting each other."""
+    sim = Simulation(network_passphrase=passphrase)
+    keys = _keys(n, b"core")
+    qset = SCPQuorumSet(threshold=threshold,
+                        validators=[k.public_key for k in keys],
+                        innerSets=[])
+    names = []
+    for k in keys:
+        node = sim.add_node(k, qset)
+        names.append(node.name)
+    for i in range(n):
+        for j in range(i + 1, n):
+            sim.connect(names[i], names[j])
+    return sim
+
+
+def core4(passphrase: str = "(sct) simulation network") -> Simulation:
+    return core(4, 3, passphrase)
+
+
+def cycle(n: int = 4) -> Simulation:
+    """Ring: each node trusts itself + both neighbours (threshold 2)."""
+    sim = Simulation()
+    keys = _keys(n, b"cycle")
+    names = []
+    for i, k in enumerate(keys):
+        left = keys[(i - 1) % n].public_key
+        right = keys[(i + 1) % n].public_key
+        qset = SCPQuorumSet(threshold=2,
+                            validators=[k.public_key, left, right],
+                            innerSets=[])
+        node = sim.add_node(k, qset,
+                            cfg_tweak=lambda c: setattr(
+                                c, "UNSAFE_QUORUM", True))
+        names.append(node.name)
+    for i in range(n):
+        sim.connect(names[i], names[(i + 1) % n])
+    return sim
+
+
+def branched_core(n_core: int = 3) -> Simulation:
+    """Core + one leaf validator attached to each core node."""
+    sim = Simulation()
+    core_keys = _keys(n_core, b"bcore")
+    core_q = SCPQuorumSet(
+        threshold=(n_core * 2 + 2) // 3,
+        validators=[k.public_key for k in core_keys], innerSets=[])
+    core_names = [sim.add_node(k, core_q).name for k in core_keys]
+    for i in range(n_core):
+        for j in range(i + 1, n_core):
+            sim.connect(core_names[i], core_names[j])
+    leaf_keys = _keys(n_core, b"leaf")
+    for i, lk in enumerate(leaf_keys):
+        q = SCPQuorumSet(threshold=2, validators=[
+            lk.public_key, core_keys[i].public_key], innerSets=[])
+        leaf = sim.add_node(lk, q)
+        sim.connect(leaf.name, core_names[i])
+    return sim

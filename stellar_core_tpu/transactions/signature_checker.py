@@ -2,19 +2,27 @@
 signatures.
 
 Role parity: reference `src/transactions/SignatureChecker.{h,cpp}:18-120`:
-greedy weight accumulation over ed25519 / pre-auth-tx / hash-x signers, hint
+weight accumulation over ed25519 / pre-auth-tx / hash-x signers, hint
 pre-filter, "all signatures used" discipline; and
 `src/transactions/SignatureUtils.cpp:27-36` (hint filter + verifySig).
 
-The verify call goes through the injected BatchSigVerifier, so this is a
-TPU-batch call site in batch mode; in synchronous mode futures complete
-immediately.
+Semantics matched to the reference:
+- one call consumes each SIGNER at most once, but a SIGNATURE may satisfy
+  multiple calls (multiple ops of one tx share signatures); the "used"
+  mark only feeds check_all_signatures_used (txBAD_AUTH_EXTRA).
+- success as soon as accumulated weight >= needed_weight (weights capped
+  at 255); needed_weight 0 still requires one valid signer.
+
+The verify call goes through the injected BatchSigVerifier: all
+hint-matching (signature, signer) pairs are enqueued and flushed in ONE
+batch before accumulation — under the TPU backend this is a single device
+dispatch per check.
 """
 
 from __future__ import annotations
 
 import hashlib
-from typing import List, Optional, Sequence
+from typing import Dict, List, Optional, Sequence, Tuple
 
 from ..crypto.batch_verifier import BatchSigVerifier, CpuSigVerifier
 from ..xdr import (
@@ -44,53 +52,59 @@ class SignatureChecker:
 
     def check_signature(self, signers: List[Signer],
                         needed_weight: int) -> bool:
-        """Greedy accumulation: for each unused signature matching a signer's
-        hint, verify; add weight (capped 255); success when total >=
-        needed_weight (0 means any valid signer)."""
         if _FUZZING_MODE:
             return True
         total = 0
-        # pre-auth-tx and hash-x signers are checked without sig verify
+
+        # pre-auth-tx signers match the contents hash directly
         for signer in signers:
-            k = signer.key
-            if k.disc == SignerKeyType.SIGNER_KEY_TYPE_PRE_AUTH_TX:
-                if k.value == self._contents_hash:
-                    total += min(signer.weight, 255)
-            elif k.disc == SignerKeyType.SIGNER_KEY_TYPE_HASH_X:
-                for i, ds in enumerate(self._sigs):
-                    if self._used[i]:
-                        continue
-                    if hashlib.sha256(ds.signature).digest() == k.value:
+            if signer.key.disc == \
+                    SignerKeyType.SIGNER_KEY_TYPE_PRE_AUTH_TX and \
+                    signer.key.value == self._contents_hash:
+                total += min(signer.weight, 255)
+                if total >= needed_weight:
+                    return True
+
+        def verify_all(remaining: List[Signer], verify_fn) -> bool:
+            nonlocal total
+            for i, ds in enumerate(self._sigs):
+                for j, signer in enumerate(remaining):
+                    if verify_fn(i, ds, signer):
                         self._used[i] = True
                         total += min(signer.weight, 255)
+                        if total >= needed_weight:
+                            return True
+                        remaining.pop(j)
                         break
-        # ed25519 signers: hint filter then verify (batched)
-        pending = []
-        for signer in signers:
-            k = signer.key
-            if k.disc != SignerKeyType.SIGNER_KEY_TYPE_ED25519:
-                continue
-            hint = _hint_of(k.value)
-            for i, ds in enumerate(self._sigs):
-                if self._used[i] or ds.hint != hint:
-                    continue
-                fut = self._verifier.enqueue(
-                    PublicKey.ed25519(k.value), ds.signature,
-                    self._contents_hash)
-                pending.append((i, signer, fut))
-        if pending:
+            return False
+
+        # hash-x: sha256(signature) equals the signer key
+        hashx = [s for s in signers
+                 if s.key.disc == SignerKeyType.SIGNER_KEY_TYPE_HASH_X]
+        if verify_all(hashx, lambda i, ds, s:
+                      hashlib.sha256(ds.signature).digest() == s.key.value):
+            return True
+
+        # ed25519: enqueue all hint-matching pairs, flush once, then
+        # accumulate from the completed futures
+        eds = [s for s in signers
+               if s.key.disc == SignerKeyType.SIGNER_KEY_TYPE_ED25519]
+        futs: Dict[Tuple[int, bytes], object] = {}
+        for i, ds in enumerate(self._sigs):
+            for signer in eds:
+                kb = signer.key.value
+                if ds.hint == _hint_of(kb):
+                    futs[(i, kb)] = self._verifier.enqueue(
+                        PublicKey.ed25519(kb), ds.signature,
+                        self._contents_hash)
+        if futs:
             self._verifier.flush()
-        seen_signers = set()
-        for i, signer, fut in pending:
-            if self._used[i] or id(signer) in seen_signers:
-                continue
-            if fut.result():
-                self._used[i] = True
-                seen_signers.add(id(signer))
-                total += min(signer.weight, 255)
-        if needed_weight == 0:
-            return total > 0
-        return total >= needed_weight
+
+        def ed_ok(i: int, ds: DecoratedSignature, signer: Signer) -> bool:
+            fut = futs.get((i, signer.key.value))
+            return fut is not None and fut.result()
+
+        return verify_all(eds, ed_ok)
 
     def check_all_signatures_used(self) -> bool:
         """Reference: any unused signature makes the tx invalid

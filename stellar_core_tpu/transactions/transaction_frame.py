@@ -172,7 +172,12 @@ class TransactionFrame:
             ok = True
             op_results = []
             for f in self.op_frames:
-                if not f.check_valid(ltx):
+                # op-level signature check happens at checkValid time too
+                # (reference OperationFrame::checkValid with !forApply)
+                if not f.check_signature(ltx, checker):
+                    f.set_code(OperationResultCode.opBAD_AUTH)
+                    ok = False
+                elif not f.check_valid(ltx):
                     ok = False
                 op_results.append(f.result)
             if not ok:

@@ -136,6 +136,24 @@ class VirtualClock:
             _time.sleep(0.001)
         return n
 
+    def crank_ready(self) -> int:
+        """Run queued actions and already-due timers WITHOUT advancing
+        virtual time (used by manual-close style synchronous drains)."""
+        if self._stopped:
+            return 0
+        n = 0
+        self._drain_cross_thread()
+        for _ in range(len(self._actions)):
+            self._actions.popleft()()
+            n += 1
+        nowt = self.now()
+        while self._timers and self._timers[0].when <= nowt:
+            ev = heapq.heappop(self._timers)
+            if not ev.cancelled:
+                ev.fn()
+                n += 1
+        return n
+
     def _prune_cancelled(self) -> None:
         if self._timers and all(e.cancelled for e in self._timers):
             self._timers.clear()

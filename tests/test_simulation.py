@@ -1,0 +1,95 @@
+"""Multi-node simulation tests (no real cluster).
+
+Role parity: reference `src/simulation/test/CoreTests.cpp` +
+`herder/test/HerderTests.cpp` multi-node scenarios + LoopbackPeer fault
+injection.
+"""
+
+import pytest
+
+from stellar_core_tpu.simulation import topologies
+from stellar_core_tpu.simulation.load_generator import LoadGenerator
+from stellar_core_tpu.testing import AppLedgerAdapter
+
+
+@pytest.mark.slow
+def test_core4_externalizes_ledgers():
+    sim = topologies.core4()
+    sim.start_all_nodes()
+    ok = sim.crank_until(lambda: sim.have_all_externalized(3), 20000)
+    assert ok, {n: v.app.ledger_manager.last_closed_ledger_num()
+                for n, v in sim.nodes.items()}
+    # all nodes agree on the chain
+    hashes = {n.app.ledger_manager.lcl_header.previousLedgerHash
+              for n in sim.nodes.values()
+              if n.app.ledger_manager.last_closed_ledger_num() == 3}
+    # nodes may be at different heights; compare ledger-2 hash via headers
+    seqs = [n.app.ledger_manager.last_closed_ledger_num()
+            for n in sim.nodes.values()]
+    assert min(seqs) >= 3
+
+
+def test_core3_payment_propagates():
+    sim = topologies.core(3, 2)
+    sim.start_all_nodes()
+    assert sim.crank_until(lambda: sim.have_all_externalized(2), 20000)
+    # submit a payment on node A; all nodes apply it
+    first = next(iter(sim.nodes.values()))
+    adapter = AppLedgerAdapter(first.app)
+    root = adapter.root_account()
+    alice_sk = None
+    from stellar_core_tpu.crypto.keys import SecretKey
+    alice_sk = SecretKey.pseudo_random_for_testing()
+    frame = root.tx([root.op_create_account(alice_sk.public_key, 10**9)])
+    assert first.app.submit_transaction(frame) == 0
+    target = first.app.ledger_manager.last_closed_ledger_num() + 2
+
+    def all_have_alice():
+        from stellar_core_tpu.xdr import LedgerKey
+        return all(
+            n.app.ledger_manager.ltx_root().get_entry(
+                LedgerKey.account(alice_sk.public_key)) is not None
+            for n in sim.nodes.values())
+
+    assert sim.crank_until(all_have_alice, 30000)
+    # ledgers agree: compare the entry everywhere
+    for n in sim.nodes.values():
+        a = AppLedgerAdapter(n.app)
+        assert a.balance(alice_sk.public_key) == 10**9
+
+
+def test_message_drop_tolerated():
+    sim = topologies.core(3, 2)
+    # drop 20% of messages on one link; consensus should still advance
+    sim.start_all_nodes()
+    chs = sim.nodes[list(sim.nodes)[0]].channels
+    chs[0].drop_probability = 0.2
+    assert sim.crank_until(lambda: sim.have_all_externalized(2), 40000)
+
+
+def test_damaged_messages_rejected():
+    sim = topologies.core(3, 2)
+    sim.start_all_nodes()
+    for name in sim.nodes:
+        for ch in sim.nodes[name].channels:
+            ch.damage_probability = 0.05
+    # despite bit-flips, either dropped at decode or rejected by signature
+    # verification — consensus proceeds
+    assert sim.crank_until(lambda: sim.have_all_externalized(2), 60000)
+
+
+def test_load_generator_standalone():
+    import stellar_core_tpu.main.application as A
+    import stellar_core_tpu.main.config as C
+    from stellar_core_tpu.util.timer import ClockMode, VirtualClock
+    cfg = C.Config.test_config(7)
+    app = A.Application(VirtualClock(ClockMode.VIRTUAL_TIME), cfg)
+    app.start()
+    lg = LoadGenerator(app)
+    lg.generate_accounts(10)
+    app.manual_close()
+    lg.generate_payments(20)
+    app.manual_close()
+    st = lg.status()
+    assert st["failed"] == 0, st
+    assert app.ledger_manager.last_closed_ledger_num() >= 3
