@@ -1,0 +1,142 @@
+"""BucketManager: content-addressed bucket store + the node's BucketList.
+
+Role parity: reference `src/bucket/BucketManager{,Impl}.{h,cpp}` — owns the
+bucket directory (files named bucket-<hex>.xdr), dedups adopted buckets by
+hash, tracks referenced hashes for GC (forgetUnreferencedBuckets), and runs
+level merges on a shared worker pool (reference worker threads;
+ThreadPoolExecutor here).
+"""
+
+from __future__ import annotations
+
+import os
+import threading
+from concurrent.futures import ThreadPoolExecutor
+from typing import Dict, List, Optional, Sequence
+
+from ..util.log import get_logger
+from ..xdr import LedgerEntry, LedgerKey
+from .bucket import Bucket
+from .bucket_list import BucketList, K_NUM_LEVELS
+
+log = get_logger("Bucket")
+
+ZERO_HASH = b"\x00" * 32
+
+
+class BucketManager:
+    def __init__(self, bucket_dir: Optional[str] = None,
+                 background_merges: bool = True,
+                 num_workers: int = 2) -> None:
+        self.bucket_dir = bucket_dir
+        if bucket_dir:
+            os.makedirs(bucket_dir, exist_ok=True)
+        self._lock = threading.Lock()
+        self._shared: Dict[bytes, Bucket] = {}
+        self._executor = (ThreadPoolExecutor(
+            max_workers=num_workers,
+            thread_name_prefix="bucket-merge") if background_merges else None)
+        self.bucket_list = BucketList(self._executor, adopt=self.adopt_bucket)
+
+    # -- store ---------------------------------------------------------------
+    def bucket_filename(self, hash_: bytes) -> Optional[str]:
+        if not self.bucket_dir:
+            return None
+        return os.path.join(self.bucket_dir, "bucket-%s.xdr" % hash_.hex())
+
+    def adopt_bucket(self, b: Bucket) -> Bucket:
+        """Deduplicate by hash and persist to the bucket dir (reference
+        BucketManagerImpl::adoptFileAsBucket)."""
+        h = b.get_hash()
+        if h == ZERO_HASH:
+            return b
+        with self._lock:
+            existing = self._shared.get(h)
+            if existing is not None:
+                return existing
+            path = self.bucket_filename(h)
+            if path and not os.path.exists(path):
+                b.write_to(path + ".tmp")
+                os.replace(path + ".tmp", path)
+                b.path = path
+            self._shared[h] = b
+            return b
+
+    def get_bucket_by_hash(self, hash_: bytes) -> Optional[Bucket]:
+        if hash_ == ZERO_HASH:
+            return Bucket()
+        with self._lock:
+            b = self._shared.get(hash_)
+        if b is not None:
+            return b
+        path = self.bucket_filename(hash_)
+        if path and os.path.exists(path):
+            b = Bucket.read_from(path)
+            return self.adopt_bucket(b)
+        return None
+
+    # -- the list ------------------------------------------------------------
+    def add_batch(self, curr_ledger: int, curr_ledger_protocol: int,
+                  init_entries: Sequence[LedgerEntry],
+                  live_entries: Sequence[LedgerEntry],
+                  dead_entries: Sequence[LedgerKey]) -> None:
+        self.bucket_list.add_batch(curr_ledger, curr_ledger_protocol,
+                                   init_entries, live_entries, dead_entries)
+
+    def get_hash(self) -> bytes:
+        return self.bucket_list.get_hash()
+
+    def get_referenced_hashes(self) -> List[bytes]:
+        refs: List[bytes] = []
+        for lev in self.bucket_list.levels:
+            for b in (lev.curr, lev.snap):
+                if b.get_hash() != ZERO_HASH:
+                    refs.append(b.get_hash())
+            if lev.next.is_live():
+                if lev.next.merge_complete():
+                    refs.append(lev.next.resolve().get_hash())
+                else:
+                    if lev.next.input_curr_hash:
+                        refs.append(lev.next.input_curr_hash)
+                    if lev.next.input_snap_hash:
+                        refs.append(lev.next.input_snap_hash)
+                    refs.extend(lev.next.input_shadow_hashes)
+        return refs
+
+    def forget_unreferenced_buckets(
+            self, extra_refs: Sequence[bytes] = ()) -> int:
+        """GC: drop in-memory and on-disk buckets not referenced by the
+        list (or by pending publish work via extra_refs) — reference
+        BucketManagerImpl::forgetUnreferencedBuckets."""
+        keep = set(self.get_referenced_hashes()) | set(extra_refs)
+        dropped = 0
+        with self._lock:
+            for h in list(self._shared):
+                if h not in keep:
+                    b = self._shared.pop(h)
+                    if b.path and os.path.exists(b.path):
+                        os.remove(b.path)
+                    dropped += 1
+        return dropped
+
+    # -- state restore (catchup / restart) -----------------------------------
+    def assume_state(self, level_hashes: Sequence[Dict[str, bytes]],
+                     curr_ledger: int, max_protocol_version: int) -> None:
+        """Adopt a full set of level hashes (from a HistoryArchiveState)
+        as the current bucket list, then restart merges (reference
+        BucketManagerImpl::assumeState)."""
+        assert len(level_hashes) == K_NUM_LEVELS
+        for i, lh in enumerate(level_hashes):
+            lev = self.bucket_list.get_level(i)
+            curr = self.get_bucket_by_hash(lh["curr"])
+            snap = self.get_bucket_by_hash(lh["snap"])
+            if curr is None or snap is None:
+                raise KeyError("missing bucket for level %d" % i)
+            lev.curr = curr
+            lev.snap = snap
+            lev.next.clear()
+        self.bucket_list.restart_merges(curr_ledger, max_protocol_version)
+
+    def shutdown(self) -> None:
+        if self._executor is not None:
+            self._executor.shutdown(wait=True)

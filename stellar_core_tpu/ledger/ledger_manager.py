@@ -192,8 +192,8 @@ class LedgerManager:
                     init_entries.append(cur)
                 else:
                     live_entries.append(cur)
-            bl.add_batch(header.ledgerSeq, init_entries, live_entries,
-                         dead_keys)
+            bl.add_batch(header.ledgerSeq, header.ledgerVersion,
+                         init_entries, live_entries, dead_keys)
             header.bucketListHash = bl.get_hash()
         else:
             h = SHA256()

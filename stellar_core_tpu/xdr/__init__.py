@@ -20,7 +20,8 @@ from .ledger_entries import (
     AssetType, DataEntry, LedgerEntry, LedgerEntryData, LedgerEntryType,
     LedgerKey, LedgerKeyAccount, LedgerKeyData, LedgerKeyOffer,
     LedgerKeyTrustLine, OfferEntry, OfferEntryFlags, Price, SequenceNumber,
-    Signer, TrustLineEntry, TrustLineFlags, ledger_entry_key, _Ext,
+    Signer, TrustLineEntry, TrustLineFlags, ledger_entry_key,
+    ledger_key_sort_key, _Ext,
 )
 from .transaction import (
     AllowTrustAsset, AllowTrustOp, BumpSequenceOp, ChangeTrustOp,
@@ -41,6 +42,7 @@ from .transaction import (
     InflationResult, ManageDataResult, BumpSequenceResult,
 )
 from .ledger import (
+    BucketEntry, BucketEntryType, BucketMetadata,
     LedgerCloseValueSignature, LedgerEntryChange, LedgerEntryChangeType,
     LedgerEntryChanges, LedgerHeader, LedgerHeaderHistoryEntry, LedgerUpgrade,
     LedgerUpgradeType, OperationMeta, StellarValue, StellarValueExt,

@@ -133,3 +133,47 @@ class TransactionMetaV1(XdrStruct):
 
 class TransactionMeta(XdrUnion):
     xdr_arms = {1: ("v1", TransactionMetaV1)}
+
+
+# --- Bucket entries (reference src/xdr/Stellar-ledger.x:148-182) -----------
+
+class BucketEntryType:
+    """METAENTRY sorts first in buckets; INITENTRY = created (protocol>=11),
+    LIVEENTRY = updated, DEADENTRY = tombstone."""
+    METAENTRY = -1
+    LIVEENTRY = 0
+    DEADENTRY = 1
+    INITENTRY = 2
+
+
+class BucketMetadata(XdrStruct):
+    """First entry of every bucket at protocol >= 11; records the protocol
+    version used to create/merge the bucket."""
+    xdr_fields = [("ledgerVersion", Uint32), ("ext", _Ext)]
+
+
+class BucketEntry(XdrUnion):
+    xdr_arms = {
+        BucketEntryType.LIVEENTRY: ("liveEntry", LedgerEntry),
+        BucketEntryType.INITENTRY: ("liveEntry", LedgerEntry),
+        BucketEntryType.DEADENTRY: ("deadEntry", LedgerKey),
+        BucketEntryType.METAENTRY: ("metaEntry", BucketMetadata),
+    }
+
+    @classmethod
+    def live(cls, e: LedgerEntry) -> "BucketEntry":
+        return cls(BucketEntryType.LIVEENTRY, e)
+
+    @classmethod
+    def init(cls, e: LedgerEntry) -> "BucketEntry":
+        return cls(BucketEntryType.INITENTRY, e)
+
+    @classmethod
+    def dead(cls, k: LedgerKey) -> "BucketEntry":
+        return cls(BucketEntryType.DEADENTRY, k)
+
+    @classmethod
+    def meta(cls, ledger_version: int) -> "BucketEntry":
+        return cls(BucketEntryType.METAENTRY,
+                   BucketMetadata(ledgerVersion=ledger_version,
+                                  ext=_Ext.v0()))

@@ -235,3 +235,24 @@ def ledger_entry_key(entry: LedgerEntry) -> LedgerKey:
     if t == LedgerEntryType.DATA:
         return LedgerKey.data(d.value.accountID, d.value.dataName)
     raise XdrError("bad entry type %d" % t)
+
+
+def ledger_key_sort_key(key: LedgerKey):
+    """Total order on ledger-entry identities matching the reference's
+    field-wise LedgerEntryIdCmp (src/bucket/LedgerCmp.h:27-87): type first,
+    then the identifying fields. dataName compares as a raw byte string
+    (C++ std::string order), NOT as XDR (which is length-prefixed)."""
+    t = key.disc
+    v = key.value
+    if t == LedgerEntryType.ACCOUNT:
+        return (t, v.accountID.to_xdr())
+    if t == LedgerEntryType.TRUSTLINE:
+        return (t, v.accountID.to_xdr(), v.asset.to_xdr())
+    if t == LedgerEntryType.OFFER:
+        return (t, v.sellerID.to_xdr(), v.offerID)
+    if t == LedgerEntryType.DATA:
+        name = v.dataName
+        if isinstance(name, str):
+            name = name.encode()
+        return (t, v.accountID.to_xdr(), name)
+    raise XdrError("bad key type %d" % t)
