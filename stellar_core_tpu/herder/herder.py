@@ -338,11 +338,14 @@ class Herder:
         self.tx_queue.remove_applied(list(txset.frames))
         self.tx_queue.shift()
 
-        # GC old slots + pending state
+        # GC old slots + pending state + overlay flood records
         keep_from = max(1, slot_index -
                         self.app.config.MAX_SLOTS_TO_REMEMBER + 1)
         self.scp.purge_slots(keep_from)
         self.pending.erase_below(keep_from)
+        overlay = getattr(self.app, "overlay_manager", None)
+        if overlay is not None and hasattr(overlay, "ledger_closed"):
+            overlay.ledger_closed(slot_index)
 
         if not self.app.config.MANUAL_CLOSE:
             self._arm_trigger_timer()

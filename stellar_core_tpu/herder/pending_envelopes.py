@@ -116,10 +116,12 @@ class PendingEnvelopes:
         if missing:
             self.fetching.setdefault(slot, []).append(env)
             for kind, h in missing:
+                # the envelope rides along so trackers know which slots
+                # still depend on the item (ItemFetcher GC keys off it)
                 if kind == "qset" and self.fetch_qset_fn:
-                    self.fetch_qset_fn(h)
+                    self.fetch_qset_fn(h, env)
                 elif kind == "txset" and self.fetch_txset_fn:
-                    self.fetch_txset_fn(h)
+                    self.fetch_txset_fn(h, env)
             return False
         self.processed.setdefault(slot, set()).add(eh)
         self.herder.envelope_ready(env)

@@ -1,0 +1,104 @@
+"""HistoryArchive: remote file store reached through operator shell
+commands.
+
+Role parity: reference `src/history/HistoryArchive.{h,cpp}` +
+`history/readme.md:1-30` — an archive is configured as `get`/`put`/`mkdir`
+command templates ({0}=remote path, {1}=local path for get; {0}=local,
+{1}=remote for put), so operators plug in curl/aws/cp. Layout
+(reference FileTransferInfo.cpp): `<category>/<aa>/<bb>/<cc>/
+<category>-<hex8>.xdr.gz` where hex8 is the checkpoint ledger and
+aa/bb/cc are its first three hex bytes; HistoryArchiveState JSON at
+`.well-known/stellar-history.json` and
+`history/<aa>/<bb>/<cc>/history-<hex8>.json`.
+"""
+
+from __future__ import annotations
+
+import os
+import shlex
+import subprocess
+from typing import Callable, Optional
+
+from ..util.log import get_logger
+
+log = get_logger("History")
+
+
+def hex8(n: int) -> str:
+    return "%08x" % n
+
+
+def category_path(category: str, checkpoint: int, suffix: str) -> str:
+    h = hex8(checkpoint)
+    return "%s/%s/%s/%s/%s-%s%s" % (category, h[0:2], h[2:4], h[4:6],
+                                    category, h, suffix)
+
+
+def bucket_path(hash_hex: str) -> str:
+    return "bucket/%s/%s/%s/bucket-%s.xdr.gz" % (
+        hash_hex[0:2], hash_hex[2:4], hash_hex[4:6], hash_hex)
+
+
+WELL_KNOWN = ".well-known/stellar-history.json"
+
+
+class HistoryArchive:
+    """One configured archive. Commands run as subprocesses (reference
+    runs them through ProcessManager); a plain directory path works too
+    (file archive: cp/mkdir fallbacks)."""
+
+    def __init__(self, name: str, get_tmpl: str = "", put_tmpl: str = "",
+                 mkdir_tmpl: str = "") -> None:
+        self.name = name
+        self.get_tmpl = get_tmpl
+        self.put_tmpl = put_tmpl
+        self.mkdir_tmpl = mkdir_tmpl
+
+    @classmethod
+    def from_config(cls, name: str, d: dict) -> "HistoryArchive":
+        return cls(name, d.get("get", ""), d.get("put", ""),
+                   d.get("mkdir", ""))
+
+    @classmethod
+    def local_dir(cls, name: str, root: str) -> "HistoryArchive":
+        """file:// archive rooted at a directory (the reference test
+        archives use exactly this shape)."""
+        root = os.path.abspath(root)
+        return cls(name,
+                   get_tmpl="cp %s/{0} {1}" % shlex.quote(root),
+                   put_tmpl="cp {0} %s/{1}" % shlex.quote(root),
+                   mkdir_tmpl="mkdir -p %s/{0}" % shlex.quote(root))
+
+    def has_get(self) -> bool:
+        return bool(self.get_tmpl)
+
+    def has_put(self) -> bool:
+        return bool(self.put_tmpl)
+
+    # -- command builders (used by history works) ----------------------------
+    def get_cmd(self, remote: str, local: str) -> str:
+        return self.get_tmpl.replace("{0}", shlex.quote(remote)) \
+                            .replace("{1}", shlex.quote(local))
+
+    def put_cmd(self, local: str, remote: str) -> str:
+        return self.put_tmpl.replace("{0}", shlex.quote(local)) \
+                            .replace("{1}", shlex.quote(remote))
+
+    def mkdir_cmd(self, remote_dir: str) -> str:
+        return self.mkdir_tmpl.replace("{0}", shlex.quote(remote_dir))
+
+    # -- synchronous conveniences (CLI paths, tests) -------------------------
+    def get_file_sync(self, remote: str, local: str) -> bool:
+        cmd = self.get_cmd(remote, local)
+        r = subprocess.run(cmd, shell=True, capture_output=True)
+        return r.returncode == 0
+
+    def put_file_sync(self, local: str, remote: str) -> bool:
+        if self.mkdir_tmpl:
+            d = os.path.dirname(remote)
+            if d:
+                subprocess.run(self.mkdir_cmd(d), shell=True,
+                               capture_output=True)
+        r = subprocess.run(self.put_cmd(local, remote), shell=True,
+                           capture_output=True)
+        return r.returncode == 0

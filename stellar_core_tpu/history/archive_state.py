@@ -1,0 +1,90 @@
+"""HistoryArchiveState: the JSON manifest naming a checkpoint's buckets.
+
+Role parity: reference `src/history/HistoryArchive.{h,cpp}` (HAS struct,
+cereal-serialized) — version, server string, currentLedger, and one
+{curr, snap, next} hash triple per bucket level. `next` captures an
+in-flight merge so restarts can resume it (reference FutureBucket
+serialization states: clear / hashes / live-output).
+"""
+
+from __future__ import annotations
+
+import json
+from typing import List, Optional
+
+HAS_VERSION = 1
+ZERO = "0" * 64
+
+
+class HASLevel:
+    def __init__(self, curr: str = ZERO, snap: str = ZERO,
+                 next_state: int = 0,
+                 next_output: Optional[str] = None) -> None:
+        self.curr = curr
+        self.snap = snap
+        self.next_state = next_state
+        self.next_output = next_output
+
+    def to_dict(self) -> dict:
+        nxt: dict = {"state": self.next_state}
+        if self.next_output is not None:
+            nxt["output"] = self.next_output
+        return {"curr": self.curr, "next": nxt, "snap": self.snap}
+
+    @classmethod
+    def from_dict(cls, d: dict) -> "HASLevel":
+        nxt = d.get("next", {}) or {}
+        return cls(d.get("curr", ZERO), d.get("snap", ZERO),
+                   nxt.get("state", 0), nxt.get("output"))
+
+
+class HistoryArchiveState:
+    def __init__(self, current_ledger: int = 0,
+                 levels: Optional[List[HASLevel]] = None,
+                 server: str = "stellar-core-tpu") -> None:
+        from ..bucket import K_NUM_LEVELS
+        self.version = HAS_VERSION
+        self.server = server
+        self.current_ledger = current_ledger
+        self.levels = levels or [HASLevel() for _ in range(K_NUM_LEVELS)]
+
+    @classmethod
+    def from_bucket_list(cls, current_ledger: int, bucket_list,
+                         server: str = "stellar-core-tpu"
+                         ) -> "HistoryArchiveState":
+        levels = []
+        for lev in bucket_list.levels:
+            nxt_state, nxt_out = 0, None
+            if lev.next.is_live() and lev.next.merge_complete():
+                nxt_state, nxt_out = 1, lev.next.resolve().get_hash().hex()
+            levels.append(HASLevel(lev.curr.get_hash().hex(),
+                                   lev.snap.get_hash().hex(),
+                                   nxt_state, nxt_out))
+        return cls(current_ledger, levels, server)
+
+    def bucket_hashes(self) -> List[str]:
+        """Every non-zero hash referenced (reference
+        HistoryArchiveState::allBuckets)."""
+        out = []
+        for lv in self.levels:
+            for h in (lv.curr, lv.snap, lv.next_output):
+                if h and h != ZERO:
+                    out.append(h)
+        return out
+
+    def to_json(self) -> str:
+        return json.dumps({
+            "version": self.version,
+            "server": self.server,
+            "currentLedger": self.current_ledger,
+            "currentBuckets": [lv.to_dict() for lv in self.levels],
+        }, indent=2)
+
+    @classmethod
+    def from_json(cls, s: str) -> "HistoryArchiveState":
+        d = json.loads(s)
+        has = cls(d["currentLedger"],
+                  [HASLevel.from_dict(x) for x in d["currentBuckets"]],
+                  d.get("server", ""))
+        has.version = d.get("version", HAS_VERSION)
+        return has

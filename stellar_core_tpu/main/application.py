@@ -64,13 +64,16 @@ class Application:
         self.bucket_manager = None   # wired in enable_buckets()
         self.history_manager = None  # wired by history layer
         self.catchup_manager = None
-        self.overlay_manager = None  # wired by overlay layer
+        self.overlay_manager = None  # real OverlayManager unless simulated
         self.ledger_manager = LedgerManager(self)
 
         from ..herder.herder import Herder
         if config.QUORUM_SET is None:
             config.QUORUM_SET = config.self_qset()
         self.herder = Herder(self)
+
+        from ..overlay.overlay_manager import OverlayManager
+        self.overlay_manager = OverlayManager(self)
 
         from ..work.scheduler import WorkScheduler
         self.work_scheduler = WorkScheduler(self.clock)

@@ -1,0 +1,89 @@
+"""Floodgate: de-duplicated gossip flooding.
+
+Role parity: reference `src/overlay/Floodgate.{h,cpp}:38-107` — a record
+per flooded message (SHA256 of its XDR) tracking which peers already have
+it; broadcast sends to every authenticated peer not in the set; records are
+garbage-collected by the ledger seq they were added at.
+"""
+
+from __future__ import annotations
+
+from typing import Dict, Set
+
+from ..crypto.hashing import sha256
+from ..util.log import get_logger
+from ..xdr import StellarMessage
+
+log = get_logger("Overlay")
+
+
+class _FloodRecord:
+    __slots__ = ("ledger_seq", "message", "peers_told")
+
+    def __init__(self, ledger_seq: int, message: StellarMessage) -> None:
+        self.ledger_seq = ledger_seq
+        self.message = message
+        self.peers_told: Set[str] = set()
+
+
+class Floodgate:
+    def __init__(self) -> None:
+        self._map: Dict[bytes, _FloodRecord] = {}
+        self._shutting_down = False
+
+    @staticmethod
+    def msg_id(msg: StellarMessage) -> bytes:
+        return sha256(msg.to_xdr())
+
+    def add_record(self, msg: StellarMessage, from_peer_id: str,
+                   ledger_seq: int) -> bool:
+        """Note an incoming flooded message; returns False if seen before
+        (reference Floodgate::addRecord)."""
+        if self._shutting_down:
+            return False
+        h = self.msg_id(msg)
+        rec = self._map.get(h)
+        if rec is None:
+            rec = _FloodRecord(ledger_seq, msg)
+            self._map[h] = rec
+            rec.peers_told.add(from_peer_id)
+            return True
+        rec.peers_told.add(from_peer_id)
+        return False
+
+    def broadcast(self, msg: StellarMessage, force: bool, peers: Dict,
+                  ledger_seq: int) -> int:
+        """Send to every authenticated peer not already told; returns the
+        number sent (reference Floodgate::broadcast, Floodgate.cpp:81-107)."""
+        if self._shutting_down:
+            return 0
+        h = self.msg_id(msg)
+        rec = self._map.get(h)
+        if rec is None:
+            rec = _FloodRecord(ledger_seq, msg)
+            self._map[h] = rec
+        n = 0
+        for pid, peer in list(peers.items()):
+            if pid in rec.peers_told:
+                continue
+            peer.send_message(msg)
+            rec.peers_told.add(pid)
+            n += 1
+        return n
+
+    def forget_record(self, msg: StellarMessage) -> None:
+        self._map.pop(self.msg_id(msg), None)
+
+    def clear_below(self, ledger_seq: int, keep: int = 2) -> None:
+        """GC records older than `keep` ledgers (reference
+        Floodgate::clearBelow)."""
+        cutoff = ledger_seq - keep
+        for h in [h for h, r in self._map.items() if r.ledger_seq < cutoff]:
+            del self._map[h]
+
+    def shutdown(self) -> None:
+        self._shutting_down = True
+        self._map.clear()
+
+    def size(self) -> int:
+        return len(self._map)

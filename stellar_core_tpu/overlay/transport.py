@@ -1,0 +1,345 @@
+"""Overlay transports: loopback pipes and real TCP sockets.
+
+Role parity:
+- LoopbackTransport ↔ reference `src/overlay/test/LoopbackPeer.{h,cpp}`:
+  paired in-memory queues between two Applications, with the same fault
+  knobs (drop/damage/duplicate/reorder probabilities) used by flood and
+  herder tests.
+- TCPTransport/TCPDoor ↔ reference `src/overlay/TCPPeer.cpp` +
+  `PeerDoor.cpp`: length-framed XDR over asio sockets. Here a per-overlay
+  reactor thread owns the sockets (the asio io thread role) and posts
+  complete frames to the owning Application's VirtualClock via
+  post_to_main, preserving the single-threaded consensus contract.
+
+Framing: 4-byte big-endian record mark with the high bit set (single
+fragment), matching the project's XDR stream framing.
+"""
+
+from __future__ import annotations
+
+import errno
+import socket
+import struct
+import threading
+from collections import deque
+from typing import Callable, Deque, Dict, Optional, Tuple
+
+from ..util import rnd
+from ..util.log import get_logger
+
+log = get_logger("Overlay")
+
+_LAST_FRAG = 0x80000000
+MAX_FRAME = 0x2000000        # 32 MiB hard cap on one message
+
+
+class Transport:
+    """Frame pipe interface: owner assigns on_frame/on_closed callbacks."""
+
+    on_frame: Callable[[bytes], None]
+    on_closed: Callable[[], None]
+
+    def send_frame(self, raw: bytes) -> None:
+        raise NotImplementedError
+
+    def close(self) -> None:
+        raise NotImplementedError
+
+
+class LoopbackTransport(Transport):
+    """One end of an in-process pipe. Delivery is posted onto the RECEIVING
+    side's clock so each node only touches its own state on its own crank
+    (the simulation lock-step contract)."""
+
+    def __init__(self, clock) -> None:
+        self.clock = clock                 # receiving side's clock
+        self.other: Optional["LoopbackTransport"] = None
+        self.on_frame = lambda raw: None
+        self.on_closed = lambda: None
+        self.closed = False
+        # fault injection on the SENDING side (reference LoopbackPeer.h:35-46)
+        self.drop_probability = 0.0
+        self.damage_probability = 0.0
+        self.duplicate_probability = 0.0
+        self.reorder_probability = 0.0
+        self._reorder_held: Optional[bytes] = None
+
+    @classmethod
+    def pair(cls, clock_a, clock_b
+             ) -> Tuple["LoopbackTransport", "LoopbackTransport"]:
+        a, b = cls(clock_a), cls(clock_b)
+        a.other, b.other = b, a
+        return a, b
+
+    def send_frame(self, raw: bytes) -> None:
+        if self.closed or self.other is None:
+            return
+        r = rnd.g_random
+        if self.drop_probability and r.random() < self.drop_probability:
+            return
+        if self.damage_probability and r.random() < self.damage_probability:
+            buf = bytearray(raw)
+            buf[r.randrange(len(buf))] ^= 0xFF
+            raw = bytes(buf)
+        frames = [raw]
+        if self.duplicate_probability and \
+                r.random() < self.duplicate_probability:
+            frames.append(raw)
+        if self.reorder_probability and r.random() < self.reorder_probability \
+                and self._reorder_held is None:
+            self._reorder_held = raw
+            return
+        if self._reorder_held is not None:
+            frames.append(self._reorder_held)
+            self._reorder_held = None
+        other = self.other
+        for f in frames:
+            other.clock.post(lambda f=f: other._deliver(f))
+
+    def _deliver(self, raw: bytes) -> None:
+        if not self.closed:
+            self.on_frame(raw)
+
+    def close(self) -> None:
+        if self.closed:
+            return
+        self.closed = True
+        other = self.other
+        if other is not None and not other.closed:
+            other.clock.post(other._closed_by_peer)
+
+    def _closed_by_peer(self) -> None:
+        if not self.closed:
+            self.closed = True
+            self.on_closed()
+
+
+class TCPReactor:
+    """Minimal socket reactor thread (the asio io-thread role): reads frames
+    off nonblocking sockets, posts them to the main clock; drains per-socket
+    write queues; accepts inbound connections."""
+
+    def __init__(self, clock) -> None:
+        self.clock = clock
+        self._lock = threading.Lock()
+        self._transports: Dict[socket.socket, "TCPTransport"] = {}
+        self._doors: Dict[socket.socket, Callable] = {}
+        self._wake_r, self._wake_w = socket.socketpair()
+        self._wake_r.setblocking(False)
+        self._thread: Optional[threading.Thread] = None
+        self._stopped = False
+
+    def start(self) -> None:
+        if self._thread is None:
+            self._thread = threading.Thread(target=self._run,
+                                            name="overlay-io", daemon=True)
+            self._thread.start()
+
+    def wake(self) -> None:
+        try:
+            self._wake_w.send(b"x")
+        except OSError:
+            pass
+
+    def add_transport(self, t: "TCPTransport") -> None:
+        with self._lock:
+            self._transports[t.sock] = t
+        self.wake()
+
+    def remove_transport(self, t: "TCPTransport") -> None:
+        with self._lock:
+            self._transports.pop(t.sock, None)
+        self.wake()
+
+    def add_door(self, sock: socket.socket,
+                 on_accept: Callable[[socket.socket, tuple], None]) -> None:
+        with self._lock:
+            self._doors[sock] = on_accept
+        self.wake()
+
+    def stop(self) -> None:
+        self._stopped = True
+        self.wake()
+        if self._thread is not None:
+            self._thread.join(timeout=2.0)
+            self._thread = None
+        with self._lock:
+            for s in list(self._doors):
+                try:
+                    s.close()
+                except OSError:
+                    pass
+            self._doors.clear()
+
+    def _run(self) -> None:
+        import select
+        while not self._stopped:
+            with self._lock:
+                transports = dict(self._transports)
+                doors = dict(self._doors)
+            rlist = [self._wake_r] + list(doors) + list(transports)
+            wlist = [s for s, t in transports.items() if t.wants_write()]
+            try:
+                r, w, _ = select.select(rlist, wlist, [], 0.25)
+            except (OSError, ValueError):
+                # a socket was closed mid-select; drop dead entries
+                with self._lock:
+                    for s in list(self._transports):
+                        if s.fileno() < 0:
+                            del self._transports[s]
+                continue
+            for s in r:
+                if s is self._wake_r:
+                    try:
+                        while self._wake_r.recv(4096):
+                            pass
+                    except OSError:
+                        pass
+                elif s in doors:
+                    try:
+                        conn, addr = s.accept()
+                        conn.setblocking(False)
+                        conn.setsockopt(socket.IPPROTO_TCP,
+                                        socket.TCP_NODELAY, 1)
+                        doors[s](conn, addr)
+                    except OSError:
+                        pass
+                else:
+                    t = transports.get(s)
+                    if t is not None:
+                        t.handle_read()
+            for s in w:
+                t = transports.get(s)
+                if t is not None:
+                    t.handle_write()
+
+
+class TCPTransport(Transport):
+    def __init__(self, reactor: TCPReactor, sock: socket.socket) -> None:
+        self.reactor = reactor
+        self.sock = sock
+        self.on_frame = lambda raw: None
+        self.on_closed = lambda: None
+        self.closed = False
+        self._rbuf = b""
+        self._wlock = threading.Lock()
+        self._wqueue: Deque[bytes] = deque()
+
+    @classmethod
+    def connect(cls, reactor: TCPReactor, host: str,
+                port: int) -> "TCPTransport":
+        sock = socket.create_connection((host, port), timeout=5.0)
+        sock.setblocking(False)
+        sock.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+        t = cls(reactor, sock)
+        reactor.add_transport(t)
+        return t
+
+    def wants_write(self) -> bool:
+        with self._wlock:
+            return bool(self._wqueue)
+
+    def send_frame(self, raw: bytes) -> None:
+        if self.closed:
+            return
+        with self._wlock:
+            self._wqueue.append(struct.pack(">I", len(raw) | _LAST_FRAG) + raw)
+        self.reactor.wake()
+
+    def handle_write(self) -> None:
+        with self._wlock:
+            while self._wqueue:
+                buf = self._wqueue[0]
+                try:
+                    n = self.sock.send(buf)
+                except OSError as e:
+                    if e.errno in (errno.EAGAIN, errno.EWOULDBLOCK):
+                        return
+                    self._fail()
+                    return
+                if n < len(buf):
+                    self._wqueue[0] = buf[n:]
+                    return
+                self._wqueue.popleft()
+
+    def handle_read(self) -> None:
+        try:
+            data = self.sock.recv(65536)
+        except OSError as e:
+            if e.errno in (errno.EAGAIN, errno.EWOULDBLOCK):
+                return
+            self._fail()
+            return
+        if not data:
+            self._fail()
+            return
+        self._rbuf += data
+        while len(self._rbuf) >= 4:
+            n = struct.unpack(">I", self._rbuf[:4])[0]
+            if not (n & _LAST_FRAG):
+                self._fail()
+                return
+            n &= ~_LAST_FRAG
+            if n > MAX_FRAME:
+                self._fail()
+                return
+            if len(self._rbuf) < 4 + n:
+                break
+            frame = self._rbuf[4:4 + n]
+            self._rbuf = self._rbuf[4 + n:]
+            self.reactor.clock.post_to_main(
+                lambda f=frame: None if self.closed else self.on_frame(f))
+
+    def _fail(self) -> None:
+        if self.closed:
+            return
+        self.reactor.remove_transport(self)
+        try:
+            self.sock.close()
+        except OSError:
+            pass
+        self.reactor.clock.post_to_main(self._notify_closed)
+
+    def _notify_closed(self) -> None:
+        if not self.closed:
+            self.closed = True
+            self.on_closed()
+
+    def close(self) -> None:
+        if self.closed:
+            return
+        self.closed = True
+        self.reactor.remove_transport(self)
+        try:
+            self.sock.close()
+        except OSError:
+            pass
+
+
+class TCPDoor:
+    """Listening socket (reference PeerDoor.cpp): accepts inbound
+    connections and hands sockets to the overlay manager on the main
+    thread."""
+
+    def __init__(self, reactor: TCPReactor, port: int,
+                 on_connection: Callable) -> None:
+        self.reactor = reactor
+        self.sock = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
+        self.sock.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
+        self.sock.bind(("127.0.0.1", port))
+        self.port = self.sock.getsockname()[1]
+        self.sock.listen(16)
+        self.sock.setblocking(False)
+
+        def accepted(conn: socket.socket, addr: tuple) -> None:
+            t = TCPTransport(reactor, conn)
+            reactor.add_transport(t)
+            reactor.clock.post_to_main(lambda: on_connection(t, addr))
+
+        reactor.add_door(self.sock, accepted)
+
+    def close(self) -> None:
+        try:
+            self.sock.close()
+        except OSError:
+            pass

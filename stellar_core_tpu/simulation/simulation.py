@@ -64,7 +64,13 @@ class SimNode:
 
 
 class Simulation:
+    # Message-level loopback: herders wired directly (fastest; default for
+    # protocol-focused tests).
     OVER_LOOPBACK = 0
+    # Full overlay stack over in-process pipes: real Peer handshake, HMAC,
+    # flood, item fetch (reference Simulation OVER_LOOPBACK with
+    # LoopbackPeer, simulation/Simulation.h:30-34).
+    OVER_PEERS = 1
 
     def __init__(self, mode: int = OVER_LOOPBACK,
                  network_passphrase: str = "(sct) simulation network"
@@ -97,15 +103,35 @@ class Simulation:
         app = Application(clock, cfg)
         node = SimNode(name, app)
         self.nodes[name] = node
-        # message-loopback broadcast shim standing in for OverlayManager
-        app.overlay_manager = _SimOverlayShim(self, name)
+        if self.mode == Simulation.OVER_LOOPBACK:
+            # message-loopback broadcast shim standing in for OverlayManager;
+            # detach the real manager's item fetchers or their trackers
+            # would keep re-arming timers against a manager with no peers
+            app.overlay_manager = _SimOverlayShim(self, name)
+            app.herder.pending.set_fetchers(None, None)
         return node
 
-    def connect(self, a: str, b: str) -> LoopbackChannel:
+    def connect(self, a: str, b: str):
+        if self.mode == Simulation.OVER_PEERS:
+            return self.connect_peers(a, b)
         ch = LoopbackChannel(self, a, b)
         self.nodes[a].channels.append(ch)
         self.nodes[b].channels.append(ch)
         return ch
+
+    def connect_peers(self, a: str, b: str):
+        """Real overlay connection over an in-process pipe: `a` plays the
+        initiator (WE_CALLED_REMOTE)."""
+        from ..overlay.transport import LoopbackTransport
+        app_a = self.nodes[a].app
+        app_b = self.nodes[b].app
+        # each end is owned by (and delivers onto the clock of) one app
+        ta, tb = LoopbackTransport.pair(app_a.clock, app_b.clock)
+        app_b.overlay_manager.add_loopback_peer(tb, outbound=False,
+                                                address=(a, 0))
+        app_a.overlay_manager.add_loopback_peer(ta, outbound=True,
+                                                address=(b, 0))
+        return ta, tb
 
     def start_all_nodes(self) -> None:
         for node in self.nodes.values():

@@ -20,9 +20,10 @@ def _keys(n: int, tag: bytes) -> List[SecretKey]:
 
 
 def core(n: int, threshold: int,
-         passphrase: str = "(sct) simulation network") -> Simulation:
+         passphrase: str = "(sct) simulation network",
+         mode: int = Simulation.OVER_LOOPBACK) -> Simulation:
     """Fully-connected core of n validators all trusting each other."""
-    sim = Simulation(network_passphrase=passphrase)
+    sim = Simulation(mode=mode, network_passphrase=passphrase)
     keys = _keys(n, b"core")
     qset = SCPQuorumSet(threshold=threshold,
                         validators=[k.public_key for k in keys],

@@ -1,0 +1,187 @@
+"""Overlay layer tests (reference src/overlay/test/{OverlayTests,
+FloodTests,PeerManagerTests}.cpp roles): auth handshake, HMAC integrity,
+flood propagation, item fetch, bans, and full consensus over the real
+overlay stack."""
+
+import pytest
+
+import stellar_core_tpu.xdr as X
+from stellar_core_tpu.crypto.hashing import sha256
+from stellar_core_tpu.crypto.keys import SecretKey
+from stellar_core_tpu.overlay import (
+    Floodgate, LoopbackTransport, PeerState,
+)
+from stellar_core_tpu.overlay.peer_auth import PeerAuth
+from stellar_core_tpu.simulation import topologies
+from stellar_core_tpu.simulation.simulation import Simulation
+from stellar_core_tpu.testing import AppLedgerAdapter
+
+
+def make_peer_sim(n=2, threshold=2):
+    sim = topologies.core(n, threshold, mode=Simulation.OVER_PEERS)
+    return sim
+
+
+def both_authenticated(sim):
+    return all(
+        node.app.overlay_manager.get_authenticated_peers_count() >= 1
+        for node in sim.nodes.values())
+
+
+# --- handshake --------------------------------------------------------------
+
+def test_loopback_handshake_authenticates():
+    sim = make_peer_sim(2)
+    assert sim.crank_until(lambda: both_authenticated(sim), 500)
+    for node in sim.nodes.values():
+        om = node.app.overlay_manager
+        assert not om.pending_peers
+        for p in om.authenticated_peers.values():
+            assert p.is_authenticated()
+            assert p.peer_id is not None
+
+
+def test_wrong_network_is_dropped():
+    sim = Simulation(mode=Simulation.OVER_PEERS)
+    keys = [SecretKey.from_seed(sha256(b"net" + bytes([i])))
+            for i in range(2)]
+    qset = X.SCPQuorumSet(threshold=1,
+                          validators=[k.public_key for k in keys],
+                          innerSets=[])
+    a = sim.add_node(keys[0], qset, name="a")
+    b = sim.add_node(keys[1], qset, name="b",
+                     cfg_tweak=lambda c: setattr(
+                         c, "NETWORK_PASSPHRASE", "some other network"))
+    sim.connect_peers("a", "b")
+    sim.crank_all_nodes(20)
+    assert a.app.overlay_manager.get_authenticated_peers_count() == 0
+    assert b.app.overlay_manager.get_authenticated_peers_count() == 0
+
+
+def test_damaged_traffic_drops_peer():
+    sim = make_peer_sim(2)
+    a, b = list(sim.nodes)
+    ta, tb = sim.connect_peers(a, b)
+    assert sim.crank_until(lambda: both_authenticated(sim), 500)
+    # now corrupt everything a sends on this second connection; peer b
+    # must drop it on MAC failure
+    ta.damage_probability = 1.0
+    from stellar_core_tpu.xdr import MessageType, StellarMessage
+    # force a message through the damaged pipe
+    for p in list(sim.nodes[a].app.overlay_manager
+                  .authenticated_peers.values()):
+        if p.transport is ta:
+            p.send_message(StellarMessage(MessageType.GET_PEERS, None))
+    sim.crank_all_nodes(20)
+    # the damaged connection is gone somewhere: b dropped a's duplicate
+    # (either on MAC or it was already refused as duplicate connection)
+    assert all(not p.dropped or p.transport is not tb
+               for p in sim.nodes[b].app.overlay_manager
+               .authenticated_peers.values())
+
+
+def test_banned_peer_rejected():
+    sim = make_peer_sim(2)
+    a, b = list(sim.nodes)
+    app_b = sim.nodes[b].app
+    app_b.overlay_manager.ban_manager.ban_node(
+        sim.nodes[a].app.config.node_id())
+    sim.crank_all_nodes(50)
+    assert app_b.overlay_manager.get_authenticated_peers_count() == 0
+
+
+# --- peer auth unit ---------------------------------------------------------
+
+def test_mac_keys_agree_between_sides():
+    sim = make_peer_sim(2)
+    assert sim.crank_until(lambda: both_authenticated(sim), 500)
+    a, b = list(sim.nodes)
+    pa = list(sim.nodes[a].app.overlay_manager
+              .authenticated_peers.values())[0]
+    pb = list(sim.nodes[b].app.overlay_manager
+              .authenticated_peers.values())[0]
+    assert pa.send_mac_key == pb.recv_mac_key
+    assert pb.send_mac_key == pa.recv_mac_key
+    assert pa.send_mac_key != pa.recv_mac_key
+
+
+def test_expired_cert_rejected():
+    sim = make_peer_sim(2)
+    a = list(sim.nodes)[0]
+    app = sim.nodes[a].app
+    auth = app.overlay_manager.peer_auth
+    cert = auth.get_auth_cert()
+    assert auth.verify_remote_cert(app.config.node_id(), cert)
+    cert.expiration = 0
+    # re-signed? no — expired wins regardless of signature
+    assert not auth.verify_remote_cert(app.config.node_id(), cert)
+    # tampered pubkey fails signature check
+    cert2 = auth.get_auth_cert()
+    cert2 = X.AuthCert(pubkey=b"\x01" * 32, expiration=cert2.expiration,
+                       sig=cert2.sig)
+    assert not auth.verify_remote_cert(app.config.node_id(), cert2)
+
+
+# --- floodgate --------------------------------------------------------------
+
+def test_floodgate_dedup_and_gc():
+    fg = Floodgate()
+    msg = X.StellarMessage(X.MessageType.GET_PEERS, None)
+    assert fg.add_record(msg, "p1", 5)
+    assert not fg.add_record(msg, "p2", 5)
+    assert fg.size() == 1
+    fg.clear_below(10)
+    assert fg.size() == 0
+
+
+class _FakePeer:
+    def __init__(self):
+        self.got = []
+
+    def send_message(self, m):
+        self.got.append(m)
+
+
+def test_floodgate_broadcast_skips_told_peers():
+    fg = Floodgate()
+    msg = X.StellarMessage(X.MessageType.GET_PEERS, None)
+    p1, p2 = _FakePeer(), _FakePeer()
+    fg.add_record(msg, "p1", 1)
+    n = fg.broadcast(msg, False, {"p1": p1, "p2": p2}, 1)
+    assert n == 1 and not p1.got and len(p2.got) == 1
+    # second broadcast: everyone already told
+    assert fg.broadcast(msg, False, {"p1": p1, "p2": p2}, 1) == 0
+
+
+# --- end-to-end over real overlay -------------------------------------------
+
+@pytest.mark.slow
+def test_consensus_over_real_overlay():
+    """3 validators, full overlay stack (handshake, flood, fetch):
+    the network closes ledgers."""
+    sim = make_peer_sim(3, 2)
+    sim.start_all_nodes()
+    assert sim.crank_until(lambda: sim.have_all_externalized(3), 30000), \
+        {n: v.app.ledger_manager.last_closed_ledger_num()
+         for n, v in sim.nodes.items()}
+
+
+@pytest.mark.slow
+def test_transaction_floods_and_applies_over_real_overlay():
+    sim = make_peer_sim(3, 2)
+    sim.start_all_nodes()
+    assert sim.crank_until(lambda: sim.have_all_externalized(2), 30000)
+    first = next(iter(sim.nodes.values()))
+    adapter = AppLedgerAdapter(first.app)
+    root = adapter.root_account()
+    alice = SecretKey.pseudo_random_for_testing()
+    frame = root.tx([root.op_create_account(alice.public_key, 10 ** 9)])
+    assert first.app.submit_transaction(frame) == 0
+
+    def all_have_alice():
+        return all(
+            n.app.ledger_manager.ltx_root().get_entry(
+                X.LedgerKey.account(alice.public_key)) is not None
+            for n in sim.nodes.values())
+
+    assert sim.crank_until(all_have_alice, 30000)
