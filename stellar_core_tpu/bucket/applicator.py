@@ -39,8 +39,12 @@ class BucketApplicator:
             t = e.disc
             if t in (BucketEntryType.LIVEENTRY, BucketEntryType.INITENTRY):
                 key = ledger_entry_key(e.value)
-                if ltx.load(key) is not None:
-                    ltx.update(e.value)
+                cur = ltx.load(key)
+                if cur is not None:
+                    cur.lastModifiedLedgerSeq = \
+                        e.value.lastModifiedLedgerSeq
+                    cur.data = e.value.data
+                    cur.ext = e.value.ext
                 else:
                     ltx.create(e.value)
             elif t == BucketEntryType.DEADENTRY:
@@ -72,8 +76,12 @@ def apply_buckets(root, buckets: Iterable[Bucket]) -> int:
                 if kx in seen:
                     continue
                 seen.add(kx)
-                if ltx.load(key) is not None:
-                    ltx.update(e.value)
+                cur = ltx.load(key)
+                if cur is not None:
+                    cur.lastModifiedLedgerSeq = \
+                        e.value.lastModifiedLedgerSeq
+                    cur.data = e.value.data
+                    cur.ext = e.value.ext
                 else:
                     ltx.create(e.value)
             elif t == BucketEntryType.DEADENTRY:

@@ -1,0 +1,11 @@
+"""Catchup: archive-driven recovery (reference `src/catchup`)."""
+
+from .catchup_manager import CatchupManager
+from .catchup_work import CatchupWork
+from .range import (CURRENT, CatchupConfiguration, CatchupRange,
+                    calculate_catchup_range)
+
+__all__ = [
+    "CURRENT", "CatchupConfiguration", "CatchupManager", "CatchupRange",
+    "CatchupWork", "calculate_catchup_range",
+]

@@ -1,0 +1,145 @@
+"""CatchupManager: online recovery — buffer externalized ledgers while a
+CatchupWork heals the gap, then drain the buffer.
+
+Role parity: reference `src/catchup/CatchupManagerImpl.cpp:79-140`
+(`processLedger` buffers `LedgerCloseData` keyed by seq, trims below the
+LCL, starts catchup at checkpoint boundaries) and
+`CatchupWork.cpp:296-305` (`ApplyBufferedLedgersWork` drains the buffer
+after the work DAG completes).
+"""
+
+from __future__ import annotations
+
+from typing import Dict, Optional
+
+from ..util.log import get_logger
+from .catchup_work import CatchupWork
+from .range import CatchupConfiguration
+
+log = get_logger("History")
+
+
+class CatchupManager:
+    def __init__(self, app) -> None:
+        self.app = app
+        self._buffered: Dict[int, object] = {}   # seq -> LedgerCloseData
+        self._work: Optional[CatchupWork] = None
+        self.catchups_started = 0
+        self.catchups_succeeded = 0
+        self.catchups_failed = 0
+        # wire the gap trigger
+        app.ledger_manager.catchup_trigger = self.process_ledger
+
+    # -- externalized-value entry point (reference processLedger) ------------
+    def process_ledger(self, lcd) -> None:
+        from ..ledger.ledger_manager import LedgerManagerState
+        lm = self.app.ledger_manager
+        lcl = lm.last_closed_ledger_num()
+        if lcd.ledger_seq <= lcl:
+            return
+        if lcd.ledger_seq == lcl + 1 and not self.catchup_running():
+            # contiguous and no work in flight: close directly, even while
+            # nominally catching up (reference CatchupManagerImpl closes
+            # the next ledger and exits catchup when the buffer drains) —
+            # this also keeps archive-less nodes alive
+            lm.close_ledger(lcd)
+            self._drain_buffer()
+            if not self._buffered:
+                lm.state = LedgerManagerState.LM_SYNCED_STATE
+            return
+        self._buffered[lcd.ledger_seq] = lcd
+        self._trim_buffer()
+        if self._work is None or self._work.is_done():
+            self.start_catchup()
+
+    def buffered_count(self) -> int:
+        return len(self._buffered)
+
+    def catchup_running(self) -> bool:
+        return self._work is not None and not self._work.is_done()
+
+    # -- catchup lifecycle ---------------------------------------------------
+    def start_catchup(self,
+                      config: Optional[CatchupConfiguration] = None,
+                      on_done=None) -> Optional[CatchupWork]:
+        hm = getattr(self.app, "history_manager", None)
+        if hm is None or hm.readable_archive() is None:
+            log.warning("catchup needed but no readable archive configured")
+            return None
+        if config is None:
+            cfg = self.app.config
+            if cfg.CATCHUP_COMPLETE:
+                config = CatchupConfiguration.complete()
+            elif cfg.CATCHUP_RECENT > 0:
+                config = CatchupConfiguration.recent(cfg.CATCHUP_RECENT)
+            else:
+                config = CatchupConfiguration.minimal()
+        self.catchups_started += 1
+        self._work = CatchupWork(self.app, config)
+
+        def done(state) -> None:
+            from ..work.basic_work import State
+            if state == State.SUCCESS:
+                self.catchups_succeeded += 1
+                self._drain_buffer()
+                self._check_gap_closed()
+            else:
+                self.catchups_failed += 1
+                log.warning("catchup failed; will retry on next gap")
+            if on_done is not None:
+                on_done(state)
+
+        self.app.work_scheduler.schedule_work(self._work, done)
+        return self._work
+
+    # -- buffered-ledger drain (reference ApplyBufferedLedgersWork) ----------
+    def _drain_buffer(self) -> None:
+        from ..ledger.ledger_manager import LedgerManagerState
+        lm = self.app.ledger_manager
+        self._trim_buffer()
+        while True:
+            nxt = lm.last_closed_ledger_num() + 1
+            lcd = self._buffered.pop(nxt, None)
+            if lcd is None:
+                break
+            try:
+                lm.close_ledger(lcd)
+            except Exception as e:
+                # archive chain vs live stream divergence (or corrupt
+                # buffered value): fatal-loud like the reference's prevHash
+                # check, but don't let the exception kill the crank loop —
+                # drop the value and stay catching-up
+                log.error("buffered ledger %d failed to close: %s — "
+                          "discarding and staying in catchup",
+                          lcd.ledger_seq, e)
+                lm.state = LedgerManagerState.LM_CATCHING_UP_STATE
+                break
+
+    def _trim_buffer(self) -> None:
+        lcl = self.app.ledger_manager.last_closed_ledger_num()
+        for seq in [s for s in self._buffered if s <= lcl]:
+            del self._buffered[seq]
+        # bound the buffer: keep only the newest window (older ledgers are
+        # in — or will be in — the archive; reference keeps a bounded
+        # buffered-ledger window)
+        cap = max(4 * self.app.config.CHECKPOINT_FREQUENCY, 128)
+        if len(self._buffered) > cap:
+            for seq in sorted(self._buffered)[:len(self._buffered) - cap]:
+                del self._buffered[seq]
+
+    def _check_gap_closed(self) -> bool:
+        """After a catchup + drain: if buffered ledgers remain beyond a
+        hole, go around again (reference: catchup restarts until the node
+        reconnects with the live stream)."""
+        from ..ledger.ledger_manager import LedgerManagerState
+        lm = self.app.ledger_manager
+        if self._buffered:
+            # a hole below min(buffered) isn't in the archive yet; stay in
+            # catching-up state — the next externalized ledger re-triggers
+            # catchup once the archive has published past the hole
+            log.info("gap remains after catchup (lcl %d, %d buffered)",
+                     lm.last_closed_ledger_num(), len(self._buffered))
+            lm.state = LedgerManagerState.LM_CATCHING_UP_STATE
+            return False
+        lm.state = LedgerManagerState.LM_SYNCED_STATE
+        return True

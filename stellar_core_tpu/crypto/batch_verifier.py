@@ -82,6 +82,16 @@ class BatchSigVerifier:
     def verify_many(self, triples: Sequence[Triple]) -> List[bool]:
         raise NotImplementedError
 
+    def prewarm_many(self, triples: Sequence[Triple]) -> List[bool]:
+        """Whole-ledger/checkpoint drain (SURVEY.md §2.2): verify a large
+        batch in one dispatch and seed the result cache so subsequent
+        synchronous per-signature checks all hit."""
+        results = self.verify_many(triples)
+        with _keys._cache_lock:
+            for (k, s, m), ok in zip(triples, results):
+                _keys._verify_cache.put(_keys._cache_key(k, s, m), ok)
+        return results
+
     def pending(self) -> int:
         return 0
 

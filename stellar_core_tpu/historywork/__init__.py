@@ -1,0 +1,19 @@
+"""History work units (reference `src/historywork`)."""
+
+from .apply_works import (ApplyBucketsWork, ApplyCheckpointWork,
+                          DownloadApplyTxsWork, checkpoint_verify_triples)
+from .works import (BatchDownloadWork, DownloadBucketsWork,
+                    GetAndUnzipRemoteFileWork, GetHistoryArchiveStateWork,
+                    GetRemoteFileWork, GunzipFileWork, GzipFileWork,
+                    MakeRemoteDirWork, PutRemoteFileWork, RunCommandWork,
+                    VerifyBucketWork, VerifyLedgerChainWork)
+
+__all__ = [
+    "ApplyBucketsWork", "ApplyCheckpointWork", "BatchDownloadWork",
+    "DownloadApplyTxsWork", "DownloadBucketsWork",
+    "GetAndUnzipRemoteFileWork", "GetHistoryArchiveStateWork",
+    "GetRemoteFileWork", "GunzipFileWork", "GzipFileWork",
+    "MakeRemoteDirWork", "PutRemoteFileWork", "RunCommandWork",
+    "VerifyBucketWork", "VerifyLedgerChainWork",
+    "checkpoint_verify_triples",
+]

@@ -1,0 +1,301 @@
+"""Apply-side history works: bucket-state restore and checkpoint replay.
+
+Role parity: reference `src/catchup/ApplyBucketsWork.cpp` (stream a
+downloaded bucket-list snapshot into the ledger, then adopt it as the
+live BucketList), `src/catchup/ApplyCheckpointWork.cpp:79-244` (stream
+headers+txsets of one checkpoint, closing one ledger per crank via
+`ApplyLedgerWork` → `LedgerManager::closeLedger`), and
+`src/catchup/DownloadApplyTxsWork.cpp:23-104` (a BatchWork that overlaps
+checkpoint N+1's download with checkpoint N's apply).
+
+TPU batch site (SURVEY.md §3.4): before replaying a checkpoint, every
+(source-key, signature, payload) triple in its txsets is drained through
+`BatchSigVerifier.verify_many` in one padded device batch, pre-warming
+the verify cache so the synchronous per-tx checks during apply all hit.
+"""
+
+from __future__ import annotations
+
+import os
+from typing import Dict, List, Optional, Tuple
+
+from ..crypto.hashing import sha256
+from ..history.archive import HistoryArchive, category_path
+from ..history.archive_state import HistoryArchiveState
+from ..history.checkpoints import checkpoints_in_range, first_in_checkpoint
+from ..util.log import get_logger
+from ..util.xdrstream import XDRInputFileStream
+from ..work.basic_work import (FAILURE, RETRY_NEVER, RUNNING, SUCCESS,
+                               BasicWork, State)
+from ..work.work import BatchWork, ConditionalWork, WorkSequence
+from ..xdr import (LedgerHeaderHistoryEntry, PublicKeyType,
+                   TransactionHistoryEntry)
+from .works import GetAndUnzipRemoteFileWork
+
+log = get_logger("History")
+
+
+class ApplyBucketsWork(BasicWork):
+    """Load the bucket snapshot named by a HAS into ledger state and
+    fast-forward the LCL to that checkpoint's header.
+
+    Reference parity: `catchup/ApplyBucketsWork.cpp` + the LCL reset in
+    `CatchupWork::applyBucketsAtLedger`. Divergence checks: the restored
+    bucket list's hash must equal the downloaded header's bucketListHash,
+    else the archive state is corrupt."""
+
+    def __init__(self, app, has: HistoryArchiveState,
+                 header_entry: LedgerHeaderHistoryEntry) -> None:
+        super().__init__(app.clock, "apply-buckets@%d"
+                         % header_entry.header.ledgerSeq, RETRY_NEVER)
+        self.app = app
+        self.has = has
+        self.header_entry = header_entry
+
+    def on_run(self) -> State:
+        from ..bucket import K_NUM_LEVELS
+        from ..bucket.applicator import apply_buckets
+        from ..bucket.bucket import Bucket
+
+        bm = self.app.bucket_manager
+        lm = self.app.ledger_manager
+        header = self.header_entry.header
+
+        # order: level 0 curr, 0 snap, 1 curr, ... (newest first)
+        ordered: List[Bucket] = []
+        for lv in self.has.levels:
+            for hh in (lv.curr, lv.snap):
+                if hh == "0" * 64:
+                    continue
+                b = (bm.get_bucket_by_hash(bytes.fromhex(hh))
+                     if bm is not None else None)
+                if b is None:
+                    log.warning("apply-buckets: missing bucket %s", hh[:8])
+                    return FAILURE
+                ordered.append(b)
+
+        # validate BEFORE destroying local state: the snapshot's whole-list
+        # hash must already match the header (pure computation over the
+        # level hashes, no mutation)
+        from ..crypto.hashing import SHA256
+        whole = SHA256()
+        for lv in self.has.levels:
+            lh = SHA256()
+            lh.add(bytes.fromhex(lv.curr))
+            lh.add(bytes.fromhex(lv.snap))
+            whole.add(lh.finish())
+        if whole.finish() != header.bucketListHash:
+            log.warning("snapshot bucket list hash mismatch at %d — "
+                        "refusing to touch local state", header.ledgerSeq)
+            return FAILURE
+
+        # the snapshot IS the state: drop anything local first, else
+        # entries deleted on-network during the gap would survive as
+        # phantoms (reference resets ledger state before bucket apply)
+        lm.ltx_root().clear_entries()
+        n = apply_buckets(lm.ltx_root(), ordered)
+        log.info("applied %d bucket entries at ledger %d", n,
+                 header.ledgerSeq)
+
+        if bm is not None:
+            level_hashes = [
+                {"curr": bytes.fromhex(lv.curr),
+                 "snap": bytes.fromhex(lv.snap)}
+                for lv in self.has.levels]
+            bm.assume_state(level_hashes, header.ledgerSeq,
+                            header.ledgerVersion)
+
+        lm.set_last_closed_ledger(header, self.header_entry.hash)
+        return SUCCESS
+
+
+def checkpoint_verify_triples(frames) -> List[Tuple]:
+    """Collect (key32, sig, payload) triples for a batch of tx frames —
+    the whole-ledger/checkpoint drain of SURVEY.md §2.2. Keys are matched
+    to signatures by hint, source-account first (multisig signers beyond
+    the source resolve through ledger state at apply time and simply miss
+    the cache)."""
+    triples = []
+    for f in frames:
+        payload = f.signature_payload()
+        src = f.source_account_id()
+        if src.disc != PublicKeyType.PUBLIC_KEY_TYPE_ED25519:
+            continue
+        hint = src.key_bytes[-4:]
+        for sig in f.signatures:
+            if sig.hint == hint:
+                triples.append((src.key_bytes, sig.signature, payload))
+    return triples
+
+
+class ApplyCheckpointWork(BasicWork):
+    """Replay one checkpoint's ledgers through LedgerManager.close_ledger,
+    one ledger per crank (reference ApplyCheckpointWork.cpp:244 →
+    ApplyLedgerWork.cpp:22-24). First crank drains the checkpoint's
+    signatures through the batch verifier."""
+
+    def __init__(self, app, download_dir: str, checkpoint: int,
+                 first_seq: int, last_seq: int) -> None:
+        super().__init__(app.clock, "apply-checkpoint %08x" % checkpoint,
+                         RETRY_NEVER)
+        self.app = app
+        self.download_dir = download_dir
+        self.checkpoint = checkpoint
+        self.first_seq = first_seq
+        self.last_seq = last_seq
+        self._loaded = False
+        self._headers: Dict[int, LedgerHeaderHistoryEntry] = {}
+        self._txsets: Dict[int, object] = {}
+        self._frames: Dict[int, object] = {}   # seq -> TxSetFrame
+        self._next: int = first_seq
+
+    def on_reset(self) -> None:
+        self._loaded = False
+        self._headers.clear()
+        self._txsets.clear()
+        self._frames.clear()
+        self._next = self.first_seq
+
+    def _load(self) -> bool:
+        lpath = os.path.join(self.download_dir,
+                             "ledger-%08x.xdr" % self.checkpoint)
+        tpath = os.path.join(self.download_dir,
+                             "transactions-%08x.xdr" % self.checkpoint)
+        if not os.path.exists(lpath):
+            return False
+        with XDRInputFileStream(lpath) as ins:
+            for e in ins.read_all(LedgerHeaderHistoryEntry):
+                self._headers[e.header.ledgerSeq] = e
+        if os.path.exists(tpath):
+            with XDRInputFileStream(tpath) as ins:
+                for t in ins.read_all(TransactionHistoryEntry):
+                    self._txsets[t.ledgerSeq] = t.txSet
+        return True
+
+    def _prewarm(self) -> None:
+        """One device batch for the whole checkpoint's signatures."""
+        from ..herder.txset import TxSetFrame
+        verifier = getattr(self.app, "sig_verifier", None)
+        if verifier is None:
+            return
+        net = self.app.config.network_id
+        frames = []
+        for seq in range(self.first_seq, self.last_seq + 1):
+            ts = self._txsets.get(seq)
+            if ts is None:
+                continue
+            fr = TxSetFrame.from_wire(net, ts)
+            self._frames[seq] = fr       # reused at apply: parse once
+            frames.extend(fr.frames)
+        triples = checkpoint_verify_triples(frames)
+        if triples:
+            verifier.prewarm_many(triples)
+            log.debug("prewarmed %d sigs for checkpoint %08x",
+                      len(triples), self.checkpoint)
+
+    def on_run(self) -> State:
+        from ..herder.txset import TxSetFrame
+        from ..ledger.ledger_manager import LedgerCloseData
+
+        if not self._loaded:
+            if not self._load():
+                return FAILURE
+            self._prewarm()
+            self._loaded = True
+
+        lm = self.app.ledger_manager
+        if self._next > self.last_seq:
+            return SUCCESS
+        seq = self._next
+        if seq <= lm.last_closed_ledger_num():
+            self._next += 1           # already applied (restart overlap)
+            return RUNNING
+        entry = self._headers.get(seq)
+        if entry is None:
+            log.warning("checkpoint %08x missing header %d",
+                        self.checkpoint, seq)
+            return FAILURE
+        net = self.app.config.network_id
+        txset = self._frames.get(seq)
+        if txset is None:
+            ts = self._txsets.get(seq)
+            txset = (TxSetFrame.from_wire(net, ts) if ts is not None else
+                     TxSetFrame(net, entry.header.previousLedgerHash, []))
+        lcd = LedgerCloseData(seq, txset, entry.header.scpValue)
+        lm.close_ledger(lcd)
+        if lm.lcl_hash != entry.hash:
+            log.error("replay diverged at ledger %d: %s != %s", seq,
+                      lm.lcl_hash.hex()[:8], entry.hash.hex()[:8])
+            return FAILURE
+        self._next += 1
+        return RUNNING if self._next <= self.last_seq else SUCCESS
+
+
+class DownloadApplyTxsWork(BatchWork):
+    """Pipelines checkpoint downloads with strictly-ordered application
+    (reference DownloadApplyTxsWork.cpp:35-104): up to `max_concurrent`
+    checkpoints download in parallel while applies run in checkpoint
+    order behind a ConditionalWork latch."""
+
+    def __init__(self, app, archive: HistoryArchive, download_dir: str,
+                 first_seq: int, last_seq: int,
+                 max_concurrent: int = 4) -> None:
+        super().__init__(app.clock, "download-apply-txs [%d..%d]"
+                         % (first_seq, last_seq), max_concurrent)
+        self.app = app
+        self.archive = archive
+        self.download_dir = download_dir
+        self.first_seq = first_seq
+        self.last_seq = last_seq
+        freq = app.config.CHECKPOINT_FREQUENCY
+        self._freq = freq
+        self._checkpoints = list(checkpoints_in_range(first_seq, last_seq,
+                                                      freq))
+        self._idx = 0
+        # apply gate: checkpoints apply strictly in order
+        self._applied_up_to = first_seq - 1
+
+    def do_reset(self) -> None:
+        self._idx = 0
+        self._applied_up_to = self.first_seq - 1
+
+    def yield_more_work(self) -> Optional[BasicWork]:
+        if self._idx >= len(self._checkpoints):
+            return None
+        c = self._checkpoints[self._idx]
+        self._idx += 1
+        lo = max(self.first_seq, first_in_checkpoint(c, self._freq))
+        hi = min(self.last_seq, c)
+
+        gets: List[BasicWork] = []
+        for cat in ("ledger", "transactions"):
+            local = os.path.join(self.download_dir,
+                                 "%s-%08x.xdr" % (cat, c))
+            if os.path.exists(local):
+                continue              # verify phase already fetched it
+            gets.append(GetAndUnzipRemoteFileWork(
+                self.app, self.archive, category_path(cat, c, ".xdr.gz"),
+                local))
+
+        apply_work = ApplyCheckpointWork(self.app, self.download_dir, c,
+                                         lo, hi)
+        gate_lo = lo
+
+        gated = ConditionalWork(
+            self.clock, "apply-gate %08x" % c,
+            lambda gate_lo=gate_lo: self._applied_up_to == gate_lo - 1,
+            apply_work)
+
+        seq = WorkSequence(self.clock, "download-apply %08x" % c,
+                          gets + [gated])
+
+        orig_on_run = apply_work.on_run
+
+        def tracked_on_run(me=apply_work, hi=hi):
+            st = orig_on_run()
+            if st == SUCCESS:
+                self._applied_up_to = hi
+            return st
+
+        apply_work.on_run = tracked_on_run
+        return seq

@@ -1,0 +1,403 @@
+"""History work units: remote file transfer, (de)compression, archive
+state fetch, batched checkpoint downloads, ledger-chain verification.
+
+Role parity: reference `src/historywork/*` — `GetRemoteFileWork` /
+`PutRemoteFileWork` / `MakeRemoteDirWork` shell out through the process
+manager (`GetRemoteFileWork.cpp`), `GunzipFileWork`/`GzipFileWork`
+(`GunzipFileWork.cpp`), `GetAndUnzipRemoteFileWork.cpp`,
+`BatchDownloadWork.cpp` (bounded-parallel per-checkpoint downloads),
+`VerifyBucketWork.cpp` (hash downloaded bucket), and
+`VerifyLedgerChainWork.cpp` (hash-chain back-link verification).
+"""
+
+from __future__ import annotations
+
+import os
+from typing import Dict, List, Optional
+
+from ..crypto.hashing import sha256
+from ..history.archive import HistoryArchive, category_path, bucket_path
+from ..history.archive_state import HistoryArchiveState
+from ..history.checkpoints import checkpoints_in_range
+from ..history.snapshot import gunzip_file, gzip_file
+from ..util.log import get_logger
+from ..util.xdrstream import XDRInputFileStream
+from ..work.basic_work import (FAILURE, RETRY_A_FEW, RETRY_NEVER, RUNNING,
+                               SUCCESS, WAITING, BasicWork, State)
+from ..work.work import BatchWork, WorkSequence
+from ..xdr import LedgerHeaderHistoryEntry
+
+log = get_logger("History")
+
+
+class RunCommandWork(BasicWork):
+    """Run one shell command through the app's ProcessManager; the work
+    WAITs until the subprocess exit event fires (reference
+    `historywork/RunCommandWork.cpp`)."""
+
+    def __init__(self, app, name: str, max_retries: int = RETRY_A_FEW
+                 ) -> None:
+        super().__init__(app.clock, name, max_retries)
+        self.app = app
+        self._ev = None
+        self._exit_code: Optional[int] = None
+
+    def get_command(self) -> str:
+        raise NotImplementedError
+
+    def on_reset(self) -> None:
+        self._ev = None
+        self._exit_code = None
+
+    def on_run(self) -> State:
+        if self._exit_code is not None:
+            return SUCCESS if self._exit_code == 0 else FAILURE
+        if self._ev is None:
+            cmd = self.get_command()
+            if not cmd:
+                return FAILURE
+            self._ev = self.app.process_manager.run_process(cmd)
+
+            def done(code: int) -> None:
+                self._exit_code = code
+                self.wake_up()
+
+            self._ev.add_done_callback(done)
+        return WAITING
+
+
+class GetRemoteFileWork(RunCommandWork):
+    """Download archive:remote -> local (reference GetRemoteFileWork)."""
+
+    def __init__(self, app, archive: HistoryArchive, remote: str,
+                 local: str) -> None:
+        super().__init__(app, "get-remote-file %s" % remote)
+        self.archive = archive
+        self.remote = remote
+        self.local = local
+
+    def get_command(self) -> str:
+        os.makedirs(os.path.dirname(self.local) or ".", exist_ok=True)
+        return self.archive.get_cmd(self.remote, self.local)
+
+    def on_failure_retry(self) -> None:
+        if os.path.exists(self.local):
+            os.unlink(self.local)
+
+
+class PutRemoteFileWork(RunCommandWork):
+    """Upload local -> archive:remote (reference PutRemoteFileWork)."""
+
+    def __init__(self, app, archive: HistoryArchive, local: str,
+                 remote: str) -> None:
+        super().__init__(app, "put-remote-file %s" % remote)
+        self.archive = archive
+        self.local = local
+        self.remote = remote
+
+    def get_command(self) -> str:
+        return self.archive.put_cmd(self.local, self.remote)
+
+
+class MakeRemoteDirWork(RunCommandWork):
+    """mkdir -p on the archive (reference MakeRemoteDirWork)."""
+
+    def __init__(self, app, archive: HistoryArchive, remote_dir: str
+                 ) -> None:
+        super().__init__(app, "make-remote-dir %s" % remote_dir)
+        self.archive = archive
+        self.remote_dir = remote_dir
+
+    def get_command(self) -> str:
+        return self.archive.mkdir_cmd(self.remote_dir)
+
+
+class GunzipFileWork(BasicWork):
+    """Decompress foo.gz -> foo in-process (reference GunzipFileWork
+    shells out to gzip; python's gzip module plays that role)."""
+
+    def __init__(self, app, gz_path: str, keep: bool = False) -> None:
+        super().__init__(app.clock, "gunzip %s" % gz_path, RETRY_NEVER)
+        self.gz_path = gz_path
+        self.keep = keep
+
+    def on_run(self) -> State:
+        if not os.path.exists(self.gz_path):
+            return FAILURE
+        gunzip_file(self.gz_path)
+        if not self.keep:
+            os.unlink(self.gz_path)
+        return SUCCESS
+
+
+class GzipFileWork(BasicWork):
+    """Compress foo -> foo.gz (reference GzipFileWork)."""
+
+    def __init__(self, app, path: str, keep: bool = False) -> None:
+        super().__init__(app.clock, "gzip %s" % path, RETRY_NEVER)
+        self.path = path
+        self.keep = keep
+
+    def on_run(self) -> State:
+        if not os.path.exists(self.path):
+            return FAILURE
+        gzip_file(self.path)
+        if not self.keep:
+            os.unlink(self.path)
+        return SUCCESS
+
+
+class GetAndUnzipRemoteFileWork(WorkSequence):
+    """Download then gunzip, optionally verifying the sha256 of the
+    decompressed file (reference GetAndUnzipRemoteFileWork)."""
+
+    def __init__(self, app, archive: HistoryArchive, remote_gz: str,
+                 local: str, expected_hash: Optional[bytes] = None) -> None:
+        self.local = local
+        self.expected_hash = expected_hash
+        seq: List[BasicWork] = [
+            GetRemoteFileWork(app, archive, remote_gz, local + ".gz"),
+            GunzipFileWork(app, local + ".gz"),
+        ]
+        super().__init__(app.clock, "get-and-unzip %s" % remote_gz, seq)
+
+    def on_run(self) -> State:
+        st = super().on_run()
+        if st == SUCCESS and self.expected_hash is not None:
+            with open(self.local, "rb") as f:
+                if sha256(f.read()) != self.expected_hash:
+                    log.warning("hash mismatch on %s", self.local)
+                    return FAILURE
+        return st
+
+
+class GetHistoryArchiveStateWork(BasicWork):
+    """Fetch a HistoryArchiveState JSON — the well-known (archive tip) or
+    a specific checkpoint's (reference GetHistoryArchiveStateWork)."""
+
+    def __init__(self, app, archive: HistoryArchive, local_dir: str,
+                 checkpoint: Optional[int] = None) -> None:
+        super().__init__(app.clock, "get-history-archive-state",
+                         RETRY_A_FEW)
+        self.app = app
+        self.archive = archive
+        self.checkpoint = checkpoint
+        self.local = os.path.join(
+            local_dir,
+            "has-%s.json" % ("well-known" if checkpoint is None
+                             else "%08x" % checkpoint))
+        self.has: Optional[HistoryArchiveState] = None
+        self._get: Optional[GetRemoteFileWork] = None
+
+    def _remote(self) -> str:
+        from ..history.archive import WELL_KNOWN
+        if self.checkpoint is None:
+            return WELL_KNOWN
+        return category_path("history", self.checkpoint, ".json")
+
+    def on_reset(self) -> None:
+        self._get = None
+        self.has = None
+
+    def on_run(self) -> State:
+        if self._get is None:
+            self._get = GetRemoteFileWork(self.app, self.archive,
+                                          self._remote(), self.local)
+            self._get._parent = self
+            self._get.start()
+        if not self._get.is_done():
+            self._get.crank_work()
+            return RUNNING
+        if self._get.state != State.SUCCESS:
+            return FAILURE
+        with open(self.local) as f:
+            self.has = HistoryArchiveState.from_json(f.read())
+        return SUCCESS
+
+
+class BatchDownloadWork(BatchWork):
+    """Download-and-unzip one category file per checkpoint over a ledger
+    range, bounded-parallel (reference BatchDownloadWork.cpp)."""
+
+    def __init__(self, app, archive: HistoryArchive, category: str,
+                 first_ledger: int, last_ledger: int, download_dir: str,
+                 max_concurrent: int = 8) -> None:
+        super().__init__(app.clock, "batch-download %s [%d..%d]"
+                         % (category, first_ledger, last_ledger),
+                         max_concurrent)
+        self.app = app
+        self.archive = archive
+        self.category = category
+        self.download_dir = download_dir
+        freq = app.config.CHECKPOINT_FREQUENCY
+        self._checkpoints = list(checkpoints_in_range(
+            first_ledger, last_ledger, freq))
+        self._idx = 0
+
+    def local_path(self, checkpoint: int) -> str:
+        return os.path.join(self.download_dir, "%s-%08x.xdr"
+                            % (self.category, checkpoint))
+
+    def do_reset(self) -> None:
+        self._idx = 0
+
+    def yield_more_work(self) -> Optional[BasicWork]:
+        if self._idx >= len(self._checkpoints):
+            return None
+        c = self._checkpoints[self._idx]
+        self._idx += 1
+        return GetAndUnzipRemoteFileWork(
+            self.app, self.archive,
+            category_path(self.category, c, ".xdr.gz"),
+            self.local_path(c))
+
+
+class VerifyBucketWork(BasicWork):
+    """Hash a downloaded bucket file and compare to its content address
+    (reference VerifyBucketWork runs the hash on a worker thread; one
+    bucket per crank keeps the loop responsive here)."""
+
+    def __init__(self, app, path: str, expected_hash: bytes) -> None:
+        super().__init__(app.clock, "verify-bucket %s"
+                         % expected_hash.hex()[:8], RETRY_NEVER)
+        self.path = path
+        self.expected_hash = expected_hash
+
+    def on_run(self) -> State:
+        from ..bucket.bucket import Bucket
+        b = Bucket.read_from(self.path)
+        if b.get_hash() != self.expected_hash:
+            log.warning("bucket %s hash mismatch",
+                        self.expected_hash.hex()[:8])
+            return FAILURE
+        return SUCCESS
+
+
+class DownloadBucketsWork(BatchWork):
+    """Fetch + verify + adopt every bucket a HAS references (reference
+    DownloadBucketsWork.cpp). Buckets already in the local store are
+    skipped (content addressing makes this safe)."""
+
+    def __init__(self, app, archive: HistoryArchive, hashes: List[str],
+                 download_dir: str, max_concurrent: int = 8) -> None:
+        super().__init__(app.clock, "download-buckets(%d)" % len(hashes),
+                         max_concurrent)
+        self.app = app
+        self.archive = archive
+        self.download_dir = download_dir
+        self._hashes = list(dict.fromkeys(hashes))  # dedup, keep order
+        self._idx = 0
+
+    def local_path(self, hash_hex: str) -> str:
+        return os.path.join(self.download_dir,
+                            "bucket-%s.xdr" % hash_hex)
+
+    def do_reset(self) -> None:
+        self._idx = 0
+
+    def yield_more_work(self) -> Optional[BasicWork]:
+        bm = self.app.bucket_manager
+        while self._idx < len(self._hashes):
+            hh = self._hashes[self._idx]
+            self._idx += 1
+            if bm is not None and \
+                    bm.get_bucket_by_hash(bytes.fromhex(hh)) is not None:
+                continue                      # already have it
+            local = self.local_path(hh)
+            seq: List[BasicWork] = [
+                GetAndUnzipRemoteFileWork(self.app, self.archive,
+                                          bucket_path(hh), local),
+                VerifyBucketWork(self.app, local, bytes.fromhex(hh)),
+            ]
+            return WorkSequence(self.clock, "fetch-bucket %s" % hh[:8],
+                                seq)
+        return None
+
+    def do_work(self) -> State:
+        # adopt everything downloaded into the content-addressed store
+        from ..bucket.bucket import Bucket
+        bm = self.app.bucket_manager
+        if bm is None:
+            return SUCCESS
+        for hh in self._hashes:
+            if bm.get_bucket_by_hash(bytes.fromhex(hh)) is not None:
+                continue
+            path = self.local_path(hh)
+            if os.path.exists(path):
+                bm.adopt_bucket(Bucket.read_from(path))
+        return SUCCESS
+
+
+class VerifyLedgerChainWork(BasicWork):
+    """Walk downloaded ledger-header files verifying the hash chain:
+    every entry's hash must equal SHA256(header) and every header's
+    previousLedgerHash must back-link the prior entry (reference
+    VerifyLedgerChainWork.cpp; it walks newest→oldest, one checkpoint
+    per crank — mirrored here oldest→newest, same predicate). An
+    optional trusted (seq, hash) pins the top of the chain."""
+
+    def __init__(self, app, download_dir: str, first_ledger: int,
+                 last_ledger: int,
+                 trusted: Optional[tuple] = None,
+                 local_genesis: Optional[tuple] = None) -> None:
+        super().__init__(app.clock, "verify-ledger-chain", RETRY_NEVER)
+        self.app = app
+        self.download_dir = download_dir
+        self.first_ledger = first_ledger
+        self.last_ledger = last_ledger
+        self.trusted = trusted            # (seq, hash) to match exactly
+        self.local_genesis = local_genesis  # (lcl_seq, lcl_hash) link check
+        freq = app.config.CHECKPOINT_FREQUENCY
+        self._checkpoints = list(checkpoints_in_range(
+            first_ledger, last_ledger, freq))
+        self._ci = 0
+        self._prev: Optional[LedgerHeaderHistoryEntry] = None
+        self.verified_ahead: Dict[int, bytes] = {}  # seq -> hash
+
+    def on_reset(self) -> None:
+        self._ci = 0
+        self._prev = None
+        self.verified_ahead = {}
+
+    def _entry_ok(self, e: LedgerHeaderHistoryEntry) -> bool:
+        if sha256(e.header.to_xdr()) != e.hash:
+            log.warning("header %d self-hash mismatch", e.header.ledgerSeq)
+            return False
+        if self._prev is not None:
+            if e.header.ledgerSeq != self._prev.header.ledgerSeq + 1:
+                # a seq gap would let a forged segment skip the back-link
+                # check entirely — reject it outright
+                log.warning("ledger seq gap: %d after %d",
+                            e.header.ledgerSeq, self._prev.header.ledgerSeq)
+                return False
+            if e.header.previousLedgerHash != self._prev.hash:
+                log.warning("chain break at %d", e.header.ledgerSeq)
+                return False
+        if self.local_genesis is not None:
+            seq, hsh = self.local_genesis
+            if e.header.ledgerSeq == seq + 1 and \
+                    e.header.previousLedgerHash != hsh:
+                log.warning("chain does not link local LCL %d", seq)
+                return False
+        return True
+
+    def on_run(self) -> State:
+        if self._ci >= len(self._checkpoints):
+            if self.trusted is not None:
+                seq, hsh = self.trusted
+                if self.verified_ahead.get(seq) != hsh:
+                    log.warning("trusted hash mismatch at %d", seq)
+                    return FAILURE
+            return SUCCESS
+        c = self._checkpoints[self._ci]
+        self._ci += 1
+        path = os.path.join(self.download_dir, "ledger-%08x.xdr" % c)
+        if not os.path.exists(path):
+            return FAILURE
+        with XDRInputFileStream(path) as ins:
+            for e in ins.read_all(LedgerHeaderHistoryEntry):
+                if not self._entry_ok(e):
+                    return FAILURE
+                self._prev = e
+                self.verified_ahead[e.header.ledgerSeq] = e.hash
+        return RUNNING

@@ -107,6 +107,18 @@ class LedgerManager:
         self.state = LedgerManagerState.LM_SYNCED_STATE
         return True
 
+    def set_last_closed_ledger(self, header: LedgerHeader,
+                               ledger_hash: bytes) -> None:
+        """Fast-forward the LCL to a verified downloaded header — the
+        bucket-apply catchup path (reference CatchupWork sets LCL after
+        ApplyBucketsWork; LedgerManagerImpl::setLastClosedLedger)."""
+        assert sha256(header.to_xdr()) == ledger_hash, "header/hash mismatch"
+        self.root.set_header(header)
+        self.lcl_hash = ledger_hash
+        self._store_header(header)
+        log.info("LCL set to %d (%s) from catchup", header.ledgerSeq,
+                 ledger_hash.hex()[:8])
+
     # -- accessors ----------------------------------------------------------
     @property
     def lcl_header(self) -> LedgerHeader:
@@ -127,6 +139,13 @@ class LedgerManager:
     # -- externalization ----------------------------------------------------
     def value_externalized(self, lcd: LedgerCloseData) -> None:
         lcl = self.last_closed_ledger_num()
+        if self.state == LedgerManagerState.LM_CATCHING_UP_STATE:
+            # mid-catchup every value is buffered, even in-order ones —
+            # closing under a concurrent bucket apply would corrupt state
+            # (reference LedgerManagerImpl.cpp:410-444)
+            if self.catchup_trigger is not None:
+                self.catchup_trigger(lcd)
+            return
         if lcd.ledger_seq == lcl + 1:
             self.close_ledger(lcd)
         elif lcd.ledger_seq <= lcl:
@@ -149,6 +168,15 @@ class LedgerManager:
 
         verifier = getattr(self.app, "sig_verifier", None)
         ltx = LedgerTxn(self.root)
+        try:
+            self._close_ledger_in(ltx, lcd, header_prev, verifier)
+        except BaseException:
+            if ltx._open:
+                ltx.rollback()   # drop children too: no dangling state
+            raise
+
+    def _close_ledger_in(self, ltx, lcd: LedgerCloseData,
+                         header_prev: LedgerHeader, verifier) -> None:
         header = ltx.load_header()
         header.ledgerSeq = lcd.ledger_seq
         header.previousLedgerHash = self.lcl_hash

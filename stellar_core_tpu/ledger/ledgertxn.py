@@ -230,7 +230,13 @@ class LedgerTxn(AbstractLedgerTxnParent):
                 cur = self._parent.get_entry(LedgerKey.from_xdr(kb))
                 self._previous[kb] = cur.to_xdr() if cur is not None else None
             self._changes[kb] = e
-        self._header = header
+        # adopt the child's header VALUES in place: callers hold references
+        # from load_header(), and replacing the object would silently orphan
+        # their later mutations (close_ledger sets txSetResultHash /
+        # bucketListHash after per-tx child commits)
+        new = _copy_header(header)
+        for n, _t in type(self._header).xdr_fields:
+            setattr(self._header, n, getattr(new, n))
 
     # -- delta (meta + invariants) ------------------------------------------
     def get_delta(self) -> List[Tuple[LedgerKey, Optional[LedgerEntry],
@@ -318,6 +324,11 @@ class InMemoryLedgerTxnRoot(AbstractLedgerTxnParent):
         for eb in self._entries.values():
             yield LedgerEntry.from_xdr(eb)
 
+    def clear_entries(self) -> None:
+        """Drop all ledger entries (bucket-apply catchup resets state
+        before loading the snapshot)."""
+        self._entries.clear()
+
 
 class LedgerTxnRoot(AbstractLedgerTxnParent):
     """SQL-backed root with an entry cache and per-type bulk writers
@@ -394,6 +405,15 @@ class LedgerTxnRoot(AbstractLedgerTxnParent):
             e = LedgerEntry.from_xdr(blob)
             out[_kb(ledger_entry_key(e))] = e
         return out
+
+    def clear_entries(self) -> None:
+        """Drop all ledger entries + cache (bucket-apply catchup resets
+        state before loading the snapshot)."""
+        with self._db.transaction():
+            for table in ("accounts", "trustlines", "offers",
+                          "accountdata"):
+                self._db.execute("DELETE FROM %s" % table)
+        self._cache.clear()
 
     # -- commit -------------------------------------------------------------
     def commit_child(self, changes, header) -> None:

@@ -81,6 +81,11 @@ class Application:
         self.process_manager = ProcessManager(
             self.clock, config.MAX_CONCURRENT_SUBPROCESSES)
 
+        from ..history.history_manager import HistoryManager
+        self.history_manager = HistoryManager(self)
+        from ..catchup.catchup_manager import CatchupManager
+        self.catchup_manager = CatchupManager(self)
+
     # -- identity ------------------------------------------------------------
     def network_root_key(self) -> SecretKey:
         """Deterministic genesis root key derived from the network id."""
@@ -95,6 +100,8 @@ class Application:
         if self.overlay_manager is not None and \
                 not self.config.RUN_STANDALONE:
             self.overlay_manager.start()
+        if self.history_manager is not None:
+            self.history_manager.publish_queued_history()
         force = self.config.FORCE_SCP or (
             self.persistent_state is not None and
             self.persistent_state.get_force_scp())

@@ -121,8 +121,15 @@ class BasicWork:
                 self.state = State.RUNNING
                 self.wake_up()
 
-        self._retry_timer.expires_from_now(delay)
-        self._retry_timer.async_wait(fire)
+        from ..util.timer import ClockMode
+        if getattr(self.clock, "mode", None) == ClockMode.VIRTUAL_TIME:
+            # virtual-time runs (tests, simulation) crank continuously, so a
+            # backoff timer could starve behind posted actions; retry on the
+            # next turn instead — the retry *count* still bounds the work
+            self.clock.post(fire)
+        else:
+            self._retry_timer.expires_from_now(delay)
+            self._retry_timer.async_wait(fire)
 
     def wake_up(self) -> None:
         if self.state == State.WAITING:

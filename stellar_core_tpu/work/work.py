@@ -70,6 +70,9 @@ class WorkSequence(BasicWork):
 
     def on_reset(self) -> None:
         self._idx = 0
+        for w in self.sequence:
+            if w.is_done():
+                w.state = State.PENDING   # re-armed on next on_run
 
     def on_run(self) -> State:
         if self._idx >= len(self.sequence):
@@ -121,7 +124,7 @@ class BatchWork(Work):
                 c.crank_work()
         if self.children:
             return RUNNING
-        return SUCCESS if self._exhausted else RUNNING
+        return self.do_work() if self._exhausted else RUNNING
 
 
 class ConditionalWork(BasicWork):
@@ -133,11 +136,19 @@ class ConditionalWork(BasicWork):
         super().__init__(clock, name, 0)
         self.condition = condition
         self.inner = inner
-        inner._parent = self
+        self._condition_met = False   # latched once true (reference
+        inner._parent = self          # ConditionalWork clears mConditionFn)
+
+    def on_reset(self) -> None:
+        self._condition_met = False
+        if self.inner.is_done():
+            self.inner.state = State.PENDING   # re-armed when gate opens
 
     def on_run(self) -> State:
-        if not self.condition():
-            return RUNNING
+        if not self._condition_met:
+            if not self.condition():
+                return RUNNING
+            self._condition_met = True
         if self.inner.state == State.PENDING:
             self.inner.start()
         if not self.inner.is_done():

@@ -1,0 +1,283 @@
+"""History publish + catchup tests.
+
+Role parity: reference `src/history/test/HistoryTests.cpp:38-1035`
+(CatchupSimulation: publish to a tmpdir file archive, generate ledgers,
+catch a second app up from it) and `src/catchup/test/CatchupWorkTests.cpp`
+(range arithmetic).
+"""
+
+import os
+
+import pytest
+
+from stellar_core_tpu.catchup import (CatchupConfiguration,
+                                      calculate_catchup_range)
+from stellar_core_tpu.history.archive import HistoryArchive
+from stellar_core_tpu.history.checkpoints import (checkpoint_containing,
+                                                  checkpoints_in_range,
+                                                  first_in_checkpoint,
+                                                  is_last_in_checkpoint)
+from stellar_core_tpu.ledger.ledger_manager import (LedgerCloseData,
+                                                    LedgerManagerState)
+from stellar_core_tpu.main.application import Application
+from stellar_core_tpu.main.config import Config
+from stellar_core_tpu.testing import AppLedgerAdapter
+from stellar_core_tpu.util.timer import ClockMode, VirtualClock
+from stellar_core_tpu.work.basic_work import State
+from stellar_core_tpu.xdr import LedgerHeader, TransactionEnvelope
+
+FREQ = 8  # small checkpoints so tests stay fast
+
+
+# ---------------------------------------------------------------- arithmetic
+
+def test_checkpoint_arithmetic():
+    assert checkpoint_containing(1, 64) == 63
+    assert checkpoint_containing(63, 64) == 63
+    assert checkpoint_containing(64, 64) == 127
+    assert is_last_in_checkpoint(63, 64)
+    assert not is_last_in_checkpoint(64, 64)
+    assert first_in_checkpoint(63, 64) == 1
+    assert first_in_checkpoint(127, 64) == 64
+    assert list(checkpoints_in_range(1, 130, 64)) == [63, 127, 191]
+
+
+def test_catchup_range_complete():
+    r = calculate_catchup_range(1, CatchupConfiguration(100, 2**32 - 1), 64)
+    assert not r.apply_buckets
+    assert (r.replay_first, r.replay_last) == (2, 100)
+
+
+def test_catchup_range_minimal():
+    r = calculate_catchup_range(1, CatchupConfiguration(127, 0), 64)
+    assert r.apply_buckets and r.apply_buckets_at == 127
+    assert r.replay_count() == 0
+    # mid-checkpoint target: buckets at the checkpoint below
+    r = calculate_catchup_range(1, CatchupConfiguration(100, 0), 64)
+    assert r.apply_buckets and r.apply_buckets_at == 63
+    assert (r.replay_first, r.replay_last) == (64, 100)
+
+
+def test_catchup_range_recent():
+    r = calculate_catchup_range(1, CatchupConfiguration(127, 10), 64)
+    assert r.apply_buckets and r.apply_buckets_at == 63
+    assert (r.replay_first, r.replay_last) == (64, 127)
+    # count covers the whole gap -> pure replay
+    r = calculate_catchup_range(120, CatchupConfiguration(127, 10), 64)
+    assert not r.apply_buckets
+    assert (r.replay_first, r.replay_last) == (121, 127)
+
+
+# ---------------------------------------------------------------- fixtures
+
+def make_app(tmp_path, n, archive_root, writable=True):
+    cfg = Config.test_config(n)
+    cfg.DATABASE = "sqlite3://:memory:"
+    cfg.CHECKPOINT_FREQUENCY = FREQ
+    arch = HistoryArchive.local_dir("test", str(archive_root))
+    d = {"get": arch.get_tmpl, "mkdir": arch.mkdir_tmpl}
+    if writable:
+        d["put"] = arch.put_tmpl
+    cfg.HISTORY = {"test": d}
+    clock = VirtualClock(ClockMode.VIRTUAL_TIME)
+    app = Application(clock, cfg)
+    app.enable_buckets(str(tmp_path / ("buckets-%d" % n)))
+    app.start()
+    return app
+
+
+def close_ledgers_with_traffic(app, upto):
+    """Manual-close ledgers with a payment in most of them."""
+    adapter = AppLedgerAdapter(app)
+    root = adapter.root_account()
+    alice = root.create(10**10)
+    while app.ledger_manager.last_closed_ledger_num() < upto:
+        f = alice.tx([alice.op_payment(root.account_id, 1000)])
+        app.submit_transaction(f)
+        app.manual_close()
+    return alice
+
+
+def run_work(app, work, max_cranks=200000):
+    for _ in range(max_cranks):
+        if work.is_done():
+            break
+        app.crank(False)
+    assert work.is_done(), "work did not finish"
+    return work.state
+
+
+@pytest.fixture
+def publisher(tmp_path):
+    archive_root = tmp_path / "archive"
+    os.makedirs(archive_root, exist_ok=True)
+    app = make_app(tmp_path, 0, archive_root)
+    close_ledgers_with_traffic(app, 2 * FREQ + 3)   # past two checkpoints
+    # let queued publishes run
+    app.crank_until(lambda: app.history_manager.publish_queue() == [],
+                    max_cranks=5000)
+    assert app.history_manager.published_checkpoints >= 2
+    return app, tmp_path, archive_root
+
+
+# ---------------------------------------------------------------- publish
+
+def test_publish_layout(publisher):
+    app, tmp_path, archive_root = publisher
+    c1 = FREQ - 1
+    assert (archive_root / ".well-known" /
+            "stellar-history.json").exists()
+    h = "%08x" % c1
+    sub = h[0:2] + "/" + h[2:4] + "/" + h[4:6]
+    for cat in ("ledger", "transactions", "results", "scp"):
+        assert (archive_root / cat / h[0:2] / h[2:4] / h[4:6] /
+                ("%s-%s.xdr.gz" % (cat, h))).exists(), cat
+    # HAS names real bucket files
+    from stellar_core_tpu.history.archive_state import HistoryArchiveState
+    has = HistoryArchiveState.from_json(
+        (archive_root / ".well-known" / "stellar-history.json").read_text())
+    assert has.current_ledger == 2 * FREQ - 1
+    for hh in has.bucket_hashes():
+        assert (archive_root / "bucket" / hh[0:2] / hh[2:4] / hh[4:6] /
+                ("bucket-%s.xdr.gz" % hh)).exists()
+
+
+# ---------------------------------------------------------------- catchup
+
+def test_catchup_complete(publisher):
+    app_a, tmp_path, archive_root = publisher
+    app_b = make_app(tmp_path, 1, archive_root, writable=False)
+    tip = 2 * FREQ - 1
+
+    work = app_b.catchup_manager.start_catchup(
+        CatchupConfiguration.complete())
+    assert work is not None
+    assert run_work(app_b, work) == State.SUCCESS
+
+    lm_b = app_b.ledger_manager
+    assert lm_b.last_closed_ledger_num() == tip
+    # byte-identical chain
+    row = app_a.database.execute(
+        "SELECT ledgerhash FROM ledgerheaders WHERE ledgerseq = ?",
+        (tip,)).fetchone()
+    assert lm_b.lcl_hash.hex() == row[0]
+    assert lm_b.is_synced()
+
+
+def test_catchup_minimal_buckets(publisher):
+    app_a, tmp_path, archive_root = publisher
+    app_b = make_app(tmp_path, 2, archive_root, writable=False)
+    tip = 2 * FREQ - 1
+
+    work = app_b.catchup_manager.start_catchup(
+        CatchupConfiguration.minimal())
+    assert run_work(app_b, work) == State.SUCCESS
+
+    lm_b = app_b.ledger_manager
+    assert lm_b.last_closed_ledger_num() == tip
+    row = app_a.database.execute(
+        "SELECT ledgerhash FROM ledgerheaders WHERE ledgerseq = ?",
+        (tip,)).fetchone()
+    assert lm_b.lcl_hash.hex() == row[0]
+    # bucket list restored bit-for-bit
+    assert app_b.bucket_manager.get_hash() == \
+        app_a.ledger_manager.lcl_header.bucketListHash or \
+        app_b.bucket_manager.get_hash() == \
+        lm_b.lcl_header.bucketListHash
+    # state usable: root balance matches A's at that ledger
+    root = app_b.network_root_key().public_key
+    assert AppLedgerAdapter(app_b).balance(root) > 0
+
+
+def make_lcd_from_db(app_src, seq):
+    """Rebuild the LedgerCloseData node A externalized for `seq`."""
+    from stellar_core_tpu.herder.txset import TxSetFrame
+    from stellar_core_tpu.transactions.transaction_frame import \
+        TransactionFrame
+    db = app_src.database
+    hrow = db.execute(
+        "SELECT data FROM ledgerheaders WHERE ledgerseq = ?",
+        (seq,)).fetchone()
+    header = LedgerHeader.from_xdr(hrow[0])
+    frames = [
+        TransactionFrame.make_from_wire(
+            app_src.config.network_id, TransactionEnvelope.from_xdr(r[0]))
+        for r in db.execute(
+            "SELECT txbody FROM txhistory WHERE ledgerseq = ? "
+            "ORDER BY txindex", (seq,)).fetchall()]
+    ts = TxSetFrame(app_src.config.network_id,
+                    header.previousLedgerHash, frames)
+    return LedgerCloseData(seq, ts, header.scpValue)
+
+
+def test_online_catchup_with_buffered_ledgers(publisher):
+    """A node that falls behind buffers live ledgers, heals from the
+    archive, then drains the buffer (reference CatchupManagerImpl)."""
+    app_a, tmp_path, archive_root = publisher
+    top = app_a.ledger_manager.last_closed_ledger_num()   # 2*FREQ+3
+    tip = 2 * FREQ - 1                                    # archive tip
+
+    app_b = make_app(tmp_path, 3, archive_root, writable=False)
+    cm = app_b.catchup_manager
+    lm_b = app_b.ledger_manager
+
+    # live stream arrives with a gap: first seq far ahead of genesis
+    for seq in range(tip + 1, top + 1):
+        lm_b.value_externalized(make_lcd_from_db(app_a, seq))
+    assert lm_b.state == LedgerManagerState.LM_CATCHING_UP_STATE
+    assert cm.buffered_count() == top - tip
+    assert cm.catchup_running()
+
+    app_b.crank_until(lambda: not cm.catchup_running(), max_cranks=200000)
+    # catchup hit the archive tip, then the buffer drained to `top`
+    assert lm_b.last_closed_ledger_num() == top
+    assert lm_b.is_synced()
+    row = app_a.database.execute(
+        "SELECT ledgerhash FROM ledgerheaders WHERE ledgerseq = ?",
+        (top,)).fetchone()
+    assert lm_b.lcl_hash.hex() == row[0]
+
+
+def test_catchup_detects_corrupt_archive(publisher):
+    """Flip a byte in a published ledger file: VerifyLedgerChainWork must
+    fail the catchup (reference VerifyLedgerChainWork hash checks)."""
+    app_a, tmp_path, archive_root = publisher
+    import gzip
+    c = "%08x" % (FREQ - 1)
+    p = (archive_root / "ledger" / c[0:2] / c[2:4] / c[4:6] /
+         ("ledger-%s.xdr.gz" % c))
+    raw = bytearray(gzip.decompress(p.read_bytes()))
+    raw[40] ^= 0xFF
+    p.write_bytes(gzip.compress(bytes(raw)))
+
+    app_b = make_app(tmp_path, 4, archive_root, writable=False)
+    work = app_b.catchup_manager.start_catchup(
+        CatchupConfiguration.complete())
+    assert run_work(app_b, work) == State.FAILURE
+    assert app_b.ledger_manager.last_closed_ledger_num() == 1
+
+
+def test_prewarm_batches_checkpoint_sigs(publisher):
+    """Catchup replay drains whole-checkpoint signature batches through
+    the verifier (SURVEY.md §3.4 TPU batch site)."""
+    app_a, tmp_path, archive_root = publisher
+
+    from stellar_core_tpu.crypto.batch_verifier import CpuSigVerifier
+
+    class CountingVerifier(CpuSigVerifier):
+        def __init__(self):
+            self.batches = []
+
+        def prewarm_many(self, triples):
+            self.batches.append(len(triples))
+            return super().prewarm_many(triples)
+
+    app_b = make_app(tmp_path, 5, archive_root, writable=False)
+    cv = CountingVerifier()
+    app_b.sig_verifier = cv
+    work = app_b.catchup_manager.start_catchup(
+        CatchupConfiguration.complete())
+    assert run_work(app_b, work) == State.SUCCESS
+    # one batch per checkpoint, each covering many ledgers' signatures
+    assert len(cv.batches) >= 2
+    assert max(cv.batches) > 1
