@@ -1,0 +1,8 @@
+"""`python -m stellar_core_tpu` entry point (reference src/main/main.cpp)."""
+
+import sys
+
+from .main.commandline import main
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -37,14 +37,14 @@ class CatchupManager:
         lcl = lm.last_closed_ledger_num()
         if lcd.ledger_seq <= lcl:
             return
-        if lcd.ledger_seq == lcl + 1 and not self.catchup_running():
+        if lcd.ledger_seq == lcl + 1 and not self.catchup_running() \
+                and not getattr(lm, "entries_invalidated", False):
             # contiguous and no work in flight: close directly, even while
             # nominally catching up (reference CatchupManagerImpl closes
             # the next ledger and exits catchup when the buffer drains) —
             # this also keeps archive-less nodes alive
-            lm.close_ledger(lcd)
-            self._drain_buffer()
-            if not self._buffered:
+            if self._close_one(lcd) and self._drain_buffer() \
+                    and not self._buffered:
                 lm.state = LedgerManagerState.LM_SYNCED_STATE
             return
         self._buffered[lcd.ledger_seq] = lcd
@@ -75,14 +75,15 @@ class CatchupManager:
             else:
                 config = CatchupConfiguration.minimal()
         self.catchups_started += 1
-        self._work = CatchupWork(self.app, config)
+        trusted = self._consensus_anchor()
+        self._work = CatchupWork(self.app, config, trusted_hash=trusted)
 
         def done(state) -> None:
             from ..work.basic_work import State
             if state == State.SUCCESS:
                 self.catchups_succeeded += 1
-                self._drain_buffer()
-                self._check_gap_closed()
+                ok = self._drain_buffer()
+                self._check_gap_closed(drained_ok=ok)
             else:
                 self.catchups_failed += 1
                 log.warning("catchup failed; will retry on next gap")
@@ -92,28 +93,46 @@ class CatchupManager:
         self.app.work_scheduler.schedule_work(self._work, done)
         return self._work
 
+    def _consensus_anchor(self):
+        """The oldest buffered externalized value pins the archive chain:
+        its txset's previousLedgerHash IS the consensus hash of ledger
+        seq-1, so a forged archive cannot graft a fake chain under real
+        SCP traffic (reference anchors catchup at the trigger ledger's
+        consensus hash)."""
+        if not self._buffered:
+            return None
+        seq = min(self._buffered)
+        lcd = self._buffered[seq]
+        prev = getattr(lcd.tx_set, "previous_ledger_hash", None)
+        return (seq - 1, prev) if prev is not None else None
+
     # -- buffered-ledger drain (reference ApplyBufferedLedgersWork) ----------
-    def _drain_buffer(self) -> None:
+    def _close_one(self, lcd) -> bool:
+        """Close one ledger; on failure log loudly, stay catching-up, and
+        never let the exception kill the caller's crank loop (reference:
+        prevHash divergence is fatal-loud, LedgerManagerImpl.cpp:463-468)."""
         from ..ledger.ledger_manager import LedgerManagerState
+        lm = self.app.ledger_manager
+        try:
+            lm.close_ledger(lcd)
+            return True
+        except Exception as e:
+            log.error("ledger %d failed to close: %s — discarding and "
+                      "staying in catchup", lcd.ledger_seq, e)
+            lm.state = LedgerManagerState.LM_CATCHING_UP_STATE
+            return False
+
+    def _drain_buffer(self) -> bool:
+        """Apply contiguous buffered ledgers; False if a close failed."""
         lm = self.app.ledger_manager
         self._trim_buffer()
         while True:
             nxt = lm.last_closed_ledger_num() + 1
             lcd = self._buffered.pop(nxt, None)
             if lcd is None:
-                break
-            try:
-                lm.close_ledger(lcd)
-            except Exception as e:
-                # archive chain vs live stream divergence (or corrupt
-                # buffered value): fatal-loud like the reference's prevHash
-                # check, but don't let the exception kill the crank loop —
-                # drop the value and stay catching-up
-                log.error("buffered ledger %d failed to close: %s — "
-                          "discarding and staying in catchup",
-                          lcd.ledger_seq, e)
-                lm.state = LedgerManagerState.LM_CATCHING_UP_STATE
-                break
+                return True
+            if not self._close_one(lcd):
+                return False
 
     def _trim_buffer(self) -> None:
         lcl = self.app.ledger_manager.last_closed_ledger_num()
@@ -127,12 +146,14 @@ class CatchupManager:
             for seq in sorted(self._buffered)[:len(self._buffered) - cap]:
                 del self._buffered[seq]
 
-    def _check_gap_closed(self) -> bool:
+    def _check_gap_closed(self, drained_ok: bool = True) -> bool:
         """After a catchup + drain: if buffered ledgers remain beyond a
         hole, go around again (reference: catchup restarts until the node
         reconnects with the live stream)."""
         from ..ledger.ledger_manager import LedgerManagerState
         lm = self.app.ledger_manager
+        if not drained_ok:
+            return False
         if self._buffered:
             # a hole below min(buffered) isn't in the archive yet; stay in
             # catching-up state — the next externalized ledger re-triggers

@@ -91,7 +91,10 @@ class ApplyBucketsWork(BasicWork):
 
         # the snapshot IS the state: drop anything local first, else
         # entries deleted on-network during the gap would survive as
-        # phantoms (reference resets ledger state before bucket apply)
+        # phantoms (reference resets ledger state before bucket apply);
+        # the invalidated flag blocks direct closes until the LCL
+        # fast-forward below lands (cleared in set_last_closed_ledger)
+        lm.entries_invalidated = True
         lm.ltx_root().clear_entries()
         n = apply_buckets(lm.ltx_root(), ordered)
         log.info("applied %d bucket entries at ledger %d", n,
@@ -286,16 +289,7 @@ class DownloadApplyTxsWork(BatchWork):
             lambda gate_lo=gate_lo: self._applied_up_to == gate_lo - 1,
             apply_work)
 
-        seq = WorkSequence(self.clock, "download-apply %08x" % c,
-                          gets + [gated])
-
-        orig_on_run = apply_work.on_run
-
-        def tracked_on_run(me=apply_work, hi=hi):
-            st = orig_on_run()
-            if st == SUCCESS:
-                self._applied_up_to = hi
-            return st
-
-        apply_work.on_run = tracked_on_run
-        return seq
+        apply_work.on_success = \
+            lambda hi=hi: setattr(self, "_applied_up_to", hi)
+        return WorkSequence(self.clock, "download-apply %08x" % c,
+                            gets + [gated])

@@ -352,12 +352,12 @@ class VerifyLedgerChainWork(BasicWork):
             first_ledger, last_ledger, freq))
         self._ci = 0
         self._prev: Optional[LedgerHeaderHistoryEntry] = None
-        self.verified_ahead: Dict[int, bytes] = {}  # seq -> hash
+        self._trusted_matched = False
 
     def on_reset(self) -> None:
         self._ci = 0
         self._prev = None
-        self.verified_ahead = {}
+        self._trusted_matched = False
 
     def _entry_ok(self, e: LedgerHeaderHistoryEntry) -> bool:
         if sha256(e.header.to_xdr()) != e.hash:
@@ -383,11 +383,12 @@ class VerifyLedgerChainWork(BasicWork):
 
     def on_run(self) -> State:
         if self._ci >= len(self._checkpoints):
-            if self.trusted is not None:
-                seq, hsh = self.trusted
-                if self.verified_ahead.get(seq) != hsh:
-                    log.warning("trusted hash mismatch at %d", seq)
-                    return FAILURE
+            if self.trusted is not None and not self._trusted_matched and \
+                    self.first_ledger <= self.trusted[0] <= self.last_ledger:
+                # the consensus anchor was inside the range but never seen
+                log.warning("trusted hash %d absent from chain",
+                            self.trusted[0])
+                return FAILURE
             return SUCCESS
         c = self._checkpoints[self._ci]
         self._ci += 1
@@ -398,6 +399,12 @@ class VerifyLedgerChainWork(BasicWork):
             for e in ins.read_all(LedgerHeaderHistoryEntry):
                 if not self._entry_ok(e):
                     return FAILURE
+                if self.trusted is not None and \
+                        e.header.ledgerSeq == self.trusted[0]:
+                    if e.hash != self.trusted[1]:
+                        log.warning("trusted hash mismatch at %d",
+                                    e.header.ledgerSeq)
+                        return FAILURE
+                    self._trusted_matched = True
                 self._prev = e
-                self.verified_ahead[e.header.ledgerSeq] = e.hash
         return RUNNING

@@ -62,6 +62,9 @@ class LedgerManager:
             self.root = LedgerTxnRoot(app.database)
         self.lcl_hash: bytes = b"\x00" * 32
         self.catchup_trigger = None  # set by CatchupManager wiring
+        # True between a bucket-apply's state wipe and its successful LCL
+        # fast-forward: no direct closes may run against half-built state
+        self.entries_invalidated = False
 
     # -- genesis / restart --------------------------------------------------
     def start_new_ledger(self) -> None:
@@ -116,6 +119,7 @@ class LedgerManager:
         self.root.set_header(header)
         self.lcl_hash = ledger_hash
         self._store_header(header)
+        self.entries_invalidated = False
         log.info("LCL set to %d (%s) from catchup", header.ledgerSeq,
                  ledger_hash.hex()[:8])
 

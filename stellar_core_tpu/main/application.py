@@ -86,6 +86,12 @@ class Application:
         from ..catchup.catchup_manager import CatchupManager
         self.catchup_manager = CatchupManager(self)
 
+        from .command_handler import CommandHandler
+        self.command_handler = CommandHandler(self)
+        from .maintainer import ExternalQueue, Maintainer
+        self.external_queue = ExternalQueue(self)
+        self.maintainer = Maintainer(self)
+
     # -- identity ------------------------------------------------------------
     def network_root_key(self) -> SecretKey:
         """Deterministic genesis root key derived from the network id."""
@@ -102,6 +108,7 @@ class Application:
             self.overlay_manager.start()
         if self.history_manager is not None:
             self.history_manager.publish_queued_history()
+        self.maintainer.start()
         force = self.config.FORCE_SCP or (
             self.persistent_state is not None and
             self.persistent_state.get_force_scp())
@@ -123,6 +130,7 @@ class Application:
 
     def stop(self) -> None:
         self.state = AppState.APP_STOPPING
+        self.command_handler.stop_http()
         if self.overlay_manager is not None:
             self.overlay_manager.shutdown()
         self.process_manager.shutdown()

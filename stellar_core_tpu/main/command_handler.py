@@ -1,0 +1,285 @@
+"""CommandHandler: the operator admin API.
+
+Role parity: reference `src/main/CommandHandler.cpp:77-105` — HTTP
+endpoints `info`, `metrics`, `peers`, `quorum`, `scp`, `tx`,
+`manualclose`, `upgrades`, `ll`, `bans`, `ban`, `unban`, `connect`,
+`droppeer`, `maintenance`, `dropcursor`, `setcursor`, `getcursor`,
+plus test-only `generateload`. Command dispatch is a pure function
+(`handle_command`) so the CLI, tests, and the HTTP server share one
+implementation; the HTTP server executes each command on the main loop
+(the reference's single-threaded-consensus invariant,
+docs/architecture.md:23-26).
+"""
+
+from __future__ import annotations
+
+import json
+import threading
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from typing import Dict, Optional, Tuple
+from urllib.parse import parse_qs, urlparse
+
+from ..util.log import get_log_levels, get_logger, set_log_level
+
+log = get_logger("Overlay")
+
+
+class CommandHandler:
+    def __init__(self, app) -> None:
+        self.app = app
+        self._server: Optional[ThreadingHTTPServer] = None
+        self._thread: Optional[threading.Thread] = None
+
+    # -- dispatch ------------------------------------------------------------
+    def handle_command(self, name: str,
+                       params: Dict[str, str]) -> Tuple[int, dict]:
+        """Returns (http_status, json-serializable body)."""
+        fn = getattr(self, "cmd_" + name.replace("-", "_"), None)
+        if fn is None:
+            return 404, {"error": "unknown command %r" % name,
+                         "commands": self.command_names()}
+        try:
+            return 200, fn(params)
+        except Exception as e:
+            return 500, {"error": "%s: %s" % (type(e).__name__, e)}
+
+    def command_names(self):
+        return sorted(m[len("cmd_"):].replace("_", "-")
+                      for m in dir(self) if m.startswith("cmd_"))
+
+    # -- introspection -------------------------------------------------------
+    def cmd_info(self, params) -> dict:
+        info = self.app.get_info()
+        lm = self.app.ledger_manager
+        info["history"] = {
+            "published_checkpoints":
+                self.app.history_manager.published_checkpoints,
+            "publish_queue_length":
+                len(self.app.history_manager.publish_queue()),
+        }
+        cm = self.app.catchup_manager
+        info["catchup"] = {
+            "running": cm.catchup_running(),
+            "buffered": cm.buffered_count(),
+            "started": cm.catchups_started,
+        }
+        info["ledger"]["synced"] = lm.is_synced()
+        return info
+
+    def cmd_metrics(self, params) -> dict:
+        return self.app.metrics.to_json()
+
+    def cmd_peers(self, params) -> dict:
+        om = self.app.overlay_manager
+        return om.get_peers_info() if om is not None else {"peers": []}
+
+    def cmd_quorum(self, params) -> dict:
+        return self.app.herder.get_json_info()
+
+    def cmd_scp(self, params) -> dict:
+        h = self.app.herder
+        limit = int(params.get("limit", 2))
+        scp = getattr(h, "scp", None)
+        out = scp.get_json_info(limit) if scp is not None else {}
+        out["tracking"] = h.current_slot()
+        return out
+
+    # -- transactions --------------------------------------------------------
+    def cmd_tx(self, params) -> dict:
+        """Submit a hex- (or base64-) encoded TransactionEnvelope
+        (reference CommandHandler.cpp:543-578)."""
+        from ..transactions.transaction_frame import TransactionFrame
+        from ..xdr import TransactionEnvelope
+        blob = params.get("blob")
+        if not blob:
+            return {"status": "ERROR", "detail": "missing 'blob' param"}
+        try:
+            raw = bytes.fromhex(blob)
+        except ValueError:
+            import base64
+            raw = base64.b64decode(blob)
+        env = TransactionEnvelope.from_xdr(raw)
+        frame = TransactionFrame.make_from_wire(
+            self.app.config.network_id, env)
+        status = self.app.submit_transaction(frame)
+        names = {0: "PENDING", 1: "DUPLICATE", 2: "ERROR", 3: "TRY_AGAIN_LATER"}
+        out = {"status": names.get(status, str(status))}
+        if status == 2 and frame.result is not None:
+            out["detail"] = str(frame.result.code)
+        return out
+
+    def cmd_manualclose(self, params) -> dict:
+        self.app.manual_close()
+        return {"status": "ok",
+                "ledger": self.app.ledger_manager.last_closed_ledger_num()}
+
+    # -- upgrades ------------------------------------------------------------
+    def cmd_upgrades(self, params) -> dict:
+        """mode=get|set|clear; set takes protocolversion/basefee/
+        basereserve/maxtxsetsize + upgradetime (reference `upgrades`)."""
+        from ..herder.upgrades import UpgradeParameters
+        ups = self.app.herder.upgrades
+        mode = params.get("mode", "get")
+        if mode == "get":
+            return ups.params.to_json()
+        if mode == "clear":
+            ups.set_parameters(UpgradeParameters())
+            return {"status": "cleared"}
+        if mode == "set":
+            p = UpgradeParameters()
+            if "upgradetime" in params:
+                p.upgrade_time = int(params["upgradetime"])
+            if "protocolversion" in params:
+                p.protocol_version = int(params["protocolversion"])
+            if "basefee" in params:
+                p.base_fee = int(params["basefee"])
+            if "basereserve" in params:
+                p.base_reserve = int(params["basereserve"])
+            if "maxtxsetsize" in params:
+                p.max_tx_set_size = int(params["maxtxsetsize"])
+            ups.set_parameters(p)
+            return p.to_json()
+        return {"error": "mode must be get|set|clear"}
+
+    # -- logging -------------------------------------------------------------
+    def cmd_ll(self, params) -> dict:
+        """Set log level: ?level=debug[&partition=Herder]
+        (reference `ll`)."""
+        if "level" in params:
+            set_log_level(params.get("partition"), params["level"])
+        return get_log_levels()
+
+    # -- peers ---------------------------------------------------------------
+    def cmd_connect(self, params) -> dict:
+        om = self.app.overlay_manager
+        peer = params.get("peer", "")
+        port = int(params.get("port", 0) or 0)
+        if not peer:
+            return {"error": "missing 'peer' param"}
+        if ":" in peer and not port:
+            peer, p = peer.rsplit(":", 1)
+            port = int(p)
+        om.connect_to(peer, port)
+        return {"status": "connecting to %s:%d" % (peer, port)}
+
+    def cmd_droppeer(self, params) -> dict:
+        om = self.app.overlay_manager
+        node = params.get("node", "")
+        ban = params.get("ban", "0") == "1"
+        for key in list(om.authenticated_peer_ids()):
+            p = om.get_peer(key)
+            if p is None:
+                continue
+            if p.peer_id is not None and \
+                    p.peer_id.key_bytes.hex().startswith(node):
+                if ban:
+                    om.ban_manager.ban_node(p.peer_id)
+                p.drop("dropped by admin")
+                return {"status": "dropped"}
+        return {"error": "peer not found"}
+
+    def cmd_bans(self, params) -> dict:
+        return {"bans": self.app.overlay_manager.ban_manager.banned()}
+
+    def cmd_unban(self, params) -> dict:
+        from ..xdr import PublicKey
+        node = params.get("node", "")
+        bm = self.app.overlay_manager.ban_manager
+        bm.unban_node(PublicKey.from_xdr(bytes.fromhex(node)))
+        return {"status": "ok"}
+
+    # -- maintenance / cursors ----------------------------------------------
+    def cmd_maintenance(self, params) -> dict:
+        count = int(params.get("count", 50000))
+        n = self.app.maintainer.perform_maintenance(count) \
+            if self.app.maintainer else 0
+        return {"status": "ok", "rows_deleted": n}
+
+    def cmd_setcursor(self, params) -> dict:
+        self.app.external_queue.set_cursor(params["id"],
+                                           int(params["cursor"]))
+        return {"status": "ok"}
+
+    def cmd_getcursor(self, params) -> dict:
+        rid = params.get("id")
+        return self.app.external_queue.get_cursors(rid)
+
+    def cmd_dropcursor(self, params) -> dict:
+        self.app.external_queue.delete_cursor(params["id"])
+        return {"status": "ok"}
+
+    # -- test-only -----------------------------------------------------------
+    def cmd_generateload(self, params) -> dict:
+        """reference CommandHandler.cpp:103 (test-only)."""
+        if not self.app.config.ARTIFICIALLY_GENERATE_LOAD_FOR_TESTING:
+            return {"error":
+                    "set ARTIFICIALLY_GENERATE_LOAD_FOR_TESTING to use"}
+        from ..simulation.load_generator import LoadGenerator
+        if not hasattr(self.app, "_load_generator"):
+            self.app._load_generator = LoadGenerator(self.app)
+        lg = self.app._load_generator
+        accounts = int(params.get("accounts", 10))
+        txs = int(params.get("txs", 10))
+        if accounts:
+            lg.generate_accounts(accounts)
+        if txs:
+            lg.generate_payments(txs)
+        return lg.status()
+
+    # -- HTTP front-end ------------------------------------------------------
+    def start_http(self, port: Optional[int] = None) -> int:
+        """Serve the admin API; returns the bound port. Handlers hop to the
+        main loop and wait (bounded) for the result."""
+        app = self
+        clock = self.app.clock
+        public = self.app.config.PUBLIC_HTTP_PORT
+        host = "" if public else "127.0.0.1"
+
+        class Handler(BaseHTTPRequestHandler):
+            def do_GET(self) -> None:
+                u = urlparse(self.path)
+                name = u.path.strip("/")
+                params = {k: v[0] for k, v in parse_qs(u.query).items()}
+                done = threading.Event()
+                result: list = [None]
+
+                def run() -> None:
+                    result[0] = app.handle_command(name, params)
+                    done.set()
+
+                clock.post_to_main(run)
+                if not done.wait(timeout=30.0):
+                    self._reply(504, {"error": "main loop busy"})
+                    return
+                status, body = result[0]
+                self._reply(status, body)
+
+            def _reply(self, status: int, body: dict) -> None:
+                data = json.dumps(body, indent=1).encode()
+                self.send_response(status)
+                self.send_header("Content-Type", "application/json")
+                self.send_header("Content-Length", str(len(data)))
+                self.end_headers()
+                self.wfile.write(data)
+
+            def log_message(self, fmt, *args) -> None:
+                pass  # route through our logger, not stderr
+
+        port = port if port is not None else self.app.config.HTTP_PORT
+        try:
+            self._server = ThreadingHTTPServer((host, port), Handler)
+        except OSError:
+            self._server = ThreadingHTTPServer((host, 0), Handler)
+        bound = self._server.server_address[1]
+        self.app.config.HTTP_PORT = bound
+        self._thread = threading.Thread(target=self._server.serve_forever,
+                                        daemon=True)
+        self._thread.start()
+        log.info("admin HTTP API on port %d", bound)
+        return bound
+
+    def stop_http(self) -> None:
+        if self._server is not None:
+            self._server.shutdown()
+            self._server.server_close()
+            self._server = None

@@ -1,0 +1,341 @@
+"""Command-line interface.
+
+Role parity: reference `src/main/CommandLine.cpp:1039-1093` — subcommand
+dispatch for node operation (`run`, `new-db`, `force-scp`, `catchup`,
+`publish`, `offline-info`), key tooling (`gen-seed`, `sec-to-pub`,
+`convert-id`, `sign-transaction`), debugging (`print-xdr`, `dump-xdr`,
+`http-command`), and `version`. Invoked via
+`python -m stellar_core_tpu <command>`.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+import time
+from typing import List, Optional
+
+from ..util.timer import ClockMode, VirtualClock
+from .config import Config
+
+
+def _load_config(args) -> Config:
+    if getattr(args, "conf", None):
+        cfg = Config.from_toml(args.conf)
+    else:
+        cfg = Config()
+    return cfg
+
+
+def _make_app(cfg: Config, real_time: bool = True):
+    from .application import Application
+    clock = VirtualClock(ClockMode.REAL_TIME if real_time
+                         else ClockMode.VIRTUAL_TIME)
+    app = Application(clock, cfg)
+    app.enable_buckets()
+    return app
+
+
+# -- commands ----------------------------------------------------------------
+
+def cmd_run(args) -> int:
+    """Run a node (reference `run` → ApplicationUtils::runWithConfig)."""
+    cfg = _load_config(args)
+    app = _make_app(cfg)
+    app.start()
+    app.command_handler.start_http()
+    print("node %s up; admin API on port %d"
+          % (cfg.NODE_SEED.public_key.key_bytes.hex()[:8]
+             if cfg.NODE_SEED else "?", cfg.HTTP_PORT))
+    try:
+        while True:
+            if app.crank(False) == 0:
+                time.sleep(0.001)
+    except KeyboardInterrupt:
+        app.stop()
+    return 0
+
+
+def cmd_new_db(args) -> int:
+    """Reset the DB to genesis (reference `new-db`)."""
+    cfg = _load_config(args)
+    app = _make_app(cfg, real_time=False)
+    app.ledger_manager.start_new_ledger()
+    print("new ledger: genesis %s"
+          % app.ledger_manager.lcl_hash.hex())
+    return 0
+
+
+def cmd_force_scp(args) -> int:
+    """Set/clear the DB flag that makes the next `run` start SCP
+    immediately from the LCL (reference `force-scp`)."""
+    cfg = _load_config(args)
+    app = _make_app(cfg, real_time=False)
+    if app.persistent_state is None:
+        print("force-scp needs a persistent database", file=sys.stderr)
+        return 1
+    app.persistent_state.set_force_scp(not args.reset)
+    print("force-scp %s" % ("cleared" if args.reset else "set"))
+    return 0
+
+
+def cmd_catchup(args) -> int:
+    """Offline catchup `<to>/<count>` (reference `catchup`)."""
+    from ..catchup import CURRENT, CatchupConfiguration
+    from ..work.basic_work import State
+    cfg = _load_config(args)
+    app = _make_app(cfg)
+    app.start()
+    spec = args.destination
+    to_s, _, count_s = spec.partition("/")
+    to = CURRENT if to_s == "current" else int(to_s)
+    count = CURRENT if count_s in ("", "max") else int(count_s)
+    work = app.catchup_manager.start_catchup(
+        CatchupConfiguration(to, count))
+    if work is None:
+        print("no readable history archive configured", file=sys.stderr)
+        return 1
+    while not work.is_done():
+        if app.crank(False) == 0:
+            time.sleep(0.001)
+    print("catchup %s at ledger %d"
+          % (work.state.name,
+             app.ledger_manager.last_closed_ledger_num()))
+    return 0 if work.state == State.SUCCESS else 1
+
+
+def cmd_publish(args) -> int:
+    """Publish any queued checkpoints (reference `publish`)."""
+    cfg = _load_config(args)
+    app = _make_app(cfg)
+    app.ledger_manager.load_last_known_ledger()
+    n = app.history_manager.publish_queued_history()
+    print("published %d checkpoint(s)" % n)
+    return 0
+
+
+def cmd_new_hist(args) -> int:
+    """Initialize a history archive with the genesis HAS (reference
+    `new-hist`)."""
+    cfg = _load_config(args)
+    app = _make_app(cfg, real_time=False)
+    app.ledger_manager.start_new_ledger()
+    hm = app.history_manager
+    ok = True
+    for name in args.archives:
+        arch = hm.archives.get(name)
+        if arch is None or not arch.has_put():
+            print("archive %r not configured/writable" % name,
+                  file=sys.stderr)
+            ok = False
+            continue
+        from ..history.archive import WELL_KNOWN
+        from ..history.archive_state import HistoryArchiveState
+        import tempfile, os
+        has = HistoryArchiveState(
+            app.ledger_manager.last_closed_ledger_num())
+        with tempfile.NamedTemporaryFile("w", suffix=".json",
+                                         delete=False) as f:
+            f.write(has.to_json())
+        if arch.put_file_sync(f.name, WELL_KNOWN):
+            print("initialized archive %s" % name)
+        else:
+            ok = False
+        os.unlink(f.name)
+    return 0 if ok else 1
+
+
+def cmd_offline_info(args) -> int:
+    cfg = _load_config(args)
+    app = _make_app(cfg, real_time=False)
+    app.ledger_manager.load_last_known_ledger()
+    print(json.dumps(app.get_info(), indent=2))
+    return 0
+
+
+def cmd_gen_seed(args) -> int:
+    """Generate a random node seed (reference `gen-seed`)."""
+    import os as _os
+    from ..crypto.keys import SecretKey
+    from ..crypto import strkey
+    sk = SecretKey.from_seed(_os.urandom(32))
+    print("Secret seed:", strkey.encode_seed(sk.seed))
+    print("Public:", strkey.encode_public_key(sk.public_key.key_bytes))
+    return 0
+
+
+def cmd_sec_to_pub(args) -> int:
+    """Print the public key for a secret seed read from stdin
+    (reference `sec-to-pub`)."""
+    from ..crypto.keys import SecretKey
+    from ..crypto import strkey
+    seed = (args.seed or sys.stdin.readline().strip())
+    sk = SecretKey.from_seed(strkey.decode_seed(seed))
+    print(strkey.encode_public_key(sk.public_key.key_bytes))
+    return 0
+
+
+def cmd_convert_id(args) -> int:
+    """Display an identifier in all known forms (reference
+    `convert-id`)."""
+    from ..crypto import strkey
+    s = args.id
+    out = {}
+    try:
+        raw = strkey.decode_public_key(s)
+        out = {"type": "public_key", "strkey": s, "hex": raw.hex()}
+    except Exception:
+        try:
+            raw = bytes.fromhex(s)
+            out = {"type": "hex", "hex": s,
+                   "strkey": strkey.encode_public_key(raw)}
+        except ValueError:
+            print("unrecognized id %r" % s, file=sys.stderr)
+            return 1
+    print(json.dumps(out, indent=2))
+    return 0
+
+
+def cmd_sign_transaction(args) -> int:
+    """Add a signature to a transaction envelope read from a file
+    (reference `sign-transaction`)."""
+    from ..crypto.keys import SecretKey
+    from ..crypto import strkey
+    from ..crypto.hashing import sha256
+    from ..transactions.transaction_frame import TransactionFrame
+    from ..xdr import TransactionEnvelope
+    cfg = _load_config(args)
+    if args.netid:
+        network_id = sha256(args.netid.encode())
+    else:
+        network_id = cfg.network_id
+    raw = open(args.txfile, "rb").read()
+    try:
+        raw = bytes.fromhex(raw.decode().strip())
+    except Exception:
+        pass
+    env = TransactionEnvelope.from_xdr(raw)
+    seed = args.seed or sys.stdin.readline().strip()
+    sk = SecretKey.from_seed(strkey.decode_seed(seed))
+    frame = TransactionFrame.make_from_wire(network_id, env)
+    frame.add_signature(sk)
+    print(frame.envelope.to_xdr().hex())
+    return 0
+
+
+def cmd_print_xdr(args) -> int:
+    """Pretty-print one XDR value (reference `print-xdr`)."""
+    import stellar_core_tpu.xdr as X
+    raw = open(args.file, "rb").read()
+    try:
+        raw = bytes.fromhex(raw.decode().strip())
+    except Exception:
+        pass
+    t = getattr(X, args.filetype, None)
+    if t is None:
+        print("unknown XDR type %r" % args.filetype, file=sys.stderr)
+        return 1
+    v = t.from_xdr(raw)
+    print(_xdr_to_jsonable(v))
+    return 0
+
+
+def _xdr_to_jsonable(v, depth: int = 0):
+    if depth > 24:
+        return "..."
+    if isinstance(v, bytes):
+        return v.hex()
+    if isinstance(v, (int, str, bool)) or v is None:
+        return v
+    if isinstance(v, (list, tuple)):
+        return [_xdr_to_jsonable(x, depth + 1) for x in v]
+    fields = getattr(type(v), "xdr_fields", None)
+    if fields is not None:
+        return {n: _xdr_to_jsonable(getattr(v, n), depth + 1)
+                for n, _t in fields}
+    if hasattr(v, "disc") and hasattr(v, "value"):
+        return {"disc": v.disc,
+                "value": _xdr_to_jsonable(v.value, depth + 1)}
+    return str(v)
+
+
+def cmd_http_command(args) -> int:
+    """Send a command to a running node's admin port (reference
+    `http-command`)."""
+    import urllib.request
+    cfg = _load_config(args)
+    url = "http://127.0.0.1:%d/%s" % (cfg.HTTP_PORT, args.command)
+    with urllib.request.urlopen(url, timeout=35) as r:
+        print(r.read().decode())
+    return 0
+
+
+def cmd_version(args) -> int:
+    cfg = Config()
+    print(cfg.VERSION_STR)
+    return 0
+
+
+def cmd_test(args) -> int:
+    """Run the test suite (reference `test`)."""
+    import pytest
+    return pytest.main(["-q"] + (args.pytest_args or []))
+
+
+# -- parser ------------------------------------------------------------------
+
+def build_parser() -> argparse.ArgumentParser:
+    ap = argparse.ArgumentParser(
+        prog="stellar-core-tpu",
+        description="TPU-native replicated ledger node")
+    sub = ap.add_subparsers(dest="command", required=True)
+
+    def add(name, fn, help_, conf=True):
+        p = sub.add_parser(name, help=help_)
+        if conf:
+            p.add_argument("--conf", help="TOML config file")
+        p.set_defaults(fn=fn)
+        return p
+
+    add("run", cmd_run, "run a node")
+    add("new-db", cmd_new_db, "reset DB to the genesis ledger")
+    p = add("force-scp", cmd_force_scp,
+            "start SCP from the LCL on next run")
+    p.add_argument("--reset", action="store_true")
+    p = add("catchup", cmd_catchup, "catch up from history archives")
+    p.add_argument("destination",
+                   help="<to>/<count>, e.g. current/max or 100000/64")
+    add("publish", cmd_publish, "publish queued checkpoints")
+    p = add("new-hist", cmd_new_hist, "initialize history archives")
+    p.add_argument("archives", nargs="+")
+    add("offline-info", cmd_offline_info, "info for an offline instance")
+    add("gen-seed", cmd_gen_seed, "generate a random node seed",
+        conf=False)
+    p = add("sec-to-pub", cmd_sec_to_pub,
+            "public key for a secret seed", conf=False)
+    p.add_argument("--seed", help="seed (otherwise read from stdin)")
+    p = add("convert-id", cmd_convert_id,
+            "display an ID in all known forms", conf=False)
+    p.add_argument("id")
+    p = add("sign-transaction", cmd_sign_transaction,
+            "add a signature to a transaction envelope")
+    p.add_argument("txfile")
+    p.add_argument("--netid", help="network passphrase")
+    p.add_argument("--seed", help="signing seed (else stdin)")
+    p = add("print-xdr", cmd_print_xdr, "pretty-print one XDR value",
+            conf=False)
+    p.add_argument("file")
+    p.add_argument("--filetype", default="TransactionEnvelope")
+    p = add("http-command", cmd_http_command,
+            "send a command to a running node")
+    p.add_argument("command")
+    add("version", cmd_version, "print version", conf=False)
+    p = add("test", cmd_test, "run the test suite", conf=False)
+    p.add_argument("pytest_args", nargs="*")
+    return ap
+
+
+def main(argv: Optional[List[str]] = None) -> int:
+    args = build_parser().parse_args(argv)
+    return args.fn(args)

@@ -257,6 +257,31 @@ def test_catchup_detects_corrupt_archive(publisher):
     assert app_b.ledger_manager.last_closed_ledger_num() == 1
 
 
+def test_trusted_anchor_rejects_wrong_chain(publisher):
+    """A consensus-derived trusted hash that doesn't match the archive's
+    chain must fail the catchup before any state is touched."""
+    from stellar_core_tpu.catchup.catchup_work import CatchupWork
+    app_a, tmp_path, archive_root = publisher
+    tip = 2 * FREQ - 1
+    app_b = make_app(tmp_path, 6, archive_root, writable=False)
+    work = CatchupWork(app_b, CatchupConfiguration.complete(),
+                       trusted_hash=(tip, b"\x13" * 32))
+    app_b.work_scheduler.schedule_work(work)
+    assert run_work(app_b, work) == State.FAILURE
+    assert app_b.ledger_manager.last_closed_ledger_num() == 1
+
+    # and the matching anchor passes
+    row = app_a.database.execute(
+        "SELECT ledgerhash FROM ledgerheaders WHERE ledgerseq = ?",
+        (tip,)).fetchone()
+    app_c = make_app(tmp_path, 7, archive_root, writable=False)
+    work = CatchupWork(app_c, CatchupConfiguration.complete(),
+                       trusted_hash=(tip, bytes.fromhex(row[0])))
+    app_c.work_scheduler.schedule_work(work)
+    assert run_work(app_c, work) == State.SUCCESS
+    assert app_c.ledger_manager.last_closed_ledger_num() == tip
+
+
 def test_prewarm_batches_checkpoint_sigs(publisher):
     """Catchup replay drains whole-checkpoint signature batches through
     the verifier (SURVEY.md §3.4 TPU batch site)."""
