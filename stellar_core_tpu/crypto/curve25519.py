@@ -41,3 +41,29 @@ def curve25519_derive_shared(local_secret32: bytes, remote_public32: bytes,
 
 def hkdf_expand_key(key32: bytes, info: bytes) -> bytes:
     return hkdf_expand(key32, info, 32)
+
+
+def curve25519_seal(recipient_public32: bytes, plaintext: bytes) -> bytes:
+    """Anonymous sealed box (libsodium crypto_box_seal role, reference
+    SurveyManager encrypted responses): ephemeral X25519 + ChaCha20-
+    Poly1305, key = HKDF(ECDH ‖ epk ‖ recipient), nonce derived from the
+    public halves. Output: epk(32) ‖ ciphertext."""
+    from cryptography.hazmat.primitives.ciphers.aead import ChaCha20Poly1305
+    from .hashing import sha256
+    esk = curve25519_random_secret()
+    epk = curve25519_derive_public(esk)
+    key = curve25519_derive_shared(esk, recipient_public32, epk,
+                                   recipient_public32)
+    nonce = sha256(b"sealed-box-nonce" + epk + recipient_public32)[:12]
+    return epk + ChaCha20Poly1305(key).encrypt(nonce, plaintext, b"")
+
+
+def curve25519_unseal(secret32: bytes, blob: bytes) -> bytes:
+    """Inverse of curve25519_seal; raises on tamper/garbage."""
+    from cryptography.hazmat.primitives.ciphers.aead import ChaCha20Poly1305
+    from .hashing import sha256
+    epk, ct = blob[:32], blob[32:]
+    pub = curve25519_derive_public(secret32)
+    key = curve25519_derive_shared(secret32, epk, epk, pub)
+    nonce = sha256(b"sealed-box-nonce" + epk + pub)[:12]
+    return ChaCha20Poly1305(key).decrypt(nonce, ct, b"")

@@ -211,6 +211,11 @@ class Herder:
         # register own qset
         q = cfg.QUORUM_SET
         self.pending.add_quorum_set(sha256(q.to_xdr()), q)
+        # transitive quorum map (reference QuorumTracker)
+        from .quorum_intersection import QuorumTracker
+        self.quorum_tracker = QuorumTracker(
+            cfg.node_id(), lambda: self.app.config.QUORUM_SET)
+        self.last_quorum_intersection: Optional[dict] = None
 
     # -- state machine -------------------------------------------------------
     def bootstrap(self) -> None:
@@ -264,7 +269,53 @@ class Herder:
 
     def envelope_ready(self, envelope: SCPEnvelope) -> None:
         """Called by PendingEnvelopes when deps are present."""
+        self._update_quorum_tracker(envelope)
         self.scp.receive_envelope(envelope)
+
+    def _update_quorum_tracker(self, envelope: SCPEnvelope) -> None:
+        """Keep the transitive quorum map current (reference
+        HerderImpl::updateTransitiveQuorum via QuorumTracker::expand,
+        rebuilding from the qset cache when expansion fails)."""
+        from .pending_envelopes import statement_qset_hash
+        st = envelope.statement
+        qh = statement_qset_hash(st)
+        qset = self.pending.get_quorum_set(qh)
+        if qset is None:
+            return
+        if not self.quorum_tracker.expand(st.nodeID, qset):
+            known = {st.nodeID.key_bytes: qset}
+            self.quorum_tracker.rebuild(
+                lambda node_id: known.get(node_id.key_bytes) or
+                self._lookup_node_qset(node_id))
+
+    def _lookup_node_qset(self, node_id):
+        """Best-effort qset lookup for rebuild: latest SCP statement this
+        node has seen from `node_id` names its qset hash."""
+        from .pending_envelopes import statement_qset_hash
+        for slot in self.scp.known_slots.values():
+            for env in slot.get_current_state():
+                if env.statement.nodeID.to_xdr() == node_id.to_xdr():
+                    return self.pending.get_quorum_set(
+                        statement_qset_hash(env.statement))
+        return None
+
+    def check_quorum_intersection(self) -> dict:
+        """Run the intersection checker over the transitive quorum map
+        (reference HerderImpl::checkAndMaybeReanalyzeQuorumMap)."""
+        from .quorum_intersection import QuorumIntersectionChecker
+        qmap = self.quorum_tracker.get_quorum()
+        checker = QuorumIntersectionChecker(qmap)
+        ok = checker.network_enjoys_quorum_intersection()
+        out = {
+            "node_count": checker.n,
+            "intersection": ok,
+            "quorums_seen": checker.quorums_seen,
+        }
+        if checker.last_split is not None:
+            out["last_good_split"] = [
+                [x.hex() for x in side] for side in checker.last_split]
+        self.last_quorum_intersection = out
+        return out
 
     def recv_tx_set(self, h: bytes, txset: TxSetFrame) -> bool:
         if txset.get_contents_hash() != h:
@@ -408,4 +459,8 @@ class Herder:
             "slot": self.tracking_slot,
             "queue_ops": self.tx_queue.size_ops(),
             "scp": self.scp.get_json_info(),
+            "transitive": {
+                "node_count": len(self.quorum_tracker.get_quorum()),
+                "intersection": self.last_quorum_intersection,
+            },
         }

@@ -1,0 +1,294 @@
+"""Quorum intersection checker + transitive quorum tracker.
+
+Role parity: reference `src/herder/QuorumIntersectionCheckerImpl.{h,cpp}`
+(min-quorum enumeration with SCC pruning, contraction to maximal quorums,
+half-space cutoff, perimeter look-ahead, max-indegree branching heuristic
+— algorithm documented at QuorumIntersectionCheckerImpl.h:7-300, after
+Lachowski arXiv:1902.06493) and `src/herder/QuorumTracker.{h,cpp}`
+(transitive closure of the local qset over received SCP traffic).
+
+Sets of nodes are Python ints used as bitmasks — the Python-idiomatic
+analogue of the reference's BitSet, giving O(1)-word intersection /
+containment over networks of hundreds of validators.
+"""
+
+from __future__ import annotations
+
+from typing import Callable, Dict, List, Optional, Tuple
+
+from ..util.log import get_logger
+from ..xdr import PublicKey, SCPQuorumSet
+
+log = get_logger("SCP")
+
+
+class QuorumIntersectionChecker:
+    def __init__(self, qmap: Dict[bytes, Optional[SCPQuorumSet]]) -> None:
+        """qmap: node id (raw 32B ed25519) -> its quorum set (None if
+        unknown; unknown nodes can never be satisfied, matching the
+        reference's treatment of missing qsets)."""
+        self.ids: List[bytes] = sorted(qmap)
+        self.index: Dict[bytes, int] = {v: i for i, v in enumerate(self.ids)}
+        self.n = len(self.ids)
+        self.full: int = (1 << self.n) - 1
+        self._qsets: List[Optional[SCPQuorumSet]] = [
+            qmap[v] for v in self.ids]
+        # dependency edges i -> j (j appears in i's qset, transitively
+        # through inner sets)
+        self._deps: List[int] = [self._dep_mask(qs) for qs in self._qsets]
+        self.interrupted = False
+        self.last_split: Optional[Tuple[List[bytes], List[bytes]]] = None
+        self.quorums_seen = 0
+
+    # -- qset satisfaction ---------------------------------------------------
+    def _dep_mask(self, qs: Optional[SCPQuorumSet]) -> int:
+        m = 0
+        if qs is None:
+            return m
+        for v in qs.validators:
+            i = self.index.get(v.key_bytes)
+            if i is not None:
+                m |= 1 << i
+        for inner in qs.innerSets:
+            m |= self._dep_mask(inner)
+        return m
+
+    def _qset_satisfied(self, qs: SCPQuorumSet, mask: int) -> bool:
+        hits = 0
+        for v in qs.validators:
+            i = self.index.get(v.key_bytes)
+            if i is not None and (mask >> i) & 1:
+                hits += 1
+        for inner in qs.innerSets:
+            if self._qset_satisfied(inner, mask):
+                hits += 1
+        return hits >= qs.threshold
+
+    def _node_satisfied(self, i: int, mask: int) -> bool:
+        qs = self._qsets[i]
+        return qs is not None and self._qset_satisfied(qs, mask)
+
+    # -- quorum machinery (refinement 2) ------------------------------------
+    def contract_to_maximal_quorum(self, mask: int) -> int:
+        """Largest quorum within `mask`, or 0 (reference
+        contractToMaximalQuorum)."""
+        while True:
+            next_mask = 0
+            m = mask
+            while m:
+                low = m & -m
+                i = low.bit_length() - 1
+                if self._node_satisfied(i, mask):
+                    next_mask |= low
+                m ^= low
+            if next_mask == mask:
+                return mask
+            mask = next_mask
+            if mask == 0:
+                return 0
+
+    def is_a_quorum(self, mask: int) -> bool:
+        return mask != 0 and self.contract_to_maximal_quorum(mask) == mask
+
+    def is_minimal_quorum(self, mask: int) -> bool:
+        """A quorum none of whose one-smaller subsets contains a quorum
+        (reference isMinimalQuorum)."""
+        m = mask
+        while m:
+            low = m & -m
+            if self.contract_to_maximal_quorum(mask & ~low) != 0:
+                return False
+            m ^= low
+        return True
+
+    # -- SCC analysis (the outer pruning) ------------------------------------
+    def _sccs(self) -> List[int]:
+        """Tarjan over the dependency graph; returns SCC masks."""
+        idx = [0] * self.n
+        low = [0] * self.n
+        on = [False] * self.n
+        comp: List[int] = []
+        stack: List[int] = []
+        counter = [1]
+
+        def strongconnect(v0: int) -> None:
+            # iterative tarjan (explicit stack) to survive big nets
+            work = [(v0, 0)]
+            while work:
+                v, pi = work.pop()
+                if pi == 0:
+                    idx[v] = low[v] = counter[0]
+                    counter[0] += 1
+                    stack.append(v)
+                    on[v] = True
+                recurse = False
+                deps = self._deps[v]
+                m = deps >> pi
+                shift = pi
+                while m:
+                    if m & 1:
+                        w = shift
+                        if idx[w] == 0:
+                            work.append((v, shift + 1))
+                            work.append((w, 0))
+                            recurse = True
+                            break
+                        elif on[w]:
+                            low[v] = min(low[v], idx[w])
+                    m >>= 1
+                    shift += 1
+                if recurse:
+                    continue
+                if low[v] == idx[v]:
+                    c = 0
+                    while True:
+                        w = stack.pop()
+                        on[w] = False
+                        c |= 1 << w
+                        if w == v:
+                            break
+                    comp.append(c)
+                if work:
+                    parent = work[-1][0]
+                    low[parent] = min(low[parent], low[v])
+
+        for v in range(self.n):
+            if idx[v] == 0:
+                strongconnect(v)
+        return comp
+
+    # -- the enumeration (refinements 3-7) -----------------------------------
+    def network_enjoys_quorum_intersection(self) -> bool:
+        if self.n == 0:
+            return True
+        sccs = self._sccs()
+        # pick the SCC containing a quorum; a quorum in any OTHER SCC is an
+        # immediate disjoint pair (SCCs don't intersect by construction)
+        main_scc = 0
+        for c in sorted(sccs, key=lambda c: -bin(c).count("1")):
+            if self.contract_to_maximal_quorum(c) != 0:
+                if main_scc:
+                    self._record_split(
+                        self.contract_to_maximal_quorum(main_scc),
+                        self.contract_to_maximal_quorum(c))
+                    return False
+                main_scc = c
+        if not main_scc:
+            log.warning("no quorum found in any SCC")
+            return True    # vacuously true: no quorums at all
+        self._main = main_scc
+        self._maxsz = bin(main_scc).count("1") // 2 + 1
+        self.quorums_seen = 0
+        return self._enumerate(0, main_scc)
+
+    def _record_split(self, a: int, b: int) -> None:
+        self.last_split = ([self.ids[i] for i in _bits(a)],
+                           [self.ids[i] for i in _bits(b)])
+        log.warning("found disjoint quorums: %s | %s",
+                    [x.hex()[:8] for x in self.last_split[0]],
+                    [x.hex()[:8] for x in self.last_split[1]])
+
+    def _enumerate(self, committed: int, remaining: int) -> bool:
+        """True iff no disjoint minq pair found in this branch (reference's
+        recursive enumerate with early exits #1-3)."""
+        if self.interrupted:
+            raise InterruptedError("quorum intersection check interrupted")
+        if bin(committed).count("1") > self._maxsz:
+            return True
+        if committed != 0 and self.is_a_quorum(committed):
+            self.quorums_seen += 1
+            if self.is_minimal_quorum(committed):
+                comp = self.contract_to_maximal_quorum(
+                    self._main & ~committed)
+                if comp:
+                    self._record_split(committed, comp)
+                    return False
+            return True   # supersets of a quorum are never minqs
+        if remaining == 0:
+            return True
+        perimeter = committed | remaining
+        maxq = self.contract_to_maximal_quorum(perimeter)
+        if maxq == 0 or (committed & ~maxq) != 0:
+            return True   # no quorum ahead extends committed
+        i = self._pick_branch_node(remaining)
+        bit = 1 << i
+        return (self._enumerate(committed, remaining & ~bit) and
+                self._enumerate(committed | bit, remaining & ~bit))
+
+    def _pick_branch_node(self, remaining: int) -> int:
+        """Max indegree within the remaining subgraph (refinement 7)."""
+        best, best_deg = -1, -1
+        for i in _bits(remaining):
+            deg = 0
+            for j in _bits(remaining):
+                if (self._deps[j] >> i) & 1:
+                    deg += 1
+            if deg > best_deg:
+                best, best_deg = i, deg
+        return best
+
+
+def _bits(mask: int):
+    while mask:
+        low = mask & -mask
+        yield low.bit_length() - 1
+        mask ^= low
+
+
+class QuorumTracker:
+    """Transitive quorum map rooted at the local node (reference
+    QuorumTracker.h:21-51)."""
+
+    def __init__(self, local_id: PublicKey,
+                 local_qset_fn: Callable[[], SCPQuorumSet]) -> None:
+        self._local_id = local_id
+        self._local_qset_fn = local_qset_fn
+        self._quorum: Dict[bytes, Optional[SCPQuorumSet]] = {}
+        self.rebuild(lambda node_id: None)
+
+    def is_node_definitely_in_quorum(self, node_id: PublicKey) -> bool:
+        return node_id.key_bytes in self._quorum
+
+    def _qset_nodes(self, qs: SCPQuorumSet) -> List[bytes]:
+        out = [v.key_bytes for v in qs.validators]
+        for inner in qs.innerSets:
+            out.extend(self._qset_nodes(inner))
+        return out
+
+    def expand(self, node_id: PublicKey,
+               qset: SCPQuorumSet) -> bool:
+        """Add node's qset if node is already in the transitive quorum
+        (reference expand); False means caller should rebuild."""
+        key = node_id.key_bytes
+        if key not in self._quorum:
+            return False
+        if self._quorum[key] is not None:
+            return self._quorum[key].to_xdr() == qset.to_xdr()
+        self._quorum[key] = qset
+        for dep in self._qset_nodes(qset):
+            self._quorum.setdefault(dep, None)
+        return True
+
+    def rebuild(self, lookup: Callable[[PublicKey],
+                                       Optional[SCPQuorumSet]]) -> None:
+        """Recompute the closure from the local qset via `lookup`
+        (reference rebuild)."""
+        self._quorum = {}
+        frontier = [(self._local_id.key_bytes, self._local_qset_fn())]
+        while frontier:
+            key, qs = frontier.pop()
+            if key in self._quorum and self._quorum[key] is not None:
+                continue
+            self._quorum[key] = qs
+            if qs is None:
+                continue
+            for dep in self._qset_nodes(qs):
+                if dep not in self._quorum:
+                    self._quorum[dep] = None
+                    got = lookup(PublicKey.ed25519(dep))
+                    if got is not None:
+                        frontier.append((dep, got))
+        self.quorum_map_changed = True
+
+    def get_quorum(self) -> Dict[bytes, Optional[SCPQuorumSet]]:
+        return self._quorum

@@ -76,6 +76,11 @@ class CommandHandler:
     def cmd_quorum(self, params) -> dict:
         return self.app.herder.get_json_info()
 
+    def cmd_checkquorum(self, params) -> dict:
+        """Run the quorum-intersection checker over the transitive quorum
+        map (reference `check-quorum` / periodic reanalysis)."""
+        return self.app.herder.check_quorum_intersection()
+
     def cmd_scp(self, params) -> dict:
         h = self.app.herder
         limit = int(params.get("limit", 2))
@@ -187,6 +192,31 @@ class CommandHandler:
         bm = self.app.overlay_manager.ban_manager
         bm.unban_node(PublicKey.from_xdr(bytes.fromhex(node)))
         return {"status": "ok"}
+
+    # -- survey / load -------------------------------------------------------
+    def cmd_surveytopology(self, params) -> dict:
+        """Start (or extend) a topology survey (reference
+        `surveytopology`)."""
+        sm = self.app.overlay_manager.survey_manager
+        duration = float(params.get("duration", 60))
+        node = params.get("node")
+        sm.start_survey(duration)
+        if node:
+            from ..xdr import PublicKey
+            sm.add_node_to_backlog(
+                PublicKey.ed25519(bytes.fromhex(node)))
+        return {"status": "started", "duration": duration}
+
+    def cmd_stopsurvey(self, params) -> dict:
+        self.app.overlay_manager.survey_manager.stop_survey()
+        return {"status": "stopped"}
+
+    def cmd_getsurveyresult(self, params) -> dict:
+        return self.app.overlay_manager.survey_manager.get_results()
+
+    def cmd_loadinfo(self, params) -> dict:
+        return {"load": self.app.overlay_manager.load_manager
+                .get_json_info()}
 
     # -- maintenance / cursors ----------------------------------------------
     def cmd_maintenance(self, params) -> dict:

@@ -43,7 +43,10 @@ class OverlayManager:
             self, lambda h: StellarMessage(MessageType.GET_TX_SET, h))
         self.qset_fetcher = ItemFetcher(
             self, lambda h: StellarMessage(MessageType.GET_SCP_QUORUMSET, h))
-        self.survey_manager = None       # wired by survey layer
+        from .survey_manager import SurveyManager
+        self.survey_manager = SurveyManager(app, self)
+        from .load_manager import LoadManager
+        self.load_manager = LoadManager(app)
         self._reactor: Optional[TCPReactor] = None
         self._door: Optional[TCPDoor] = None
         self._tick_timer = VirtualTimer(app.clock)
@@ -134,6 +137,7 @@ class OverlayManager:
             for rec in self.peer_manager.candidates_to_connect(
                     missing, exclude):
                 self.connect_to(rec.host, rec.port)
+        self.load_manager.maybe_shed_excess_load(self)
         self._arm_tick()
 
     def num_connections(self) -> int:
@@ -212,6 +216,7 @@ class OverlayManager:
             key = peer.peer_id.to_xdr()
             if self.authenticated_peers.get(key) is peer:
                 del self.authenticated_peers[key]
+                self.load_manager.forget(key)
 
     # -- registry views ------------------------------------------------------
     def authenticated_peer_ids(self) -> List[bytes]:
@@ -232,9 +237,10 @@ class OverlayManager:
     def _current_ledger_seq(self) -> int:
         return self.app.ledger_manager.last_closed_ledger_num()
 
-    def recv_flooded_msg(self, msg: StellarMessage, peer: Peer) -> None:
-        self.floodgate.add_record(msg, peer.peer_id.to_xdr(),
-                                  self._current_ledger_seq())
+    def recv_flooded_msg(self, msg: StellarMessage, peer: Peer) -> bool:
+        """Returns False if this flooded message was seen before."""
+        return self.floodgate.add_record(msg, peer.peer_id.to_xdr(),
+                                         self._current_ledger_seq())
 
     def broadcast_message(self, msg: StellarMessage,
                           force: bool = False) -> int:

@@ -184,7 +184,14 @@ class Peer:
                 return
             self.recv_mac_seq += 1
         try:
-            self._dispatch(msg)
+            if self.peer_id is not None:
+                # per-peer cost accounting (reference LoadManager contexts)
+                lm = self.overlay.load_manager
+                with lm.context(self.peer_id.to_xdr()):
+                    self._dispatch(msg)
+                lm.record_bytes(self.peer_id.to_xdr(), 0, len(raw))
+            else:
+                self._dispatch(msg)
         except Exception as e:       # noqa: BLE001 — peer input is hostile
             log.warning("error handling %d from %s: %s", t, self.id_str(), e)
             self.drop("internal error handling message")

@@ -1,0 +1,167 @@
+"""Quorum intersection checker + QuorumTracker tests.
+
+Role parity: reference `src/herder/test/QuorumIntersectionTests.cpp`
+(known-topology matrices) and QuorumTracker coverage in HerderTests.
+"""
+
+import pytest
+
+from stellar_core_tpu.crypto.hashing import sha256
+from stellar_core_tpu.crypto.keys import SecretKey
+from stellar_core_tpu.herder.quorum_intersection import (
+    QuorumIntersectionChecker, QuorumTracker)
+from stellar_core_tpu.xdr import PublicKey, SCPQuorumSet
+
+
+def keys(n):
+    return [SecretKey.from_seed(sha256(b"qic-%d" % i)).public_key
+            for i in range(n)]
+
+
+def qs(threshold, validators, inner=()):
+    return SCPQuorumSet(threshold=threshold, validators=list(validators),
+                        innerSets=list(inner))
+
+
+def qmap_of(nodes, qsets):
+    return {k.key_bytes: q for k, q in zip(nodes, qsets)}
+
+
+def check(qmap):
+    return QuorumIntersectionChecker(qmap) \
+        .network_enjoys_quorum_intersection()
+
+
+# ----------------------------------------------------------------- basics
+
+def test_singleton_network():
+    (a,) = keys(1)
+    assert check({a.key_bytes: qs(1, [a])})
+
+
+def test_empty_network():
+    assert check({})
+
+
+def test_symmetric_3_of_4_intersects():
+    ks = keys(4)
+    q = qs(3, ks)
+    assert check(qmap_of(ks, [q] * 4))
+
+
+def test_symmetric_2_of_4_splits():
+    """Threshold 2-of-4: {A,B} and {C,D} are disjoint quorums."""
+    ks = keys(4)
+    q = qs(2, ks)
+    c = QuorumIntersectionChecker(qmap_of(ks, [q] * 4))
+    assert not c.network_enjoys_quorum_intersection()
+    assert c.last_split is not None
+    side_a, side_b = c.last_split
+    assert not (set(side_a) & set(side_b))
+
+
+def test_two_disjoint_cliques_split():
+    a, b, c, d = keys(4)
+    q1 = qs(2, [a, b])
+    q2 = qs(2, [c, d])
+    assert not check({a.key_bytes: q1, b.key_bytes: q1,
+                      c.key_bytes: q2, d.key_bytes: q2})
+
+
+def test_bridged_cliques_intersect():
+    """Two cliques that both require a shared bridge node intersect."""
+    a, b, c, d, e = keys(5)
+    q1 = qs(3, [a, b, e])
+    q2 = qs(3, [c, d, e])
+    qe = qs(3, [a, b, e])
+    assert check({a.key_bytes: q1, b.key_bytes: q1,
+                  c.key_bytes: q2, d.key_bytes: q2,
+                  e.key_bytes: qe})
+
+
+def test_majority_of_5_intersects():
+    ks = keys(5)
+    q = qs(3, ks)
+    assert check(qmap_of(ks, [q] * 5))
+
+
+def test_inner_sets():
+    """Nested slices: 2-of-{A, {2-of-B,C,D}} style qsets."""
+    a, b, c, d = keys(4)
+    inner = qs(2, [b, c, d])
+    top = qs(2, [a], inner=[inner])
+    assert check({a.key_bytes: top, b.key_bytes: top,
+                  c.key_bytes: top, d.key_bytes: top})
+
+
+def test_missing_qset_never_satisfied():
+    """A node with unknown qset can't be part of any quorum, but the rest
+    of the network still enjoys intersection."""
+    ks = keys(4)
+    q = qs(3, ks)
+    qmap = qmap_of(ks, [q] * 4)
+    qmap[ks[3].key_bytes] = None
+    assert check(qmap)   # remaining 3-of-4 quorums all intersect
+
+
+def test_contract_to_maximal_quorum():
+    ks = keys(4)
+    q = qs(3, ks)
+    c = QuorumIntersectionChecker(qmap_of(ks, [q] * 4))
+    assert c.contract_to_maximal_quorum(c.full) == c.full
+    # a 2-node subset of 3-of-4 contains no quorum
+    assert c.contract_to_maximal_quorum(0b0011) == 0
+    assert c.is_a_quorum(0b0111)
+    assert c.is_minimal_quorum(0b0111)
+    assert not c.is_minimal_quorum(c.full)
+
+
+def test_interrupt():
+    ks = keys(6)
+    q = qs(4, ks)
+    c = QuorumIntersectionChecker(qmap_of(ks, [q] * 6))
+    c.interrupted = True
+    with pytest.raises(InterruptedError):
+        c.network_enjoys_quorum_intersection()
+
+
+# ------------------------------------------------------------ QuorumTracker
+
+def test_tracker_expand_and_rebuild():
+    a, b, c = keys(3)
+    qa = qs(2, [a, b])
+    qb = qs(2, [b, c])
+    qc = qs(1, [c])
+    t = QuorumTracker(a, lambda: qa)
+    # local closure starts with a's qset deps
+    assert t.is_node_definitely_in_quorum(a)
+    assert t.is_node_definitely_in_quorum(b)
+    assert not t.is_node_definitely_in_quorum(c)
+    # expanding b pulls in c
+    assert t.expand(b, qb)
+    assert t.is_node_definitely_in_quorum(c)
+    assert t.expand(c, qc)
+    # unknown node fails expansion → rebuild path
+    d = SecretKey.from_seed(sha256(b"qic-d")).public_key
+    assert not t.expand(d, qc)
+    known = {a.key_bytes: qa, b.key_bytes: qb, c.key_bytes: qc}
+    t.rebuild(lambda nid: known.get(nid.key_bytes))
+    got = t.get_quorum()
+    assert set(got) == {a.key_bytes, b.key_bytes, c.key_bytes}
+    assert all(v is not None for v in got.values())
+
+
+def test_herder_tracker_via_simulation():
+    """After a loopback network externalizes, every node's transitive
+    quorum map holds all validators and intersection passes."""
+    from stellar_core_tpu.simulation import topologies
+    sim = topologies.core(4, 3)
+    sim.start_all_nodes()
+    assert sim.crank_until(lambda: sim.have_all_externalized(2), 30000)
+    for node in sim.nodes.values():
+        h = node.app.herder
+        assert len(h.quorum_tracker.get_quorum()) == 4
+        res = h.check_quorum_intersection()
+        assert res["intersection"] is True
+        assert res["node_count"] == 4
+    sim.stop_all_nodes()

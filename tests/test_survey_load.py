@@ -1,0 +1,144 @@
+"""SurveyManager + LoadManager + sealed-box tests.
+
+Role parity: reference `src/overlay/test/SurveyManagerTests.cpp` and
+LoadManager coverage in OverlayTests.
+"""
+
+import pytest
+
+from stellar_core_tpu.crypto.curve25519 import (
+    curve25519_derive_public, curve25519_random_secret, curve25519_seal,
+    curve25519_unseal)
+from stellar_core_tpu.simulation import topologies
+from stellar_core_tpu.simulation.simulation import Simulation
+
+
+# ------------------------------------------------------------- sealed box
+
+def test_sealed_box_roundtrip():
+    sk = curve25519_random_secret()
+    pk = curve25519_derive_public(sk)
+    msg = b"topology payload" * 100
+    blob = curve25519_seal(pk, msg)
+    assert blob != msg and len(blob) == 32 + len(msg) + 16
+    assert curve25519_unseal(sk, blob) == msg
+
+
+def test_sealed_box_tamper_detected():
+    sk = curve25519_random_secret()
+    pk = curve25519_derive_public(sk)
+    blob = bytearray(curve25519_seal(pk, b"secret"))
+    blob[40] ^= 0x01
+    with pytest.raises(Exception):
+        curve25519_unseal(sk, bytes(blob))
+    # wrong recipient key
+    sk2 = curve25519_random_secret()
+    with pytest.raises(Exception):
+        curve25519_unseal(sk2, curve25519_seal(pk, b"secret"))
+
+
+# ------------------------------------------------------------- survey e2e
+
+def test_survey_over_real_overlay():
+    """Surveyor collects encrypted topology stats from every peer over
+    the real overlay stack (handshake + flood relay)."""
+    sim = topologies.core(3, 2, mode=Simulation.OVER_PEERS)
+    sim.start_all_nodes()
+    assert sim.crank_until(lambda: sim.have_all_externalized(2), 50000)
+
+    names = list(sim.nodes)
+    surveyor = sim.nodes[names[0]].app
+    others = [sim.nodes[n].app for n in names[1:]]
+    sm = surveyor.overlay_manager.survey_manager
+    sm.start_survey(duration=300.0)
+
+    want = {o.config.node_id().key_bytes.hex() for o in others}
+    ok = sim.crank_until(
+        lambda: want.issubset(sm.get_results()["topology"]), 60000)
+    assert ok, sm.get_results()
+    res = sm.get_results()
+    assert res["badResponses"] == 0
+    for node_hex in want:
+        entry = res["topology"][node_hex]
+        # each surveyed node reports its own peer connections
+        assert entry["totalInbound"] + entry["totalOutbound"] >= 1
+        all_stats = entry["inboundPeers"] + entry["outboundPeers"]
+        assert all(s["bytesRead"] > 0 for s in all_stats)
+    sm.stop_survey()
+    assert sm.get_results()["surveyInProgress"] is False
+    sim.stop_all_nodes()
+
+
+def test_survey_bad_signature_rejected():
+    sim = topologies.core(2, 2, mode=Simulation.OVER_PEERS)
+    sim.start_all_nodes()
+    assert sim.crank_until(lambda: sim.have_all_externalized(2), 50000)
+    names = list(sim.nodes)
+    a = sim.nodes[names[0]].app
+    b = sim.nodes[names[1]].app
+
+    from stellar_core_tpu.crypto.curve25519 import (
+        curve25519_derive_public, curve25519_random_secret)
+    from stellar_core_tpu.xdr import (MessageType,
+                                      SignedSurveyRequestMessage,
+                                      StellarMessage, SurveyRequestMessage,
+                                      SurveyMessageCommandType)
+    req = SurveyRequestMessage(
+        surveyorPeerID=a.config.node_id(),
+        surveyedPeerID=b.config.node_id(),
+        ledgerNum=2,
+        encryptionKey=curve25519_derive_public(
+            curve25519_random_secret()),
+        commandType=SurveyMessageCommandType.SURVEY_TOPOLOGY)
+    forged = StellarMessage(
+        MessageType.SURVEY_REQUEST,
+        SignedSurveyRequestMessage(requestSignature=b"\x00" * 64,
+                                   request=req))
+    bsm = b.overlay_manager.survey_manager
+    before = bsm.bad_responses
+    class FakePeer:
+        peer_id = a.config.node_id()
+    bsm.relay_or_process(forged, FakePeer())
+    assert bsm.bad_responses == before + 1
+    sim.stop_all_nodes()
+
+
+# ------------------------------------------------------------- load manager
+
+def test_load_manager_accounting_and_shedding():
+    from stellar_core_tpu.overlay.load_manager import LoadManager
+
+    class FakeCfg:
+        TARGET_PEER_CONNECTIONS = 1
+        MAX_ADDITIONAL_PEER_CONNECTIONS = 0
+
+    class FakeApp:
+        config = FakeCfg()
+
+    lm = LoadManager(FakeApp())
+    with lm.context(b"peer-a"):
+        pass
+    lm.record_bytes(b"peer-a", 10, 20)
+    lm.record_bytes(b"peer-b", 1, 1)
+    with lm.context(b"peer-b"):
+        x = sum(range(10000))   # costlier peer
+    info = lm.get_json_info()
+    assert len(info) == 2
+
+    dropped = []
+
+    class FakePeer:
+        def __init__(self, key): self.key = key
+        def drop(self, reason=""): dropped.append((self.key, reason))
+
+    class FakeOverlay:
+        def get_authenticated_peers_count(self): return 2
+        def get_peer(self, key): return FakePeer(key)
+
+    assert lm.maybe_shed_excess_load(FakeOverlay())
+    assert dropped and dropped[0][0] == b"peer-b"   # costliest went first
+
+    class QuietOverlay(FakeOverlay):
+        def get_authenticated_peers_count(self): return 1
+
+    assert not lm.maybe_shed_excess_load(QuietOverlay())
