@@ -205,6 +205,27 @@ def pt_add(p: jnp.ndarray, q: jnp.ndarray) -> jnp.ndarray:
                       fe_mul(f, g), fe_mul(e, h)], axis=-2)
 
 
+def pt_add_folded(p: jnp.ndarray, q: jnp.ndarray,
+                  need_t: bool = False) -> jnp.ndarray:
+    """Extended add where q's T row is pre-multiplied by 2d (table form).
+    Ladder adds feed doublings, which never read T, so by default the
+    output T (the e·h multiply) is skipped; the final window add passes
+    need_t=True because the fixed-base Niels chain reads it."""
+    x1, y1, z1, t1 = p[..., 0, :], p[..., 1, :], p[..., 2, :], p[..., 3, :]
+    x2, y2, z2, t2d = q[..., 0, :], q[..., 1, :], q[..., 2, :], q[..., 3, :]
+    a = fe_mul(fe_sub(y1, x1), fe_sub(y2, x2))
+    b = fe_mul(fe_add(y1, x1), fe_add(y2, x2))
+    c = fe_mul(t1, t2d)
+    d = fe_mul_small(fe_mul(z1, z2), 2)
+    e = fe_sub(b, a)
+    f = fe_sub(d, c)
+    g = fe_add(d, c)
+    h = fe_add(b, a)
+    t = fe_mul(e, h) if need_t else fe_zero(x1.shape[:-1])
+    return jnp.stack([fe_mul(e, f), fe_mul(g, h),
+                      fe_mul(f, g), t], axis=-2)
+
+
 def pt_add_niels(p: jnp.ndarray, n: jnp.ndarray) -> jnp.ndarray:
     """Mixed addition with a precomputed Niels point (y+x, y−x, 2dxy)."""
     x1, y1, z1, t1 = p[..., 0, :], p[..., 1, :], p[..., 2, :], p[..., 3, :]
@@ -221,8 +242,11 @@ def pt_add_niels(p: jnp.ndarray, n: jnp.ndarray) -> jnp.ndarray:
                       fe_mul(f, g), fe_mul(e, h)], axis=-2)
 
 
-def pt_dbl(p: jnp.ndarray) -> jnp.ndarray:
-    """a=−1 extended doubling (dbl-2008-hwcd)."""
+def pt_dbl(p: jnp.ndarray, need_t: bool = True) -> jnp.ndarray:
+    """a=−1 extended doubling (dbl-2008-hwcd). Doubling never READS the
+    T coordinate, so ladder doublings whose output feeds another doubling
+    pass need_t=False and skip the e·h multiply (3 of every 4 ladder
+    steps)."""
     x1, y1, z1 = p[..., 0, :], p[..., 1, :], p[..., 2, :]
     a = fe_sq(x1)
     b = fe_sq(y1)
@@ -231,8 +255,9 @@ def pt_dbl(p: jnp.ndarray) -> jnp.ndarray:
     e = fe_sub(h, fe_sq(fe_add(x1, y1)))
     g = fe_sub(a, b)
     f = fe_add(c, g)
+    t = fe_mul(e, h) if need_t else fe_zero(x1.shape[:-1])
     return jnp.stack([fe_mul(e, f), fe_mul(g, h),
-                      fe_mul(f, g), fe_mul(e, h)], axis=-2)
+                      fe_mul(f, g), t], axis=-2)
 
 
 def pt_neg(p: jnp.ndarray) -> jnp.ndarray:
@@ -293,23 +318,38 @@ def verify_kernel(ay: jnp.ndarray, a_sign: jnp.ndarray,
     neg_at = fe_neg(fe_mul(ax, ay))
     a_pt = jnp.stack([neg_ax, ay, fe_one(batch), neg_at], axis=-2)
 
-    # per-item table of v·(−A), v = 0..15, extended coords: (B, 16, 4, 20)
+    # per-item table of v·(−A), v = 0..15, extended coords: (B, 16, 4, 20);
+    # entry T is pre-multiplied by 2d so the ladder add does c = T1·(2d·T2)
+    # in ONE multiply (Niels-style T folding)
     entries = [pt_identity(batch), a_pt]
     for v in range(2, 16):
         if v % 2 == 0:
             entries.append(pt_dbl(entries[v // 2]))
         else:
             entries.append(pt_add(entries[v - 1], a_pt))
-    a_table = jnp.stack(entries, axis=-3)
+    d2 = jnp.asarray(_D2_LIMBS)
+    folded = [jnp.concatenate(
+        [e[..., :3, :], fe_mul(e[..., 3, :], d2)[..., None, :]], axis=-2)
+        for e in entries]
+    a_table = jnp.stack(folded, axis=-3)
 
-    # variable-base: MSB-first over 64 nibbles of k
+    # variable-base: MSB-first over 64 nibbles of k. The window add's T
+    # output is never read (the next 4 doublings ignore T; the 4th
+    # doubling regenerates it), so the add also skips its e·h multiply.
+    def vb_window(q, nib, need_t):
+        q = pt_dbl(q, need_t=False)
+        q = pt_dbl(q, need_t=False)
+        q = pt_dbl(q, need_t=False)
+        q = pt_dbl(q, need_t=True)
+        return pt_add_folded(q, _select16(a_table, nib), need_t=need_t)
+
     def vb_body(i, q):
-        for _ in range(4):
-            q = pt_dbl(q)
-        nib = k_nibs[..., 63 - i]
-        return pt_add(q, _select16(a_table, nib))
+        return vb_window(q, k_nibs[..., 63 - i], False)
 
-    q = jax.lax.fori_loop(0, 64, vb_body, pt_identity(batch))
+    q = jax.lax.fori_loop(0, 63, vb_body, pt_identity(batch))
+    # final window peeled: its add DOES produce T, which the fixed-base
+    # Niels chain below consumes
+    q = vb_window(q, k_nibs[..., 0], True)
 
     # fixed-base: Σ_j table[j][s_nib_j], 64 Niels additions, no doublings
     ftab = jnp.asarray(fixed_table())  # (64, 16, 3, 20)

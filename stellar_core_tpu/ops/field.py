@@ -32,7 +32,15 @@ NLIMBS = 20
 LIMB_BITS = 13
 LIMB_MASK = (1 << LIMB_BITS) - 1
 FOLD = 19 * 32  # 2^260 ≡ 19·2^5 (mod p)
-LIMB_BOUND = 9500  # loose per-limb bound maintained between ops
+LIMB_BOUND = 10100  # loose per-limb bound maintained between ops
+# Bound audit (every op must keep limbs <= LIMB_BOUND and intermediate
+# column sums < 2^31):
+#   columns:      20 * 10100^2            = 2.04e9  < 2^31 (5% margin)
+#   fe_sub/neg:   10100 + 16382           = 26482; 1 carry round ->
+#                 8191 + 3 + 3*608        = 10015  <= LIMB_BOUND
+#   fe_add/x2:    2*10100 = 20200; 1 round -> 8191 + 2 + 2*608 = 9409
+#   fe_mul tail:  post-round cols <= 2.57e5; fold <= 1.57e8; two carry
+#                 rounds -> <= 10015
 
 P = 2**255 - 19
 
@@ -41,11 +49,12 @@ P = 2**255 - 19
 # 32p = 2^260 - 608 = [8192-608, 8191, ..., 8191]; doubled below.
 _K64P_NP = np.array([2 * (8192 - 608)] + [2 * 8191] * 19, np.int32)
 
-# index matrix for the shifted-b gather: SHIFT_IDX[i, k] = k - i (clipped),
-# SHIFT_MASK[i, k] = 1 iff 0 <= k - i < 20.
+# index matrix for the shifted-b gather: PAD_IDX[i, k] = k - i where valid,
+# else 20 — pointing at a zero limb appended to b, so no mask multiply is
+# needed (the old mask cost one extra vector multiply per product term).
 _idx = np.arange(39)[None, :] - np.arange(NLIMBS)[:, None]
-SHIFT_MASK_NP = ((_idx >= 0) & (_idx < NLIMBS)).astype(np.int32)
-SHIFT_IDX_NP = np.clip(_idx, 0, NLIMBS - 1).astype(np.int32)
+PAD_IDX_NP = np.where((_idx >= 0) & (_idx < NLIMBS),
+                      np.clip(_idx, 0, NLIMBS - 1), NLIMBS).astype(np.int32)
 
 
 def limbs_from_int(x: int) -> np.ndarray:
@@ -81,40 +90,41 @@ def fe_add(a: jnp.ndarray, b: jnp.ndarray) -> jnp.ndarray:
 
 def fe_sub(a: jnp.ndarray, b: jnp.ndarray) -> jnp.ndarray:
     k = jnp.asarray(_K64P_NP)
-    return fe_carry(a + k - b, rounds=2)
+    return fe_carry(a + k - b, rounds=1)
 
 
 def fe_neg(a: jnp.ndarray) -> jnp.ndarray:
     k = jnp.asarray(_K64P_NP)
-    return fe_carry(k - a, rounds=2)
+    return fe_carry(k - a, rounds=1)
 
 
 def fe_mul_small(a: jnp.ndarray, c: int) -> jnp.ndarray:
-    """Multiply by a small constant (c·LIMB_BOUND must stay < 2^31)."""
-    return fe_carry(a * c, rounds=2)
+    """Multiply by a small constant (c·LIMB_BOUND must stay < 2^31);
+    c <= 2 for the 1-round carry bound to hold."""
+    assert c <= 2
+    return fe_carry(a * c, rounds=1)
 
 
 def _columns(a: jnp.ndarray, b: jnp.ndarray) -> jnp.ndarray:
     """Polynomial product columns: (..., 39) with col k = Σ_{i+j=k} a_i·b_j."""
-    idx = jnp.asarray(SHIFT_IDX_NP)
-    mask = jnp.asarray(SHIFT_MASK_NP)
-    bmat = b[..., idx] * mask          # (..., 20, 39)
+    bpad = jnp.concatenate([b, jnp.zeros_like(b[..., :1])], axis=-1)
+    bmat = bpad[..., jnp.asarray(PAD_IDX_NP)]       # (..., 20, 39), no mask
     return jnp.sum(a[..., :, None] * bmat, axis=-2)
 
 
 def fe_mul(a: jnp.ndarray, b: jnp.ndarray) -> jnp.ndarray:
     c = _columns(a, b)                                     # (..., 39) < 2^31
-    c = jnp.concatenate([c, jnp.zeros_like(c[..., :1])], axis=-1)  # 40 wide
-    # two parallel carry rounds over the 40 columns (carry i -> i+1); the
-    # carry out of column 39 has weight 2^520 ≡ 608² (mod p) and folds to
-    # column 0 — dropping it corrupts ~1.5% of products (both top limbs
-    # large), so it is wrapped explicitly.
-    for _ in range(2):
-        lo = c & LIMB_MASK
-        hi = c >> LIMB_BITS
-        c = lo + jnp.concatenate([hi[..., 39:40] * (FOLD * FOLD),
-                                  hi[..., :39]], axis=-1)
-    # fold the high 20 columns: 2^(260+13j) ≡ 608·2^13j (mod p)
+    # ONE parallel carry round, widening to 40 columns (carry out of col 38
+    # lands in col 39; cols now <= 2^13 + 2^31>>13 ~ 2.6e5, so the fold
+    # below stays in int32: 2.6e5 * (1+608) ~ 1.6e8)
+    lo = c & LIMB_MASK
+    hi = c >> LIMB_BITS
+    z1 = jnp.zeros_like(c[..., :1])
+    c = jnp.concatenate([lo, z1], axis=-1) + \
+        jnp.concatenate([z1, hi], axis=-1)
+    # fold the high 20 columns: 2^(260+13j) ≡ 608·2^13j (mod p); col 39's
+    # fold (608·2^247... i.e. j=19) is exact — no 2^520 wrap survives a
+    # single round because col 39 starts at zero
     low = c[..., :NLIMBS] + FOLD * c[..., NLIMBS:]
     return fe_carry(low, rounds=2)
 
@@ -148,12 +158,32 @@ def fe_pow(x: jnp.ndarray, exp_bits_msb_first) -> jnp.ndarray:
     return jax.lax.fori_loop(1, n, body, x)
 
 
-_P58_BITS = np.array([int(b) for b in bin(2**252 - 3)[2:]], np.int32)
+def _sqn(x: jnp.ndarray, n: int) -> jnp.ndarray:
+    """x^(2^n) via a fori_loop of squarings."""
+    if n == 1:
+        return fe_sq(x)
+    return jax.lax.fori_loop(0, n, lambda i, v: fe_sq(v), x)
 
 
 def fe_pow_p58(x: jnp.ndarray) -> jnp.ndarray:
-    """x^((p-5)/8), the exponent used in square-root decompression."""
-    return fe_pow(x, _P58_BITS)
+    """x^((p-5)/8) = x^(2^252 - 3) via the standard curve25519 addition
+    chain (ref10 pow22523 structure): 252 squarings + 12 multiplies,
+    instead of square-and-multiply's ~250 multiplies — decompression is
+    2 of these per signature, so this cuts ~15% of total verify work."""
+    z2 = fe_sq(x)                      # 2
+    z8 = _sqn(z2, 2)                   # 8
+    z9 = fe_mul(x, z8)                 # 9
+    z11 = fe_mul(z2, z9)               # 11
+    z22 = fe_sq(z11)                   # 22
+    z_5_0 = fe_mul(z9, z22)            # 2^5 - 1
+    z_10_0 = fe_mul(_sqn(z_5_0, 5), z_5_0)      # 2^10 - 1
+    z_20_0 = fe_mul(_sqn(z_10_0, 10), z_10_0)   # 2^20 - 1
+    z_40_0 = fe_mul(_sqn(z_20_0, 20), z_20_0)   # 2^40 - 1
+    z_50_0 = fe_mul(_sqn(z_40_0, 10), z_10_0)   # 2^50 - 1
+    z_100_0 = fe_mul(_sqn(z_50_0, 50), z_50_0)  # 2^100 - 1
+    z_200_0 = fe_mul(_sqn(z_100_0, 100), z_100_0)  # 2^200 - 1
+    z_250_0 = fe_mul(_sqn(z_200_0, 50), z_50_0)    # 2^250 - 1
+    return fe_mul(_sqn(z_250_0, 2), x)  # 2^252 - 3
 
 
 def fe_freeze(a: jnp.ndarray) -> jnp.ndarray:
