@@ -72,6 +72,10 @@ class BatchSigVerifier:
     """Abstract backend; see module docstring."""
 
     name = "abstract"
+    # True for backends where one big device dispatch beats many small
+    # ones — TxSetFrame.check_or_trim prewarms the whole set's signatures
+    # through verify_many before walking txs (two-phase validation).
+    wants_prewarm = False
 
     def enqueue(self, key: PublicKey, sig: bytes, msg: bytes) -> VerifyFuture:
         raise NotImplementedError
@@ -85,12 +89,24 @@ class BatchSigVerifier:
     def prewarm_many(self, triples: Sequence[Triple]) -> List[bool]:
         """Whole-ledger/checkpoint drain (SURVEY.md §2.2): verify a large
         batch in one dispatch and seed the result cache so subsequent
-        synchronous per-signature checks all hit."""
-        results = self.verify_many(triples)
+        synchronous per-signature checks all hit. Already-cached triples
+        are not re-dispatched."""
+        out: List[Optional[bool]] = [None] * len(triples)
+        todo: List[Tuple[int, Triple]] = []
         with _keys._cache_lock:
-            for (k, s, m), ok in zip(triples, results):
-                _keys._verify_cache.put(_keys._cache_key(k, s, m), ok)
-        return results
+            for i, (k, s, m) in enumerate(triples):
+                hit = _keys._verify_cache.maybe_get(_keys._cache_key(k, s, m))
+                if hit is not None:
+                    out[i] = hit
+                else:
+                    todo.append((i, (k, s, m)))
+        if todo:
+            results = self.verify_many([t for (_i, t) in todo])
+            with _keys._cache_lock:
+                for ((i, (k, s, m)), ok) in zip(todo, results):
+                    _keys._verify_cache.put(_keys._cache_key(k, s, m), ok)
+                    out[i] = ok
+        return out  # type: ignore[return-value]
 
     def pending(self) -> int:
         return 0
@@ -122,13 +138,68 @@ class TpuSigVerifier(BatchSigVerifier):
     """
 
     name = "tpu"
+    wants_prewarm = True
     BUCKETS = (128, 512, 2048, 8192)
 
-    def __init__(self, max_pending: int = 8192) -> None:
+    def __init__(self, max_pending: int = 8192,
+                 compile_cache_dir: Optional[str] = None) -> None:
         self._pending: List[Tuple[Triple, VerifyFuture]] = []
         self._max_pending = max_pending
         self.batches_dispatched = 0
         self.sigs_verified = 0
+        self._compile_cache_dir = compile_cache_dir
+        self._warmed = False
+        self._warmup_thread: Optional[threading.Thread] = None
+
+    def _enable_compile_cache(self) -> None:
+        """Persistent XLA compilation cache: a node restart never re-pays
+        kernel compilation (VERDICT r1: lazy compile on the consensus path
+        stalls a validator for the compile duration)."""
+        import os
+        path = self._compile_cache_dir or os.environ.get(
+            "JAX_COMPILATION_CACHE_DIR") or os.path.expanduser(
+            "~/.cache/stellar_core_tpu/jax_cache")
+        try:
+            import jax
+            os.makedirs(path, exist_ok=True)
+            jax.config.update("jax_compilation_cache_dir", path)
+            jax.config.update("jax_persistent_cache_min_compile_time_secs",
+                              0.5)
+        except Exception as e:  # cache is an optimization, never fatal
+            log.warning("compile cache unavailable: %s", e)
+
+    def warmup(self, wait: bool = False) -> None:
+        """AOT-compile every bucket shape off the consensus path (startup
+        background thread; reference analog: no lazy work on first
+        envelope). Idempotent."""
+        if self._warmed:
+            return
+        if self._warmup_thread is None:
+            self._warmup_thread = threading.Thread(
+                target=self._warmup_impl, daemon=True)
+            self._warmup_thread.start()
+        if wait:
+            self._warmup_thread.join()
+
+    def _warmup_impl(self) -> None:
+        try:
+            self._enable_compile_cache()
+            import numpy as np
+            import jax.numpy as jnp
+            from ..ops import ed25519 as _e
+            for b in self.BUCKETS:
+                args = (jnp.zeros((b, 20), jnp.int32),
+                        jnp.zeros((b,), jnp.int32),
+                        jnp.zeros((b, 20), jnp.int32),
+                        jnp.zeros((b,), jnp.int32),
+                        jnp.zeros((b, 64), jnp.int32),
+                        jnp.zeros((b, 64), jnp.int32))
+                np.asarray(_e.verify_batch_jit(*args))
+            self._warmed = True
+            log.info("verify kernel warmup complete (%s buckets)",
+                     len(self.BUCKETS))
+        except Exception as e:
+            log.warning("verify kernel warmup failed: %s", e)
 
     def enqueue(self, key: PublicKey, sig: bytes, msg: bytes) -> VerifyFuture:
         # L0: result cache
@@ -205,6 +276,19 @@ class ThreadedBatchVerifier(BatchSigVerifier):
         self._pending: List[Tuple[Triple, VerifyFuture]] = []
         self._inflight = False
 
+    @property
+    def wants_prewarm(self) -> bool:
+        return self._inner.wants_prewarm
+
+    @property
+    def inner(self) -> BatchSigVerifier:
+        return self._inner
+
+    def warmup(self, wait: bool = False) -> None:
+        w = getattr(self._inner, "warmup", None)
+        if w is not None:
+            w(wait)
+
     def enqueue(self, key: PublicKey, sig: bytes, msg: bytes) -> VerifyFuture:
         ck = _keys._cache_key(key.key_bytes, sig, msg)
         with _keys._cache_lock:
@@ -239,6 +323,11 @@ class ThreadedBatchVerifier(BatchSigVerifier):
                     f._complete(ok)
                 with self._lock:
                     self._inflight = False
+                    more = bool(self._pending)
+                if more:
+                    # verifies enqueued while the batch was in flight form
+                    # the next batch immediately
+                    self.flush()
 
             self._clock.post_to_main(complete)
 
@@ -249,14 +338,18 @@ class ThreadedBatchVerifier(BatchSigVerifier):
 
 
 def make_verifier(backend: str = "cpu", clock=None,
-                  max_pending: int = 8192) -> BatchSigVerifier:
+                  max_pending: int = 8192,
+                  compile_cache_dir: Optional[str] = None
+                  ) -> BatchSigVerifier:
     """Config-gated backend selection (Config.SIG_VERIFY_BACKEND)."""
     if backend == "cpu":
         return CpuSigVerifier()
     if backend == "tpu":
-        return TpuSigVerifier(max_pending=max_pending)
+        return TpuSigVerifier(max_pending=max_pending,
+                              compile_cache_dir=compile_cache_dir)
     if backend == "tpu-async":
         assert clock is not None
-        return ThreadedBatchVerifier(TpuSigVerifier(max_pending=max_pending),
-                                     clock)
+        return ThreadedBatchVerifier(
+            TpuSigVerifier(max_pending=max_pending,
+                           compile_cache_dir=compile_cache_dir), clock)
     raise ValueError("unknown sig verify backend %r" % backend)

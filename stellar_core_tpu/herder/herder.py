@@ -60,14 +60,6 @@ class HerderSCPDriver(SCPDriver):
         envelope.signature = sk.sign(
             self._envelope_sign_bytes(envelope.statement))
 
-    def verify_envelope(self, envelope: SCPEnvelope) -> bool:
-        """HOT CALLER #1: one ed25519 verify per envelope."""
-        fut = self.herder.verifier.enqueue(
-            envelope.statement.nodeID, envelope.signature,
-            self._envelope_sign_bytes(envelope.statement))
-        self.herder.verifier.flush()
-        return fut.result()
-
     def emit_envelope(self, envelope: SCPEnvelope) -> None:
         self.herder.emit_envelope(envelope)
 
@@ -254,18 +246,47 @@ class Herder:
         return self.tx_queue.try_add(frame)
 
     # -- SCP envelope intake -------------------------------------------------
-    def recv_scp_envelope(self, envelope: SCPEnvelope) -> int:
+    def recv_scp_envelope(self, envelope: SCPEnvelope,
+                          on_verified=None) -> int:
+        """HOT CALLER #1. The signature verify is enqueued on the batch
+        backend; with an async backend (tpu/tpu-async) verifies accumulate
+        across envelopes into one device dispatch and complete on the main
+        loop (the PendingEnvelopes 'verifying' state — async analog of the
+        reference's fetch-before-feed buffering). `on_verified(ok)` fires
+        when the decision lands (immediately on the sync backend)."""
         st = envelope.statement
         slot = st.slotIndex
         cur = self.current_slot()
         if slot < max(1, cur - 1) or \
                 slot > cur + self.LEDGER_VALIDITY_BRACKET:
             return SCP.EnvelopeState.INVALID
-        if not self.scp_driver.verify_envelope(envelope):
-            log.debug("bad envelope signature")
+        eh = sha256(envelope.to_xdr())
+        if not self.pending.begin_verify(envelope, eh):
+            # duplicate (processed / discarded / already verifying)
             return SCP.EnvelopeState.INVALID
-        self.pending.recv_scp_envelope(envelope)
-        return SCP.EnvelopeState.VALID
+        fut = self.verifier.enqueue(
+            st.nodeID, envelope.signature,
+            self.scp_driver._envelope_sign_bytes(st))
+
+        def done(ok: bool) -> None:
+            if not ok:
+                log.debug("bad envelope signature")
+            self.pending.finish_verify(envelope, ok, eh)
+            if on_verified is not None:
+                on_verified(ok)
+
+        if fut.done():
+            done(fut.result())
+            return (SCP.EnvelopeState.VALID if fut.result()
+                    else SCP.EnvelopeState.INVALID)
+        fut.add_done_callback(done)
+        # batch backends: make sure a dispatch happens even outside the
+        # app crank loop (flush coalesces: one dispatch per burst)
+        self.verifier.flush()
+        if fut.done():
+            return (SCP.EnvelopeState.VALID if fut.result()
+                    else SCP.EnvelopeState.INVALID)
+        return SCP.EnvelopeState.PENDING
 
     def envelope_ready(self, envelope: SCPEnvelope) -> None:
         """Called by PendingEnvelopes when deps are present."""

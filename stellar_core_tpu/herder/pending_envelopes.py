@@ -72,6 +72,10 @@ class PendingEnvelopes:
         self.fetching: Dict[int, List[SCPEnvelope]] = {}
         self.processed: Dict[int, Set[bytes]] = {}
         self.discarded: Dict[int, Set[bytes]] = {}
+        # slot -> envelope hashes whose signature verify is in flight on
+        # the batch backend (async analog of "fetching": buffered until
+        # the device batch completes on the main loop)
+        self.verifying: Dict[int, Set[bytes]] = {}
 
     def set_fetchers(self, fetch_txset, fetch_qset) -> None:
         self.fetch_txset_fn = fetch_txset
@@ -104,11 +108,41 @@ class PendingEnvelopes:
                 missing.append(("txset", th))
         return missing
 
-    def recv_scp_envelope(self, env: SCPEnvelope) -> bool:
+    def begin_verify(self, env: SCPEnvelope,
+                     eh: Optional[bytes] = None) -> bool:
+        """Enter the 'verifying' state. False when the envelope is already
+        known (processed / discarded / verify in flight) — callers skip
+        re-verification and re-flooding."""
+        slot = env.statement.slotIndex
+        eh = eh or sha256(env.to_xdr())
+        if eh in self.processed.get(slot, set()) or \
+                eh in self.discarded.get(slot, set()) or \
+                eh in self.verifying.get(slot, set()):
+            return False
+        self.verifying.setdefault(slot, set()).add(eh)
+        return True
+
+    def finish_verify(self, env: SCPEnvelope, ok: bool,
+                      eh: Optional[bytes] = None) -> bool:
+        """Resolve a verify: promote to the normal intake path or discard."""
+        slot = env.statement.slotIndex
+        eh = eh or sha256(env.to_xdr())
+        vs = self.verifying.get(slot)
+        if vs is not None:
+            vs.discard(eh)
+            if not vs:
+                del self.verifying[slot]
+        if not ok:
+            self.discarded.setdefault(slot, set()).add(eh)
+            return False
+        return self.recv_scp_envelope(env, eh)
+
+    def recv_scp_envelope(self, env: SCPEnvelope,
+                          eh: Optional[bytes] = None) -> bool:
         """Returns True if the envelope became ready (delivered to SCP
         queue); False if buffered/discarded."""
         slot = env.statement.slotIndex
-        eh = sha256(env.to_xdr())
+        eh = eh or sha256(env.to_xdr())
         if eh in self.processed.get(slot, set()) or \
                 eh in self.discarded.get(slot, set()):
             return False
@@ -148,6 +182,7 @@ class PendingEnvelopes:
 
     # -- GC -----------------------------------------------------------------
     def erase_below(self, slot: int) -> None:
-        for d in (self.fetching, self.processed, self.discarded):
+        for d in (self.fetching, self.processed, self.discarded,
+                  self.verifying):
             for s in [s for s in d if s < slot]:
                 del d[s]

@@ -156,6 +156,7 @@ class TxSetFrame:
         chain fee balance). trim=True removes invalid txs (and their
         dependents); returns (all_valid, trimmed)."""
         removed: List[AnyFrame] = []
+        self._prewarm_signatures(ltx_parent, verifier)
         by_acc: Dict[bytes, List[AnyFrame]] = {}
         for f in self.frames:
             by_acc.setdefault(f.source_account_id().key_bytes,
@@ -195,6 +196,27 @@ class TxSetFrame:
             self._hash = None
             return (not removed), removed
         return (not removed), removed
+
+    def _prewarm_signatures(self, ltx_parent, verifier) -> None:
+        """Two-phase validation (TPU batch hot caller #3): collect every
+        hint-matching signature triple for the WHOLE set and verify them in
+        one device dispatch; the per-tx walk below then completes entirely
+        off the warm verify cache. Reference walks tx-by-tx
+        (TxSetFrame.cpp:277-359); batching is the TPU-native reshape."""
+        if verifier is None or not getattr(verifier, "wants_prewarm", False):
+            return
+        if len(self.frames) <= 1:
+            return
+        ltx = LedgerTxn(ltx_parent)
+        try:
+            seen = {}
+            for f in self.frames:
+                for t in f.candidate_sig_triples(ltx):
+                    seen[t] = None
+        finally:
+            ltx.rollback()
+        if seen:
+            verifier.prewarm_many(list(seen))
 
     def trim_invalid(self, ltx_parent, verifier=None) -> List[AnyFrame]:
         _, removed = self.check_or_trim(ltx_parent, verifier, trim=True)

@@ -55,7 +55,8 @@ class Application:
         # crypto backend (config-gated; the TPU boundary)
         self.sig_verifier = make_verifier(
             config.SIG_VERIFY_BACKEND, clock,
-            config.SIG_VERIFY_MAX_BATCH)
+            config.SIG_VERIFY_MAX_BATCH,
+            config.SIG_VERIFY_COMPILE_CACHE_DIR)
 
         self.invariant_manager = InvariantManager(self.metrics)
         for pattern in config.INVARIANT_CHECKS:
@@ -99,6 +100,12 @@ class Application:
 
     # -- lifecycle -----------------------------------------------------------
     def start(self) -> None:
+        # AOT kernel warmup on a background thread: every bucket shape is
+        # compiled (or loaded from the persistent cache) before the first
+        # envelope can trigger a lazy compile on the consensus path
+        if self.config.SIG_VERIFY_WARMUP and \
+                getattr(self.sig_verifier, "wants_prewarm", False):
+            self.sig_verifier.warmup(wait=False)
         lm = self.ledger_manager
         if not lm.load_last_known_ledger():
             lm.start_new_ledger()
@@ -119,7 +126,11 @@ class Application:
             self.state = AppState.APP_ACQUIRING_CONSENSUS
 
     def crank(self, block: bool = False) -> int:
-        return self.clock.crank(block)
+        n = self.clock.crank(block)
+        # dispatch any signature verifies accumulated during this crank's
+        # handlers (coalesced: one device batch per burst; no-op when empty)
+        self.sig_verifier.flush()
+        return n
 
     def crank_until(self, pred, max_cranks: int = 100000) -> bool:
         for _ in range(max_cranks):

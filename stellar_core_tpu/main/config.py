@@ -91,6 +91,11 @@ class Config:
         # "cpu" (default, OpenSSL), "tpu" (JAX batched), "tpu-async"
         self.SIG_VERIFY_BACKEND = "cpu"
         self.SIG_VERIFY_MAX_BATCH = 8192
+        # AOT-compile all kernel bucket shapes at startup (background
+        # thread) so no lazy compile lands on the consensus path
+        self.SIG_VERIFY_WARMUP = True
+        # persistent XLA compilation cache (None = env or ~/.cache default)
+        self.SIG_VERIFY_COMPILE_CACHE_DIR: Optional[str] = None
 
         # maintenance
         self.AUTOMATIC_MAINTENANCE_PERIOD = 359.0

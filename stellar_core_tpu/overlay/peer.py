@@ -253,12 +253,14 @@ class Peer:
             self.overlay.item_fetched_qset(h)
         elif t == MessageType.SCP_MESSAGE:
             self.overlay.recv_flooded_msg(msg, self)
-            from ..scp.scp import SCP
-            status = herder.recv_scp_envelope(msg.value)
             # only relay envelopes that verified (reference Peer.cpp
-            # rebroadcasts unless the herder discarded the envelope)
-            if status != SCP.EnvelopeState.INVALID:
-                self.overlay.broadcast_message(msg)
+            # rebroadcasts unless the herder discarded the envelope); with
+            # an async batch backend the flood is deferred until the
+            # device batch completes on the main loop
+            herder.recv_scp_envelope(
+                msg.value,
+                on_verified=lambda ok:
+                    self.overlay.broadcast_message(msg) if ok else None)
         elif t == MessageType.GET_SCP_STATE:
             self._send_scp_state(msg.value)
         elif t in (MessageType.SURVEY_REQUEST, MessageType.SURVEY_RESPONSE):

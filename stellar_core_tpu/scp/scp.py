@@ -20,6 +20,9 @@ class SCP:
     class EnvelopeState:
         INVALID = BallotProtocol.EnvelopeState.INVALID
         VALID = BallotProtocol.EnvelopeState.VALID
+        # signature verify in flight on the batch backend; resolution is
+        # delivered via the recv_scp_envelope on_verified callback
+        PENDING = 2
 
     def __init__(self, driver: SCPDriver, node_id: NodeID,
                  is_validator: bool, qset: SCPQuorumSet) -> None:

@@ -21,7 +21,8 @@ def _keys(n: int, tag: bytes) -> List[SecretKey]:
 
 def core(n: int, threshold: int,
          passphrase: str = "(sct) simulation network",
-         mode: int = Simulation.OVER_LOOPBACK) -> Simulation:
+         mode: int = Simulation.OVER_LOOPBACK,
+         cfg_tweak=None) -> Simulation:
     """Fully-connected core of n validators all trusting each other."""
     sim = Simulation(mode=mode, network_passphrase=passphrase)
     keys = _keys(n, b"core")
@@ -30,7 +31,7 @@ def core(n: int, threshold: int,
                         innerSets=[])
     names = []
     for k in keys:
-        node = sim.add_node(k, qset)
+        node = sim.add_node(k, qset, cfg_tweak=cfg_tweak)
         names.append(node.name)
     for i in range(n):
         for j in range(i + 1, n):

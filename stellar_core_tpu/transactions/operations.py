@@ -11,7 +11,7 @@ from __future__ import annotations
 from ..xdr import (
     AccountFlags, Asset, AssetType, DataEntry, LedgerEntry, LedgerEntryData,
     LedgerEntryType, LedgerKey, OperationType, SignerKeyType, TrustLineEntry,
-    TrustLineFlags, _Ext,
+    TrustLineEntryExt, TrustLineFlags, _Ext,
 )
 from .account_helpers import (
     INT64_MAX, ThresholdLevel, add_balance, change_subentries,
@@ -336,7 +336,8 @@ class ChangeTrustOpFrame(OperationFrame):
         flags = 0 if is_auth_required(issuer_acc.data.value) \
             else TrustLineFlags.AUTHORIZED_FLAG
         tl = TrustLineEntry(accountID=src_id, asset=b.line, balance=0,
-                            limit=b.limit, flags=flags, ext=_Ext.v0())
+                            limit=b.limit, flags=flags,
+                            ext=TrustLineEntryExt.v0())
         ltx.create(LedgerEntry(
             lastModifiedLedgerSeq=header.ledgerSeq,
             data=LedgerEntryData(LedgerEntryType.TRUSTLINE, tl),

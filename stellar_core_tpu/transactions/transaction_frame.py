@@ -36,6 +36,31 @@ from . import operations as _ops  # noqa: F401  (populates the op registry)
 from . import offers as _offers   # noqa: F401
 
 
+def collect_sig_triples(ltx, account_ids, signatures,
+                        contents_hash: bytes
+                        ) -> List[Tuple[bytes, bytes, bytes]]:
+    """Hint-matching (ed25519-key, signature, contents-hash) pairs against
+    the signer sets (master key + account signers) of `account_ids`.
+    Shared by the tx and fee-bump frames' candidate_sig_triples — the
+    collection half of TxSetFrame's two-phase prewarm."""
+    from ..xdr import SignerKeyType
+    keys = set()
+    for acc_id in account_ids:
+        keys.add(acc_id)  # master key; also the missing-account case
+        entry = ltx.load_without_record(
+            LedgerKey.account(PublicKey.ed25519(acc_id)))
+        if entry is not None:
+            for s in entry.data.value.signers:
+                if s.key.disc == SignerKeyType.SIGNER_KEY_TYPE_ED25519:
+                    keys.add(s.key.value)
+    out = []
+    for ds in signatures:
+        for kb in keys:
+            if ds.hint == kb[-4:]:
+                out.append((kb, ds.signature, contents_hash))
+    return out
+
+
 def _make_result(fee_charged: int, code: int,
                  op_results: Optional[List[OperationResult]] = None
                  ) -> TransactionResult:
@@ -105,6 +130,21 @@ class TransactionFrame:
         sha256(signature payload), not the raw payload)."""
         self.signatures.append(
             secret_key.sign_decorated(self.contents_hash()))
+
+    # -- batched signature collection ----------------------------------------
+    def candidate_sig_triples(self, ltx) -> List[Tuple[bytes, bytes, bytes]]:
+        """Every (ed25519-key, signature, contents-hash) pair a
+        SignatureChecker over this tx could end up verifying: hint-matching
+        pairs against the signer sets (master key + account signers) of the
+        tx source and every op source. Used by TxSetFrame.check_or_trim's
+        two-phase prewarm — one device dispatch for the whole set, then the
+        per-tx walk completes off the warm verify cache (reference hot
+        caller #3, TxSetFrame.cpp:277-359, batched the TPU way)."""
+        accs = {self.source_account_id().key_bytes}
+        for f in self.op_frames:
+            accs.add(f.source_account_id().key_bytes)
+        return collect_sig_triples(ltx, accs, self.signatures,
+                                   self.contents_hash())
 
     # -- fees ---------------------------------------------------------------
     def min_fee(self, header) -> int:
@@ -343,6 +383,15 @@ class FeeBumpTransactionFrame:
     def add_signature(self, secret_key) -> None:
         self.signatures.append(
             secret_key.sign_decorated(self.contents_hash()))
+
+    def candidate_sig_triples(self, ltx) -> List[Tuple[bytes, bytes, bytes]]:
+        """Fee-bump outer signatures (fee source signers) + the inner tx's
+        triples; see TransactionFrame.candidate_sig_triples."""
+        out = collect_sig_triples(
+            ltx, {self.source_account_id().key_bytes}, self.signatures,
+            self.contents_hash())
+        out.extend(self.inner.candidate_sig_triples(ltx))
+        return out
 
     def min_fee(self, header) -> int:
         return header.baseFee * self.num_operations()

@@ -16,11 +16,13 @@ from .basic import (
     DataValue, Uint256, UpgradeType, Value,
 )
 from .ledger_entries import (
-    AccountEntry, AccountFlags, Asset, AssetAlphaNum4, AssetAlphaNum12,
+    AccountEntry, AccountEntryExt, AccountEntryExtensionV1, AccountFlags,
+    Asset, AssetAlphaNum4, AssetAlphaNum12,
     AssetType, DataEntry, LedgerEntry, LedgerEntryData, LedgerEntryType,
     LedgerKey, LedgerKeyAccount, LedgerKeyData, LedgerKeyOffer,
-    LedgerKeyTrustLine, OfferEntry, OfferEntryFlags, Price, SequenceNumber,
-    Signer, TrustLineEntry, TrustLineFlags, ledger_entry_key,
+    LedgerKeyTrustLine, Liabilities, OfferEntry, OfferEntryFlags, Price,
+    SequenceNumber, Signer, TrustLineEntry, TrustLineEntryExt,
+    TrustLineEntryExtensionV1, TrustLineFlags, ledger_entry_key,
     ledger_key_sort_key, _Ext,
 )
 from .transaction import (

@@ -93,6 +93,38 @@ class _Ext(XdrUnion):
         return cls(0)
 
 
+class Liabilities(XdrStruct):
+    """Protocol >= 10 balance encumbrance (reference
+    Stellar-ledger-entries.x Liabilities): `buying` reserves room below
+    the balance/limit ceiling, `selling` reserves balance above the
+    floor — both maintained by open offers."""
+    xdr_fields = [("buying", Int64), ("selling", Int64)]
+
+
+class AccountEntryExtensionV1(XdrStruct):
+    xdr_fields = [("liabilities", Liabilities), ("ext", _Ext)]
+
+
+class AccountEntryExt(XdrUnion):
+    xdr_arms = {0: ("v0", None), 1: ("v1", AccountEntryExtensionV1)}
+
+    @classmethod
+    def v0(cls) -> "AccountEntryExt":
+        return cls(0)
+
+
+class TrustLineEntryExtensionV1(XdrStruct):
+    xdr_fields = [("liabilities", Liabilities), ("ext", _Ext)]
+
+
+class TrustLineEntryExt(XdrUnion):
+    xdr_arms = {0: ("v0", None), 1: ("v1", TrustLineEntryExtensionV1)}
+
+    @classmethod
+    def v0(cls) -> "TrustLineEntryExt":
+        return cls(0)
+
+
 class AccountEntry(XdrStruct):
     MAX_SIGNERS = 20
     xdr_fields = [
@@ -105,7 +137,7 @@ class AccountEntry(XdrStruct):
         ("homeDomain", String32),
         ("thresholds", Thresholds),
         ("signers", VarArray(Signer, 20)),
-        ("ext", _Ext),
+        ("ext", AccountEntryExt),
     ]
 
 
@@ -121,7 +153,7 @@ class TrustLineEntry(XdrStruct):
         ("balance", Int64),
         ("limit", Int64),
         ("flags", Uint32),
-        ("ext", _Ext),
+        ("ext", TrustLineEntryExt),
     ]
 
 

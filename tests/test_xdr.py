@@ -53,7 +53,7 @@ def test_struct_union_roundtrip():
             X.AccountEntry(accountID=acc(2), balance=100, seqNum=1,
                            numSubEntries=0, inflationDest=None, flags=0,
                            homeDomain="x", thresholds=bytes(4), signers=[],
-                           ext=X._Ext.v0())),
+                           ext=X.AccountEntryExt.v0())),
         ext=X._Ext.v0())
     assert X.LedgerEntry.from_xdr(e.to_xdr()) == e
     assert X.ledger_entry_key(e) == X.LedgerKey.account(acc(2))
