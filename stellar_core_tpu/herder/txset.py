@@ -184,10 +184,15 @@ class TxSetFrame:
                     cur_seq = f.seq_num
                     total_fee += f.fee_charged(ltx.load_header())
                     chain_ok.append(f)
-                if chain_ok and \
-                        acc_entry.data.value.balance < total_fee:
-                    removed.extend(chain_ok)
-                    chain_ok = []
+                if chain_ok:
+                    from ..transactions.account_helpers import (
+                        account_available_balance,
+                    )
+                    avail = account_available_balance(
+                        ltx.load_header(), acc_entry.data.value)
+                    if avail < total_fee:
+                        removed.extend(chain_ok)
+                        chain_ok = []
                 keep.extend(chain_ok)
             finally:
                 ltx.rollback()

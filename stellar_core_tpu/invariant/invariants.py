@@ -33,6 +33,22 @@ class Invariant:
         """Return an error string or None."""
         return None
 
+    def check_on_operation(self, op_frame, delta: Delta,
+                           header_prev: LedgerHeader,
+                           header_cur: LedgerHeader) -> Optional[str]:
+        """Per-operation check over the op's own LedgerTxn delta
+        (reference InvariantManager::checkOnOperationApply,
+        InvariantManager.h:39-49). Catches compensating-bug pairs that a
+        whole-ledger delta would cancel out. Default: reuse the close
+        check on the op delta."""
+        return self.check_on_close(delta, header_prev, header_cur)
+
+    def check_on_bucket_apply(self, entries, ltx_root, level: int,
+                              is_curr: bool) -> Optional[str]:
+        """Post-bucket-application consistency (reference
+        checkOnBucketApply)."""
+        return None
+
 
 class LedgerEntryIsValid(Invariant):
     name = "LedgerEntryIsValid"
@@ -138,9 +154,131 @@ class SequentialLedgers(Invariant):
             return "ledger seq not sequential"
         return None
 
+    def check_on_operation(self, op_frame, delta, header_prev, header_cur):
+        return None  # ops run within one ledger
+
+
+class LiabilitiesMatchOffers(Invariant):
+    """Reference src/invariant/LiabilitiesMatchOffers.cpp: every change in
+    an account's/trustline's liabilities must be explained by offer
+    changes in the same delta, and liabilities must stay within balance /
+    limit bounds."""
+
+    name = "LiabilitiesMatchOffers"
+
+    @staticmethod
+    def _liab(entry) -> Tuple[int, int]:
+        if entry is None:
+            return (0, 0)
+        dv = entry.data.value
+        if dv.ext.disc == 0:
+            return (0, 0)
+        li = dv.ext.value.liabilities
+        return (li.buying, li.selling)
+
+    def _offer_deltas(self, delta):
+        from ..transactions.offer_exchange import offer_liabilities
+        from ..xdr import Asset
+        d_buying: Dict[tuple, int] = {}
+        d_selling: Dict[tuple, int] = {}
+        for key, prev, cur in delta:
+            if (cur or prev).data.disc != LedgerEntryType.OFFER:
+                continue
+            for e, sign in ((prev, -1), (cur, +1)):
+                if e is None:
+                    continue
+                o = e.data.value
+                bl, sl = offer_liabilities(o.price.n, o.price.d, o.amount)
+                seller = o.sellerID.key_bytes
+                # issuer side carries no liability (issuer mints/burns)
+                if o.buying.is_native or o.sellerID != o.buying.issuer:
+                    k = (seller, o.buying.to_xdr())
+                    d_buying[k] = d_buying.get(k, 0) + sign * bl
+                if o.selling.is_native or o.sellerID != o.selling.issuer:
+                    k = (seller, o.selling.to_xdr())
+                    d_selling[k] = d_selling.get(k, 0) + sign * sl
+        return d_buying, d_selling
+
+    def check_on_operation(self, op_frame, delta, header_prev, header_cur):
+        from ..xdr import Asset
+        if header_cur.ledgerVersion < 10:
+            return None
+        d_buying, d_selling = self._offer_deltas(delta)
+        native = Asset.native().to_xdr()
+        for key, prev, cur in delta:
+            t = (cur or prev).data.disc
+            if t == LedgerEntryType.ACCOUNT:
+                dv = (cur or prev).data.value
+                k = (dv.accountID.key_bytes, native)
+            elif t == LedgerEntryType.TRUSTLINE:
+                dv = (cur or prev).data.value
+                k = (dv.accountID.key_bytes, dv.asset.to_xdr())
+            else:
+                continue
+            pb, ps = self._liab(prev)
+            cb, cs = self._liab(cur)
+            if cb - pb != d_buying.pop(k, 0):
+                return ("buying liabilities changed by %d without matching "
+                        "offer delta" % (cb - pb))
+            if cs - ps != d_selling.pop(k, 0):
+                return ("selling liabilities changed by %d without "
+                        "matching offer delta" % (cs - ps))
+            # bound checks on the new state (reference checkBalanceAndLimit)
+            if cur is not None:
+                dvc = cur.data.value
+                if cb < 0 or cs < 0:
+                    return "negative liabilities"
+                if t == LedgerEntryType.ACCOUNT:
+                    reserve = (2 + dvc.numSubEntries) * header_cur.baseReserve
+                    if dvc.balance - reserve < cs:
+                        return "selling liabilities exceed available balance"
+                    if dvc.balance > (2**63 - 1) - cb:
+                        return "buying liabilities exceed INT64 headroom"
+                else:
+                    if dvc.balance < cs:
+                        return "selling liabilities exceed trust balance"
+                    if dvc.balance > dvc.limit - cb:
+                        return "buying liabilities exceed trust limit"
+        for k, v in list(d_buying.items()) + list(d_selling.items()):
+            if v != 0:
+                return ("offer liability delta %d has no matching "
+                        "account/trustline change" % v)
+        return None
+
+    def check_on_close(self, delta, header_prev, header_cur):
+        return self.check_on_operation(None, delta, header_prev, header_cur)
+
+
+class BucketListIsConsistentWithDatabase(Invariant):
+    """Reference src/invariant/BucketListIsConsistentWithDatabase.cpp:
+    after a bucket is applied during catchup, the ledger state must
+    contain exactly the bucket's live entries and none of its dead
+    keys."""
+
+    name = "BucketListIsConsistentWithDatabase"
+
+    def check_on_bucket_apply(self, entries, ltx_root, level, is_curr):
+        from ..bucket.bucket import BucketEntryType
+        from ..xdr import ledger_entry_key
+        for be in entries:
+            if be.type in (BucketEntryType.LIVEENTRY,
+                           BucketEntryType.INITENTRY):
+                key = ledger_entry_key(be.entry)
+                got = ltx_root.get_entry(key)
+                if got is None:
+                    return "live bucket entry missing from ledger state"
+                if got.to_xdr() != be.entry.to_xdr():
+                    return "ledger state disagrees with applied bucket entry"
+            elif be.type == BucketEntryType.DEADENTRY:
+                if ltx_root.get_entry(be.key) is not None:
+                    return "dead bucket key still present in ledger state"
+        return None
+
 
 ALL_INVARIANTS = [LedgerEntryIsValid, ConservationOfLumens,
-                  AccountSubEntriesCountIsValid, SequentialLedgers]
+                  AccountSubEntriesCountIsValid, SequentialLedgers,
+                  LiabilitiesMatchOffers,
+                  BucketListIsConsistentWithDatabase]
 
 
 class InvariantManager:

@@ -24,8 +24,10 @@ from ..xdr import (
     TrustLineFlags, ledger_entry_key,
 )
 from .account_helpers import (
-    INT64_MAX, add_balance, change_subentries, load_account, load_trustline,
-    min_balance,
+    INT64_MAX, LIABILITIES_VERSION, add_balance, add_buying_liabilities,
+    add_selling_liabilities, add_trust_balance, change_subentries,
+    get_buying_liabilities, get_selling_liabilities, load_account,
+    load_trustline, min_balance,
 )
 
 
@@ -50,29 +52,42 @@ def exchange(offer_amount: int, n: int, d: int, max_wheat_receive: int,
 
 
 def _available_to_sell(ltx, account_id, asset: Asset) -> int:
-    """How much of `asset` the account can actually deliver."""
+    """How much of `asset` the account can actually deliver (reference
+    canSellAtMost: available balance net of reserve and SELLING
+    liabilities)."""
     header = ltx.get_header()
     if asset.is_native:
         acc_e = ltx.load_without_record(LedgerKey.account(account_id))
         if acc_e is None:
             return 0
         acc = acc_e.data.value
-        return max(0, acc.balance - min_balance(header, acc.numSubEntries))
+        avail = acc.balance - min_balance(header, acc.numSubEntries)
+        if header.ledgerVersion >= LIABILITIES_VERSION:
+            avail -= get_selling_liabilities(header, acc_e)
+        return max(0, avail)
     if account_id == asset.issuer:
         return INT64_MAX
     tl_e = ltx.load_without_record(LedgerKey.trustline(account_id, asset))
     if tl_e is None or not (tl_e.data.value.flags &
                             TrustLineFlags.AUTHORIZED_FLAG):
         return 0
-    return max(0, tl_e.data.value.balance)
+    avail = tl_e.data.value.balance
+    if header.ledgerVersion >= LIABILITIES_VERSION:
+        avail -= get_selling_liabilities(header, tl_e)
+    return max(0, avail)
 
 
 def _available_to_receive(ltx, account_id, asset: Asset) -> int:
+    """Reference canBuyAtMost: headroom net of BUYING liabilities."""
+    header = ltx.get_header()
     if asset.is_native:
         acc_e = ltx.load_without_record(LedgerKey.account(account_id))
         if acc_e is None:
             return 0
-        return INT64_MAX - acc_e.data.value.balance
+        out = INT64_MAX - acc_e.data.value.balance
+        if header.ledgerVersion >= LIABILITIES_VERSION:
+            out -= get_buying_liabilities(header, acc_e)
+        return max(0, out)
     if account_id == asset.issuer:
         return INT64_MAX
     tl_e = ltx.load_without_record(LedgerKey.trustline(account_id, asset))
@@ -80,7 +95,10 @@ def _available_to_receive(ltx, account_id, asset: Asset) -> int:
                             TrustLineFlags.AUTHORIZED_FLAG):
         return 0
     tl = tl_e.data.value
-    return max(0, tl.limit - tl.balance)
+    out = tl.limit - tl.balance
+    if header.ledgerVersion >= LIABILITIES_VERSION:
+        out -= get_buying_liabilities(header, tl_e)
+    return max(0, out)
 
 
 def _credit(ltx, account_id, asset: Asset, amount: int) -> bool:
@@ -95,11 +113,7 @@ def _credit(ltx, account_id, asset: Asset, amount: int) -> bool:
     e = load_trustline(ltx, account_id, asset)
     if e is None:
         return False
-    tl = e.data.value
-    if tl.balance + amount > tl.limit:
-        return False
-    tl.balance += amount
-    return True
+    return add_trust_balance(header, e, amount)
 
 
 def _debit(ltx, account_id, asset: Asset, amount: int) -> bool:
@@ -112,10 +126,68 @@ def _debit(ltx, account_id, asset: Asset, amount: int) -> bool:
     if account_id == asset.issuer:
         return True  # issuer paying its own asset mints it
     e = load_trustline(ltx, account_id, asset)
-    if e is None or e.data.value.balance < amount:
+    if e is None:
         return False
-    e.data.value.balance -= amount
-    return True
+    return add_trust_balance(header, e, -amount)
+
+
+# -- offer liabilities (reference TransactionUtils.cpp:590-632 + ManageOffer
+#    getOfferBuying/SellingLiabilities) --------------------------------------
+
+def offer_liabilities(n: int, d: int, amount: int):
+    """(buying, selling) liabilities a resting offer of `amount` at price
+    n/d encumbers: the owner owes `amount` wheat (selling) and has claim
+    to ceil(amount*n/d) sheep (buying)."""
+    wheat, sheep = exchange(amount, n, d, INT64_MAX, INT64_MAX)
+    return sheep, wheat
+
+
+def adjust_offer(n: int, d: int, max_sell: int, max_receive: int) -> int:
+    """Largest posting amount backable by max_sell/max_receive (reference
+    adjustOffer, OfferExchange.cpp:903: idempotent on adjusted offers)."""
+    wheat, _sheep = exchange(max_sell, n, d, max_sell, max_receive)
+    return wheat
+
+
+def apply_offer_liabilities(ltx, offer, sign: int) -> bool:
+    """Acquire (+1) or release (-1) the liabilities an offer encumbers on
+    its owner's account/trustlines (reference
+    acquireOrReleaseLiabilities, TransactionUtils.cpp:134-206)."""
+    header = ltx.get_header()
+    if header.ledgerVersion < LIABILITIES_VERSION:
+        return True
+    buying_liab, selling_liab = offer_liabilities(
+        offer.price.n, offer.price.d, offer.amount)
+    seller = offer.sellerID
+    ok = True
+    if offer.buying.is_native:
+        e = load_account(ltx, seller)
+        ok = e is not None and \
+            add_buying_liabilities(header, e, sign * buying_liab)
+    elif seller != offer.buying.issuer:
+        e = load_trustline(ltx, seller, offer.buying)
+        ok = e is not None and \
+            add_buying_liabilities(header, e, sign * buying_liab)
+    if not ok:
+        return False
+    if offer.selling.is_native:
+        e = load_account(ltx, seller)
+        ok = e is not None and \
+            add_selling_liabilities(header, e, sign * selling_liab)
+    elif seller != offer.selling.issuer:
+        e = load_trustline(ltx, seller, offer.selling)
+        ok = e is not None and \
+            add_selling_liabilities(header, e, sign * selling_liab)
+    return ok
+
+
+def acquire_liabilities(ltx, offer) -> bool:
+    return apply_offer_liabilities(ltx, offer, +1)
+
+
+def release_liabilities(ltx, offer) -> None:
+    ok = apply_offer_liabilities(ltx, offer, -1)
+    assert ok, "releasing offer liabilities must succeed"
 
 
 class CrossResult:
@@ -165,6 +237,10 @@ def cross_offers(ltx, taker_id, sell_asset: Asset, buy_asset: Asset,
 
         owner = offer.sellerID
         key = ledger_entry_key(best)
+        # release the resting offer's liabilities up front so the owner's
+        # full capacity is visible to the exchange; re-acquired below if
+        # the offer survives (reference crossOfferV10 shape)
+        release_liabilities(ltx, offer)
         wheat_cap = min(offer.amount,
                         _available_to_sell(ltx, owner, buy_asset))
         recv_cap = _available_to_receive(ltx, owner, sell_asset)
@@ -177,6 +253,9 @@ def cross_offers(ltx, taker_id, sell_asset: Asset, buy_asset: Asset,
         wheat, sheep = exchange(wheat_cap, n, d, max_buy - bought,
                                 max_sell - sold)
         if wheat == 0:
+            # taker exhausted; restore the resting offer's liabilities
+            assert acquire_liabilities(ltx, offer), \
+                "re-acquire after release must succeed"
             return CrossResult.SUCCESS, bought, sold, claims
         # settle the owner's side
         ok1 = _debit(ltx, owner, buy_asset, wheat)
@@ -188,6 +267,18 @@ def cross_offers(ltx, taker_id, sell_asset: Asset, buy_asset: Asset,
         if o.amount <= 0 or wheat == wheat_cap and wheat < offer.amount:
             # fully taken, or residual is unfunded
             _erase_offer(ltx, key, owner)
+        else:
+            # clamp the residual to what the owner can still back, then
+            # re-encumber (reference performExchange newAmount + acquire)
+            o.amount = adjust_offer(
+                n, d, min(o.amount, _available_to_sell(ltx, owner,
+                                                       buy_asset)),
+                _available_to_receive(ltx, owner, sell_asset))
+            if o.amount <= 0:
+                _erase_offer(ltx, key, owner)
+            else:
+                assert acquire_liabilities(ltx, o), \
+                    "re-acquire of clamped residual must succeed"
         bought += wheat
         sold += sheep
         claims.append(ClaimOfferAtom(

@@ -22,7 +22,8 @@ from .account_helpers import (
 )
 from .offer_exchange import (
     CrossResult, _available_to_receive, _available_to_sell, _credit, _debit,
-    cross_offers,
+    acquire_liabilities, adjust_offer, cross_offers, offer_liabilities,
+    release_liabilities,
 )
 from .operation_frame import OperationFrame, register_op
 from .operations import _valid_asset
@@ -120,6 +121,9 @@ class _ManageOfferBase(OperationFrame):
         return None
 
     def do_apply(self, ltx) -> bool:
+        """Reference ManageOfferOpFrameBase::doApply:200-460: release the
+        old offer's liabilities, check the posted offer is fully backable,
+        cross, clamp the residual to capacity, acquire liabilities."""
         selling, buying, amount, price, offer_id = self._params()
         src_id = self.source_account_id()
         header = ltx.load_header()
@@ -135,6 +139,8 @@ class _ManageOfferBase(OperationFrame):
             existing = ltx.load(key)
             if existing is None:
                 return self.set_inner(ManageOfferResultCode.NOT_FOUND)
+            # free the balance this offer encumbered before erasing it
+            release_liabilities(ltx, existing.data.value)
             existing_flags = existing.data.value.flags
             ltx.erase(key)  # pulled from the book; subentry kept for now
             is_update = True
@@ -147,14 +153,22 @@ class _ManageOfferBase(OperationFrame):
                 ManageOfferSuccessResult(offersClaimed=[],
                                          offer=_offer_deleted()))
 
+        # the posted offer must be fully backable by the available limit
+        # and balance (reference computeOfferExchangeParameters:161-186);
+        # a NEW offer also consumes a subentry's reserve first
+        if not is_update:
+            src = load_account(ltx, src_id)
+            if not change_subentries(header, src, +1):
+                return self.set_inner(ManageOfferResultCode.LOW_RESERVE)
+        buy_liab, sell_liab = offer_liabilities(price.n, price.d, amount)
         max_sell_funds = _available_to_sell(ltx, src_id, selling)
-        if max_sell_funds <= 0 and amount > 0:
-            # restore bookkeeping consistency on failure path: op ltx rolls
-            # back wholesale, so no cleanup needed
-            return self.set_inner(ManageOfferResultCode.UNDERFUNDED)
         recv_cap = _available_to_receive(ltx, src_id, buying)
-        if recv_cap <= 0:
+        if recv_cap < buy_liab or recv_cap <= 0:
             return self.set_inner(ManageOfferResultCode.LINE_FULL)
+        if max_sell_funds < sell_liab:
+            return self.set_inner(ManageOfferResultCode.UNDERFUNDED)
+        if max_sell_funds <= 0 and amount > 0:
+            return self.set_inner(ManageOfferResultCode.UNDERFUNDED)
 
         max_sell = min(amount, max_sell_funds)
         code, bought, sold, claims = cross_offers(
@@ -167,19 +181,17 @@ class _ManageOfferBase(OperationFrame):
         assert _debit(ltx, src_id, selling, sold)
         assert _credit(ltx, src_id, buying, bought)
 
-        remaining = min(amount - sold,
-                        _available_to_sell(ltx, src_id, selling))
-        recv_left = _available_to_receive(ltx, src_id, buying)
-        if recv_left < INT64_MAX:
-            remaining = min(remaining, (recv_left * price.d) // price.n)
+        # residual amount clamped to post-trade capacity (reference
+        # adjustOffer idempotence)
+        remaining = adjust_offer(
+            price.n, price.d,
+            min(amount - sold, _available_to_sell(ltx, src_id, selling)),
+            _available_to_receive(ltx, src_id, buying))
 
         if remaining > 0:
             if is_update:
                 new_id = offer_id
             else:
-                src = load_account(ltx, src_id)
-                if not change_subentries(header, src, +1):
-                    return self.set_inner(ManageOfferResultCode.LOW_RESERVE)
                 header.idPool += 1
                 new_id = header.idPool
             flags = OfferEntryFlags.PASSIVE_FLAG if (
@@ -193,11 +205,14 @@ class _ManageOfferBase(OperationFrame):
                 data=LedgerEntryData(LedgerEntryType.OFFER, oe),
                 ext=_Ext.v0())
             ltx.create(entry)
+            assert acquire_liabilities(ltx, oe), \
+                "acquire after backability check must succeed"
             arm = ManageOfferSuccessResultOffer(1 if is_update else 0, oe)
         else:
-            if is_update:
-                src = load_account(ltx, src_id)
-                change_subentries(header, src, -1)
+            # no offer stays: give back the subentry taken above (new) or
+            # the one the erased offer held (update)
+            src = load_account(ltx, src_id)
+            change_subentries(header, src, -1)
             arm = _offer_deleted()
         return self.set_inner(
             ManageOfferResultCode.SUCCESS,
@@ -269,7 +284,7 @@ class _PathPaymentBase(OperationFrame):
         t = tl.data.value
         if not (t.flags & TrustLineFlags.AUTHORIZED_FLAG):
             return PathPaymentResultCode.NOT_AUTHORIZED
-        if t.balance + amount > t.limit:
+        if _available_to_receive(ltx, dest_id, asset) < amount:
             return PathPaymentResultCode.LINE_FULL
         return None
 
@@ -290,7 +305,7 @@ class _PathPaymentBase(OperationFrame):
         t = tl.data.value
         if not (t.flags & TrustLineFlags.AUTHORIZED_FLAG):
             return PathPaymentResultCode.SRC_NOT_AUTHORIZED
-        if t.balance < amount:
+        if _available_to_sell(ltx, src_id, asset) < amount:
             return PathPaymentResultCode.UNDERFUNDED
         return None
 

@@ -14,9 +14,10 @@ from ..xdr import (
     TrustLineEntryExt, TrustLineFlags, _Ext,
 )
 from .account_helpers import (
-    INT64_MAX, ThresholdLevel, add_balance, change_subentries,
-    is_auth_required, is_immutable_auth, load_account, load_trustline,
-    make_account_entry, min_balance, starting_sequence_number,
+    INT64_MAX, ThresholdLevel, add_balance, add_trust_balance,
+    change_subentries, is_auth_required, is_immutable_auth, load_account,
+    load_trustline, make_account_entry, min_balance,
+    starting_sequence_number,
 )
 from .operation_frame import OperationFrame, register_op
 
@@ -175,7 +176,7 @@ class PaymentOpFrame(OperationFrame):
             return self.set_inner(PaymentResultCode.SUCCESS)
 
         issuer = asset.issuer
-        # source side
+        # source side (liability-aware: cannot spend encumbered balance)
         if src_id != issuer:
             stl = load_trustline(ltx, src_id, asset)
             if stl is None:
@@ -183,13 +184,12 @@ class PaymentOpFrame(OperationFrame):
             tl = stl.data.value
             if not (tl.flags & TrustLineFlags.AUTHORIZED_FLAG):
                 return self.set_inner(PaymentResultCode.SRC_NOT_AUTHORIZED)
-            if tl.balance < amount:
+            if not add_trust_balance(header, stl, -amount):
                 return self.set_inner(PaymentResultCode.UNDERFUNDED)
-            tl.balance -= amount
         else:
             if load_account(ltx, issuer) is None:
                 return self.set_inner(PaymentResultCode.NO_ISSUER)
-        # destination side
+        # destination side (cannot receive into buying-encumbered headroom)
         if dest_id != issuer:
             dtl = load_trustline(ltx, dest_id, asset)
             if dtl is None:
@@ -197,9 +197,8 @@ class PaymentOpFrame(OperationFrame):
             tl = dtl.data.value
             if not (tl.flags & TrustLineFlags.AUTHORIZED_FLAG):
                 return self.set_inner(PaymentResultCode.NOT_AUTHORIZED)
-            if tl.balance + amount > tl.limit:
+            if not add_trust_balance(header, dtl, amount):
                 return self.set_inner(PaymentResultCode.LINE_FULL)
-            tl.balance += amount
         return self.set_inner(PaymentResultCode.SUCCESS)
 
 
@@ -311,16 +310,22 @@ class ChangeTrustOpFrame(OperationFrame):
         key = LedgerKey.trustline(src_id, b.line)
         existing = ltx.load(key)
         if existing is not None:
+            from .account_helpers import get_buying_liabilities
             tl = existing.data.value
             if b.limit == 0:
-                if tl.balance != 0:
+                # cannot delete a trustline that open offers encumber
+                if tl.balance != 0 or \
+                        get_buying_liabilities(header, existing) != 0:
                     return self.set_inner(
                         ChangeTrustResultCode.INVALID_LIMIT)
                 ltx.erase(key)
                 src = load_account(ltx, src_id)
                 change_subentries(header, src, -1)
                 return self.set_inner(ChangeTrustResultCode.SUCCESS)
-            if b.limit < tl.balance:
+            # new limit must cover balance + buying liabilities (reference
+            # ChangeTrustOpFrame::doApply protocol >= 10)
+            if b.limit < tl.balance + get_buying_liabilities(header,
+                                                             existing):
                 return self.set_inner(ChangeTrustResultCode.INVALID_LIMIT)
             tl.limit = b.limit
             return self.set_inner(ChangeTrustResultCode.SUCCESS)

@@ -28,7 +28,8 @@ from ..xdr import (
 )
 from ..xdr.transaction import _TaggedTransaction, _TxResultResult
 from .account_helpers import (
-    ThresholdLevel, account_threshold, account_master_weight, load_account,
+    ThresholdLevel, account_available_balance, account_threshold,
+    account_master_weight, load_account,
 )
 from .operation_frame import make_operation_frame
 from .signature_checker import SignatureChecker
@@ -180,7 +181,10 @@ class TransactionFrame:
             return TransactionResultCode.txBAD_SEQ
         if not self._check_signature(checker, acc, ThresholdLevel.LOW):
             return TransactionResultCode.txBAD_AUTH
-        if not applying and acc.balance < self.fee_charged(header):
+        # fee must come from the AVAILABLE balance (net of reserve and
+        # selling liabilities; reference commonValid + getAvailableBalance)
+        if not applying and account_available_balance(header, acc) < \
+                self.fee_charged(header):
             return TransactionResultCode.txINSUFFICIENT_BALANCE
         return TransactionResultCode.txSUCCESS
 
@@ -442,7 +446,8 @@ class FeeBumpTransactionFrame:
                 self.result = _make_result(
                     0, TransactionResultCode.txBAD_AUTH_EXTRA)
                 return False
-            if acc.balance < self.fee_charged(header):
+            if account_available_balance(header, acc) < \
+                    self.fee_charged(header):
                 self.result = _make_result(
                     0, TransactionResultCode.txINSUFFICIENT_BALANCE)
                 return False
