@@ -7,19 +7,26 @@ Design (TPU-first; replaces the reference's per-call libsodium
   backend semantics exactly): [S]B == R + [k]A with k = SHA512(R‖A‖M) mod L.
   We compute Q = [S]B + [k](−A) on-device and compare with the decompressed
   R projectively (no inversion).
-- The batch axis is the parallelism: every step below is a fused vector op
-  over the whole batch; scalar control flow is eliminated (fori_loop with
-  static trip counts, masked table selects instead of branches).
+- LAYOUT: all device arrays are limb-first / batch-last ((20, B) field
+  elements, (64, B) scalar digits) so the batch rides the TPU lane
+  dimension at full width; see ops/field.py header. The public
+  `verify_kernel` still takes batch-first arrays (the host/byte layout)
+  and transposes once at the jit boundary.
+- Points are (x, y, z, t) TUPLES of (20, B) field elements — no stacked
+  (4, 20) axis for XLA to pad; each coordinate is an independent
+  full-lane array.
 - Host does the byte-level work that TPUs are bad at: SHA-512 (tiny
   messages), canonicality prechecks (S < L, y < p), bit-slicing keys into
-  13-bit limbs and scalars into 4-bit windows.
+  13-bit limbs and scalars into 4-bit windows — all numpy-vectorized
+  across the batch except the per-item SHA-512 + mod L (C-speed hashlib).
 - Fixed-base [S]B uses a precomputed 64×16 radix-16 table of B multiples in
   Niels form (y+x, y−x, 2dxy): 64 masked-lookup additions, zero doublings.
 - Variable-base [k](−A) builds a per-item 16-entry extended-coordinate
   table (15 additions) then runs 63 iterations of 4 doublings + 1 table
   addition inside a fori_loop.
 - Point formulas: extended coordinates, a=−1 twisted Edwards unified
-  add/double (complete on the prime-order subgroup).
+  add/double (complete on the prime-order subgroup); doublings skip the
+  T output unless the next step reads it.
 
 A pure-Python (int) implementation lives alongside for table generation and
 as a test oracle.
@@ -36,9 +43,9 @@ import jax
 import jax.numpy as jnp
 
 from .field import (
-    NLIMBS, LIMB_BITS, LIMB_MASK, P, fe_add, fe_carry, fe_eq, fe_freeze,
-    fe_is_zero, fe_mul, fe_mul_small, fe_neg, fe_one, fe_parity, fe_pow_p58,
-    fe_sq, fe_sub, fe_zero, int_from_limbs, limbs_from_int,
+    NLIMBS, LIMB_BITS, LIMB_MASK, P, _bcast, fe_add, fe_carry, fe_eq,
+    fe_freeze, fe_is_zero, fe_mul, fe_mul_small, fe_neg, fe_one, fe_parity,
+    fe_pow_p58, fe_sq, fe_sub, fe_zero, int_from_limbs, limbs_from_int,
 )
 
 # --- curve constants (python ints) ----------------------------------------
@@ -177,11 +184,14 @@ def fixed_table() -> np.ndarray:
     return _FIXED_TABLE
 
 
-# --- jax point ops (points = (X, Y, Z, T) stacked as (..., 4, 20)) ---------
+# --- jax point ops: points are (x, y, z, t) tuples of (20, ...) limbs ------
 
-def pt_identity(batch_shape=()) -> jnp.ndarray:
-    return jnp.stack([fe_zero(batch_shape), fe_one(batch_shape),
-                      fe_one(batch_shape), fe_zero(batch_shape)], axis=-2)
+Point = tuple  # (x, y, z, t)
+
+
+def pt_identity(batch_shape=()) -> Point:
+    return (fe_zero(batch_shape), fe_one(batch_shape),
+            fe_one(batch_shape), fe_zero(batch_shape))
 
 
 _D2_LIMBS = limbs_from_int(D2)
@@ -189,30 +199,28 @@ _SQRT_M1_LIMBS = limbs_from_int(SQRT_M1)
 _D_LIMBS = limbs_from_int(D)
 
 
-def pt_add(p: jnp.ndarray, q: jnp.ndarray) -> jnp.ndarray:
+def pt_add(p: Point, q: Point) -> Point:
     """Unified a=−1 extended addition (add-2008-hwcd-3)."""
-    x1, y1, z1, t1 = p[..., 0, :], p[..., 1, :], p[..., 2, :], p[..., 3, :]
-    x2, y2, z2, t2 = q[..., 0, :], q[..., 1, :], q[..., 2, :], q[..., 3, :]
+    x1, y1, z1, t1 = p
+    x2, y2, z2, t2 = q
     a = fe_mul(fe_sub(y1, x1), fe_sub(y2, x2))
     b = fe_mul(fe_add(y1, x1), fe_add(y2, x2))
-    c = fe_mul(fe_mul(t1, jnp.asarray(_D2_LIMBS)), t2)
+    c = fe_mul(fe_mul(t1, _bcast(_D2_LIMBS, t1)), t2)
     d = fe_mul_small(fe_mul(z1, z2), 2)
     e = fe_sub(b, a)
     f = fe_sub(d, c)
     g = fe_add(d, c)
     h = fe_add(b, a)
-    return jnp.stack([fe_mul(e, f), fe_mul(g, h),
-                      fe_mul(f, g), fe_mul(e, h)], axis=-2)
+    return (fe_mul(e, f), fe_mul(g, h), fe_mul(f, g), fe_mul(e, h))
 
 
-def pt_add_folded(p: jnp.ndarray, q: jnp.ndarray,
-                  need_t: bool = False) -> jnp.ndarray:
-    """Extended add where q's T row is pre-multiplied by 2d (table form).
-    Ladder adds feed doublings, which never read T, so by default the
-    output T (the e·h multiply) is skipped; the final window add passes
-    need_t=True because the fixed-base Niels chain reads it."""
-    x1, y1, z1, t1 = p[..., 0, :], p[..., 1, :], p[..., 2, :], p[..., 3, :]
-    x2, y2, z2, t2d = q[..., 0, :], q[..., 1, :], q[..., 2, :], q[..., 3, :]
+def pt_add_folded(p: Point, q: Point, need_t: bool = False) -> Point:
+    """Extended add where q's T coordinate is pre-multiplied by 2d (table
+    form). Ladder adds feed doublings, which never read T, so by default
+    the output T (the e·h multiply) is skipped; the final window add
+    passes need_t=True because the fixed-base Niels chain reads it."""
+    x1, y1, z1, t1 = p
+    x2, y2, z2, t2d = q
     a = fe_mul(fe_sub(y1, x1), fe_sub(y2, x2))
     b = fe_mul(fe_add(y1, x1), fe_add(y2, x2))
     c = fe_mul(t1, t2d)
@@ -221,15 +229,14 @@ def pt_add_folded(p: jnp.ndarray, q: jnp.ndarray,
     f = fe_sub(d, c)
     g = fe_add(d, c)
     h = fe_add(b, a)
-    t = fe_mul(e, h) if need_t else fe_zero(x1.shape[:-1])
-    return jnp.stack([fe_mul(e, f), fe_mul(g, h),
-                      fe_mul(f, g), t], axis=-2)
+    t = fe_mul(e, h) if need_t else fe_zero(x1.shape[1:])
+    return (fe_mul(e, f), fe_mul(g, h), fe_mul(f, g), t)
 
 
-def pt_add_niels(p: jnp.ndarray, n: jnp.ndarray) -> jnp.ndarray:
+def pt_add_niels(p: Point, n: tuple) -> Point:
     """Mixed addition with a precomputed Niels point (y+x, y−x, 2dxy)."""
-    x1, y1, z1, t1 = p[..., 0, :], p[..., 1, :], p[..., 2, :], p[..., 3, :]
-    ypx, ymx, xy2d = n[..., 0, :], n[..., 1, :], n[..., 2, :]
+    x1, y1, z1, t1 = p
+    ypx, ymx, xy2d = n
     a = fe_mul(fe_sub(y1, x1), ymx)
     b = fe_mul(fe_add(y1, x1), ypx)
     c = fe_mul(t1, xy2d)
@@ -238,16 +245,15 @@ def pt_add_niels(p: jnp.ndarray, n: jnp.ndarray) -> jnp.ndarray:
     f = fe_sub(d, c)
     g = fe_add(d, c)
     h = fe_add(b, a)
-    return jnp.stack([fe_mul(e, f), fe_mul(g, h),
-                      fe_mul(f, g), fe_mul(e, h)], axis=-2)
+    return (fe_mul(e, f), fe_mul(g, h), fe_mul(f, g), fe_mul(e, h))
 
 
-def pt_dbl(p: jnp.ndarray, need_t: bool = True) -> jnp.ndarray:
+def pt_dbl(p: Point, need_t: bool = True) -> Point:
     """a=−1 extended doubling (dbl-2008-hwcd). Doubling never READS the
     T coordinate, so ladder doublings whose output feeds another doubling
     pass need_t=False and skip the e·h multiply (3 of every 4 ladder
-    steps)."""
-    x1, y1, z1 = p[..., 0, :], p[..., 1, :], p[..., 2, :]
+    steps). The four squarings use the symmetric half-product."""
+    x1, y1, z1, _ = p
     a = fe_sq(x1)
     b = fe_sq(y1)
     c = fe_mul_small(fe_sq(z1), 2)
@@ -255,14 +261,13 @@ def pt_dbl(p: jnp.ndarray, need_t: bool = True) -> jnp.ndarray:
     e = fe_sub(h, fe_sq(fe_add(x1, y1)))
     g = fe_sub(a, b)
     f = fe_add(c, g)
-    t = fe_mul(e, h) if need_t else fe_zero(x1.shape[:-1])
-    return jnp.stack([fe_mul(e, f), fe_mul(g, h),
-                      fe_mul(f, g), t], axis=-2)
+    t = fe_mul(e, h) if need_t else fe_zero(x1.shape[1:])
+    return (fe_mul(e, f), fe_mul(g, h), fe_mul(f, g), t)
 
 
-def pt_neg(p: jnp.ndarray) -> jnp.ndarray:
-    return jnp.stack([fe_neg(p[..., 0, :]), p[..., 1, :],
-                      p[..., 2, :], fe_neg(p[..., 3, :])], axis=-2)
+def pt_neg(p: Point) -> Point:
+    x, y, z, t = p
+    return (fe_neg(x), y, z, fe_neg(t))
 
 
 def fe_decompress(y_limbs: jnp.ndarray, sign: jnp.ndarray):
@@ -271,44 +276,52 @@ def fe_decompress(y_limbs: jnp.ndarray, sign: jnp.ndarray):
     x = sqrt((y²−1)/(dy²+1)); multiply by sqrt(−1) when the first candidate
     fails; reject when neither squares to the target or x=0 with sign=1.
     """
-    one = fe_one(y_limbs.shape[:-1])
+    one = fe_one(y_limbs.shape[1:])
     y2 = fe_sq(y_limbs)
     u = fe_sub(y2, one)
-    v = fe_add(fe_mul(y2, jnp.asarray(_D_LIMBS)), one)
+    v = fe_add(fe_mul(y2, _bcast(_D_LIMBS, y2)), one)
     v3 = fe_mul(fe_sq(v), v)
     v7 = fe_mul(fe_sq(v3), v)
     x = fe_mul(fe_mul(u, v3), fe_pow_p58(fe_mul(u, v7)))
     vx2 = fe_mul(v, fe_sq(x))
     ok1 = fe_eq(vx2, u)
     ok2 = fe_eq(vx2, fe_neg(u))
-    x_alt = fe_mul(x, jnp.asarray(_SQRT_M1_LIMBS))
-    x = jnp.where(ok2[..., None] & ~ok1[..., None], x_alt, x)
+    x_alt = fe_mul(x, _bcast(_SQRT_M1_LIMBS, x))
+    x = jnp.where((ok2 & ~ok1)[None], x_alt, x)
     ok = ok1 | ok2
     x_is_zero = fe_is_zero(x)
     ok = ok & ~(x_is_zero & (sign == 1))
     # fix parity
     flip = (fe_parity(x) != sign)
-    x = jnp.where(flip[..., None], fe_neg(x), x)
+    x = jnp.where(flip[None], fe_neg(x), x)
     return x, ok
 
 
-def _select16(table: jnp.ndarray, nib: jnp.ndarray) -> jnp.ndarray:
-    """Constant-shape 16-way select: table (..., 16, K, 20), nib (...,).
+def _select16(stacks: tuple, nib: jnp.ndarray) -> tuple:
+    """Constant-shape 16-way select: each stack (16, 20, B), nib (B,).
     A masked sum instead of a gather — XLA fuses it into vector selects."""
-    oh = (jnp.arange(16, dtype=jnp.int32) ==
-          nib[..., None]).astype(jnp.int32)           # (..., 16)
-    return jnp.sum(table * oh[..., :, None, None], axis=-3)
+    oh = (jnp.arange(16, dtype=jnp.int32)[:, None] ==
+          nib[None, :]).astype(jnp.int32)             # (16, B)
+    ohc = oh[:, None, :]                              # (16, 1, B)
+    return tuple(jnp.sum(s * ohc, axis=0) for s in stacks)
 
 
 def verify_kernel(ay: jnp.ndarray, a_sign: jnp.ndarray,
                   ry: jnp.ndarray, r_sign: jnp.ndarray,
                   s_nibs: jnp.ndarray, k_nibs: jnp.ndarray) -> jnp.ndarray:
-    """Batched verify core. All inputs int32:
+    """Batched verify core. All inputs int32, batch-first (host layout):
     ay, ry: (B, 20) canonical y limbs; a_sign, r_sign: (B,);
     s_nibs, k_nibs: (B, 64) radix-16 digits of S (LSB-first) and
     k = SHA512(R‖A‖M) mod L (LSB-first). Returns (B,) bool.
+
+    Internally everything is limb-first (20, B) / digit-first (64, B); the
+    transposes below are the only layout shuffles in the whole kernel.
     """
-    batch = ay.shape[:-1]
+    ay = jnp.moveaxis(ay, -1, 0)
+    ry = jnp.moveaxis(ry, -1, 0)
+    s_nibs = jnp.moveaxis(s_nibs, -1, 0)
+    k_nibs = jnp.moveaxis(k_nibs, -1, 0)
+    batch = ay.shape[1:]
 
     ax, a_ok = fe_decompress(ay, a_sign)
     rx, r_ok = fe_decompress(ry, r_sign)
@@ -316,22 +329,22 @@ def verify_kernel(ay: jnp.ndarray, a_sign: jnp.ndarray,
     # A in extended coords, negated: Q = [S]B + [k](−A)
     neg_ax = fe_neg(ax)
     neg_at = fe_neg(fe_mul(ax, ay))
-    a_pt = jnp.stack([neg_ax, ay, fe_one(batch), neg_at], axis=-2)
+    a_pt = (neg_ax, ay, fe_one(batch), neg_at)
 
-    # per-item table of v·(−A), v = 0..15, extended coords: (B, 16, 4, 20);
-    # entry T is pre-multiplied by 2d so the ladder add does c = T1·(2d·T2)
-    # in ONE multiply (Niels-style T folding)
+    # per-item table of v·(−A), v = 0..15, extended coords; entry T is
+    # pre-multiplied by 2d so the ladder add does c = T1·(2d·T2) in ONE
+    # multiply (Niels-style T folding)
     entries = [pt_identity(batch), a_pt]
     for v in range(2, 16):
         if v % 2 == 0:
             entries.append(pt_dbl(entries[v // 2]))
         else:
             entries.append(pt_add(entries[v - 1], a_pt))
-    d2 = jnp.asarray(_D2_LIMBS)
-    folded = [jnp.concatenate(
-        [e[..., :3, :], fe_mul(e[..., 3, :], d2)[..., None, :]], axis=-2)
-        for e in entries]
-    a_table = jnp.stack(folded, axis=-3)
+    d2 = _bcast(_D2_LIMBS, ax)
+    a_table = tuple(
+        jnp.stack([e[c] if c < 3 else fe_mul(e[3], d2) for e in entries],
+                  axis=0)
+        for c in range(4))                       # 4 × (16, 20, B)
 
     # variable-base: MSB-first over 64 nibbles of k. The window add's T
     # output is never read (the next 4 doublings ignore T; the 4th
@@ -344,36 +357,38 @@ def verify_kernel(ay: jnp.ndarray, a_sign: jnp.ndarray,
         return pt_add_folded(q, _select16(a_table, nib), need_t=need_t)
 
     def vb_body(i, q):
-        return vb_window(q, k_nibs[..., 63 - i], False)
+        return vb_window(q, k_nibs[63 - i], False)
 
     q = jax.lax.fori_loop(0, 63, vb_body, pt_identity(batch))
     # final window peeled: its add DOES produce T, which the fixed-base
     # Niels chain below consumes
-    q = vb_window(q, k_nibs[..., 0], True)
+    q = vb_window(q, k_nibs[0], True)
 
     # fixed-base: Σ_j table[j][s_nib_j], 64 Niels additions, no doublings
-    ftab = jnp.asarray(fixed_table())  # (64, 16, 3, 20)
+    ftab = jnp.asarray(fixed_table())  # (64, 16, 3, 20) static
 
     def fb_body(j, acc):
         row = jax.lax.dynamic_index_in_dim(ftab, j, axis=0,
                                            keepdims=False)  # (16, 3, 20)
-        nib = s_nibs[..., j]
-        oh = (jnp.arange(16, dtype=jnp.int32) ==
-              nib[..., None]).astype(jnp.int32)
-        sel = jnp.sum(row * oh[..., :, None, None], axis=-3)
-        return pt_add_niels(acc, sel)
+        nib = s_nibs[j]                                     # (B,)
+        oh = (jnp.arange(16, dtype=jnp.int32)[:, None] ==
+              nib[None, :]).astype(jnp.int32)               # (16, B)
+        # (16, 3, 20, 1) * (16, 1, 1, B) summed over v → (3, 20, B)
+        sel = jnp.sum(row[..., None] * oh[:, None, None, :], axis=0)
+        return pt_add_niels(acc, (sel[0], sel[1], sel[2]))
 
     q = jax.lax.fori_loop(0, 64, fb_body, q)
 
     # projective compare with affine R: X == rx·Z and Y == ry·Z
-    xq, yq, zq = q[..., 0, :], q[..., 1, :], q[..., 2, :]
+    xq, yq, zq, _ = q
     eq = fe_eq(xq, fe_mul(rx, zq)) & fe_eq(yq, fe_mul(ry, zq))
     return a_ok & r_ok & eq
 
 
-# --- host-side batch preparation ------------------------------------------
+# --- host-side batch preparation (numpy-vectorized) ------------------------
 
-_BYTE_SHIFTS = None
+_L_BYTES_BE = np.frombuffer(L.to_bytes(32, "big"), np.uint8)
+_P_BYTES_BE = np.frombuffer(P.to_bytes(32, "big"), np.uint8)
 
 
 def bytes_to_limbs_np(b: np.ndarray) -> np.ndarray:
@@ -392,45 +407,82 @@ def bytes_to_limbs_np(b: np.ndarray) -> np.ndarray:
     return out.astype(np.int32)
 
 
-def scalar_to_nibs(s: int) -> np.ndarray:
-    return np.array([(s >> (4 * j)) & 15 for j in range(64)], np.int32)
+def bytes_to_nibs_np(b: np.ndarray) -> np.ndarray:
+    """(B, 32) uint8 → (B, 64) int32 radix-16 digits, LSB-first."""
+    lo = (b & 15).astype(np.int32)
+    hi = (b >> 4).astype(np.int32)
+    return np.stack([lo, hi], axis=-1).reshape(*b.shape[:-1], 64)
+
+
+def _lex_lt_be(a: np.ndarray, bound_be: np.ndarray) -> np.ndarray:
+    """Vectorized big-endian lexicographic a < bound over (B, 32) uint8."""
+    diff = a != bound_be[None, :]
+    first = np.argmax(diff, axis=-1)
+    rows = np.arange(a.shape[0])
+    return np.where(diff.any(axis=-1),
+                    a[rows, first] < bound_be[first], False)
+
+
+def _pack32(items, n: int, width: int) -> np.ndarray:
+    """List of bytes → (n, width) uint8, zero-filling wrong-length items
+    and normalizing the list length to n (short lists pad with invalid
+    zero rows; callers mark those pre_ok=False via the length check)."""
+    items = list(items[:n]) + [b""] * (n - len(items))
+    blob = b"".join(x if len(x) == width else b"\x00" * width for x in items)
+    return np.frombuffer(blob, np.uint8).reshape(n, width)
 
 
 def prepare_batch(pubs: list[bytes], sigs: list[bytes],
                   msgs: list[bytes]) -> dict:
     """Host preprocessing: hashing, canonicality prechecks, bit-slicing.
-    Returns device-ready int32 arrays + a host-side precheck mask."""
+    Returns device-ready int32 arrays + a host-side precheck mask.
+
+    Everything is numpy-vectorized across the batch except the per-item
+    SHA-512 + 512-bit mod L (hashlib/CPython bignum — C speed, ~1.5 µs
+    per item; at the 100K sigs/s north star this is ~15% of one core,
+    and it overlaps the device batch in the async backend)."""
     n = len(pubs)
-    ay = np.zeros((n, 32), np.uint8)
-    ry = np.zeros((n, 32), np.uint8)
-    a_sign = np.zeros(n, np.int32)
-    r_sign = np.zeros(n, np.int32)
-    s_nibs = np.zeros((n, 64), np.int32)
-    k_nibs = np.zeros((n, 64), np.int32)
-    pre_ok = np.zeros(n, bool)
-    for i, (pub, sig, msg) in enumerate(zip(pubs, sigs, msgs)):
-        if len(pub) != 32 or len(sig) != 64:
+    good = np.zeros(n, bool)
+    for i in range(min(n, len(sigs), len(msgs))):
+        good[i] = len(pubs[i]) == 32 and len(sigs[i]) == 64
+    msgs = list(msgs[:n]) + [b""] * (n - len(msgs))
+    pub_arr = _pack32(pubs, n, 32)
+    sig_arr = _pack32(sigs, n, 64)
+    r_arr = sig_arr[:, :32]
+    s_arr = sig_arr[:, 32:]
+
+    a_sign = (pub_arr[:, 31] >> 7).astype(np.int32)
+    r_sign = (r_arr[:, 31] >> 7).astype(np.int32)
+    ay = pub_arr.copy()
+    ay[:, 31] &= 0x7F
+    ry = r_arr.copy()
+    ry[:, 31] &= 0x7F
+
+    # canonicality prechecks, big-endian lexicographic compare
+    s_ok = _lex_lt_be(s_arr[:, ::-1], _L_BYTES_BE)
+    ay_ok = _lex_lt_be(ay[:, ::-1], _P_BYTES_BE)
+    ry_ok = _lex_lt_be(ry[:, ::-1], _P_BYTES_BE)
+    pre_ok = good & s_ok & ay_ok & ry_ok
+
+    # k = SHA512(R‖A‖M) mod L — the only per-item loop
+    k_bytes = bytearray(32 * n)
+    for i in range(n):
+        if not pre_ok[i]:
             continue
-        s = int.from_bytes(sig[32:], "little")
-        ayi = int.from_bytes(pub, "little")
-        ryi = int.from_bytes(sig[:32], "little")
-        a_sign[i], ayv = ayi >> 255, ayi & ((1 << 255) - 1)
-        r_sign[i], ryv = ryi >> 255, ryi & ((1 << 255) - 1)
-        if s >= L or ayv >= P or ryv >= P:
-            continue
-        pre_ok[i] = True
-        ay[i] = np.frombuffer(
-            ayv.to_bytes(32, "little"), np.uint8)
-        ry[i] = np.frombuffer(
-            ryv.to_bytes(32, "little"), np.uint8)
-        s_nibs[i] = scalar_to_nibs(s)
-        k = int.from_bytes(
-            hashlib.sha512(sig[:32] + pub + msg).digest(), "little") % L
-        k_nibs[i] = scalar_to_nibs(k)
+        h = hashlib.sha512(
+            sig_arr[i, :32].tobytes() + pub_arr[i].tobytes() +
+            msgs[i]).digest()
+        k = int.from_bytes(h, "little") % L
+        k_bytes[32 * i:32 * i + 32] = k.to_bytes(32, "little")
+    k_arr = np.frombuffer(bytes(k_bytes), np.uint8).reshape(n, 32)
+
+    zero_bad = pre_ok[:, None].astype(np.uint8)
     return {
-        "ay": bytes_to_limbs_np(ay), "a_sign": a_sign,
-        "ry": bytes_to_limbs_np(ry), "r_sign": r_sign,
-        "s_nibs": s_nibs, "k_nibs": k_nibs, "pre_ok": pre_ok,
+        "ay": bytes_to_limbs_np(ay * zero_bad), "a_sign": a_sign,
+        "ry": bytes_to_limbs_np(ry * zero_bad), "r_sign": r_sign,
+        "s_nibs": bytes_to_nibs_np(s_arr * zero_bad),
+        "k_nibs": bytes_to_nibs_np(k_arr),
+        "pre_ok": pre_ok,
     }
 
 

@@ -3,18 +3,26 @@
 TPU-first design notes (this is the compute plane of the batched ed25519
 verifier; see SURVEY.md §2.2 "batch-verify service"):
 
+- LAYOUT: limbs on the LEADING axis, batch on the TRAILING axes — a field
+  element batch is (20, B). TPU vector registers are (8 sublanes, 128
+  lanes) tiled over the two minor dims; with the batch minormost, every
+  elementwise op runs at full lane utilization. (The previous (B, 20)
+  layout padded the 20-limb axis to 128 lanes — ~16% utilization — and
+  was the round-2 bottleneck: 17.9K sigs/s vs the 100K target.)
 - No 64-bit integers: TPUs have no native s64, so a field element is 20
-  limbs of radix 2^13 held in int32 (shape (..., 20)). 13-bit limbs keep
-  every product < 2^26 and every 20-term column sum < 2^31, so schoolbook
+  limbs of radix 2^13 held in int32. 13-bit limbs keep every product
+  < 2^26 and every 20-term column sum < 2^31, so schoolbook
   multiplication accumulates safely in int32.
-- Multiplication lowers to: one broadcast outer product (..., 20, 20), a
-  static gather that re-indexes b into a shifted (20, 39) matrix, and one
-  reduction — three fused vector ops instead of 400 scalar MACs, which is
-  what XLA tiles well.
+- Multiplication is 20 shifted partial products summed into 39 columns —
+  per limb one (20, B)·broadcast multiply plus a zero-pad, all fusable
+  into a single vector loop by XLA (no gather, no (B, 20, 39) blowup).
+- Squaring uses the symmetric half-product: 210 column terms instead of
+  400 (diagonal + doubled upper triangle). The scalar-mult ladder and the
+  sqrt/inversion addition chains are ~70% squarings, so this matters.
 - Carries are PARALLEL, not sequential: k rounds of (mask, shift, add)
   bound limbs at 2^13 + eps rather than fully normalizing. The invariant
-  maintained between ops is limbs <= LIMB_BOUND (9500); a full sequential
-  normalization (`fe_freeze`) happens only at equality checks.
+  maintained between ops is limbs <= LIMB_BOUND (10100); a full
+  sequential normalization (`fe_freeze`) happens only at equality checks.
 - The wrap at 2^260: limb 20 would carry weight 2^260 ≡ 19·2^5 = 608
   (mod p), so high columns fold back with a multiply by 608.
 
@@ -35,11 +43,14 @@ FOLD = 19 * 32  # 2^260 ≡ 19·2^5 (mod p)
 LIMB_BOUND = 10100  # loose per-limb bound maintained between ops
 # Bound audit (every op must keep limbs <= LIMB_BOUND and intermediate
 # column sums < 2^31):
-#   columns:      20 * 10100^2            = 2.04e9  < 2^31 (5% margin)
+#   mul columns:  20 * 10100^2            = 2.04e9  < 2^31 (5% margin)
+#   sq columns:   diagonal a_i^2 plus doubled pairs 2·a_i·a_j — the same
+#                 value as the 20x20 ordered sum, so the same 2.04e9 bound;
+#                 each doubled term 2·10100^2 = 2.04e8 < 2^31
 #   fe_sub/neg:   10100 + 16382           = 26482; 1 carry round ->
 #                 8191 + 3 + 3*608        = 10015  <= LIMB_BOUND
 #   fe_add/x2:    2*10100 = 20200; 1 round -> 8191 + 2 + 2*608 = 9409
-#   fe_mul tail:  post-round cols <= 2.57e5; fold <= 1.57e8; two carry
+#   mul/sq tail:  post-round cols <= 2.57e5; fold <= 1.57e8; two carry
 #                 rounds -> <= 10015
 
 P = 2**255 - 19
@@ -48,13 +59,6 @@ P = 2**255 - 19
 # non-negative limb-wise whenever b's limbs are within bound.
 # 32p = 2^260 - 608 = [8192-608, 8191, ..., 8191]; doubled below.
 _K64P_NP = np.array([2 * (8192 - 608)] + [2 * 8191] * 19, np.int32)
-
-# index matrix for the shifted-b gather: PAD_IDX[i, k] = k - i where valid,
-# else 20 — pointing at a zero limb appended to b, so no mask multiply is
-# needed (the old mask cost one extra vector multiply per product term).
-_idx = np.arange(39)[None, :] - np.arange(NLIMBS)[:, None]
-PAD_IDX_NP = np.where((_idx >= 0) & (_idx < NLIMBS),
-                      np.clip(_idx, 0, NLIMBS - 1), NLIMBS).astype(np.int32)
 
 
 def limbs_from_int(x: int) -> np.ndarray:
@@ -66,15 +70,19 @@ def limbs_from_int(x: int) -> np.ndarray:
 
 def int_from_limbs(a) -> int:
     a = np.asarray(a)
-    return sum(int(a[..., i]) << (LIMB_BITS * i) for i in range(NLIMBS))
+    return sum(int(a[i, ...]) << (LIMB_BITS * i) for i in range(NLIMBS))
+
+
+def _bcast(v: np.ndarray, like: jnp.ndarray) -> jnp.ndarray:
+    """Static (20,) limb vector broadcast against (20, ...batch)."""
+    return jnp.asarray(v).reshape((NLIMBS,) + (1,) * (like.ndim - 1))
 
 
 def _carry_round_20(c: jnp.ndarray) -> jnp.ndarray:
     """One parallel carry round over 20 limbs with top fold (2^260 wrap)."""
     lo = c & LIMB_MASK
     hi = c >> LIMB_BITS
-    wrapped = jnp.concatenate(
-        [hi[..., 19:20] * FOLD, hi[..., :19]], axis=-1)
+    wrapped = jnp.concatenate([hi[19:20] * FOLD, hi[:19]], axis=0)
     return lo + wrapped
 
 
@@ -89,13 +97,11 @@ def fe_add(a: jnp.ndarray, b: jnp.ndarray) -> jnp.ndarray:
 
 
 def fe_sub(a: jnp.ndarray, b: jnp.ndarray) -> jnp.ndarray:
-    k = jnp.asarray(_K64P_NP)
-    return fe_carry(a + k - b, rounds=1)
+    return fe_carry(a + _bcast(_K64P_NP, a) - b, rounds=1)
 
 
 def fe_neg(a: jnp.ndarray) -> jnp.ndarray:
-    k = jnp.asarray(_K64P_NP)
-    return fe_carry(k - a, rounds=1)
+    return fe_carry(_bcast(_K64P_NP, a) - a, rounds=1)
 
 
 def fe_mul_small(a: jnp.ndarray, c: int) -> jnp.ndarray:
@@ -105,42 +111,65 @@ def fe_mul_small(a: jnp.ndarray, c: int) -> jnp.ndarray:
     return fe_carry(a * c, rounds=1)
 
 
-def _columns(a: jnp.ndarray, b: jnp.ndarray) -> jnp.ndarray:
-    """Polynomial product columns: (..., 39) with col k = Σ_{i+j=k} a_i·b_j."""
-    bpad = jnp.concatenate([b, jnp.zeros_like(b[..., :1])], axis=-1)
-    bmat = bpad[..., jnp.asarray(PAD_IDX_NP)]       # (..., 20, 39), no mask
-    return jnp.sum(a[..., :, None] * bmat, axis=-2)
+def _pad39(p: jnp.ndarray, lo: int) -> jnp.ndarray:
+    """Place a (k, ...) strip at column offset `lo` inside (39, ...)."""
+    hi = 39 - lo - p.shape[0]
+    return jnp.pad(p, ((lo, hi),) + ((0, 0),) * (p.ndim - 1))
 
 
-def fe_mul(a: jnp.ndarray, b: jnp.ndarray) -> jnp.ndarray:
-    c = _columns(a, b)                                     # (..., 39) < 2^31
-    # ONE parallel carry round, widening to 40 columns (carry out of col 38
-    # lands in col 39; cols now <= 2^13 + 2^31>>13 ~ 2.6e5, so the fold
-    # below stays in int32: 2.6e5 * (1+608) ~ 1.6e8)
+def _columns_mul(a: jnp.ndarray, b: jnp.ndarray) -> jnp.ndarray:
+    """Product columns c[k] = Σ_{i+j=k} a_i·b_j as (39, ...): 20 shifted
+    broadcast partial products, summed. All terms < 2^31 (bound audit)."""
+    terms = [_pad39(a[i][None] * b, i) for i in range(NLIMBS)]
+    return sum(terms)
+
+
+def _columns_sq(a: jnp.ndarray) -> jnp.ndarray:
+    """Squaring columns via symmetry: diagonal a_i² at column 2i plus
+    doubled upper-triangle strips — 210 products instead of 400."""
+    diag = a * a                                   # (20, ...) at cols 0,2,..38
+    z = jnp.zeros_like(diag)
+    inter = jnp.stack([diag, z], axis=1).reshape(
+        (2 * NLIMBS,) + a.shape[1:])[:39]          # interleave with zeros
+    terms = [inter]
+    for i in range(NLIMBS - 1):
+        strip = (a[i] * 2)[None] * a[i + 1:]       # cols 2i+1 .. i+19
+        terms.append(_pad39(strip, 2 * i + 1))
+    return sum(terms)
+
+
+def _reduce39(c: jnp.ndarray) -> jnp.ndarray:
+    """Columns (39, ...) → field element: one widening carry round (cols
+    drop to <= 2^13 + 2^31>>13 ~ 2.6e5, so the 608-fold stays in int32:
+    2.6e5 * 609 ~ 1.6e8), fold the high 20 columns (2^(260+13j) ≡ 608·2^13j
+    mod p; col 39 starts at zero so a single round leaves no 2^520 wrap),
+    then two parallel carry rounds."""
     lo = c & LIMB_MASK
     hi = c >> LIMB_BITS
-    z1 = jnp.zeros_like(c[..., :1])
-    c = jnp.concatenate([lo, z1], axis=-1) + \
-        jnp.concatenate([z1, hi], axis=-1)
-    # fold the high 20 columns: 2^(260+13j) ≡ 608·2^13j (mod p); col 39's
-    # fold (608·2^247... i.e. j=19) is exact — no 2^520 wrap survives a
-    # single round because col 39 starts at zero
-    low = c[..., :NLIMBS] + FOLD * c[..., NLIMBS:]
+    z1 = jnp.zeros_like(c[:1])
+    c = jnp.concatenate([lo, z1], axis=0) + jnp.concatenate([z1, hi], axis=0)
+    low = c[:NLIMBS] + FOLD * c[NLIMBS:]
     return fe_carry(low, rounds=2)
 
 
+def fe_mul(a: jnp.ndarray, b: jnp.ndarray) -> jnp.ndarray:
+    return _reduce39(_columns_mul(a, b))
+
+
 def fe_sq(a: jnp.ndarray) -> jnp.ndarray:
-    return fe_mul(a, a)
+    return _reduce39(_columns_sq(a))
 
 
 def fe_one(batch_shape=()) -> jnp.ndarray:
     one = np.zeros(NLIMBS, np.int32)
     one[0] = 1
-    return jnp.broadcast_to(jnp.asarray(one), (*batch_shape, NLIMBS))
+    return jnp.broadcast_to(
+        jnp.asarray(one).reshape((NLIMBS,) + (1,) * len(batch_shape)),
+        (NLIMBS, *batch_shape))
 
 
 def fe_zero(batch_shape=()) -> jnp.ndarray:
-    return jnp.zeros((*batch_shape, NLIMBS), jnp.int32)
+    return jnp.zeros((NLIMBS, *batch_shape), jnp.int32)
 
 
 def fe_pow(x: jnp.ndarray, exp_bits_msb_first) -> jnp.ndarray:
@@ -193,40 +222,40 @@ def fe_freeze(a: jnp.ndarray) -> jnp.ndarray:
     # 1) exact sequential carry over 20 limbs, folding the top twice
     def seq_carry(v):
         limbs = []
-        carry = jnp.zeros_like(v[..., 0])
+        carry = jnp.zeros_like(v[0])
         for i in range(NLIMBS):
-            t = v[..., i] + carry
+            t = v[i] + carry
             limbs.append(t & LIMB_MASK)
             carry = t >> LIMB_BITS
-        return jnp.stack(limbs, axis=-1), carry
+        return jnp.stack(limbs, axis=0), carry
 
     v, c = seq_carry(a)
-    v = v.at[..., 0].add(c * FOLD)
+    v = v.at[0].add(c * FOLD)
     v, c = seq_carry(v)  # c == 0 now; value < 2^260
     # 2) fold bits 255..259: hi = limb19 >> 8, v mod 2^255 + 19*hi
     for _ in range(2):
-        hi = v[..., 19] >> 8
-        v = v.at[..., 19].set(v[..., 19] & 0xFF)
-        v = v.at[..., 0].add(19 * hi)
+        hi = v[19] >> 8
+        v = v.at[19].set(v[19] & 0xFF)
+        v = v.at[0].add(19 * hi)
         v, _ = seq_carry(v)
     # 3) value < 2^255 + eps; conditional subtract p via the +19 trick:
     #    v >= p  <=>  v + 19 >= 2^255
-    t = v.at[..., 0].add(19)
+    t = v.at[0].add(19)
     t, _ = seq_carry(t)
-    ge = (t[..., 19] >> 8) > 0
-    t = t.at[..., 19].set(t[..., 19] & 0xFF)
-    return jnp.where(ge[..., None], t, v)
+    ge = (t[19] >> 8) > 0
+    t = t.at[19].set(t[19] & 0xFF)
+    return jnp.where(ge[None], t, v)
 
 
 def fe_eq(a: jnp.ndarray, b: jnp.ndarray) -> jnp.ndarray:
     """Constant-shape equality over the canonical forms: (...,) bool."""
-    return jnp.all(fe_freeze(a) == fe_freeze(b), axis=-1)
+    return jnp.all(fe_freeze(a) == fe_freeze(b), axis=0)
 
 
 def fe_is_zero(a: jnp.ndarray) -> jnp.ndarray:
-    return jnp.all(fe_freeze(a) == 0, axis=-1)
+    return jnp.all(fe_freeze(a) == 0, axis=0)
 
 
 def fe_parity(a: jnp.ndarray) -> jnp.ndarray:
     """Low bit of the canonical representative."""
-    return fe_freeze(a)[..., 0] & 1
+    return fe_freeze(a)[0] & 1
