@@ -86,6 +86,96 @@ def device_bench(batch: int = 8192, iters: int = 10) -> dict:
             "init_s": round(init_s, 2), "compile_s": round(compile_s, 2)}
 
 
+def replay_bench(backend: str, n_checkpoints: int = 4,
+                 txs_per_ledger: int = 48) -> dict:
+    """Catchup-replay benchmark: the second north-star metric
+    (BASELINE.md: >=5x pubnet replay vs libsodium CPU; reference
+    methodology /root/reference/performance-eval/performance-eval.md:52-66).
+
+    Publishes a dense synthetic history (txs_per_ledger payments per
+    ledger) to a tmpdir file archive, then times a fresh node replaying it
+    with the given SIG_VERIFY_BACKEND. Runs in a child process."""
+    import shutil
+    import tempfile
+
+    from stellar_core_tpu.crypto import keys as _keys
+    from stellar_core_tpu.catchup.catchup_work import CatchupConfiguration
+    from stellar_core_tpu.history.archive import HistoryArchive
+    from stellar_core_tpu.main.application import Application
+    from stellar_core_tpu.main.config import Config
+    from stellar_core_tpu.testing import AppLedgerAdapter
+    from stellar_core_tpu.util.timer import ClockMode, VirtualClock
+    from stellar_core_tpu.work.basic_work import State
+
+    freq = 8
+    tmp = tempfile.mkdtemp(prefix="sct-replay-")
+    try:
+        archive_root = os.path.join(tmp, "archive")
+        os.makedirs(archive_root, exist_ok=True)
+
+        def make_app(n, writable, be):
+            cfg = Config.test_config(n)
+            cfg.DATABASE = "sqlite3://:memory:"
+            cfg.CHECKPOINT_FREQUENCY = freq
+            cfg.SIG_VERIFY_BACKEND = be
+            arch = HistoryArchive.local_dir("bench", archive_root)
+            d = {"get": arch.get_tmpl, "mkdir": arch.mkdir_tmpl}
+            if writable:
+                d["put"] = arch.put_tmpl
+            cfg.HISTORY = {"bench": d}
+            app = Application(VirtualClock(ClockMode.VIRTUAL_TIME), cfg)
+            app.enable_buckets(os.path.join(tmp, "buckets-%d" % n))
+            app.start()
+            return app
+
+        # --- publish a dense history (cpu backend; cost excluded) ---------
+        pub = make_app(0, True, "cpu")
+        adapter = AppLedgerAdapter(pub)
+        root = adapter.root_account()
+        senders = [root.create(10**10) for _ in range(txs_per_ledger)]
+        tip = n_checkpoints * freq - 1
+        while pub.ledger_manager.last_closed_ledger_num() < tip:
+            for s in senders:
+                pub.submit_transaction(
+                    s.tx([s.op_payment(root.account_id, 1000)]))
+            pub.manual_close()
+        pub.crank_until(
+            lambda: pub.history_manager.publish_queue() == [],
+            max_cranks=20000)
+        assert pub.history_manager.published_checkpoints >= n_checkpoints
+
+        # --- replay it with the target backend ----------------------------
+        with _keys._cache_lock:
+            _keys._verify_cache.clear()   # publish filled the result cache
+        app = make_app(1, False, backend)
+        v = getattr(app, "sig_verifier", None)
+        inner = getattr(v, "inner", v)
+        if hasattr(inner, "BUCKETS"):
+            # one bucket shape: a checkpoint of this history is ~8 sigs,
+            # and each extra bucket costs a kernel compile at warmup
+            inner.BUCKETS = (1024,)
+        if v is not None and hasattr(v, "warmup"):
+            v.warmup(wait=True)           # compile off the clock
+        work = app.catchup_manager.start_catchup(
+            CatchupConfiguration.complete())
+        t0 = time.perf_counter()
+        for _ in range(10**7):
+            if work.is_done():
+                break
+            app.crank(False)
+        wall = time.perf_counter() - t0
+        assert work.state == State.SUCCESS, "catchup replay failed"
+        got = app.ledger_manager.last_closed_ledger_num()
+        assert got == tip, (got, tip)
+        n_txs = (tip - 1) * txs_per_ledger
+        return {"backend": backend, "ledgers": tip, "wall_s": round(wall, 3),
+                "ledgers_per_sec": round(tip / wall, 2),
+                "txs_per_sec": round(n_txs / wall, 1),
+                "txs_per_ledger": txs_per_ledger}
+    finally:
+        shutil.rmtree(tmp, ignore_errors=True)
+
+
 def _scrubbed_cpu_env() -> dict:
     # single source of truth for the axon-env scrub lives in __graft_entry__
     from __graft_entry__ import _scrubbed_env
@@ -106,17 +196,29 @@ def _spawn_child(env: dict, batch: int, iters: int) -> subprocess.Popen:
         stdout=subprocess.PIPE, stderr=subprocess.PIPE, text=True)
 
 
-def _harvest(proc: subprocess.Popen) -> tuple:
+def _harvest(proc: subprocess.Popen, prefix: str = "BENCH_JSON") -> tuple:
     """(result_dict | None, error_str | None); proc must have exited."""
     out, err_txt = proc.communicate()
     if proc.returncode != 0:
         return None, ("rc=%d: %s" % (proc.returncode,
                                      err_txt.strip()[-600:]))
     for line in out.splitlines():
-        if line.startswith("BENCH_JSON "):
-            return json.loads(line[len("BENCH_JSON "):]), None
-    return None, "no BENCH_JSON line in child output: %s" % (
-        out.strip()[-300:])
+        if line.startswith(prefix + " "):
+            return json.loads(line[len(prefix) + 1:]), None
+    return None, "no %s line in child output: %s" % (
+        prefix, out.strip()[-300:])
+
+
+def _spawn_replay(env: dict, backend: str) -> subprocess.Popen:
+    code = ("import bench, json; "
+            "print('REPLAY_JSON ' + json.dumps("
+            "bench.replay_bench(%r)))" % backend)
+    env = dict(env)
+    env.setdefault("JAX_COMPILATION_CACHE_DIR",
+                   os.path.join(_REPO, ".jax_cache"))
+    return subprocess.Popen(
+        [sys.executable, "-c", code], cwd=_REPO, env=env,
+        stdout=subprocess.PIPE, stderr=subprocess.PIPE, text=True)
 
 
 def openssl_backend_rate(n: int = 4000) -> float:
@@ -197,6 +299,37 @@ def main() -> None:
         out["value"] = round(rate, 1)
         out["vs_baseline"] = round(rate / cpu, 3)
         out["platform"] = "openssl-fallback"
+    # --- second north star: catchup-replay speedup (tpu vs cpu backend) ---
+    # run SEQUENTIALLY: concurrent children contend for the same cores and
+    # contaminate the timed sections (the ratio is the metric)
+    tpu_env = dict(os.environ) if (res is not None and
+                                   res.get("platform") in ("tpu", "axon")) \
+        else _scrubbed_cpu_env()
+    rep_cpu = rep_tpu = None
+    rep_deadline = time.time() + 420
+    for tag, env_r in (("cpu", _scrubbed_cpu_env()), ("tpu", tpu_env)):
+        if time.time() >= rep_deadline:
+            errors.setdefault("replay", "deadline before %s run" % tag)
+            break
+        proc = _spawn_replay(env_r, tag)
+        while time.time() < rep_deadline and proc.poll() is None:
+            time.sleep(1.0)
+        if proc.poll() is None:
+            proc.kill()
+            errors["replay_" + tag] = "killed at deadline"
+            continue
+        got, err = _harvest(proc, "REPLAY_JSON")
+        if err:
+            errors["replay_" + tag] = err
+        elif tag == "cpu":
+            rep_cpu = got
+        else:
+            rep_tpu = got
+    if rep_cpu is not None and rep_tpu is not None:
+        out["replay"] = {"cpu": rep_cpu, "tpu": rep_tpu}
+        out["replay_speedup"] = round(
+            rep_tpu["ledgers_per_sec"] / rep_cpu["ledgers_per_sec"], 3)
+
     if errors:
         out["errors"] = errors
     print(json.dumps(out))

@@ -72,10 +72,10 @@ class BallotProtocol:
         self.h: Optional[Ballot] = None          # high
         self.value_override: Optional[bytes] = None
         self.latest_envelopes: Dict[bytes, SCPEnvelope] = {}
-        self.last_stmt_xdr: Optional[bytes] = None
+        self.last_envelope: Optional[SCPEnvelope] = None
+        self.last_envelope_emit: Optional[SCPEnvelope] = None
         self.heard_from_quorum = False
         self.current_message_level = 0
-        self.timer_counter = 0
 
     # ------------------------------------------------------------------ util
     def _driver(self):
@@ -118,9 +118,8 @@ class BallotProtocol:
             return True
         if t == SCPStatementType.SCP_ST_CONFIRM:
             c = st.pledges.value
-            b = _bt(c.ballot)
-            return (b[0] > 0 and c.nH <= c.nPrepared and
-                    0 < c.nCommit <= c.nH <= b[0])
+            return (c.ballot.counter > 0 and c.nH <= c.ballot.counter and
+                    c.nCommit <= c.nH)
         if t == SCPStatementType.SCP_ST_EXTERNALIZE:
             e = st.pledges.value
             return e.commit.counter > 0 and e.nH >= e.commit.counter
@@ -271,27 +270,19 @@ class BallotProtocol:
     # -------------------------------------------------------------- bumping
     def bump_state(self, value: bytes, force: bool = True,
                    counter: Optional[int] = None) -> bool:
-        if not force and self.b is not None:
+        """Move to ballot (counter, value) — reference bumpState. The value
+        is overridden by value_override once a confirmed-prepared /
+        accepted-commit value is locked in."""
+        if counter is None:
+            if not force and self.b is not None:
+                return False
+            counter = 1 if self.b is None else self.b[0] + 1
+        if self.phase not in (SCPPhase.PREPARE, SCPPhase.CONFIRM):
             return False
-        if self.phase != SCPPhase.PREPARE and \
-                self.phase != SCPPhase.CONFIRM:
-            return False
-        n = counter if counter is not None else (
-            1 if self.b is None else self.b[0] + 1)
-        if self.phase == SCPPhase.CONFIRM:
-            # value is locked in confirm phase
-            value = self.h[1]
-        target = (n, self.value_override
-                  if self.value_override is not None else
-                  (self.h[1] if self.h is not None else value))
-        if self.phase == SCPPhase.PREPARE and self.h is not None:
-            target = (n, self.h[1])
-        elif self.phase == SCPPhase.PREPARE:
-            target = (n, value)
-        updated = self._update_current_value(target)
+        new_b = (counter, self.value_override
+                 if self.value_override is not None else value)
+        updated = self._update_current_value(new_b)
         if updated:
-            self._driver().started_ballot_protocol(
-                self.slot.slot_index, _mk(self.b))
             self._emit_current_statement()
             self._check_heard_from_quorum()
         return updated
@@ -299,30 +290,40 @@ class BallotProtocol:
     def _update_current_value(self, ballot: Ballot) -> bool:
         if self.phase not in (SCPPhase.PREPARE, SCPPhase.CONFIRM):
             return False
+        updated = False
         if self.b is None:
-            ok = True
-        elif self.phase == SCPPhase.CONFIRM and \
-                not compatible(ballot, self.b):
-            return False
-        elif self.b > ballot:
-            return False
-        elif self.b == ballot:
-            return False
+            updated = True
         else:
-            ok = True
-        # commit guard: cannot change value while c is set
-        if self.c is not None and not compatible(ballot, self.c):
-            return False
+            # never change the value once committed to one
+            if self.c is not None and not compatible(self.c, ballot):
+                return False
+            if self.b < ballot:
+                updated = True
+            elif self.b > ballot:
+                return False  # never go backwards
+        if updated:
+            self._bump_to_ballot(ballot, True)
+        return updated
+
+    def _bump_to_ballot(self, ballot: Ballot, check: bool) -> None:
+        assert self.phase != SCPPhase.EXTERNALIZE
+        if check:
+            assert self.b is None or ballot >= self.b
+        got_bumped = self.b is None or self.b[0] != ballot[0]
+        if self.b is None:
+            self._driver().started_ballot_protocol(
+                self.slot.slot_index, _mk(ballot))
         self.b = ballot
-        return ok
+        if got_bumped:
+            # a new counter starts a new "heard from quorum" round
+            self.heard_from_quorum = False
 
     def abandon_ballot(self, n: int = 0) -> bool:
-        """Timer fired or externally poked: move to a higher counter with
+        """Timer fired or v-blocking ahead: move to a higher counter with
         the best known value (reference abandonBallot)."""
         v = self.slot.get_latest_composite_candidate()
-        if not v:
-            if self.b is not None:
-                v = self.b[1]
+        if not v and self.b is not None:
+            v = self.b[1]
         if not v:
             return False
         if n == 0:
@@ -331,162 +332,207 @@ class BallotProtocol:
 
     # ------------------------------------------------------- advance engine
     def advance_slot(self, hint: SCPStatement) -> None:
+        """One pass of the protocol steps, in whitepaper order. State
+        changes re-enter via self-processing in _emit_current_statement;
+        the emitted envelope is consolidated: only the LATEST statement is
+        sent, once, when the outermost advance pass unwinds (reference
+        advanceSlot/sendLatestEnvelope — this is why cascaded transitions
+        produce exactly one wire message)."""
         self.current_message_level += 1
         if self.current_message_level >= 50:
             raise RuntimeError("maximum number of transitions reached")
-        did = True
-        while did:
-            did = False
-            self._update_current_if_needed(hint)
-            if self.attempt_accept_prepared(hint):
-                did = True
-            if self.attempt_confirm_prepared(hint):
-                did = True
-            if self.attempt_accept_commit(hint):
-                did = True
-            if self.attempt_confirm_commit(hint):
-                did = True
+        did = self.attempt_accept_prepared(hint)
+        did = self.attempt_confirm_prepared(hint) or did
+        did = self.attempt_accept_commit(hint) or did
+        did = self.attempt_confirm_commit(hint) or did
         if self.current_message_level == 1:
-            # only check bump/quorum at the top of the reentrancy stack
-            self._attempt_bump()
+            did_bump = True
+            while did_bump:
+                did_bump = self._attempt_bump()
+                did = did_bump or did
             self._check_heard_from_quorum()
         self.current_message_level -= 1
+        if did:
+            self._send_latest_envelope()
 
-    def _update_current_if_needed(self, hint: SCPStatement) -> None:
-        if self.phase == SCPPhase.PREPARE and self.p is not None:
-            if self.b is None or self.b < self.p:
-                self._update_current_value(self.p)
+    # prepare candidates: ballots from the hint, intersected downward with
+    # everything nodes have claimed (reference getPrepareCandidates)
+    def _prepare_candidates(self, hint: SCPStatement) -> List[Ballot]:
+        hint_ballots: Set[Ballot] = set()
+        t = hint.pledges.disc
+        if t == SCPStatementType.SCP_ST_PREPARE:
+            p = hint.pledges.value
+            hint_ballots.add(_bt(p.ballot))
+            if p.prepared is not None:
+                hint_ballots.add(_bt(p.prepared))
+            if p.preparedPrime is not None:
+                hint_ballots.add(_bt(p.preparedPrime))
+        elif t == SCPStatementType.SCP_ST_CONFIRM:
+            c = hint.pledges.value
+            hint_ballots.add((c.nPrepared, c.ballot.value))
+            hint_ballots.add((UINT32_MAX, c.ballot.value))
+        else:
+            e = hint.pledges.value
+            hint_ballots.add((UINT32_MAX, e.commit.value))
 
-    # prepare candidates from all statements, descending
-    def _prepare_candidates(self) -> List[Ballot]:
         out: Set[Ballot] = set()
-        for env in self.latest_envelopes.values():
-            st = env.statement
-            t = st.pledges.disc
-            if t == SCPStatementType.SCP_ST_PREPARE:
-                p = st.pledges.value
-                if p.ballot.counter:
-                    out.add(_bt(p.ballot))
-                if p.prepared is not None:
-                    out.add(_bt(p.prepared))
-                if p.preparedPrime is not None:
-                    out.add(_bt(p.preparedPrime))
-            elif t == SCPStatementType.SCP_ST_CONFIRM:
-                c = st.pledges.value
-                out.add((c.nPrepared, c.ballot.value))
-                out.add((UINT32_MAX, c.ballot.value))
-            else:
-                e = st.pledges.value
-                out.add((UINT32_MAX, e.commit.value))
+        for top in hint_ballots:
+            val = top[1]
+            for env in self.latest_envelopes.values():
+                st = env.statement
+                tt = st.pledges.disc
+                if tt == SCPStatementType.SCP_ST_PREPARE:
+                    pp_ = st.pledges.value
+                    if less_and_compatible(_bt(pp_.ballot), top):
+                        out.add(_bt(pp_.ballot))
+                    if pp_.prepared is not None and \
+                            less_and_compatible(_bt(pp_.prepared), top):
+                        out.add(_bt(pp_.prepared))
+                    if pp_.preparedPrime is not None and \
+                            less_and_compatible(_bt(pp_.preparedPrime), top):
+                        out.add(_bt(pp_.preparedPrime))
+                elif tt == SCPStatementType.SCP_ST_CONFIRM:
+                    cc = st.pledges.value
+                    if compatible(top, _bt(cc.ballot)):
+                        out.add(top)
+                        if cc.nPrepared < top[0]:
+                            out.add((cc.nPrepared, val))
+                else:
+                    ee = st.pledges.value
+                    if compatible(top, _bt(ee.commit)):
+                        out.add(top)
         return sorted(out, reverse=True)
 
     def attempt_accept_prepared(self, hint: SCPStatement) -> bool:
-        if self.phase != SCPPhase.PREPARE and \
-                self.phase != SCPPhase.CONFIRM:
+        if self.phase not in (SCPPhase.PREPARE, SCPPhase.CONFIRM):
             return False
-        for cand in self._prepare_candidates():
+        for cand in self._prepare_candidates(hint):
             if self.phase == SCPPhase.CONFIRM:
-                # only interested in ballots compatible with commit value
+                # can only augment the prepared interval around the commit
                 if not (self.p is not None and
-                        less_and_compatible(cand, self.p)) and \
-                        not compatible(cand, self.h):
+                        less_and_compatible(self.p, cand)):
                     continue
-            if self.p is not None and cand <= self.p:
-                break  # nothing new below current prepared
             if self.pp is not None and cand <= self.pp:
-                continue
-            accepted = self._federated_accept(
-                lambda st, c=cand: self.votes_prepared(c, st),
-                lambda st, c=cand: self.has_prepared_ballot(c, st))
-            if accepted:
-                return self._set_prepared(cand)
+                continue  # would help neither p nor p'
+            if self.p is not None and less_and_compatible(cand, self.p):
+                continue  # already covered by p
+            if self._federated_accept(
+                    lambda st, c=cand: self.votes_prepared(c, st),
+                    lambda st, c=cand: self.has_prepared_ballot(c, st)):
+                return self._set_accept_prepared(cand)
         return False
+
+    def _set_accept_prepared(self, ballot: Ballot) -> bool:
+        did = self._set_prepared(ballot)
+        # an accepted-prepared ballot above h and incompatible with it
+        # aborts the pending commit votes
+        if self.c is not None and self.h is not None:
+            if (self.p is not None and
+                    less_and_incompatible(self.h, self.p)) or \
+                    (self.pp is not None and
+                     less_and_incompatible(self.h, self.pp)):
+                assert self.phase == SCPPhase.PREPARE
+                self.c = None
+                did = True
+        if did:
+            self._driver().accepted_ballot_prepared(self.slot.slot_index,
+                                                    _mk(ballot))
+            self._emit_current_statement()
+        return did
 
     def _set_prepared(self, ballot: Ballot) -> bool:
         did = False
-        if self.p is None or self.p < ballot:
-            if self.p is not None and not compatible(self.p, ballot):
-                if self.pp is None or self.pp < self.p:
-                    self.pp = self.p
+        if self.p is not None:
+            if self.p < ballot:
+                if not compatible(self.p, ballot):
+                    self.pp = self.p  # displaced p becomes p'
+                self.p = ballot
+                did = True
+            elif self.p > ballot:
+                if self.pp is None or (self.pp < ballot and
+                                       not compatible(self.p, ballot)):
+                    self.pp = ballot
+                    did = True
+        else:
             self.p = ballot
             did = True
-        elif self.p > ballot and not compatible(self.p, ballot):
-            if self.pp is None or self.pp < ballot:
-                self.pp = ballot
-                did = True
-        if did:
-            # abort commit if prepared aborts it: p incompatible >= c
-            if self.c is not None and self.h is not None:
-                incompatible = (
-                    (self.p is not None and
-                     less_and_incompatible(self.h, self.p)) or
-                    (self.pp is not None and
-                     less_and_incompatible(self.h, self.pp)))
-                if incompatible:
-                    self.c = None
-            self._driver().accepted_ballot_prepared(self.slot.slot_index,
-                                                    _mk(self.p))
-            self._emit_current_statement()
         return did
 
     def attempt_confirm_prepared(self, hint: SCPStatement) -> bool:
         if self.phase != SCPPhase.PREPARE or self.p is None:
             return False
-        # find highest ratified prepared ballot → h; then extend down to c
+        candidates = self._prepare_candidates(hint)
         new_h = None
-        for cand in self._prepare_candidates():
-            if self.h is not None and cand <= self.h:
-                break
+        idx = 0
+        for i, cand in enumerate(candidates):
+            if self.h is not None and self.h >= cand:
+                break  # can't raise h
             if self._federated_ratify(
                     lambda st, c=cand: self.has_prepared_ballot(c, st)):
                 new_h = cand
+                idx = i
                 break
         if new_h is None:
             return False
+        # extend downward to the lowest ratified c >= b (step 3), unless a
+        # commit is already set or h is aborted by p/p'
+        new_c: Optional[Ballot] = None
+        b = self.b if self.b is not None else (0, b"")
+        if self.c is None and \
+                (self.p is None or
+                 not less_and_incompatible(new_h, self.p)) and \
+                (self.pp is None or
+                 not less_and_incompatible(new_h, self.pp)):
+            for cand in candidates[idx:]:
+                if cand < b:
+                    break
+                if not less_and_compatible(cand, new_h):
+                    continue
+                if self._federated_ratify(
+                        lambda st, c=cand: self.has_prepared_ballot(c, st)):
+                    new_c = cand
+                else:
+                    break
+        return self._set_confirm_prepared(new_c, new_h)
+
+    def _set_confirm_prepared(self, new_c: Optional[Ballot],
+                              new_h: Ballot) -> bool:
         did = False
-        if self.h is None or new_h > self.h:
-            self.h = new_h
-            did = True
-            if self.b is not None and new_h > self.b:
-                self._update_current_value(new_h)
-        # compute c: lowest ballot such that the whole range [c, h] is
-        # confirmed prepared and nothing aborts it
-        if did and self.c is None and self.b is not None:
-            if self.p is not None and \
-                    less_and_incompatible(self.h, self.p):
-                pass
-            elif self.pp is not None and \
-                    less_and_incompatible(self.h, self.pp):
-                pass
-            elif self.b <= self.h and compatible(self.b, self.h):
-                new_c = None
-                for cand in sorted(self._prepare_candidates()):
-                    if cand < self.b:
-                        continue
-                    if not less_and_compatible(cand, self.h):
-                        continue
-                    if self._federated_ratify(
-                            lambda st, c=cand: self.has_prepared_ballot(
-                                c, st)):
-                        new_c = cand
-                        break
-                if new_c is not None:
-                    self.c = new_c
+        self.value_override = new_h[1]
+        # c/h only move while we're on a compatible ballot
+        if self.b is None or compatible(self.b, new_h):
+            if self.h is None or new_h > self.h:
+                self.h = new_h
+                did = True
+            if new_c is not None:
+                assert self.c is None
+                self.c = new_c
+                did = True
+            if did:
+                self._driver().confirmed_ballot_prepared(
+                    self.slot.slot_index, _mk(new_h))
+        # always perform step (8) with the computed h
+        did = self._update_current_if_needed(new_h) or did
         if did:
-            self._driver().confirmed_ballot_prepared(self.slot.slot_index,
-                                                     _mk(self.h))
             self._emit_current_statement()
         return did
 
-    # commit boundaries for a value
-    def _commit_boundaries(self, v: bytes) -> List[int]:
+    def _update_current_if_needed(self, h: Ballot) -> bool:
+        if self.b is None or self.b < h:
+            self._bump_to_ballot(h, True)
+            return True
+        return False
+
+    # commit boundaries for statements compatible with ballot's value
+    def _commit_boundaries(self, ballot: Ballot) -> List[int]:
         out: Set[int] = set()
+        v = ballot[1]
         for env in self.latest_envelopes.values():
             st = env.statement
             t = st.pledges.disc
             if t == SCPStatementType.SCP_ST_PREPARE:
                 p = st.pledges.value
-                if p.ballot.value == v and p.nC > 0:
+                if p.ballot.value == v and p.nC:
                     out.add(p.nC)
                     out.add(p.nH)
             elif t == SCPStatementType.SCP_ST_CONFIRM:
@@ -499,44 +545,48 @@ class BallotProtocol:
                 if e.commit.value == v:
                     out.add(e.commit.counter)
                     out.add(e.nH)
+                    out.add(UINT32_MAX)  # externalize accepts [c, ∞)
         return sorted(out)
 
-    def _find_extended_interval(self, v: bytes, pred) -> Optional[
+    def _find_extended_interval(self, ballot: Ballot, pred) -> Optional[
             Tuple[int, int]]:
-        """Largest [lo, hi] over the boundary grid where pred holds for
-        every (lo, hi) — scanning from the top (reference
-        findExtendedInterval)."""
-        boundaries = self._commit_boundaries(v)
+        """Largest [lo, hi] over the boundary grid where pred holds,
+        scanning from the top (reference findExtendedInterval)."""
         best: Optional[Tuple[int, int]] = None
-        cur: Optional[Tuple[int, int]] = None
-        for bval in reversed(boundaries):
-            if cur is None:
+        for bval in reversed(self._commit_boundaries(ballot)):
+            if best is None:
                 cand = (bval, bval)
+            elif bval > best[1]:
+                continue
             else:
-                cand = (bval, cur[1])
+                cand = (bval, best[1])
             if pred(cand[0], cand[1]):
-                cur = cand
-                best = cur
-            elif cur is not None:
+                best = cand
+            elif best is not None:
                 break
         return best
 
-    def attempt_accept_commit(self, hint: SCPStatement) -> bool:
-        if self.phase not in (SCPPhase.PREPARE, SCPPhase.CONFIRM):
-            return False
-        # work off the hint's ballot value
+    @staticmethod
+    def _hint_commit_ballot(hint: SCPStatement) -> Optional[Ballot]:
+        """(nH, value) the hint pushes toward committing; None if none."""
         t = hint.pledges.disc
         if t == SCPStatementType.SCP_ST_PREPARE:
             p = hint.pledges.value
             if p.nC == 0:
-                return False
-            ballot = (p.nH, p.ballot.value)
-        elif t == SCPStatementType.SCP_ST_CONFIRM:
+                return None
+            return (p.nH, p.ballot.value)
+        if t == SCPStatementType.SCP_ST_CONFIRM:
             c = hint.pledges.value
-            ballot = (c.nH, c.ballot.value)
-        else:
-            e = hint.pledges.value
-            ballot = (e.nH, e.commit.value)
+            return (c.nH, c.ballot.value)
+        e = hint.pledges.value
+        return (e.nH, e.commit.value)
+
+    def attempt_accept_commit(self, hint: SCPStatement) -> bool:
+        if self.phase not in (SCPPhase.PREPARE, SCPPhase.CONFIRM):
+            return False
+        ballot = self._hint_commit_ballot(hint)
+        if ballot is None:
+            return False
         if self.phase == SCPPhase.CONFIRM and \
                 not compatible(ballot, self.h):
             return False
@@ -546,111 +596,132 @@ class BallotProtocol:
             return self._federated_accept(
                 lambda st: self.votes_commit(v, lo, hi, st),
                 lambda st: self.accepts_commit(v, lo, hi, st))
-        interval = self._find_extended_interval(v, pred)
-        if interval is None:
-            return False
+
+        interval = self._find_extended_interval(ballot, pred)
+        if interval is None or interval[0] == 0:
+            return False  # reference rejects lo=0 (nCommit=0 statements)
         lo, hi = interval
-        # sanity: don't regress
-        if self.phase == SCPPhase.CONFIRM and self.h is not None and \
-                hi <= self.h[0] and (self.c[0], self.h[0]) == (lo, hi):
-            return False
+        if self.phase == SCPPhase.CONFIRM and hi <= self.h[0]:
+            return False  # nothing gained
+        return self._set_accept_commit((lo, v), (hi, v))
+
+    def _set_accept_commit(self, c: Ballot, h: Ballot) -> bool:
+        did = False
+        self.value_override = h[1]
+        if self.h != h or self.c != c:
+            self.c = c
+            self.h = h
+            did = True
         if self.phase == SCPPhase.PREPARE:
-            if self.p is not None and not compatible((0, v), self.p) and \
-                    self.p[0] >= lo:
-                # accepting commit of an aborted value would be unsafe
-                if not less_and_compatible((lo, v), self.p):
-                    pass
             self.phase = SCPPhase.CONFIRM
-        self.c = (lo, v)
-        self.h = (hi, v)
-        if self.b is None or self.b[0] < hi or self.b[1] != v:
-            self.b = (max(hi, self.b[0] if self.b else 0), v)
-        self.p = (self.p[0], v) if (self.p and self.p[1] == v) else self.p
-        self._driver().accepted_commit(self.slot.slot_index, _mk(self.c))
-        self._emit_current_statement()
-        return True
+            if self.b is not None and not less_and_compatible(h, self.b):
+                self._bump_to_ballot(h, False)
+            self.pp = None
+            did = True
+        if did:
+            self._update_current_if_needed(self.h)
+            self._driver().accepted_commit(self.slot.slot_index, _mk(h))
+            self._emit_current_statement()
+        return did
 
     def attempt_confirm_commit(self, hint: SCPStatement) -> bool:
-        if self.phase != SCPPhase.CONFIRM or self.c is None:
+        if self.phase != SCPPhase.CONFIRM or \
+                self.h is None or self.c is None:
             return False
-        v = self.c[1]
+        if hint.pledges.disc == SCPStatementType.SCP_ST_PREPARE:
+            return False
+        ballot = self._hint_commit_ballot(hint)
+        if ballot is None or not compatible(ballot, self.c):
+            return False
+        v = ballot[1]
 
         def pred(lo: int, hi: int) -> bool:
             return self._federated_ratify(
                 lambda st: self.accepts_commit(v, lo, hi, st))
-        interval = self._find_extended_interval(v, pred)
-        if interval is None:
-            return False
+
+        interval = self._find_extended_interval(ballot, pred)
+        if interval is None or interval[0] == 0:
+            return False  # reference rejects lo=0
         lo, hi = interval
-        self.c = (lo, v)
-        self.h = (hi, v)
+        return self._set_confirm_commit((lo, v), (hi, v))
+
+    def _set_confirm_commit(self, c: Ballot, h: Ballot) -> bool:
+        self.c = c
+        self.h = h
+        self._update_current_if_needed(h)
         self.phase = SCPPhase.EXTERNALIZE
         self._emit_current_statement()
         self.slot.stop_nomination()
-        self._driver().value_externalized(self.slot.slot_index, v)
+        self._driver().value_externalized(self.slot.slot_index, c[1])
         return True
 
     def _attempt_bump(self) -> bool:
-        """v-blocking set is ahead → jump to their lowest counter
-        (repeat)."""
+        """A v-blocking set is strictly ahead → jump to the minimal counter
+        at which that stops being true (reference attemptBump)."""
         if self.phase not in (SCPPhase.PREPARE, SCPPhase.CONFIRM):
             return False
-        did = False
-        while True:
-            prev_b = self.b
-            local_counter = self.b[0] if self.b is not None else 0
-            counters = sorted({self.statement_ballot_counter(e.statement)
-                               for e in self.latest_envelopes.values()
-                               if self.statement_ballot_counter(e.statement)
-                               > local_counter})
-            target = None
-            for n in counters:
-                if LocalNode.is_v_blocking_filter(
-                        self._local().qset, self.latest_envelopes.values(),
-                        lambda st, n=n:
-                        self.statement_ballot_counter(st) >= n):
-                    target = n
-                    # take the lowest v-blocking counter
-                    break
-            if target is None:
-                return did
-            self.abandon_ballot(target)
-            if self.b == prev_b:
-                return did  # bump had no effect; avoid spinning
-            did = True
+        local_counter = self.b[0] if self.b is not None else 0
+
+        def vblocking_ahead_of(n: int) -> bool:
+            return LocalNode.is_v_blocking_filter(
+                self._local().qset, self.latest_envelopes.values(),
+                lambda st, n=n: self.statement_ballot_counter(st) > n)
+
+        if not vblocking_ahead_of(local_counter):
+            return False
+        counters = sorted({self.statement_ballot_counter(e.statement)
+                           for e in self.latest_envelopes.values()
+                           if self.statement_ballot_counter(e.statement)
+                           > local_counter})
+        for n in counters:
+            if not vblocking_ahead_of(n):
+                return self.abandon_ballot(n)
+        return False
 
     # ------------------------------------------------------ timers / quorum
     def _check_heard_from_quorum(self) -> None:
+        """Reference semantics (BallotProtocol.cpp:2163-2213): a node has
+        "heard from quorum" when a quorum is at-or-past its ballot counter —
+        PREPARE statements filter by counter, CONFIRM/EXTERNALIZE always
+        count (their counters only move forward). The ballot timer starts
+        only on the not-heard → heard transition and is cancelled when the
+        quorum falls behind (local counter bumped) or on EXTERNALIZE."""
         if self.b is None:
             return
         bn = self.b[0]
 
         def pred(st: SCPStatement) -> bool:
-            return self.statement_ballot_counter(st) >= bn
+            if st.pledges.disc == SCPStatementType.SCP_ST_PREPARE:
+                return bn <= st.pledges.value.ballot.counter
+            return True
         if LocalNode.is_quorum(self._local().qset, self.latest_envelopes,
                                self._qset_of, pred):
             was = self.heard_from_quorum
             self.heard_from_quorum = True
-            if self.phase != SCPPhase.EXTERNALIZE:
-                self._arm_timer()
             if not was:
                 self._driver().ballot_did_hear_from_quorum(
                     self.slot.slot_index, _mk(self.b))
+                if self.phase != SCPPhase.EXTERNALIZE:
+                    self._start_timer()
+            if self.phase == SCPPhase.EXTERNALIZE:
+                self._stop_timer()
         else:
             self.heard_from_quorum = False
+            self._stop_timer()
 
-    def _arm_timer(self) -> None:
+    def _start_timer(self) -> None:
         from .driver import SCPTimerID
-        if self.b is None or self.timer_counter == self.b[0]:
-            return
-        self.timer_counter = self.b[0]
         timeout = self._driver().compute_timeout(self.b[0])
         self._driver().setup_timer(
             self.slot.slot_index, SCPTimerID.BALLOT, timeout,
             self._on_timeout)
 
+    def _stop_timer(self) -> None:
+        from .driver import SCPTimerID
+        self._driver().setup_timer(
+            self.slot.slot_index, SCPTimerID.BALLOT, 0.0, None)
+
     def _on_timeout(self) -> None:
-        self.timer_counter = 0
         self.abandon_ballot(0)
 
     # ------------------------------------------------------------- emission
@@ -684,16 +755,85 @@ class BallotProtocol:
                             slotIndex=self.slot.slot_index, pledges=pl)
 
     def _emit_current_statement(self) -> None:
+        """Record the new statement and process it as our own (re-entering
+        advance_slot). The envelope is only SENT when the outermost advance
+        pass unwinds — see advance_slot."""
         st = self._make_statement()
         env = self.slot.create_envelope(st)
-        # process our own statement first; broadcast only if it sticks
-        if self.process_envelope(env, is_self=True) == \
+        can_emit = self.b is not None
+        own = self.latest_envelopes.get(self._local().node_id.key_bytes)
+        if own is not None and own.statement.to_xdr() == st.to_xdr():
+            return  # same statement; h.value can differ while h.n doesn't
+        if self.process_envelope(env, is_self=True) != \
                 self.EnvelopeState.VALID:
-            sx = st.to_xdr()
-            if self.last_stmt_xdr != sx:
-                self.last_stmt_xdr = sx
+            # The statement total order is (type, b, p, p', h) — it does not
+            # cover nC. A c-only update (e.g. confirm-prepared sets c after
+            # an incompatible-b pass already emitted the same (b,p,p',h))
+            # ties in that order. The reference's own test vectors require
+            # the new commit vote to be visible to subsequent quorum math in
+            # the same cascade, so record it for ourselves; it is never sent
+            # (last_envelope keeps the strict order), and a genuinely
+            # regressed statement is a protocol bug.
+            if own is not None and self.is_statement_sane(st, True) and \
+                    not self._is_newer(own.statement, st):
+                self.latest_envelopes[
+                    self._local().node_id.key_bytes] = env
+                return
+            raise RuntimeError("moved to a bad state (ballot protocol)")
+        if can_emit and (self.last_envelope is None or
+                         self._is_newer(st, self.last_envelope.statement)):
+            self.last_envelope = env
+            self._send_latest_envelope()
+
+    def _send_latest_envelope(self) -> None:
+        if self.current_message_level == 0 and \
+                self.last_envelope is not None and self.slot.fully_validated:
+            if self.last_envelope_emit is not self.last_envelope:
+                self.last_envelope_emit = self.last_envelope
                 if self._local().is_validator:
-                    self._driver().emit_envelope(env)
+                    self._driver().emit_envelope(self.last_envelope)
+
+    def set_state_from_envelope(self, envelope: SCPEnvelope) -> None:
+        """Restore persisted own state directly (reference
+        setStateFromEnvelope) — no federated processing, just the statement
+        fields back into b/p/p'/c/h and the phase."""
+        if self.b is not None:
+            raise RuntimeError(
+                "cannot set state after starting ballot protocol")
+        st = envelope.statement
+        self.latest_envelopes[st.nodeID.key_bytes] = envelope
+        self.last_envelope = envelope
+        self.last_envelope_emit = envelope
+        t = st.pledges.disc
+        if t == SCPStatementType.SCP_ST_PREPARE:
+            p = st.pledges.value
+            b = _bt(p.ballot)
+            self._bump_to_ballot(b, True)
+            if p.prepared is not None:
+                self.p = _bt(p.prepared)
+            if p.preparedPrime is not None:
+                self.pp = _bt(p.preparedPrime)
+            if p.nH:
+                self.h = (p.nH, b[1])
+            if p.nC:
+                self.c = (p.nC, b[1])
+            self.phase = SCPPhase.PREPARE
+        elif t == SCPStatementType.SCP_ST_CONFIRM:
+            c = st.pledges.value
+            v = c.ballot.value
+            self._bump_to_ballot(_bt(c.ballot), True)
+            self.p = (c.nPrepared, v)
+            self.h = (c.nH, v)
+            self.c = (c.nCommit, v)
+            self.phase = SCPPhase.CONFIRM
+        else:
+            e = st.pledges.value
+            v = e.commit.value
+            self._bump_to_ballot((UINT32_MAX, v), True)
+            self.p = (UINT32_MAX, v)
+            self.h = (e.nH, v)
+            self.c = _bt(e.commit)
+            self.phase = SCPPhase.EXTERNALIZE
 
     # --------------------------------------------------------------- state
     def get_json_info(self) -> dict:

@@ -258,6 +258,20 @@ class NominationProtocol:
                 if local.is_validator:
                     self._driver().emit_envelope(env)
 
+    def set_state_from_envelope(self, envelope) -> None:
+        """Restore persisted nomination state (reference
+        setStateFromEnvelope)."""
+        if self.nomination_started:
+            raise RuntimeError("cannot set state after nomination started")
+        st = envelope.statement
+        self.latest_nominations[st.nodeID.key_bytes] = envelope
+        nom = st.pledges.value
+        for a in nom.accepted:
+            self.accepted.add(a)
+        for v in nom.votes:
+            self.votes.add(v)
+        self.last_envelope = envelope
+
     def get_json_info(self) -> dict:
         return {
             "roundnumber": self.round_number,

@@ -78,8 +78,8 @@ class SCP:
 
     def set_state_from_envelope(self, envelope: SCPEnvelope) -> None:
         """Restore persisted state (reference setStateFromEnvelope)."""
-        self.get_slot(envelope.statement.slotIndex).process_envelope(
-            envelope, is_self=True)
+        self.get_slot(envelope.statement.slotIndex).set_state_from_envelope(
+            envelope)
 
     def empty(self) -> bool:
         return not self.known_slots

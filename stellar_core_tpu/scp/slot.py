@@ -39,6 +39,16 @@ class Slot:
             return self.nomination.process_envelope(envelope)
         return self.ballot.process_envelope(envelope, is_self)
 
+    def set_state_from_envelope(self, envelope: SCPEnvelope) -> None:
+        st = envelope.statement
+        if st.nodeID.key_bytes != self.scp.local_node.node_id.key_bytes or \
+                st.slotIndex != self.slot_index:
+            return  # not our own persisted state; ignore
+        if st.pledges.disc == SCPStatementType.SCP_ST_NOMINATE:
+            self.nomination.set_state_from_envelope(envelope)
+        else:
+            self.ballot.set_state_from_envelope(envelope)
+
     # -- quorum sets --------------------------------------------------------
     def get_quorum_set_from_statement(
             self, st: SCPStatement) -> Optional[SCPQuorumSet]:
@@ -72,12 +82,8 @@ class Slot:
         out = []
         if self.nomination.last_envelope is not None:
             out.append(self.nomination.last_envelope)
-        if self.ballot.last_stmt_xdr is not None:
-            # rebuild from latest own envelope
-            nb = self.scp.local_node.node_id.key_bytes
-            own = self.ballot.latest_envelopes.get(nb)
-            if own is not None:
-                out.append(own)
+        if self.ballot.last_envelope_emit is not None:
+            out.append(self.ballot.last_envelope_emit)
         return out
 
     def get_current_state(self) -> List[SCPEnvelope]:

@@ -20,6 +20,9 @@ if os.environ.get(_CLEAN_FLAG) != "1" and os.environ.get(
     env[_CLEAN_FLAG] = "1"
     env.pop("PALLAS_AXON_POOL_IPS", None)
     env["JAX_PLATFORMS"] = "cpu"
+    env.setdefault("JAX_COMPILATION_CACHE_DIR",
+                   os.path.join(os.path.dirname(os.path.dirname(
+                       os.path.abspath(__file__))), ".jax_cache"))
     env["XLA_FLAGS"] = (env.get("XLA_FLAGS", "") +
                         " --xla_force_host_platform_device_count=8").strip()
     # drop the axon sitecustomize injection

@@ -1,0 +1,77 @@
+"""Multi-chip sharding of the production verifier on the virtual CPU mesh.
+
+The conftest forces an 8-device CPU platform, so these tests exercise the
+same dp-sharded dispatch a v5e pod slice would use (VERDICT r2 #3: the
+production TpuSigVerifier must use the mesh, not only the dryrun).
+Reference analog: SURVEY.md §2.3 — verify batches shard pure
+data-parallel over ICI; the only cross-chip traffic is the result gather.
+"""
+
+import jax
+import pytest
+
+from stellar_core_tpu.crypto.batch_verifier import TpuSigVerifier
+from stellar_core_tpu.crypto.keys import SecretKey
+from stellar_core_tpu.ops.ed25519 import L, verify_oracle
+from stellar_core_tpu.parallel.mesh import (
+    make_mesh, multichip_verify, sharded_verify_fn,
+)
+
+
+def _batch(n, n_keys=4):
+    sks = [SecretKey.from_seed(bytes([i + 1] * 32)) for i in range(n_keys)]
+    pubs, sigs, msgs = [], [], []
+    for i in range(n):
+        sk = sks[i % n_keys]
+        m = b"mc-%04d" % i
+        pubs.append(sk.public_key.key_bytes)
+        sigs.append(sk.sign(m))
+        msgs.append(m)
+    return pubs, sigs, msgs
+
+
+@pytest.fixture(autouse=True)
+def require_mesh():
+    if jax.device_count() < 2:
+        pytest.skip("needs the virtual multi-device CPU platform")
+
+
+def test_production_verifier_uses_mesh_and_matches_oracle():
+    pubs, sigs, msgs = _batch(50)
+    # adversarial rows: bit flip, wrong message, non-canonical S, bad length
+    sigs[7] = bytes([sigs[7][0] ^ 1]) + sigs[7][1:]
+    msgs[11] = b"evil"
+    s = int.from_bytes(sigs[13][32:], "little")
+    sigs[13] = sigs[13][:32] + (s + L).to_bytes(32, "little")
+    sigs[17] = sigs[17][:40]
+    triples = list(zip(pubs, sigs, msgs))
+
+    v = TpuSigVerifier(shard_threshold=1)
+    got = v.verify_many(triples)
+    want = [verify_oracle(*t) for t in triples]
+    assert got == want
+    # the sharded jit must actually have been taken on a multi-device host
+    assert v._sharded_fn is not None
+    assert v.batches_dispatched == 1  # 50 sigs -> one padded bucket
+
+
+def test_multichip_verify_padding_not_multiple_of_mesh():
+    # 13 items on an 8-device mesh: pads to 16, pad lanes masked out
+    pubs, sigs, msgs = _batch(13)
+    ok = multichip_verify(pubs, sigs, msgs, make_mesh())
+    assert list(ok) == [True] * 13
+
+
+def test_sharded_fn_equals_single_device_kernel():
+    import numpy as np
+    import jax.numpy as jnp
+    from stellar_core_tpu.ops.ed25519 import prepare_batch, verify_batch_jit
+
+    pubs, sigs, msgs = _batch(16)
+    sigs[3] = bytes([sigs[3][0] ^ 1]) + sigs[3][1:]
+    prep = prepare_batch(pubs, sigs, msgs)
+    args = tuple(jnp.asarray(prep[k]) for k in
+                 ("ay", "a_sign", "ry", "r_sign", "s_nibs", "k_nibs"))
+    single = np.asarray(verify_batch_jit(*args))
+    sharded = np.asarray(sharded_verify_fn(make_mesh())(*args))
+    assert (single == sharded).all()

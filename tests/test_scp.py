@@ -88,7 +88,10 @@ class TestDriver(SCPDriver):
         return self.network.qsets.get(qset_hash)
 
     def setup_timer(self, slot_index, timer_id, timeout, cb):
-        self.timers[timer_id] = (timeout, cb)
+        if cb is None:
+            self.timers.pop(timer_id, None)  # reference cancel idiom
+        else:
+            self.timers[timer_id] = (timeout, cb)
 
     def fire_timer(self, timer_id) -> bool:
         t = self.timers.pop(timer_id, None)

@@ -1,0 +1,788 @@
+"""Ballot-protocol test vectors, ported scenario-for-scenario from the
+reference's table-driven suite (/root/reference/src/scp/test/SCPTests.cpp:
+575-2456, "ballot protocol core5"): a 5-node quorum set with threshold 4
+(v-blocking size 2, quorum = 3 others + self) driven against a mock driver,
+asserting the EXACT emitted statement after every envelope.
+
+Vocabulary: A = the value our node starts with; B > A ("start <1,x>").
+A1..A5 = ballots (1..5, A); AInf = (UINT32_MAX, A); similarly B*.
+"""
+
+from typing import Callable, Dict, List, Optional
+
+import pytest
+
+from stellar_core_tpu.crypto.hashing import sha256
+from stellar_core_tpu.scp.driver import SCPDriver, SCPTimerID, ValidationLevel
+from stellar_core_tpu.scp.scp import SCP
+from stellar_core_tpu.xdr import (
+    PublicKey, SCPBallot, SCPConfirm, SCPEnvelope, SCPExternalize,
+    SCPPledges, SCPPrepare, SCPQuorumSet, SCPStatement, SCPStatementType,
+)
+
+UINT32_MAX = 2**32 - 1
+X, Y, Z, ZZ = b"\x01" * 32, b"\x02" * 32, b"\x03" * 32, b"\x04" * 32
+
+
+def nid(i: int) -> PublicKey:
+    return PublicKey.ed25519(bytes([i + 40]) * 32)
+
+
+def bal(n: int, v: bytes) -> SCPBallot:
+    return SCPBallot(counter=n, value=v)
+
+
+def bump(b: SCPBallot, k: int = 1) -> SCPBallot:
+    return SCPBallot(counter=b.counter + k, value=b.value)
+
+
+class VecDriver(SCPDriver):
+    def __init__(self, qsets: Dict[bytes, SCPQuorumSet]) -> None:
+        self.qsets = qsets
+        self.envs: List[SCPEnvelope] = []
+        self.externalized: Dict[int, bytes] = {}
+        self.heard: Dict[int, List[tuple]] = {}
+        self.timers: Dict[int, tuple] = {}
+        self.offset = 0.0
+
+    def validate_value(self, slot_index, value, nomination):
+        return ValidationLevel.FULLY_VALIDATED
+
+    def combine_candidates(self, slot_index, candidates):
+        return sorted(candidates)[-1]
+
+    def sign_envelope(self, envelope):
+        envelope.signature = b"\x05\x06\x07\x08"
+
+    def emit_envelope(self, envelope):
+        self.envs.append(envelope)
+
+    def get_qset(self, qset_hash):
+        return self.qsets.get(qset_hash)
+
+    def setup_timer(self, slot_index, timer_id, timeout, cb):
+        # reference TestSCP: absolute timeout vs an artificial offset clock;
+        # a None cb is the cancel idiom
+        self.timers[timer_id] = (
+            (self.offset + timeout) if cb else 0.0, cb)
+
+    def compute_timeout(self, round_number):
+        return float(min(round_number, 30 * 60))
+
+    def value_externalized(self, slot_index, value):
+        assert slot_index not in self.externalized, "double externalize"
+        self.externalized[slot_index] = value
+
+    def ballot_did_hear_from_quorum(self, slot_index, ballot):
+        self.heard.setdefault(slot_index, []).append(
+            (ballot.counter, ballot.value))
+
+
+class H:
+    """v0's SCP instance in the core5 topology + reference test helpers."""
+
+    def __init__(self) -> None:
+        self.ids = [nid(i) for i in range(5)]
+        self.q = SCPQuorumSet(threshold=4, validators=list(self.ids),
+                              innerSets=[])
+        self.qh = sha256(self.q.to_xdr())
+        self.drv = VecDriver({self.qh: self.q})
+        self.scp = SCP(self.drv, self.ids[0], True, self.q)
+
+    # -- state access -------------------------------------------------------
+    @property
+    def envs(self) -> List[SCPEnvelope]:
+        return self.drv.envs
+
+    def bump_state(self, v: bytes) -> bool:
+        return self.scp.get_slot(0, True).bump_state(v, True)
+
+    def recv(self, env: SCPEnvelope) -> None:
+        self.scp.receive_envelope(env)
+
+    def bump_timer_offset(self) -> None:
+        self.drv.offset += 5 * 3600.0
+
+    def has_ballot_timer(self) -> bool:
+        t = self.drv.timers.get(SCPTimerID.BALLOT)
+        return bool(t and t[1])
+
+    def has_ballot_timer_upcoming(self) -> bool:
+        t = self.drv.timers.get(SCPTimerID.BALLOT)
+        assert t and t[1], "no ballot timer scheduled at all"
+        return self.drv.offset < t[0]
+
+    # -- statement builders (for nodes v1..v4) ------------------------------
+    def _env(self, i: int, pledges: SCPPledges) -> SCPEnvelope:
+        st = SCPStatement(nodeID=self.ids[i], slotIndex=0, pledges=pledges)
+        return SCPEnvelope(statement=st, signature=b"\x01\x02")
+
+    def make_prepare(self, i, b, p=None, nC=0, nH=0, pp=None):
+        return self._env(i, SCPPledges(
+            SCPStatementType.SCP_ST_PREPARE,
+            SCPPrepare(quorumSetHash=self.qh, ballot=b, prepared=p,
+                       preparedPrime=pp, nC=nC, nH=nH)))
+
+    def make_confirm(self, i, n_prepared, b, nC, nH):
+        return self._env(i, SCPPledges(
+            SCPStatementType.SCP_ST_CONFIRM,
+            SCPConfirm(ballot=b, nPrepared=n_prepared, nCommit=nC, nH=nH,
+                       quorumSetHash=self.qh)))
+
+    def make_externalize(self, i, commit, nH):
+        return self._env(i, SCPPledges(
+            SCPStatementType.SCP_ST_EXTERNALIZE,
+            SCPExternalize(commit=commit, nH=nH,
+                           commitQuorumSetHash=self.qh)))
+
+    def prepare_gen(self, b, p=None, nC=0, nH=0, pp=None) -> Callable:
+        return lambda i: self.make_prepare(i, b, p, nC, nH, pp)
+
+    def confirm_gen(self, n_prepared, b, nC, nH) -> Callable:
+        return lambda i: self.make_confirm(i, n_prepared, b, nC, nH)
+
+    def externalize_gen(self, commit, nH) -> Callable:
+        return lambda i: self.make_externalize(i, commit, nH)
+
+    # -- emitted-statement verification -------------------------------------
+    def _verify(self, env: SCPEnvelope, pledges: SCPPledges) -> None:
+        exp = SCPStatement(nodeID=self.ids[0], slotIndex=0, pledges=pledges)
+        assert env.statement.to_xdr() == exp.to_xdr(), (
+            "emitted statement mismatch:\n got %r\nwant %r"
+            % (env.statement, exp))
+
+    def verify_prepare(self, env, b, p=None, nC=0, nH=0, pp=None):
+        self._verify(env, SCPPledges(
+            SCPStatementType.SCP_ST_PREPARE,
+            SCPPrepare(quorumSetHash=self.qh, ballot=b, prepared=p,
+                       preparedPrime=pp, nC=nC, nH=nH)))
+
+    def verify_confirm(self, env, n_prepared, b, nC, nH):
+        self._verify(env, SCPPledges(
+            SCPStatementType.SCP_ST_CONFIRM,
+            SCPConfirm(ballot=b, nPrepared=n_prepared, nCommit=nC, nH=nH,
+                       quorumSetHash=self.qh)))
+
+    def verify_externalize(self, env, commit, nH):
+        self._verify(env, SCPPledges(
+            SCPStatementType.SCP_ST_EXTERNALIZE,
+            SCPExternalize(commit=commit, nH=nH,
+                           commitQuorumSetHash=self.qh)))
+
+    # -- reference receive helpers (SCPTests.cpp:609-668) --------------------
+    def recv_vblocking_checks(self, gen: Callable, with_checks: bool):
+        e1, e2 = gen(1), gen(2)
+        self.bump_timer_offset()
+        i = len(self.envs)
+        self.recv(e1)
+        if with_checks:
+            assert len(self.envs) == i
+        i += 1
+        self.recv(e2)
+        if with_checks:
+            assert len(self.envs) == i
+
+    def recv_vblocking(self, gen: Callable):
+        self.recv_vblocking_checks(gen, True)
+
+    def recv_quorum_checks_ex(self, gen: Callable, with_checks: bool,
+                              delayed_quorum: bool, check_upcoming: bool):
+        e1, e2, e3, e4 = gen(1), gen(2), gen(3), gen(4)
+        self.bump_timer_offset()
+        self.recv(e1)
+        self.recv(e2)
+        i = len(self.envs) + 1
+        self.recv(e3)
+        if with_checks and not delayed_quorum:
+            assert len(self.envs) == i
+        if check_upcoming and not delayed_quorum:
+            assert self.has_ballot_timer_upcoming()
+        self.recv(e4)
+        if with_checks and delayed_quorum:
+            assert len(self.envs) == i
+        if check_upcoming and delayed_quorum:
+            assert self.has_ballot_timer_upcoming()
+
+    def recv_quorum_checks(self, gen, with_checks, delayed_quorum):
+        self.recv_quorum_checks_ex(gen, with_checks, delayed_quorum, False)
+
+    def recv_quorum_ex(self, gen, check_upcoming=False):
+        self.recv_quorum_checks_ex(gen, True, False, check_upcoming)
+
+    def recv_quorum(self, gen):
+        self.recv_quorum_ex(gen, False)
+
+
+class S1X:
+    """The "start <1,x>" scenario prefix chain (SCPTests.cpp:734-800):
+    our node starts on A=(1,x); B=z sorts above A."""
+
+    def __init__(self, a=X, b=Z, mid=Y, big=ZZ):
+        self.h = H()
+        self.aValue, self.bValue = a, b
+        self.A1, self.B1 = bal(1, a), bal(1, b)
+        self.Mid1, self.Big1 = bal(1, mid), bal(1, big)
+        self.A2, self.A3 = bal(2, a), bal(3, a)
+        self.A4, self.A5 = bal(4, a), bal(5, a)
+        self.B2, self.B3 = bal(2, b), bal(3, b)
+        self.Mid2, self.Big2 = bal(2, mid), bal(2, big)
+        self.AInf, self.BInf = bal(UINT32_MAX, a), bal(UINT32_MAX, b)
+        h = self.h
+        assert not h.has_ballot_timer()
+        assert h.bump_state(a)
+        assert len(h.envs) == 1
+        assert not h.has_ballot_timer()
+
+    # ---- prefix steps, each mirroring one nesting level --------------------
+    def prepared_A1(self):
+        h = self.h
+        h.recv_quorum_ex(h.prepare_gen(self.A1), True)
+        assert len(h.envs) == 2
+        h.verify_prepare(h.envs[1], self.A1, p=self.A1)
+
+    def bump_prepared_A2(self):
+        h = self.h
+        h.bump_timer_offset()
+        assert h.bump_state(self.aValue)
+        assert len(h.envs) == 3
+        h.verify_prepare(h.envs[2], self.A2, p=self.A1)
+        assert not h.has_ballot_timer()
+        h.recv_quorum_ex(h.prepare_gen(self.A2), True)
+        assert len(h.envs) == 4
+        h.verify_prepare(h.envs[3], self.A2, p=self.A2)
+
+    def confirm_prepared_A2(self):
+        h = self.h
+        h.recv_quorum(h.prepare_gen(self.A2, self.A2))
+        assert len(h.envs) == 5
+        h.verify_prepare(h.envs[4], self.A2, p=self.A2, nC=2, nH=2)
+        assert not h.has_ballot_timer_upcoming()
+
+    def accept_commit_quorum_A2(self):
+        h = self.h
+        h.recv_quorum(h.prepare_gen(self.A2, self.A2, 2, 2))
+        assert len(h.envs) == 6
+        h.verify_confirm(h.envs[5], 2, self.A2, 2, 2)
+        assert not h.has_ballot_timer_upcoming()
+
+    def quorum_prepared_A3(self):
+        h = self.h
+        h.recv_vblocking(h.prepare_gen(self.A3, self.A2, 2, 2))
+        assert len(h.envs) == 7
+        h.verify_confirm(h.envs[6], 2, self.A3, 2, 2)
+        assert not h.has_ballot_timer()
+        h.recv_quorum_ex(h.prepare_gen(self.A3, self.A2, 2, 2), True)
+        assert len(h.envs) == 8
+        h.verify_confirm(h.envs[7], 3, self.A3, 2, 2)
+
+    def accept_more_commit_A3(self):
+        h = self.h
+        h.recv_quorum(h.prepare_gen(self.A3, self.A3, 2, 3))
+        assert len(h.envs) == 9
+        h.verify_confirm(h.envs[8], 3, self.A3, 2, 3)
+        assert not h.has_ballot_timer_upcoming()
+        assert len(h.drv.externalized) == 0
+
+
+# ---------------------------------------------------------------- top level
+
+def test_bump_state_x():
+    h = H()
+    assert h.bump_state(X)
+    assert len(h.envs) == 1
+    h.verify_prepare(h.envs[0], bal(1, X))
+
+
+def test_nodes_all_pledge_to_commit():
+    # SCPTests.cpp:696-733 (nodesAllPledgeToCommit)
+    h = H()
+    b = bal(1, X)
+    assert h.bump_state(X)
+    assert len(h.envs) == 1
+    h.verify_prepare(h.envs[0], b)
+
+    h.recv(h.make_prepare(1, b))
+    assert len(h.envs) == 1
+    assert len(h.drv.heard.get(0, [])) == 0
+    h.recv(h.make_prepare(2, b))
+    assert len(h.envs) == 1
+    assert len(h.drv.heard.get(0, [])) == 0
+    h.recv(h.make_prepare(3, b))
+    assert len(h.envs) == 2
+    assert h.drv.heard[0] == [(1, X)]
+    h.verify_prepare(h.envs[1], b, p=b)
+    h.recv(h.make_prepare(4, b))
+    assert len(h.envs) == 2
+
+    h.recv(h.make_prepare(4, b, b))
+    h.recv(h.make_prepare(3, b, b))
+    assert len(h.envs) == 2
+    h.recv(h.make_prepare(2, b, b))
+    assert len(h.envs) == 3
+    h.verify_prepare(h.envs[2], b, p=b, nC=1, nH=1)
+    h.recv(h.make_prepare(1, b, b))
+    assert len(h.envs) == 3
+
+
+# ------------------------------------------------- start <1,x>: deep chain
+
+def test_prepared_a1():
+    s = S1X()
+    s.prepared_A1()
+
+
+def test_bump_prepared_a2():
+    s = S1X()
+    s.prepared_A1()
+    s.bump_prepared_A2()
+
+
+def test_confirm_prepared_a2():
+    s = S1X()
+    s.prepared_A1()
+    s.bump_prepared_A2()
+    s.confirm_prepared_A2()
+
+
+def test_accept_commit_quorum_a2():
+    s = S1X()
+    s.prepared_A1()
+    s.bump_prepared_A2()
+    s.confirm_prepared_A2()
+    s.accept_commit_quorum_A2()
+
+
+def test_quorum_prepared_a3():
+    s = S1X()
+    s.prepared_A1()
+    s.bump_prepared_A2()
+    s.confirm_prepared_A2()
+    s.accept_commit_quorum_A2()
+    s.quorum_prepared_A3()
+
+
+def test_accept_more_commit_a3():
+    s = S1X()
+    s.prepared_A1()
+    s.bump_prepared_A2()
+    s.confirm_prepared_A2()
+    s.accept_commit_quorum_A2()
+    s.quorum_prepared_A3()
+    s.accept_more_commit_A3()
+
+
+def test_quorum_externalize_a3():
+    s = S1X()
+    s.prepared_A1()
+    s.bump_prepared_A2()
+    s.confirm_prepared_A2()
+    s.accept_commit_quorum_A2()
+    s.quorum_prepared_A3()
+    s.accept_more_commit_A3()
+    h = s.h
+    h.recv_quorum(h.confirm_gen(3, s.A3, 2, 3))
+    assert len(h.envs) == 10
+    h.verify_externalize(h.envs[9], s.A2, 3)
+    assert not h.has_ballot_timer()
+    assert h.drv.externalized == {0: s.aValue}
+
+
+def _quorum_prepared_a3_base():
+    # "v-blocking accept more A3" is a SIBLING of "Accept more commit A3"
+    # (SCPTests.cpp:863): it builds on the quorum-prepared-A3 state (8 envs)
+    s = S1X()
+    s.prepared_A1()
+    s.bump_prepared_A2()
+    s.confirm_prepared_A2()
+    s.accept_commit_quorum_A2()
+    s.quorum_prepared_A3()
+    return s
+
+
+def test_vblocking_accept_more_confirm_a3():
+    s = _quorum_prepared_a3_base()
+    h = s.h
+    h.recv_vblocking(h.confirm_gen(3, s.A3, 2, 3))
+    assert len(h.envs) == 9
+    h.verify_confirm(h.envs[8], 3, s.A3, 2, 3)
+    assert not h.has_ballot_timer_upcoming()
+
+
+def test_vblocking_accept_more_externalize_a3():
+    s = _quorum_prepared_a3_base()
+    h = s.h
+    h.recv_vblocking(h.externalize_gen(s.A2, 3))
+    assert len(h.envs) == 9
+    h.verify_confirm(h.envs[8], UINT32_MAX, s.AInf, 2, UINT32_MAX)
+    assert not h.has_ballot_timer()
+
+
+def test_vblocking_accept_more_confirm_a4_5():
+    s = _quorum_prepared_a3_base()
+    h = s.h
+    h.recv_vblocking(h.confirm_gen(3, s.A5, 4, 5))
+    assert len(h.envs) == 9
+    h.verify_confirm(h.envs[8], 3, s.A5, 4, 5)
+    assert not h.has_ballot_timer()
+
+
+def test_vblocking_accept_more_externalize_a4_5():
+    s = _quorum_prepared_a3_base()
+    h = s.h
+    h.recv_vblocking(h.externalize_gen(s.A4, 5))
+    assert len(h.envs) == 9
+    h.verify_confirm(h.envs[8], UINT32_MAX, s.AInf, 4, UINT32_MAX)
+    assert not h.has_ballot_timer()
+
+
+def _quorum_a2_base():
+    s = S1X()
+    s.prepared_A1()
+    s.bump_prepared_A2()
+    s.confirm_prepared_A2()
+    s.accept_commit_quorum_A2()
+    return s
+
+
+def test_vblocking_prepared_a3():
+    s = _quorum_a2_base()
+    h = s.h
+    h.recv_vblocking(h.prepare_gen(s.A3, s.A3, 2, 2))
+    assert len(h.envs) == 7
+    h.verify_confirm(h.envs[6], 3, s.A3, 2, 2)
+    assert not h.has_ballot_timer()
+
+
+def test_vblocking_prepared_a3_plus_b3():
+    s = _quorum_a2_base()
+    h = s.h
+    h.recv_vblocking(h.prepare_gen(s.A3, s.B3, 2, 2, s.A3))
+    assert len(h.envs) == 7
+    h.verify_confirm(h.envs[6], 3, s.A3, 2, 2)
+    assert not h.has_ballot_timer()
+
+
+def test_vblocking_confirm_a3():
+    s = _quorum_a2_base()
+    h = s.h
+    h.recv_vblocking(h.confirm_gen(3, s.A3, 2, 2))
+    assert len(h.envs) == 7
+    h.verify_confirm(h.envs[6], 3, s.A3, 2, 2)
+    assert not h.has_ballot_timer()
+
+
+def test_hang_network_externalize():
+    # in CONFIRM phase on A, the network externalizes B: node gets stuck at
+    # (inf, A) but never switches value
+    s = _quorum_a2_base()
+    h = s.h
+    h.recv_vblocking(h.externalize_gen(s.B2, 3))
+    assert len(h.envs) == 7
+    h.verify_confirm(h.envs[6], 2, s.AInf, 2, 2)
+    assert not h.has_ballot_timer()
+
+    h.recv_quorum_checks(h.externalize_gen(s.B2, 3), False, False)
+    assert len(h.envs) == 7
+    assert len(h.drv.externalized) == 0
+    # timer scheduled as there is a quorum with (2, *)
+    assert h.has_ballot_timer_upcoming()
+
+
+def test_hang_network_confirms_other_ballot_same_counter():
+    s = _quorum_a2_base()
+    h = s.h
+    h.recv_quorum_checks(h.confirm_gen(3, s.B2, 2, 3), False, False)
+    assert len(h.envs) == 6
+    assert len(h.drv.externalized) == 0
+    assert not h.has_ballot_timer_upcoming()
+
+
+def test_hang_network_confirms_other_ballot_different_counter():
+    s = _quorum_a2_base()
+    h = s.h
+    h.recv_vblocking(h.confirm_gen(3, s.B3, 3, 3))
+    assert len(h.envs) == 7
+    h.verify_confirm(h.envs[6], 2, s.A3, 2, 2)
+    assert not h.has_ballot_timer()
+
+    h.recv_quorum_checks(h.confirm_gen(3, s.B3, 3, 3), False, False)
+    assert len(h.envs) == 7
+    assert len(h.drv.externalized) == 0
+    assert h.has_ballot_timer_upcoming()
+
+
+def _confirm_prepared_base():
+    s = S1X()
+    s.prepared_A1()
+    s.bump_prepared_A2()
+    s.confirm_prepared_A2()
+    return s
+
+
+def test_accept_commit_vblocking_confirm_a2():
+    s = _confirm_prepared_base()
+    h = s.h
+    h.recv_vblocking(h.confirm_gen(2, s.A2, 2, 2))
+    assert len(h.envs) == 6
+    h.verify_confirm(h.envs[5], 2, s.A2, 2, 2)
+    assert not h.has_ballot_timer_upcoming()
+
+
+def test_accept_commit_vblocking_confirm_a3_4():
+    s = _confirm_prepared_base()
+    h = s.h
+    h.recv_vblocking(h.confirm_gen(4, s.A4, 3, 4))
+    assert len(h.envs) == 6
+    h.verify_confirm(h.envs[5], 4, s.A4, 3, 4)
+    assert not h.has_ballot_timer()
+
+
+def test_accept_commit_vblocking_confirm_b2():
+    s = _confirm_prepared_base()
+    h = s.h
+    h.recv_vblocking(h.confirm_gen(2, s.B2, 2, 2))
+    assert len(h.envs) == 6
+    h.verify_confirm(h.envs[5], 2, s.B2, 2, 2)
+    assert not h.has_ballot_timer_upcoming()
+
+
+def test_accept_commit_vblocking_externalize_a2():
+    s = _confirm_prepared_base()
+    h = s.h
+    h.recv_vblocking(h.externalize_gen(s.A2, 2))
+    assert len(h.envs) == 6
+    h.verify_confirm(h.envs[5], UINT32_MAX, s.AInf, 2, UINT32_MAX)
+    assert not h.has_ballot_timer()
+
+
+def test_accept_commit_vblocking_externalize_b2():
+    s = _confirm_prepared_base()
+    h = s.h
+    h.recv_vblocking(h.externalize_gen(s.B2, 2))
+    assert len(h.envs) == 6
+    h.verify_confirm(h.envs[5], UINT32_MAX, s.BInf, 2, UINT32_MAX)
+    assert not h.has_ballot_timer()
+
+
+def test_conflicting_prepared_b_same_counter():
+    s = _confirm_prepared_base()
+    h = s.h
+    h.recv_vblocking(h.prepare_gen(s.B2, s.B2))
+    assert len(h.envs) == 6
+    h.verify_prepare(h.envs[5], s.A2, p=s.B2, nC=0, nH=2, pp=s.A2)
+    assert not h.has_ballot_timer_upcoming()
+
+    h.recv_quorum(h.prepare_gen(s.B2, s.B2, 2, 2))
+    assert len(h.envs) == 7
+    h.verify_confirm(h.envs[6], 2, s.B2, 2, 2)
+    assert not h.has_ballot_timer_upcoming()
+
+
+def test_conflicting_prepared_b_higher_counter():
+    s = _confirm_prepared_base()
+    h = s.h
+    h.recv_vblocking(h.prepare_gen(s.B3, s.B2, 2, 2))
+    assert len(h.envs) == 6
+    h.verify_prepare(h.envs[5], s.A3, p=s.B2, nC=0, nH=2, pp=s.A2)
+    assert not h.has_ballot_timer()
+
+    h.recv_quorum_checks_ex(h.prepare_gen(s.B3, s.B2, 2, 2), True, True,
+                            True)
+    assert len(h.envs) == 7
+    h.verify_confirm(h.envs[6], 3, s.B3, 2, 2)
+
+
+def _bump_prepared_a2_base():
+    s = S1X()
+    s.prepared_A1()
+    s.bump_prepared_A2()
+    return s
+
+
+def test_confirm_prepared_mixed():
+    # a few nodes prepared B2 (SCPTests.cpp:1095-1144)
+    s = _bump_prepared_a2_base()
+    h = s.h
+    h.recv_vblocking(h.prepare_gen(s.B2, s.B2, 0, 0, s.A2))
+    assert len(h.envs) == 5
+    h.verify_prepare(h.envs[4], s.A2, p=s.B2, nC=0, nH=0, pp=s.A2)
+    assert not h.has_ballot_timer_upcoming()
+
+
+def test_confirm_prepared_mixed_a2():
+    s = _bump_prepared_a2_base()
+    h = s.h
+    h.recv_vblocking(h.prepare_gen(s.B2, s.B2, 0, 0, s.A2))
+    assert len(h.envs) == 5
+    # causes h=A2, but c=0 as p is incompatible with h
+    h.bump_timer_offset()
+    h.recv(h.make_prepare(3, s.A2, s.A2))
+    assert len(h.envs) == 6
+    h.verify_prepare(h.envs[5], s.A2, p=s.B2, nC=0, nH=2, pp=s.A2)
+    assert not h.has_ballot_timer_upcoming()
+
+    h.bump_timer_offset()
+    h.recv(h.make_prepare(4, s.A2, s.A2))
+    assert len(h.envs) == 6
+    assert not h.has_ballot_timer_upcoming()
+
+
+def test_confirm_prepared_mixed_b2():
+    s = _bump_prepared_a2_base()
+    h = s.h
+    h.recv_vblocking(h.prepare_gen(s.B2, s.B2, 0, 0, s.A2))
+    assert len(h.envs) == 5
+    # causes h=B2, c=B2
+    h.bump_timer_offset()
+    h.recv(h.make_prepare(3, s.B2, s.B2))
+    assert len(h.envs) == 6
+    h.verify_prepare(h.envs[5], s.B2, p=s.B2, nC=2, nH=2, pp=s.A2)
+    assert not h.has_ballot_timer_upcoming()
+
+    h.bump_timer_offset()
+    h.recv(h.make_prepare(4, s.B2, s.B2))
+    assert len(h.envs) == 6
+    assert not h.has_ballot_timer_upcoming()
+
+
+def _prepared_a1_base():
+    s = S1X()
+    s.prepared_A1()
+    return s
+
+
+def test_switch_prepared_b1_from_a1():
+    s = _prepared_a1_base()
+    h = s.h
+    # (p,p') = (B1, A1) [from (A1, null)]
+    h.recv_vblocking(h.prepare_gen(s.B1, s.B1))
+    assert len(h.envs) == 3
+    h.verify_prepare(h.envs[2], s.A1, p=s.B1, nC=0, nH=0, pp=s.A1)
+    assert not h.has_ballot_timer_upcoming()
+
+    # v-blocking with n=2 -> bump n
+    h.recv_vblocking(h.prepare_gen(s.B2))
+    assert len(h.envs) == 4
+    h.verify_prepare(h.envs[3], s.A2, p=s.B1, nC=0, nH=0, pp=s.A1)
+
+    # move to (p,p') = (B2, A1)
+    h.recv_vblocking(h.prepare_gen(s.B2, s.B2))
+    assert len(h.envs) == 5
+    h.verify_prepare(h.envs[4], s.A2, p=s.B2, nC=0, nH=0, pp=s.A1)
+    assert not h.has_ballot_timer()
+    return s
+
+
+def test_switch_prepared_vblocking_previous_p():
+    s = test_switch_prepared_b1_from_a1()
+    h = s.h
+    # v-blocking with n=3 -> bump n
+    h.recv_vblocking(h.prepare_gen(s.B3))
+    assert len(h.envs) == 6
+    h.verify_prepare(h.envs[5], s.A3, p=s.B2, nC=0, nH=0, pp=s.A1)
+    assert not h.has_ballot_timer()
+
+    # v-blocking says B1 prepared — we already have p=B2, nothing happens
+    h.recv_vblocking_checks(h.prepare_gen(s.B3, s.B1), False)
+    assert len(h.envs) == 6
+    assert not h.has_ballot_timer()
+
+
+def test_switch_prepared_p_prime_to_mid2():
+    s = test_switch_prepared_b1_from_a1()
+    h = s.h
+    h.recv_vblocking(h.prepare_gen(s.B2, s.B2, 0, 0, s.Mid2))
+    assert len(h.envs) == 6
+    h.verify_prepare(h.envs[5], s.A2, p=s.B2, nC=0, nH=0, pp=s.Mid2)
+    assert not h.has_ballot_timer()
+
+
+def test_switch_prepared_again_big2():
+    s = test_switch_prepared_b1_from_a1()
+    h = s.h
+    # both p and p' get updated: (p,p') = (Big2, B2)
+    h.recv_vblocking(h.prepare_gen(s.B2, s.Big2, 0, 0, s.B2))
+    assert len(h.envs) == 6
+    h.verify_prepare(h.envs[5], s.A2, p=s.Big2, nC=0, nH=0, pp=s.B2)
+    assert not h.has_ballot_timer()
+
+
+def test_switch_prepare_b1():
+    s = _prepared_a1_base()
+    h = s.h
+    h.recv_quorum_checks(h.prepare_gen(s.B1), True, True)
+    assert len(h.envs) == 3
+    h.verify_prepare(h.envs[2], s.A1, p=s.B1, nC=0, nH=0, pp=s.A1)
+    assert not h.has_ballot_timer_upcoming()
+
+
+def test_prepare_higher_counter_vblocking():
+    s = _prepared_a1_base()
+    h = s.h
+    h.recv_vblocking(h.prepare_gen(s.B2))
+    assert len(h.envs) == 3
+    h.verify_prepare(h.envs[2], s.A2, p=s.A1)
+    assert not h.has_ballot_timer()
+
+    h.recv_vblocking(h.prepare_gen(s.B3))
+    assert len(h.envs) == 4
+    h.verify_prepare(h.envs[3], s.A3, p=s.A1)
+    assert not h.has_ballot_timer()
+
+
+def test_prepared_b_vblocking():
+    s = S1X()
+    h = s.h
+    h.recv_vblocking(h.prepare_gen(s.B1, s.B1))
+    assert len(h.envs) == 2
+    h.verify_prepare(h.envs[1], s.A1, p=s.B1)
+    assert not h.has_ballot_timer()
+
+
+def test_prepare_b_quorum():
+    s = S1X()
+    h = s.h
+    h.recv_quorum_checks_ex(h.prepare_gen(s.B1), True, True, True)
+    assert len(h.envs) == 2
+    h.verify_prepare(h.envs[1], s.A1, p=s.B1)
+
+
+def test_confirm_vblocking_via_confirm():
+    s = S1X()
+    h = s.h
+    h.bump_timer_offset()
+    h.recv(h.make_confirm(1, 3, s.A3, 3, 3))
+    h.recv(h.make_confirm(2, 4, s.A4, 2, 4))
+    assert len(h.envs) == 2
+    h.verify_confirm(h.envs[1], 3, s.A3, 3, 3)
+    assert not h.has_ballot_timer()
+
+
+def test_confirm_vblocking_via_externalize():
+    s = S1X()
+    h = s.h
+    h.recv(h.make_externalize(1, s.A2, 4))
+    h.recv(h.make_externalize(2, s.A3, 5))
+    assert len(h.envs) == 2
+    h.verify_confirm(h.envs[1], UINT32_MAX, s.AInf, 3, UINT32_MAX)
+    assert not h.has_ballot_timer()
+
+
+def test_byzantine_ncommit_zero_does_not_poison_commit():
+    """CONFIRM statements with nCommit=0 are sane but must never produce an
+    accepted commit interval with lo=0 (reference BallotProtocol.cpp:1277:
+    candidate.first != 0) — otherwise honest nodes would build EXTERNALIZE
+    statements with commit.counter=0 and crash."""
+    s = S1X()
+    h = s.h
+    # v-blocking byzantine pair claims commit [0, 2] on A
+    h.recv(h.make_confirm(1, 2, s.A2, 0, 2))
+    h.recv(h.make_confirm(2, 2, s.A2, 0, 2))
+    bp = h.scp.get_slot(0, False).ballot
+    assert bp.c is None or bp.c[0] != 0
+    # quorum of them must not externalize at counter 0 either
+    h.recv(h.make_confirm(3, 2, s.A2, 0, 2))
+    h.recv(h.make_confirm(4, 2, s.A2, 0, 2))
+    bp = h.scp.get_slot(0, False).ballot
+    assert bp.c is None or bp.c[0] != 0
+    assert 0 not in h.drv.externalized
