@@ -351,7 +351,10 @@ class Herder:
         return True
 
     def emit_envelope(self, envelope: SCPEnvelope) -> None:
-        self.persist_scp_state(envelope)
+        # persist our pledges BEFORE they hit the wire: a crash mid-slot
+        # must not forget ballots other nodes may hold us to (reference
+        # persistSCPState in emitEnvelope, HerderImpl.cpp:302)
+        self.persist_latest_scp_state(envelope.statement.slotIndex)
         overlay = getattr(self.app, "overlay_manager", None)
         if overlay is not None:
             from ..xdr import MessageType, StellarMessage
@@ -401,6 +404,7 @@ class Herder:
         assert txset is not None, "externalized unknown txset"
         self.set_tracking(slot_index)
         self.persist_latest_scp_state(slot_index)
+        self.save_scp_history(slot_index)
 
         lm = self.app.ledger_manager
         lcd = LedgerCloseData(slot_index, txset, sv)
@@ -437,8 +441,37 @@ class Herder:
         t.async_wait(cb)
 
     # -- persistence ---------------------------------------------------------
-    def persist_scp_state(self, envelope: SCPEnvelope) -> None:
-        pass  # per-envelope persistence folded into persist_latest_scp_state
+    def save_scp_history(self, slot_index: int) -> None:
+        """Write the slot's SCP envelopes + quorum sets to the history
+        tables feeding checkpoint publication (reference
+        HerderPersistence::saveSCPHistory, called from
+        HerderImpl::valueExternalized at HerderImpl.cpp:183)."""
+        db = getattr(self.app, "database", None)
+        if db is None:
+            return
+        from ..crypto.hashing import sha256
+        from .pending_envelopes import statement_qset_hash
+        envs = self.scp.get_externalizing_state(slot_index)
+        db.execute("DELETE FROM scphistory WHERE ledgerseq = ?",
+                   (slot_index,))
+        for env in envs:
+            db.execute(
+                "INSERT INTO scphistory (nodeid, ledgerseq, envelope) "
+                "VALUES (?, ?, ?)",
+                (env.statement.nodeID.key_bytes.hex(), slot_index,
+                 env.to_xdr()))
+            qh = statement_qset_hash(env.statement)
+            qset = self.pending.qsets.get(qh)
+            if qset is None and self.app.config.QUORUM_SET is not None:
+                local = self.app.config.QUORUM_SET
+                if sha256(local.to_xdr()) == qh:
+                    qset = local
+            if qset is not None:
+                db.execute(
+                    "INSERT OR REPLACE INTO scpquorums "
+                    "(qsethash, lastledgerseq, qset) VALUES (?, ?, ?)",
+                    (qh.hex(), slot_index, qset.to_xdr()))
+        db.commit()
 
     def persist_latest_scp_state(self, slot_index: int) -> None:
         db = getattr(self.app, "database", None)

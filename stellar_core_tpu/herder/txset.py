@@ -84,29 +84,51 @@ class TxSetFrame:
     def size_txs(self) -> int:
         return len(self.frames)
 
+    # largest op count one tx can carry (reference MAX_OPS_PER_TX)
+    MAX_OPS_PER_TX = 100
+
+    @staticmethod
+    def _cap_units(f: AnyFrame, header) -> int:
+        """Capacity unit: OPERATIONS from protocol 11, whole TRANSACTIONS
+        before (reference TxSetFrame::size, TxSetFrame.cpp:449-453)."""
+        return max(1, f.num_operations()) if header.ledgerVersion >= 11 \
+            else 1
+
+    def size_for_cap(self, header) -> int:
+        return sum(self._cap_units(f, header) for f in self.frames)
+
     def base_fee(self, header) -> Optional[int]:
-        """Per-set effective base fee: when surge-priced, the lowest
-        fee-per-op among included txs (reference computeBaseFee
-        TxSetFrame.cpp:466-495)."""
-        if self.size_ops() <= header.maxTxSetSize:
-            return None  # protocol base fee applies
+        """Per-set effective base fee (reference getBaseFee
+        TxSetFrame.cpp:466-495): from protocol 11, when the set is within
+        MAX_OPS_PER_TX of capacity, every tx pays the LOWEST
+        ceil(feeBid/numOps) bid in the set; otherwise (and always pre-11)
+        the protocol base fee applies (returned as None)."""
+        if header.ledgerVersion < 11:
+            return None
+        ops = 0
         lowest = None
         for f in self.frames:
-            per_op = f.fee_bid // max(1, f.num_operations())
-            if lowest is None or per_op < lowest:
-                lowest = per_op
-        return max(lowest or header.baseFee, header.baseFee)
+            n = max(1, f.num_operations())
+            ops += n
+            bid = -(-f.fee_bid // n)  # ROUND_UP
+            if lowest is None or bid < lowest:
+                lowest = bid
+        cutoff = max(0, header.maxTxSetSize - self.MAX_OPS_PER_TX)
+        if ops > cutoff and lowest is not None:
+            return lowest
+        return None
 
-    def _fee_rate_key(self, f: AnyFrame) -> Tuple:
-        # higher fee per op first; tie-break by full hash
-        ops = max(1, f.num_operations())
-        return (f.fee_bid * 2**32 // ops, f.full_hash())
+    def _fee_rate_key(self, f: AnyFrame, header) -> Tuple:
+        # higher fee per OPERATION first regardless of protocol (reference
+        # SurgeCompare, TxSetFrame.cpp:150-186); tie-break by full hash
+        return (f.fee_bid * 2**32 // max(1, f.num_operations()),
+                f.full_hash())
 
     def surge_pricing_filter(self, header) -> None:
-        """Trim to maxTxSetSize ops keeping highest fee-per-op, whole
+        """Trim to maxTxSetSize units keeping highest fee-per-unit, whole
         account chains at a time (reference surgePricingFilter)."""
         max_ops = header.maxTxSetSize
-        if self.size_ops() <= max_ops:
+        if self.size_for_cap(header) <= max_ops:
             return
         by_acc: Dict[bytes, List[AnyFrame]] = {}
         for f in self.frames:
@@ -125,26 +147,27 @@ class TxSetFrame:
         heap = []
         for ci, (c, idx) in enumerate(heads):
             f = c[0]
-            heapq.heappush(heap, (tuple(-x if isinstance(x, int) else x
-                                        for x in self._fee_rate_key(f)[:1]) +
-                                  (f.full_hash(),), ci, 0))
+            heapq.heappush(
+                heap, (tuple(-x if isinstance(x, int) else x
+                             for x in self._fee_rate_key(f, header)[:1]) +
+                       (f.full_hash(),), ci, 0))
         heads_idx = [0] * len(chains)
         while heap:
             _, ci, idx = heapq.heappop(heap)
             if idx != heads_idx[ci]:
                 continue
             f = chains[ci][idx]
-            if ops_used + f.num_operations() > max_ops:
+            if ops_used + self._cap_units(f, header) > max_ops:
                 break
             included.append(f)
-            ops_used += f.num_operations()
+            ops_used += self._cap_units(f, header)
             heads_idx[ci] += 1
             if heads_idx[ci] < len(chains[ci]):
                 nf = chains[ci][heads_idx[ci]]
                 heapq.heappush(
                     heap,
                     (tuple(-x if isinstance(x, int) else x
-                           for x in self._fee_rate_key(nf)[:1]) +
+                           for x in self._fee_rate_key(nf, header)[:1]) +
                      (nf.full_hash(),), ci, heads_idx[ci]))
         self.frames = included
         self._hash = None

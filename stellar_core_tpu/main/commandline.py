@@ -283,6 +283,45 @@ def cmd_test(args) -> int:
     return pytest.main(["-q"] + (args.pytest_args or []))
 
 
+def cmd_infer_quorum(args) -> int:
+    """Mine quorum sets from published SCP history (reference infer-quorum,
+    src/history/InferredQuorum.cpp)."""
+    import json
+
+    from ..history.archive import HistoryArchive
+    from ..history.inferred_quorum import InferredQuorum
+    from .config import Config
+
+    cfg = Config.from_toml(args.conf) if args.conf else Config()
+    iq = InferredQuorum()
+    total = 0
+    for name, d in cfg.HISTORY.items():
+        arch = HistoryArchive.from_config(name, d)
+        if not arch.has_get():
+            continue
+        total += iq.harvest_archive(arch, args.first, args.last,
+                                    cfg.CHECKPOINT_FREQUENCY)
+    out = iq.to_json()
+    out["entries"] = total
+    out["quorum_intersection"] = iq.check_quorum_intersection()
+    print(json.dumps(out, indent=1))
+    return 0
+
+
+def cmd_fuzz(args) -> int:
+    """Mutational fuzz run over an untrusted intake surface (reference
+    `fuzz` AFL mode, src/test/FuzzerImpl.cpp; docs/fuzzing.md)."""
+    import json
+    import logging
+
+    from .fuzz import fuzz_overlay, fuzz_tx
+    logging.disable(logging.ERROR)
+    fn = fuzz_tx if args.mode == "tx" else fuzz_overlay
+    stats = fn(iterations=args.iterations, seed=args.seed)
+    print(json.dumps({"mode": args.mode, **stats}))
+    return 0
+
+
 # -- parser ------------------------------------------------------------------
 
 def build_parser() -> argparse.ArgumentParser:
@@ -299,6 +338,11 @@ def build_parser() -> argparse.ArgumentParser:
         return p
 
     add("run", cmd_run, "run a node")
+    p = add("fuzz", cmd_fuzz, "fuzz an intake surface (tx|overlay)",
+            conf=False)
+    p.add_argument("--mode", choices=("tx", "overlay"), default="tx")
+    p.add_argument("--iterations", type=int, default=10000)
+    p.add_argument("--seed", type=int, default=1)
     add("new-db", cmd_new_db, "reset DB to the genesis ledger")
     p = add("force-scp", cmd_force_scp,
             "start SCP from the LCL on next run")
@@ -307,6 +351,10 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("destination",
                    help="<to>/<count>, e.g. current/max or 100000/64")
     add("publish", cmd_publish, "publish queued checkpoints")
+    p = add("infer-quorum", cmd_infer_quorum,
+            "infer the network quorum structure from SCP history")
+    p.add_argument("--first", type=int, default=1)
+    p.add_argument("--last", type=int, default=2**31 - 1)
     p = add("new-hist", cmd_new_hist, "initialize history archives")
     p.add_argument("archives", nargs="+")
     add("offline-info", cmd_offline_info, "info for an offline instance")
@@ -339,3 +387,8 @@ def build_parser() -> argparse.ArgumentParser:
 def main(argv: Optional[List[str]] = None) -> int:
     args = build_parser().parse_args(argv)
     return args.fn(args)
+
+
+if __name__ == "__main__":
+    import sys
+    sys.exit(main())

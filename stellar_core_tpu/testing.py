@@ -47,9 +47,10 @@ class TestLedger:
     directly (fee+seq then apply), without consensus."""
 
     def __init__(self, network_id: bytes = TESTING_NETWORK_ID,
-                 verifier=None) -> None:
+                 verifier=None, ledger_version: int = 13) -> None:
         self.network_id = network_id
-        self.root = InMemoryLedgerTxnRoot(genesis_header())
+        self.root = InMemoryLedgerTxnRoot(
+            genesis_header(ledger_version=ledger_version))
         self.verifier = verifier
         root_sk = root_secret_key(network_id)
         from .transactions.account_helpers import make_account_entry

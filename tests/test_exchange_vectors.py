@@ -1,0 +1,228 @@
+"""Exchange/offer numeric edge cases ported from the reference's vector
+tables (VERDICT r2 #6): `src/transactions/test/ExchangeTests.cpp` (the
+exchangeV3 rounding semantics this framework implements) and crossing /
+liability-saturation scenarios from `src/transactions/test/OfferTests.cpp`.
+
+Every exchange vector also re-checks the two safety invariants the
+reference asserts: wheat·n <= sheep·d (the taker never underpays the
+price) and sheep <= maxSheepSend.
+"""
+
+import pytest
+
+from stellar_core_tpu.testing import (
+    TestAccount, TestLedger, root_secret_key,
+)
+from stellar_core_tpu.transactions.offer_exchange import (
+    adjust_offer, exchange, offer_liabilities,
+)
+from stellar_core_tpu.transactions.offers import ManageOfferResultCode
+from stellar_core_tpu.xdr import Asset
+
+I32 = 2**31 - 1
+I64 = 2**63 - 1
+
+# (wheatToReceive, n, d, maxWheatReceive, maxSheepSend,
+#  expWheat, expSheep, expReduced) — reference validateV3 rows
+V3_VECTORS = [
+    # normal prices, no limits (ExchangeTests.cpp:85-136)
+    (1000, 3, 2, I64, I64, 1000, 1500, False),
+    (1000, 1, 1, I64, I64, 1000, 1000, False),
+    (1000, 2, 3, I64, I64, 1000, 667, False),
+    (999, 3, 2, I64, I64, 999, 1499, False),
+    (999, 1, 1, I64, I64, 999, 999, False),
+    (999, 2, 3, I64, I64, 999, 666, False),
+    (1, 1, 1, I64, I64, 1, 1, False),
+    (1, 2, 3, I64, I64, 1, 1, False),
+    # normal prices, send limits (:138-169)
+    (1000, 3, 2, I64, 750, 500, 750, True),
+    (1000, 1, 1, I64, 500, 500, 500, True),
+    (1000, 2, 3, I64, 333, 499, 333, True),
+    (999, 3, 2, I64, 749, 499, 749, True),
+    (999, 1, 1, I64, 499, 499, 499, True),
+    (999, 2, 3, I64, 333, 499, 333, True),
+    (20, 3, 2, I64, 15, 10, 15, True),
+    (20, 1, 1, I64, 10, 10, 10, True),
+    (20, 2, 3, I64, 7, 10, 7, True),
+    (2, 3, 2, I64, 2, 1, 2, True),
+    (2, 1, 1, I64, 1, 1, 1, True),
+    (2, 2, 3, I64, 1, 1, 1, True),
+    # normal prices, receive limits (:171-209)
+    (1000, 3, 2, 500, I64, 500, 750, True),
+    (1000, 1, 1, 500, I64, 500, 500, True),
+    (1000, 2, 3, 500, I64, 500, 334, True),
+    (999, 3, 2, 499, I64, 499, 749, True),
+    (999, 1, 1, 499, I64, 499, 499, True),
+    (999, 2, 3, 499, I64, 499, 333, True),
+    (20, 3, 2, 10, I64, 10, 15, True),
+    (20, 1, 1, 10, I64, 10, 10, True),
+    (20, 2, 3, 10, I64, 10, 7, True),
+    (2, 3, 2, 1, I64, 1, 2, True),
+    (2, 1, 1, 1, I64, 1, 1, True),
+    (2, 2, 3, 1, I64, 1, 1, True),
+    # extra big prices (:211-316)
+    (1000, I32, 1, I64, I64, 1000, 1000 * I32, False),
+    (999, I32, 1, I64, I64, 999, 999 * I32, False),
+    (1, I32, 1, I64, I64, 1, I32, False),
+    (1000, I32, 1, I64, I32, 1, I32, True),
+    (999, I32, 1, I64, I32, 1, I32, True),
+    (1, I32, 1, I64, I32, 1, I32, False),
+    (1000, I32, 1, I64, 750 * I32, 750, 750 * I32, True),
+    (999, I32, 1, I64, 750 * I32, 750, 750 * I32, True),
+    (1, I32, 1, I64, 750 * I32, 1, I32, False),
+    (1000, I32, 1, 750, I64, 750, 750 * I32, True),
+    (999, I32, 1, 750, I64, 750, 750 * I32, True),
+    (1, I32, 1, 750, I64, 1, I32, False),
+    (1000, I32, 1, I32, I64, 1000, 1000 * I32, False),
+    # extra small prices (:317-420)
+    (1000 * I32, 1, I32, I64, I64, 1000 * I32, 1000, False),
+    (999 * I32, 1, I32, I64, I64, 999 * I32, 999, False),
+    (I32, 1, I32, I64, I64, I32, 1, False),
+    (1000 * I32, 1, I32, I64, 750, 750 * I32, 750, True),
+    (999 * I32, 1, I32, I64, 750, 750 * I32, 750, True),
+    (I32, 1, I32, I64, 750, I32, 1, False),
+    (1000 * I32, 1, I32, I64, I32, 1000 * I32, 1000, False),
+    (1000 * I32, 1, I32, 750, I64, 750, 1, True),
+    (999 * I32, 1, I32, 750, I64, 750, 1, True),
+    (I32, 1, I32, 750, I64, 750, 1, True),
+    (750, 1, I32, 750, I64, 750, 1, False),
+    (1000 * I32, 1, I32, 750 * I32, I64, 750 * I32, 750, True),
+    (999 * I32, 1, I32, 750 * I32, I64, 750 * I32, 750, True),
+    (I32, 1, I32, 750 * I32, I64, I32, 1, False),
+    (750, 1, I32, 750 * I32, I64, 750, 1, False),
+]
+
+# rows where the reference returns REDUCED_TO_ZERO / BOGUS → (0, 0)
+ZERO_VECTORS = [
+    (0, 3, 2, I64, I64),
+    (0, 1, 1, I64, I64),
+    (0, 2, 3, I64, I64),
+    (1000, I32, 1, I64, 750),   # price too high for the send limit
+    (999, I32, 1, I64, 750),
+    (1, I32, 1, I64, 750),
+    (0, I32, 1, I64, 750),
+    (0, I32, 1, I64, I32),
+    (0, 1, I32, I64, I64),
+]
+
+
+@pytest.mark.parametrize(
+    "wheat_req,n,d,max_recv,max_send,exp_wheat,exp_sheep,exp_reduced",
+    V3_VECTORS)
+def test_exchange_v3_vector(wheat_req, n, d, max_recv, max_send,
+                            exp_wheat, exp_sheep, exp_reduced):
+    wheat, sheep = exchange(wheat_req, n, d, max_recv, max_send)
+    assert (wheat, sheep) == (exp_wheat, exp_sheep)
+    # safety invariants (ExchangeTests.cpp:55-69)
+    assert wheat * n <= sheep * d
+    assert sheep <= max_send
+    assert (wheat < wheat_req) == exp_reduced
+
+
+@pytest.mark.parametrize("wheat_req,n,d,max_recv,max_send", ZERO_VECTORS)
+def test_exchange_reduced_to_zero(wheat_req, n, d, max_recv, max_send):
+    assert exchange(wheat_req, n, d, max_recv, max_send) == (0, 0)
+
+
+# ------------------------------------------------------- offer adjustment
+
+def test_adjust_offer_caps_at_liability_limits():
+    """adjustOffer shrinks an offer to what the owner can actually deliver
+    / the buyer can hold (reference adjustOffer + OfferTests liability
+    saturation)."""
+    # selling at 2/1: 100 sellable but only 10 deliverable
+    assert adjust_offer(2, 1, 10, I64) == 10
+    # receiving side capped: can only receive 10 units of buying asset
+    #   buying liabilities of (n=1,d=2, amount a) = ceil(a*1/2)
+    a = adjust_offer(1, 2, I64, 10)
+    assert offer_liabilities(1, 2, a)[0] <= 10
+    # zero room → offer adjusted away
+    assert adjust_offer(1, 1, 0, I64) == 0
+
+
+def test_offer_liabilities_rounding():
+    # buying liabilities round UP (taker protection), amount*n/d
+    assert offer_liabilities(3, 2, 999) == (-(-999 * 3 // 2), 999)
+    assert offer_liabilities(2, 3, 1) == (1, 1)
+
+
+# ----------------------------------------------------- crossing scenarios
+
+@pytest.fixture
+def market():
+    led = TestLedger()
+    root = TestAccount(led, root_secret_key())
+    issuer = root.create(10**10)
+    usd = Asset.credit("USD", issuer.account_id)
+    a = root.create(10**10)
+    b = root.create(10**10)
+    for acct in (a, b):
+        assert acct.change_trust(usd, 10**12)
+        assert issuer.pay(acct, 10**9, usd)
+    return led, root, issuer, usd, a, b
+
+
+def _sell(led, acct, selling, buying, amount, n, d, offer_id=0):
+    f = acct.tx([acct.op_manage_sell_offer(selling, buying, amount, n, d,
+                                           offer_id)])
+    ok = led.apply_frame(f)
+    return ok, f
+
+
+def test_cross_full_fill(market):
+    led, root, issuer, usd, a, b = market
+    xlm = Asset.native()
+    ok, _ = _sell(led, a, xlm, usd, 1000, 1, 1)       # a sells 1000 XLM
+    assert ok
+    before_b = b.balance()
+    ok, _ = _sell(led, b, usd, xlm, 1000, 1, 1)       # b sells 1000 USD
+    assert ok
+    fee = led.header().baseFee
+    assert b.balance() == before_b + 1000 - fee       # b got the XLM
+    assert led.trust_balance(a.account_id, usd) == 10**9 + 1000
+
+
+def test_cross_partial_fill_leaves_remainder(market):
+    led, root, issuer, usd, a, b = market
+    xlm = Asset.native()
+    assert _sell(led, a, xlm, usd, 1000, 1, 1)[0]
+    assert _sell(led, b, usd, xlm, 400, 1, 1)[0]
+    # a's offer partially consumed: 600 left in the book
+    from stellar_core_tpu.xdr import LedgerKey
+    rem = led.root.get_entry(LedgerKey.offer(a.account_id, 1))
+    assert rem is not None and rem.data.value.amount == 600
+
+
+def test_cross_self_prohibited(market):
+    led, root, issuer, usd, a, b = market
+    xlm = Asset.native()
+    assert _sell(led, a, xlm, usd, 1000, 1, 1)[0]
+    ok, f = _sell(led, a, usd, xlm, 100, 1, 1)        # would cross own offer
+    assert not ok
+    res = f.result.op_results[0].value
+    assert res.value.disc == ManageOfferResultCode.CROSS_SELF
+
+
+def test_cross_price_rounding_favors_maker(market):
+    """Crossing at price 3/2: taker pays ceil(amount·3/2) — the maker never
+    receives less than the price (ExchangeTests invariant on-ledger)."""
+    led, root, issuer, usd, a, b = market
+    xlm = Asset.native()
+    assert _sell(led, a, xlm, usd, 999, 3, 2)[0]      # sell XLM @1.5 USD
+    before = led.trust_balance(a.account_id, usd)
+    assert _sell(led, b, usd, xlm, 10**6, 2, 3)[0]    # taker
+    got = led.trust_balance(a.account_id, usd) - before
+    assert got * 2 >= 999 * 3                         # wheat·n <= sheep·d
+    assert got == -(-999 * 3 // 2)                    # exactly ceil
+
+
+def test_tiny_cross_rounds_to_zero_no_trade(market):
+    led, root, issuer, usd, a, b = market
+    xlm = Asset.native()
+    # a sells 1 stroop of XLM at a price where the taker would pay 0
+    assert _sell(led, a, xlm, usd, 10**6, 1, I32)[0]
+    before = led.trust_balance(a.account_id, usd)
+    # b tries to buy a dust amount: sheep send rounds up to >=1 or no trade
+    assert _sell(led, b, usd, xlm, 1, I32, 1, 0)[0]
+    after = led.trust_balance(a.account_id, usd)
+    assert after >= before                            # never negative trade

@@ -1,0 +1,33 @@
+"""Fuzz harness smoke runs (VERDICT r2 #7): a few thousand mutated inputs
+through each intake surface with no uncaught exceptions. The full
+10K-iteration runs are `stellar-core-tpu fuzz --mode tx|overlay`."""
+
+import logging
+
+from stellar_core_tpu.main.fuzz import fuzz_overlay, fuzz_tx
+
+
+def test_fuzz_tx_smoke():
+    stats = fuzz_tx(iterations=3000, seed=42)
+    assert stats["iterations"] == 3000
+    # mutated envelopes overwhelmingly fail to decode; the interesting part
+    # is that everything that DOES decode is handled without raising
+    assert stats["decode_rejects"] > 0
+    assert stats["applied"] > 0, "apply path never reached: %r" % stats
+
+
+def test_fuzz_overlay_smoke():
+    logging.disable(logging.ERROR)
+    try:
+        stats = fuzz_overlay(iterations=600, seed=42)
+    finally:
+        logging.disable(logging.NOTSET)
+    assert stats["iterations"] == 600
+    assert stats["handler_errors"] == 0, (
+        "message handlers raised on hostile input: %r" % stats)
+
+
+def test_fuzzing_mode_restored():
+    from stellar_core_tpu.transactions import signature_checker as sc
+    fuzz_tx(iterations=10, seed=1)
+    assert not sc._FUZZING_MODE

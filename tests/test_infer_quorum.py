@@ -1,0 +1,36 @@
+"""InferredQuorum: mine qsets from a published archive (VERDICT r2 #10;
+reference src/history/InferredQuorum.cpp + infer-quorum CLI)."""
+
+import os
+
+import pytest
+
+from stellar_core_tpu.history.inferred_quorum import InferredQuorum
+
+from test_catchup import FREQ, close_ledgers_with_traffic, make_app
+
+
+def test_infer_quorum_from_published_history(tmp_path):
+    archive_root = tmp_path / "archive"
+    os.makedirs(archive_root, exist_ok=True)
+    app = make_app(tmp_path, 0, archive_root)
+    close_ledgers_with_traffic(app, 2 * FREQ + 3)
+    app.crank_until(lambda: app.history_manager.publish_queue() == [],
+                    max_cranks=5000)
+
+    from stellar_core_tpu.history.archive import HistoryArchive
+    arch = HistoryArchive.local_dir("test", str(archive_root))
+    iq = InferredQuorum()
+    n = iq.harvest_archive(arch, 1, 2 * FREQ, FREQ)
+    assert n > 0, "no SCP history entries harvested"
+
+    me = app.config.NODE_SEED.public_key.key_bytes
+    assert me in iq.counts and iq.counts[me] > 0
+    q = iq.get_qset(me)
+    assert q is not None
+    assert q.threshold == app.config.QUORUM_SET.threshold
+    j = iq.to_json()
+    assert j["node_count"] == 1
+    assert j["nodes"][0]["qset"]["threshold"] == q.threshold
+    # 1-node network trivially enjoys quorum intersection
+    assert iq.check_quorum_intersection() is True
