@@ -35,6 +35,7 @@ as a test oracle.
 from __future__ import annotations
 
 import hashlib
+import os
 from functools import partial
 
 import numpy as np
@@ -448,6 +449,13 @@ def prepare_batch(pubs: list[bytes], sigs: list[bytes],
     msgs = list(msgs[:n]) + [b""] * (n - len(msgs))
     pub_arr = _pack32(pubs, n, 32)
     sig_arr = _pack32(sigs, n, 64)
+
+    if os.environ.get("SCT_NATIVE_PREP", "1") != "0":
+        from .. import native
+        prep = native.prepare_batch_native(pub_arr, sig_arr, msgs)
+        if prep is not None:
+            prep["pre_ok"] = prep["pre_ok"] & good
+            return prep
     r_arr = sig_arr[:, :32]
     s_arr = sig_arr[:, 32:]
 
