@@ -69,7 +69,9 @@ class NominationProtocol:
                 leaders.add(nb)
         if not leaders:
             leaders = {local.node_id.key_bytes}
-        self.round_leaders = leaders
+        # leaders ACCUMULATE across rounds (reference updateRoundLeaders):
+        # a new round adds its leader without forgetting previous ones
+        self.round_leaders |= leaders
 
     # ------------------------------------------------------------- intake
     @staticmethod
@@ -101,26 +103,18 @@ class NominationProtocol:
         self.latest_nominations[nb] = envelope
         if not self.nomination_started:
             return BallotProtocol.EnvelopeState.VALID
+        from .driver import ValidationLevel
         modified = False
         new_candidates = False
-        nom = st.pledges.value
-        from .driver import ValidationLevel
-        # vote for values voted by a round leader
+        # echo a round leader's BEST new value (reference
+        # getNewValueFromNomination — one value per message, highest
+        # value-hash among those we don't already vote for)
         if nb in self.round_leaders:
-            for v in nom.votes:
-                if v in self.votes:
-                    continue
-                lvl = self._driver().validate_value(
-                    self.slot.slot_index, v, True)
-                if lvl == ValidationLevel.FULLY_VALIDATED:
-                    self.votes.add(v)
-                    modified = True
-                else:
-                    alt = self._driver().extract_valid_value(
-                        self.slot.slot_index, v)
-                    if alt is not None and alt not in self.votes:
-                        self.votes.add(alt)
-                        modified = True
+            v = self._pick_leader_value(envelope)
+            if v is not None:
+                self.votes.add(v)
+                self._driver().nominating_value(self.slot.slot_index, v)
+                modified = True
         # federated voting on each known value
         for v in self._all_known_values():
             if v in self.accepted:
@@ -198,14 +192,16 @@ class NominationProtocol:
                 self.votes.add(value)
                 modified = True
             self._driver().nominating_value(self.slot.slot_index, value)
-        else:
-            for nb in self.round_leaders:
-                env = self.latest_nominations.get(nb)
-                if env is not None:
-                    v = self._pick_leader_value(env)
-                    if v is not None and v not in self.votes:
-                        self.votes.add(v)
-                        modified = True
+        # regardless of own leadership, adopt the best new value from every
+        # round leader we have heard from (reference nominate)
+        for nb in self.round_leaders:
+            env = self.latest_nominations.get(nb)
+            if env is not None:
+                v = self._pick_leader_value(env)
+                if v is not None:  # _pick skips values already voted
+                    self.votes.add(v)
+                    self._driver().nominating_value(self.slot.slot_index, v)
+                    modified = True
         # re-arm next round
         timeout = self._driver().compute_timeout(self.round_number)
         self._driver().setup_timer(
@@ -230,10 +226,12 @@ class NominationProtocol:
                 if v2 is None:
                     continue
                 v = v2
+            if v in self.votes:
+                continue  # only NEW values (reference :472-491)
             h = self._driver().compute_value_hash(
                 self.slot.slot_index, self.previous_value,
                 self.round_number, v)
-            if h > best_h:
+            if h >= best_h:  # ties: later (higher) value wins (reference)
                 best, best_h = v, h
         return best
 

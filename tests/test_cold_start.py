@@ -1,0 +1,65 @@
+"""Cold-start story (VERDICT r2 #9): a restarted validator must not re-pay
+kernel compilation — the persistent XLA cache makes the second process's
+warmup fast.
+
+Reference analog: no lazy work on the consensus path; a stellar-core
+restart is serving envelopes as soon as state is restored. Here the
+equivalent hazard is XLA compilation (~67s on TPU in round 2), so
+TpuSigVerifier.warmup() + jax_compilation_cache_dir must turn a restart
+into a cache load.
+"""
+
+import json
+import os
+import subprocess
+import sys
+import time
+
+import pytest
+
+_CHILD = r"""
+import json, os, time
+t0 = time.perf_counter()
+from stellar_core_tpu.crypto.batch_verifier import TpuSigVerifier
+from stellar_core_tpu.crypto.keys import SecretKey
+v = TpuSigVerifier(compile_cache_dir=os.environ["SCT_TEST_CACHE"])
+v.BUCKETS = (32,)
+v.warmup(wait=True)
+warm_s = time.perf_counter() - t0
+sk = SecretKey.from_seed(b"\x31" * 32)
+t0 = time.perf_counter()
+res = v.verify_many([(sk.public_key.key_bytes, sk.sign(b"m"), b"m")])
+verify_s = time.perf_counter() - t0
+assert res == [True]
+print("COLD_JSON " + json.dumps({"warm_s": warm_s, "verify_s": verify_s}))
+"""
+
+
+def _run_node(cache_dir: str) -> dict:
+    env = dict(os.environ)
+    env["SCT_TEST_CACHE"] = cache_dir
+    env.pop("JAX_COMPILATION_CACHE_DIR", None)
+    r = subprocess.run([sys.executable, "-c", _CHILD],
+                       capture_output=True, text=True, timeout=900,
+                       env=env)
+    assert r.returncode == 0, r.stderr[-2000:]
+    for line in r.stdout.splitlines():
+        if line.startswith("COLD_JSON "):
+            return json.loads(line[10:])
+    raise AssertionError("no COLD_JSON in output: %s" % r.stdout[-300:])
+
+
+def test_restart_compiles_from_cache(tmp_path):
+    """Second process start loads the kernel from the persistent cache —
+    dramatically faster than the cold compile. (Absolute restart time on
+    this CPU test host is dominated by jax import + cache deserialization;
+    the TPU validator's restart compile time is what BENCH records as
+    compile_s.)"""
+    cache = str(tmp_path / "xla-cache")
+    cold = _run_node(cache)
+    assert os.path.exists(cache) and os.listdir(cache), \
+        "persistent compilation cache was not populated"
+    warm = _run_node(cache)
+    assert warm["warm_s"] < cold["warm_s"] / 2, (cold, warm)
+    assert warm["warm_s"] < 60.0, warm
+    assert warm["verify_s"] < 2.0, warm  # first live batch is instant

@@ -1,0 +1,324 @@
+"""Ballot-protocol vectors, second tranche (SCPTests.cpp:1959-2456):
+the full normal round, commit lock-in (bumpToBallot prevented), commit
+range arithmetic, timeout/h interactions, the non-validator path, state
+restore, the <1,z> value-ordering mirror of the prefix chain, and the
+core3 min-quorum edge case (v-blocking set == quorum slice)."""
+
+from typing import Callable
+
+import pytest
+
+from stellar_core_tpu.crypto.hashing import sha256
+from stellar_core_tpu.scp.scp import SCP
+from stellar_core_tpu.xdr import SCPQuorumSet
+
+from test_scp_ballot_vectors import (
+    UINT32_MAX, H, S1X, VecDriver, X, Y, Z, ZZ, bal, nid,
+)
+
+
+def _pledged_base():
+    """nodesAllPledgeToCommit prefix (SCPTests.cpp:696-733): envs == 3,
+    state PREPARE(b, p=b, nC=1, nH=1) with b = (1, x)."""
+    h = H()
+    b = bal(1, X)
+    assert h.bump_state(X)
+    h.recv(h.make_prepare(1, b))
+    h.recv(h.make_prepare(2, b))
+    h.recv(h.make_prepare(3, b))
+    h.recv(h.make_prepare(4, b))
+    for i in (4, 3, 2, 1):
+        h.recv(h.make_prepare(i, b, b))
+    assert len(h.envs) == 3
+    h.verify_prepare(h.envs[2], b, p=b, nC=1, nH=1)
+    return h, b
+
+
+def _normal_round_externalized():
+    h, b = _pledged_base()
+    for i in (1, 2):
+        h.recv(h.make_prepare(i, b, b, 1, 1))
+    assert len(h.envs) == 3
+    h.recv(h.make_prepare(3, b, b, 1, 1))
+    assert len(h.envs) == 4
+    h.verify_confirm(h.envs[3], 1, b, 1, 1)
+    for i in (1, 2):
+        h.recv(h.make_confirm(i, 1, b, 1, 1))
+    assert len(h.envs) == 4
+    h.recv(h.make_confirm(3, 1, b, 1, 1))
+    assert len(h.envs) == 5
+    assert h.drv.externalized == {0: X}
+    h.verify_externalize(h.envs[4], b, 1)
+    # extra vote and duplicate no-op
+    h.recv(h.make_confirm(4, 1, b, 1, 1))
+    h.recv(h.make_confirm(2, 1, b, 1, 1))
+    assert len(h.envs) == 5
+    assert len(h.drv.externalized) == 1
+    return h, b
+
+
+def test_normal_round_1x():
+    _normal_round_externalized()
+
+
+@pytest.mark.parametrize("b2", [bal(1, Z), bal(2, X), bal(2, Z)],
+                         ids=["by-value", "by-counter", "by-both"])
+def test_bump_to_ballot_prevented_once_committed(b2):
+    # SCPTests.cpp:2026-2059: once externalized, even a full quorum on a
+    # different ballot moves nothing
+    h, b = _normal_round_externalized()
+    for i in (1, 2, 3, 4):
+        h.recv(h.make_confirm(i, b2.counter, b2, b2.counter, b2.counter))
+    assert len(h.envs) == 5
+    assert h.drv.externalized == {0: X}
+
+
+def test_commit_range_check():
+    # SCPTests.cpp:2061-2126
+    h, b = _pledged_base()
+    for i in (1, 2):
+        h.recv(h.make_prepare(i, b, b, 1, 1))
+    assert len(h.envs) == 3
+    h.recv(h.make_prepare(3, b, b, 1, 1))
+    assert len(h.envs) == 4
+    h.verify_confirm(h.envs[3], 1, b, 1, 1)
+
+    h.recv(h.make_confirm(1, 4, bal(4, X), 2, 4))
+    # v-blocking: b → (4,x), p → (4,x), (c,h) → (2,4)
+    h.recv(h.make_confirm(2, 6, bal(6, X), 2, 6))
+    assert len(h.envs) == 5
+    h.verify_confirm(h.envs[4], 4, bal(4, X), 2, 4)
+    # externalize on range [3,4]
+    h.recv(h.make_confirm(4, 6, bal(6, X), 3, 6))
+    assert len(h.envs) == 6
+    assert h.drv.externalized == {0: X}
+    h.verify_externalize(h.envs[5], bal(3, X), 4)
+
+
+def test_timeout_with_h_set_stays_locked_on_h():
+    # SCPTests.cpp:2128-2152
+    h = H()
+    bx = bal(1, X)
+    assert h.bump_state(X)
+    assert len(h.envs) == 1
+    h.recv_quorum(h.prepare_gen(bx, bx))
+    assert len(h.envs) == 3
+    h.verify_prepare(h.envs[2], bx, p=bx, nC=1, nH=1)
+    # timeout with a different value: stays locked on h's value
+    assert h.bump_state(Y)
+    assert len(h.envs) == 4
+    h.verify_prepare(h.envs[3], bal(2, X), p=bx, nC=1, nH=1)
+
+
+def test_timeout_h_exists_but_cannot_be_set():
+    # SCPTests.cpp:2153-2177
+    h = H()
+    by, bx = bal(1, Y), bal(1, X)
+    assert h.bump_state(Y)
+    assert len(h.envs) == 1
+    h.recv_vblocking(h.prepare_gen(bx, bx))
+    assert len(h.envs) == 2
+    h.verify_prepare(h.envs[1], by, p=bx)
+    h.recv_quorum_checks(h.prepare_gen(bx, bx), False, False)
+    assert len(h.envs) == 2
+    assert h.bump_state(Y)
+    assert len(h.envs) == 3
+    # moves to the quorum's h value; c unset since b > h
+    h.verify_prepare(h.envs[2], bal(2, X), p=bx, nC=0, nH=1)
+
+
+def test_timeout_from_multiple_nodes():
+    # SCPTests.cpp:2179-2214
+    h = H()
+    x1, x2 = bal(1, X), bal(2, X)
+    assert h.bump_state(X)
+    assert len(h.envs) == 1
+    h.verify_prepare(h.envs[0], x1)
+    h.recv_quorum(h.prepare_gen(x1))
+    assert len(h.envs) == 2
+    h.verify_prepare(h.envs[1], x1, p=x1)
+    assert h.bump_state(X)
+    assert len(h.envs) == 3
+    h.verify_prepare(h.envs[2], x2, p=x1)
+    h.recv_quorum(h.prepare_gen(x1, x1))
+    assert len(h.envs) == 4
+    h.verify_prepare(h.envs[3], x2, p=x1, nC=0, nH=1)
+    h.recv_vblocking(h.prepare_gen(x2, x2, 1, 1))
+    assert len(h.envs) == 5
+    h.verify_prepare(h.envs[4], x2, p=x2, nC=0, nH=1)
+    h.recv_quorum(h.prepare_gen(x2, x2, 1, 1))
+    assert len(h.envs) == 7
+    h.verify_prepare(h.envs[5], x2, p=x2, nC=2, nH=2)
+    h.verify_confirm(h.envs[6], 2, x2, 1, 1)
+
+
+def test_timeout_after_prepare_receive_old_messages():
+    # SCPTests.cpp:2217-2263
+    h = H()
+    x1, x2, x3 = bal(1, X), bal(2, X), bal(3, X)
+    assert h.bump_state(X)
+    assert len(h.envs) == 1
+    h.verify_prepare(h.envs[0], x1)
+    for i in (1, 2, 3):
+        h.recv(h.make_prepare(i, x1))
+    assert len(h.envs) == 2
+    h.verify_prepare(h.envs[1], x1, p=x1)
+    assert h.bump_state(X)
+    assert len(h.envs) == 3
+    h.verify_prepare(h.envs[2], x2, p=x1)
+    assert h.bump_state(X)
+    assert len(h.envs) == 4
+    h.verify_prepare(h.envs[3], x3, p=x1)
+    # other nodes moved on with x2
+    h.recv(h.make_prepare(1, x2, x2, 1, 2))
+    h.recv(h.make_prepare(2, x2, x2, 1, 2))
+    assert len(h.envs) == 5
+    h.verify_prepare(h.envs[4], x3, p=x2)
+    h.recv(h.make_prepare(3, x2, x2, 1, 2))
+    assert len(h.envs) == 6
+    h.verify_prepare(h.envs[5], x3, p=x2, nC=0, nH=2)
+
+
+def test_non_validator_watches_but_never_emits():
+    # SCPTests.cpp:2265-2292
+    h = H()
+    nv_id = nid(9)
+    nv = SCP(h.drv, nv_id, False, h.q)
+    b = bal(1, X)
+    assert nv.get_slot(0, True).bump_state(X, True)
+    assert len(h.envs) == 0   # nothing hits the wire
+    own = [e for e in nv.get_current_state(0)
+           if e.statement.nodeID.key_bytes == nv_id.key_bytes]
+    assert own and own[0].statement.pledges.disc == 0  # PREPARE recorded
+    for i in (1, 2, 3):
+        nv.receive_envelope(h.make_externalize(i, b, 1))
+    assert len(h.envs) == 0
+    own = [e for e in nv.get_current_state(0)
+           if e.statement.nodeID.key_bytes == nv_id.key_bytes]
+    st = own[0].statement.pledges
+    assert st.disc == 1   # CONFIRM(inf, (inf,x), 1, inf)
+    assert st.value.nPrepared == UINT32_MAX
+    assert st.value.nCommit == 1 and st.value.nH == UINT32_MAX
+    nv.receive_envelope(h.make_externalize(4, b, 1))
+    assert len(h.envs) == 0
+    own = [e for e in nv.get_current_state(0)
+           if e.statement.nodeID.key_bytes == nv_id.key_bytes]
+    assert own[0].statement.pledges.disc == 2  # EXTERNALIZE
+    assert h.drv.externalized == {0: X}
+
+
+@pytest.mark.parametrize("kind", ["prepare", "confirm", "externalize"])
+def test_restore_ballot_protocol_each_phase(kind):
+    # SCPTests.cpp:2294-2318: restoring own persisted statement of each
+    # phase initializes a fresh instance without processing
+    h = H()
+    b = bal(2, X)
+    fresh = SCP(h.drv, h.ids[0], True, h.q)
+    if kind == "prepare":
+        env = h.make_prepare(0, b)
+    elif kind == "confirm":
+        env = h.make_confirm(0, 2, b, 1, 2)
+    else:
+        env = h.make_externalize(0, b, 2)
+    fresh.set_state_from_envelope(env)
+    slot = fresh.get_slot(0, False)
+    assert slot is not None
+    phases = {"prepare": 0, "confirm": 1, "externalize": 2}
+    assert slot.ballot.phase == phases[kind]
+    assert len(h.envs) == 0
+
+
+# ------------------------------------------------- <1,z> ordering mirror
+
+def test_z_ordering_prefix_chain():
+    """start <1,z>: the whole prefix chain holds with the value order
+    flipped (A=z above B=x; SCPTests.cpp:1271-1334)."""
+    s = S1X(a=Z, b=X, mid=Y, big=ZZ)
+    s.prepared_A1()
+    s.bump_prepared_A2()
+    s.confirm_prepared_A2()
+    s.accept_commit_quorum_A2()
+    s.quorum_prepared_A3()
+    s.accept_more_commit_A3()
+    h = s.h
+    h.recv_quorum(h.confirm_gen(3, s.A3, 2, 3))
+    assert len(h.envs) == 10
+    h.verify_externalize(h.envs[9], s.A2, 3)
+    assert h.drv.externalized == {0: Z}
+
+
+def test_z_ordering_prepared_b_vblocking():
+    # with B below A, a v-blocking prepared-B still updates p
+    s = S1X(a=Z, b=X)
+    h = s.h
+    h.recv_vblocking(h.prepare_gen(s.B1, s.B1))
+    assert len(h.envs) == 2
+    h.verify_prepare(h.envs[1], s.A1, p=s.B1)
+    assert not h.has_ballot_timer()
+
+
+# --------------------------------------------------------- core3 topology
+
+class H3(H):
+    """3-node qset threshold 2: a v-blocking set and a quorum slice can be
+    the same two nodes (SCPTests.cpp:2320-2456)."""
+
+    def __init__(self) -> None:
+        self.ids = [nid(i) for i in range(3)]
+        self.q = SCPQuorumSet(threshold=2, validators=list(self.ids),
+                              innerSets=[])
+        self.qh = sha256(self.q.to_xdr())
+        self.drv = VecDriver({self.qh: self.q})
+        self.scp = SCP(self.drv, self.ids[0], True, self.q)
+
+    def recv_quorum_checks2(self, gen: Callable, with_checks: bool,
+                            delayed_quorum: bool, min_quorum: bool = False):
+        e1, e2 = gen(1), gen(2)
+        self.bump_timer_offset()
+        i = len(self.envs) + 1
+        self.recv(e1)
+        if with_checks and not delayed_quorum:
+            assert len(self.envs) == i
+        if not min_quorum:
+            self.recv(e2)
+            if with_checks:
+                assert len(self.envs) == i
+
+
+def test_core3_quorum_votes_b1_then_commits_a1():
+    h = H3()
+    A1, B1 = bal(1, Z), bal(1, X)
+    A2 = bal(2, Z)
+    assert not h.has_ballot_timer()
+    assert h.bump_state(Z)
+    assert len(h.envs) == 1
+    assert not h.has_ballot_timer()
+
+    # quorum votes B1 (delayed: our own vote is for A)
+    h.bump_timer_offset()
+    h.recv_quorum_checks2(h.prepare_gen(B1), True, True)
+    assert len(h.envs) == 2
+    h.verify_prepare(h.envs[1], A1, p=B1)
+    assert h.has_ballot_timer_upcoming()
+
+    # quorum prepared B1: nothing happens (computed h below current b)
+    h.bump_timer_offset()
+    h.recv_quorum_checks2(h.prepare_gen(B1, B1), False, False)
+    assert len(h.envs) == 2
+    assert not h.has_ballot_timer_upcoming()
+
+    # quorum bumps to A1 — min-quorum (v1 + self are a quorum slice)
+    h.bump_timer_offset()
+    h.recv_quorum_checks2(h.prepare_gen(A1, B1), False, False,
+                          min_quorum=True)
+    assert len(h.envs) == 3
+    h.verify_prepare(h.envs[2], A1, p=A1, nC=0, nH=0, pp=B1)
+    assert not h.has_ballot_timer_upcoming()
+
+    # quorum commits A1
+    h.bump_timer_offset()
+    h.recv_quorum_checks2(h.prepare_gen(A2, A1, 1, 1, B1), False, False,
+                          min_quorum=True)
+    assert len(h.envs) == 4
+    h.verify_confirm(h.envs[3], 2, A1, 1, 1)
+    assert not h.has_ballot_timer_upcoming()

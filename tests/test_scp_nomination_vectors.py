@@ -1,0 +1,276 @@
+"""Nomination-protocol vectors ported from the reference tables
+(SCPTests.cpp:2457-2924, "nomination tests core5"): leader election with a
+controlled priority function, vote echo, federated accept of values,
+candidate confirmation driving the ballot protocol, restore, and leader
+switching on timeout."""
+
+from typing import Callable, Optional, Set
+
+import pytest
+
+from stellar_core_tpu.crypto.hashing import sha256
+from stellar_core_tpu.scp.scp import SCP
+from stellar_core_tpu.xdr import (
+    SCPNomination, SCPPledges, SCPQuorumSet, SCPStatement, SCPStatementType,
+)
+
+from test_scp_ballot_vectors import H, VecDriver, X, Y, Z, bal, nid
+
+K = b"\x05" * 32  # the reference's kValue analog
+
+
+class NomDriver(VecDriver):
+    """VecDriver + the reference TestSCP's overridable hash hooks."""
+
+    def __init__(self, qsets, me: bytes) -> None:
+        super().__init__(qsets)
+        self.priority_lookup = lambda nb: 1000 if nb == me else 1
+        self.value_hash: Optional[Callable[[bytes], int]] = None
+        self.expected_candidates: Optional[Set[bytes]] = None
+        self.composite_value: Optional[bytes] = None
+
+    def compute_hash_node(self, slot_index, prev, is_priority,
+                          round_number, node_id):
+        return self.priority_lookup(node_id.key_bytes) if is_priority else 0
+
+    def compute_value_hash(self, slot_index, prev, round_number, value):
+        if self.value_hash is not None:
+            return self.value_hash(value)
+        return 1
+
+    def combine_candidates(self, slot_index, candidates):
+        if self.expected_candidates is not None:
+            assert set(candidates) == self.expected_candidates, candidates
+        assert self.composite_value is not None
+        return self.composite_value
+
+
+class NH(H):
+    def __init__(self, top: int = 0) -> None:
+        self.ids = [nid(i) for i in range(5)]
+        self.q = SCPQuorumSet(threshold=4, validators=list(self.ids),
+                              innerSets=[])
+        self.qh = sha256(self.q.to_xdr())
+        self.drv = NomDriver({self.qh: self.q}, self.ids[top].key_bytes)
+        self.scp = SCP(self.drv, self.ids[0], True, self.q)
+
+    def nominate(self, value: bytes, timed_out: bool = False) -> bool:
+        return self.scp.get_slot(0, True).nomination.nominate(
+            value, b"prev", timed_out)
+
+    def leaders(self) -> Set[bytes]:
+        return self.scp.get_slot(0, True).nomination.round_leaders
+
+    def make_nominate(self, i, votes, accepted):
+        return self._env(i, SCPPledges(
+            SCPStatementType.SCP_ST_NOMINATE,
+            SCPNomination(quorumSetHash=self.qh, votes=sorted(votes),
+                          accepted=sorted(accepted))))
+
+    def verify_nominate(self, env, votes, accepted):
+        self._verify(env, SCPPledges(
+            SCPStatementType.SCP_ST_NOMINATE,
+            SCPNomination(quorumSetHash=self.qh, votes=sorted(votes),
+                          accepted=sorted(accepted))))
+
+
+def _v0_top_accepted_x():
+    """Prefix (SCPTests.cpp:2494-2563): v0 leads, nominates x; quorum
+    votes x → accepted; quorum accepts x → candidate → PREPARE(1,x)."""
+    h = NH(top=0)
+    assert h.nominate(X)
+    assert h.leaders() == {h.ids[0].key_bytes}
+    assert len(h.envs) == 1
+    h.verify_nominate(h.envs[0], [X], [])
+
+    for i in (1, 2):
+        h.recv(h.make_nominate(i, [X], []))
+    assert len(h.envs) == 1
+    h.recv(h.make_nominate(3, [X], []))
+    assert len(h.envs) == 2
+    h.drv.expected_candidates = {X}
+    h.drv.composite_value = X
+    h.verify_nominate(h.envs[1], [X], [X])
+    h.recv(h.make_nominate(4, [X], []))
+    assert len(h.envs) == 2
+
+    for i in (1, 2):
+        h.recv(h.make_nominate(i, [X], [X]))
+    assert len(h.envs) == 2
+    h.recv(h.make_nominate(3, [X], [X]))
+    assert len(h.envs) == 3
+    h.verify_prepare(h.envs[2], bal(1, X))
+    h.recv(h.make_nominate(4, [X], [X]))
+    assert len(h.envs) == 3
+    return h
+
+
+def test_nomination_v0_top_prepares_x():
+    _v0_top_accepted_x()
+
+
+def test_nomination_others_accept_y_updates_composite():
+    # SCPTests.cpp:2565-2600: after preparing x, a v-blocking set accepting
+    # y pulls y in; quorum accepting y updates the composite, no new ballot
+    h = _v0_top_accepted_x()
+    votes2 = [X, Y]
+    h.recv(h.make_nominate(1, votes2, votes2))
+    assert len(h.envs) == 3
+    h.recv(h.make_nominate(2, votes2, votes2))   # v-blocking accepts y
+    assert len(h.envs) == 4
+    h.verify_nominate(h.envs[3], votes2, votes2)
+
+    h.drv.expected_candidates = {X, Y}
+    h.drv.composite_value = K
+    h.recv(h.make_nominate(3, votes2, votes2))
+    assert len(h.envs) == 4                      # composite only
+    slot = h.scp.get_slot(0, True)
+    assert slot.get_latest_composite_candidate() == K
+    h.recv(h.make_nominate(4, votes2, votes2))
+    assert len(h.envs) == 4
+
+
+def test_nomination_restored_state_ballot_not_started():
+    # SCPTests.cpp:2602-2656
+    h = NH(top=0)
+    restored = h.make_nominate(0, [X], [X])
+    h.scp.set_state_from_envelope(restored)
+    assert h.nominate(Y)
+    assert h.leaders() == {h.ids[0].key_bytes}
+    assert len(h.envs) == 1
+    h.verify_nominate(h.envs[0], [X, Y], [X])
+    for i in (1, 2, 3):
+        h.recv(h.make_nominate(i, [X], []))
+    assert len(h.envs) == 1   # x already accepted in restored state
+    h.drv.expected_candidates = {X}
+    h.drv.composite_value = X
+    for i in (1, 2):
+        h.recv(h.make_nominate(i, [X], [X]))
+    assert len(h.envs) == 1
+    h.recv(h.make_nominate(3, [X], [X]))
+    # candidate confirmed → ballot protocol starts on x
+    assert len(h.envs) == 2
+    h.verify_prepare(h.envs[1], bal(1, X))
+
+
+def test_nomination_restored_state_ballot_already_started():
+    # SCPTests.cpp:2657-2668: with a restored PREPARE on k, confirming the
+    # x candidate does NOT bump the ballot away from k
+    h = NH(top=0)
+    h.scp.set_state_from_envelope(h.make_nominate(0, [X], [X]))
+    h.scp.set_state_from_envelope(h.make_prepare(0, bal(1, K)))
+    assert h.nominate(Y)
+    assert len(h.envs) == 1
+    h.verify_nominate(h.envs[0], [X, Y], [X])
+    h.drv.expected_candidates = {X}
+    h.drv.composite_value = X
+    for i in (1, 2, 3):
+        h.recv(h.make_nominate(i, [X], [X]))
+    assert len(h.envs) == 1   # already working on k: no new message
+
+
+def test_nomination_switch_leader_on_timeout():
+    # SCPTests.cpp:2670-2698: new round with v1 as top leader echoes v1's
+    # vote
+    h = NH(top=0)
+    assert h.nominate(X)
+    assert len(h.envs) == 1
+    h.recv(h.make_nominate(1, [K], []))
+    h.recv(h.make_nominate(2, [Y], []))
+    assert len(h.envs) == 1
+    h.drv.priority_lookup = \
+        lambda nb: 1000 if nb == h.ids[1].key_bytes else 1
+    assert h.nominate(X, timed_out=True)
+    assert len(h.envs) == 2
+    h.verify_nominate(h.envs[1], [X, K], [])
+
+
+def test_nomination_self_x_others_only_vote_y():
+    # SCPTests.cpp:2700-2742
+    h = NH(top=0)
+    h.drv.expected_candidates = {X}
+    h.drv.composite_value = X
+    assert h.nominate(X)
+    assert len(h.envs) == 1
+    h.verify_nominate(h.envs[0], [X], [])
+    for i in (1, 2, 3):
+        h.recv(h.make_nominate(i, [Y], []))
+    assert len(h.envs) == 1
+    h.recv(h.make_nominate(4, [Y], []))   # quorum votes y → accept y
+    assert len(h.envs) == 2
+    h.verify_nominate(h.envs[1], [X, Y], [Y])
+
+
+def test_nomination_self_x_others_accepted_y_prepares_y():
+    # SCPTests.cpp:2743-2779
+    h = NH(top=0)
+    h.drv.expected_candidates = {X}
+    h.drv.composite_value = X
+    assert h.nominate(X)
+    assert len(h.envs) == 1
+    h.recv(h.make_nominate(1, [Y], [Y]))
+    assert len(h.envs) == 1
+    h.recv(h.make_nominate(2, [Y], [Y]))  # v-blocking accepts y
+    assert len(h.envs) == 2
+    h.verify_nominate(h.envs[1], [X, Y], [Y])
+    h.drv.expected_candidates = {Y}
+    h.drv.composite_value = Y
+    h.recv(h.make_nominate(3, [Y], [Y]))  # quorum → candidate → prepare
+    assert len(h.envs) == 3
+    h.verify_prepare(h.envs[2], bal(1, Y))
+    h.recv(h.make_nominate(4, [Y], [Y]))
+    assert len(h.envs) == 3
+
+
+def test_nomination_waits_for_leader_v1():
+    # SCPTests.cpp:2826-2864: with v1 the round leader, nominate(x) waits;
+    # only v1's own nomination triggers an echo of its best value; on
+    # timeout the next-best NEW value is adopted (and here accepted)
+    h = NH(top=1)
+    h.drv.value_hash = lambda v: {X: 1, Y: 2, K: 3}.get(v, 0)
+    assert not h.nominate(X)
+    assert h.leaders() == {h.ids[1].key_bytes}
+    assert len(h.envs) == 0
+    # nothing happens with non-top nodes
+    h.recv(h.make_nominate(2, [X, K], []))
+    h.recv(h.make_nominate(3, [Y, K], []))
+    assert len(h.envs) == 0
+    h.recv(h.make_nominate(1, [X, Y], []))
+    assert len(h.envs) == 1
+    h.verify_nominate(h.envs[0], [Y], [])   # y has the higher value hash
+    h.recv(h.make_nominate(4, [X, K], []))
+    assert len(h.envs) == 1
+    # timeout: picks x from v1 (we already vote y); the value passed in is
+    # ignored; x then gets quorum-accepted (v1, v2, v4 + self vote x)
+    h.drv.expected_candidates = {X}
+    h.drv.composite_value = X
+    assert h.nominate(K, timed_out=True)
+    assert len(h.envs) == 2
+    h.verify_nominate(h.envs[1], [X, Y], [X])
+
+
+def test_nomination_leader_dead_then_new_top():
+    # SCPTests.cpp:2866-2924 "v1 dead, timeout"
+    h = NH(top=1)
+    assert not h.nominate(X)
+    assert len(h.envs) == 0
+    h.recv(h.make_nominate(2, [X, K], []))
+    assert len(h.envs) == 0
+    assert h.leaders() == {h.ids[1].key_bytes}
+    # v2 becomes top: leaders accumulate; v2's best value gets adopted
+    h.drv.priority_lookup =         lambda nb: 1000 if nb == h.ids[2].key_bytes else 1
+    assert h.nominate(X, timed_out=True)
+    assert h.leaders() == {h.ids[1].key_bytes, h.ids[2].key_bytes}
+    assert len(h.envs) == 1
+    h.verify_nominate(h.envs[0], [max(X, K)], [])
+
+
+def test_nomination_leader_dead_no_message_from_new_top():
+    # SCPTests.cpp "v3 is new top node": nothing happens without v3 input
+    h = NH(top=1)
+    assert not h.nominate(X)
+    h.recv(h.make_nominate(2, [X, K], []))
+    h.drv.priority_lookup =         lambda nb: 1000 if nb == h.ids[3].key_bytes else 1
+    assert not h.nominate(X, timed_out=True)
+    assert h.leaders() == {h.ids[1].key_bytes, h.ids[3].key_bytes}
+    assert len(h.envs) == 0
