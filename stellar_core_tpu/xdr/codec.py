@@ -247,16 +247,11 @@ class XdrStruct:
 
     # value semantics -------------------------------------------------------
     def to_xdr(self) -> bytes:
-        p = Packer()
-        type(self).pack(p, self)
-        return p.bytes()
+        return xdr_bytes(type(self), self)
 
     @classmethod
     def from_xdr(cls, b: bytes) -> "XdrStruct":
-        u = Unpacker(b)
-        v = cls.unpack(u)
-        u.assert_done()
-        return v
+        return xdr_from(cls, b)
 
     def __eq__(self, other: Any) -> bool:
         return type(self) is type(other) and self.to_xdr() == other.to_xdr()
@@ -308,16 +303,11 @@ class XdrUnion:
         return cls(disc, value)
 
     def to_xdr(self) -> bytes:
-        p = Packer()
-        type(self).pack(p, self)
-        return p.bytes()
+        return xdr_bytes(type(self), self)
 
     @classmethod
     def from_xdr(cls, b: bytes) -> "XdrUnion":
-        u = Unpacker(b)
-        v = cls.unpack(u)
-        u.assert_done()
-        return v
+        return xdr_from(cls, b)
 
     def __eq__(self, other: Any) -> bool:
         return type(self) is type(other) and self.to_xdr() == other.to_xdr()
@@ -331,13 +321,15 @@ class XdrUnion:
 
 
 def xdr_bytes(t: Any, v: Any) -> bytes:
-    p = Packer()
-    t.pack(p, v)
-    return p.bytes()
+    from . import fastcodec
+    out: list[bytes] = []
+    fastcodec.compile_pack(t)(out.append, v)
+    return b"".join(out)
 
 
 def xdr_from(t: Any, b: bytes) -> Any:
-    u = Unpacker(b)
-    v = t.unpack(u)
-    u.assert_done()
+    from . import fastcodec
+    v, pos = fastcodec.compile_unpack(t)(b, 0)
+    if pos != len(b):
+        raise XdrError("XDR trailing bytes: %d left" % (len(b) - pos))
     return v
