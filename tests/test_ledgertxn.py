@@ -160,3 +160,124 @@ def test_offers_by_account(root):
     offers = ltx.load_offers_by_account(acc(1))
     assert sorted(o.data.value.offerID for o in offers) == [1, 2]
     ltx.rollback()
+
+
+# -- depth cases from the reference suite (LedgerTxnTests.cpp) --------------
+
+def test_erase_then_create_same_key(root):
+    """Erase + re-create in one txn nets out to an update at the parent."""
+    e = make_account_entry(acc(1), 10**9, 1 << 32)
+    key = X.LedgerKey.account(acc(1))
+    with LedgerTxn(root) as ltx:
+        ltx.create(e)
+        ltx.commit()
+    with LedgerTxn(root) as ltx:
+        ltx.erase(key)
+        e2 = make_account_entry(acc(1), 5, 2 << 32)
+        ltx.create(e2)
+        ltx.commit()
+    with LedgerTxn(root) as ltx:
+        got = ltx.load(key)
+        assert got is not None and got.data.value.balance == 5
+
+
+def test_create_existing_key_raises(root):
+    e = make_account_entry(acc(1), 10**9, 1 << 32)
+    with LedgerTxn(root) as ltx:
+        ltx.create(e)
+        with pytest.raises(Exception):
+            ltx.create(make_account_entry(acc(1), 1, 1 << 32))
+        ltx.rollback()
+
+
+def test_erase_missing_key_raises(root):
+    with LedgerTxn(root) as ltx:
+        with pytest.raises(Exception):
+            ltx.erase(X.LedgerKey.account(acc(9)))
+        ltx.rollback()
+
+
+def test_child_sees_parent_uncommitted_state(root):
+    e = make_account_entry(acc(1), 777, 1 << 32)
+    key = X.LedgerKey.account(acc(1))
+    parent = LedgerTxn(root)
+    parent.create(e)
+    child = LedgerTxn(parent)
+    got = child.load(key)
+    assert got is not None and got.data.value.balance == 777
+    # child modification invisible to grandparent root until both commit
+    got.data.value.balance = 778
+    child.commit()
+    assert root.get_entry(key) is None   # parent not committed yet
+    parent.commit()
+    with LedgerTxn(root) as chk:
+        assert chk.load(key).data.value.balance == 778
+        chk.rollback()
+
+
+def test_rollback_discards_nested_changes(root):
+    e = make_account_entry(acc(1), 100, 1 << 32)
+    key = X.LedgerKey.account(acc(1))
+    with LedgerTxn(root) as ltx:
+        ltx.create(e)
+        ltx.commit()
+    parent = LedgerTxn(root)
+    child = LedgerTxn(parent)
+    child.load(key).data.value.balance = 999
+    child.commit()          # into parent
+    parent.rollback()       # parent discards everything
+    with LedgerTxn(root) as chk:
+        assert chk.load(key).data.value.balance == 100
+        chk.rollback()
+
+
+def test_load_without_record_does_not_dirty(root):
+    e = make_account_entry(acc(1), 100, 1 << 32)
+    key = X.LedgerKey.account(acc(1))
+    with LedgerTxn(root) as ltx:
+        ltx.create(e)
+        ltx.commit()
+    ltx = LedgerTxn(root)
+    snap = ltx.load_without_record(key)
+    snap.data.value.balance = 31337   # mutating the copy must NOT stick
+    assert not ltx.has_changes()
+    ltx.commit()
+    with LedgerTxn(root) as chk:
+        assert chk.load(key).data.value.balance == 100
+        chk.rollback()
+
+
+def test_best_offer_skips_worse_in_child(root):
+    """A child-txn update changing an offer's price re-ranks the book."""
+    usd = X.Asset.credit("USD", acc(9))
+    xlm = X.Asset.native()
+    with LedgerTxn(root) as ltx:
+        ltx.create(make_offer(acc(1), 1, xlm, usd, 100, 2, 1))   # 2.0
+        ltx.create(make_offer(acc(2), 2, xlm, usd, 100, 3, 1))   # 3.0
+        ltx.commit()
+    ltx = LedgerTxn(root)
+    best = ltx.best_offer(xlm, usd)
+    assert best.data.value.offerID == 1
+    # child worsens offer 1's price beyond offer 2
+    child = LedgerTxn(ltx)
+    o1 = child.load(X.LedgerKey.offer(acc(1), 1))
+    o1.data.value.price = X.Price(n=4, d=1)
+    child.commit()
+    best = ltx.best_offer(xlm, usd)
+    assert best.data.value.offerID == 2
+    ltx.rollback()
+
+
+def test_bulk_commit_round_trips_sql():
+    """Many entries commit through the SQL root and read back identically
+    (LEDGER_ENTRY_BATCH_COMMIT role)."""
+    root = LedgerTxnRoot(Database(":memory:"), make_header())
+    with LedgerTxn(root) as ltx:
+        for i in range(1, 120):
+            ltx.create(make_account_entry(acc(i), 1000 + i, i << 32))
+        ltx.commit()
+    with LedgerTxn(root) as ltx:
+        for i in (1, 57, 119):
+            got = ltx.load(X.LedgerKey.account(acc(i)))
+            assert got is not None and got.data.value.balance == 1000 + i
+        ltx.rollback()

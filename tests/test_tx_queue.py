@@ -1,0 +1,149 @@
+"""TransactionQueue behaviors, modeled on the reference's dedicated suite
+(src/herder/test/TransactionQueueTests.cpp): per-account seq chains,
+age-based expiry into the ban list, ban-depth recovery, replace-by-fee
+(>= 10x), duplicate/gap rejection, and the pool cap."""
+
+import pytest
+
+from stellar_core_tpu.herder.tx_queue import TransactionQueue, TxQueueResult
+from stellar_core_tpu.testing import (
+    TestAccount, TestLedger, root_secret_key,
+)
+
+PENDING = TxQueueResult.ADD_STATUS_PENDING
+DUP = TxQueueResult.ADD_STATUS_DUPLICATE
+ERR = TxQueueResult.ADD_STATUS_ERROR
+LATER = TxQueueResult.ADD_STATUS_TRY_AGAIN_LATER
+
+
+class _LM:
+    """LedgerManager facade over TestLedger (queue reads ltx_root +
+    header, the shape Application provides)."""
+
+    def __init__(self, led):
+        self._led = led
+
+    def ltx_root(self):
+        return self._led.root
+
+    def header(self):
+        return self._led.header()
+
+
+@pytest.fixture
+def env():
+    led = TestLedger()
+    root = TestAccount(led, root_secret_key())
+    a = root.create(10**10)
+    b = root.create(10**10)
+    q = TransactionQueue(_LM(led), pending_depth=4, ban_depth=10,
+                         pool_ledger_multiplier=2, verifier=None)
+    return led, root, a, b, q
+
+
+def _pay(acct, root, seq=None, fee=None):
+    return acct.tx([acct.op_payment(root.account_id, 100)], seq=seq,
+                   fee=fee)
+
+
+def test_add_duplicate_and_gap(env):
+    led, root, a, b, q = env
+    f1 = _pay(a, root)
+    assert q.try_add(f1) == PENDING
+    assert q.try_add(f1) == DUP
+    # gap: seq +2 without +1 queued
+    f3 = _pay(a, root, seq=f1.seq_num + 2)
+    assert q.try_add(f3) == ERR
+    # chain extension works
+    f2 = _pay(a, root, seq=f1.seq_num + 1)
+    assert q.try_add(f2) == PENDING
+    assert q.size_ops() == 2
+
+
+def test_replace_by_fee_requires_10x(env):
+    led, root, a, b, q = env
+    base = led.header().baseFee
+    f1 = _pay(a, root, fee=base)
+    assert q.try_add(f1) == PENDING
+    # 9x: rejected
+    low = _pay(a, root, seq=f1.seq_num, fee=base * 9)
+    assert q.try_add(low) == ERR
+    # 10x: replaces, old tx banned
+    hi = _pay(a, root, seq=f1.seq_num, fee=base * 10)
+    assert q.try_add(hi) == PENDING
+    assert q.is_banned(f1.full_hash())
+    assert q.try_add(f1) == LATER
+    assert q.size_ops() == 1
+
+
+def test_age_expiry_bans_then_recovers(env):
+    led, root, a, b, q = env
+    f1 = _pay(a, root)
+    assert q.try_add(f1) == PENDING
+    for _ in range(4):   # pending_depth shifts
+        q.shift()
+    assert q.size_ops() == 0
+    assert q.is_banned(f1.full_hash())
+    assert q.try_add(f1) == LATER
+    # after ban_depth more shifts the ban rolls off
+    for _ in range(10):
+        q.shift()
+    assert not q.is_banned(f1.full_hash())
+    assert q.try_add(f1) == PENDING
+
+
+def test_pool_cap(env):
+    led, root, a, b, q = env
+    led.header().maxTxSetSize = 2   # cap = 2 * 2 = 4 ops
+    f1 = _pay(a, root)
+    f2 = _pay(a, root, seq=f1.seq_num + 1)
+    g1 = _pay(b, root)
+    g2 = _pay(b, root, seq=g1.seq_num + 1)
+    for f in (f1, f2, g1, g2):
+        assert q.try_add(f) == PENDING
+    g3 = _pay(b, root, seq=g1.seq_num + 2)
+    assert q.try_add(g3) == LATER
+    assert q.size_ops() == 4
+
+
+def test_remove_applied_keeps_chain_consistent(env):
+    led, root, a, b, q = env
+    f1 = _pay(a, root)
+    f2 = _pay(a, root, seq=f1.seq_num + 1)
+    assert q.try_add(f1) == PENDING
+    assert q.try_add(f2) == PENDING
+    # ledger applies f1 (externally): queue drops it, keeps f2
+    assert led.apply_frame(f1)
+    q.remove_applied([f1])
+    assert q.size_ops() == 1
+    assert q.try_add(f1) == ERR  # stale seq now
+    ts = q.to_txset(b"\x00" * 32, led.network_id)
+    assert [f.full_hash() for f in ts.frames] == [f2.full_hash()]
+
+
+def test_invalid_tx_rejected_at_admission(env):
+    led, root, a, b, q = env
+    # malformed op (zero amount): fails per-op checkValid at try_add
+    # (balance sufficiency is an APPLY-time check, as in the reference)
+    f = a.tx([a.op_payment(root.account_id, 0)])
+    assert q.try_add(f) == ERR
+    assert q.size_ops() == 0
+
+
+def test_to_txset_orders_chains(env):
+    led, root, a, b, q = env
+    a1 = _pay(a, root)
+    a2 = _pay(a, root, seq=a1.seq_num + 1)
+    b1 = _pay(b, root)
+    # out-of-order add: a2 before a1 is a seq gap and must be rejected
+    assert q.try_add(a2) == ERR
+    assert q.try_add(a1) == PENDING
+    assert q.try_add(b1) == PENDING
+    assert q.try_add(a2) == PENDING
+    ts = q.to_txset(b"\x00" * 32, led.network_id)
+    applied = ts.sort_for_apply()
+    assert {f.full_hash() for f in applied} == \
+        {a1.full_hash(), a2.full_hash(), b1.full_hash()}
+    order = [f.seq_num for f in applied
+             if f.source_account_id().key_bytes == a.account_id.key_bytes]
+    assert order == [a1.seq_num, a2.seq_num]

@@ -1,0 +1,104 @@
+"""Upgrade voting/validation/application behaviors (reference
+src/herder/test/UpgradesTests.cpp role): armed parameters nominate only
+after their scheduled time, foreign upgrades are voted down but applied
+once externalized, and applying each upgrade type mutates the header and
+downstream behavior (fees, reserves, capacity, protocol gates)."""
+
+import pytest
+
+from stellar_core_tpu.herder.upgrades import UpgradeParameters, Upgrades
+from stellar_core_tpu.main.application import Application
+from stellar_core_tpu.main.config import Config
+from stellar_core_tpu.testing import AppLedgerAdapter
+from stellar_core_tpu.util.timer import ClockMode, VirtualClock
+from stellar_core_tpu.xdr import LedgerUpgrade, LedgerUpgradeType
+
+from test_ledgertxn import make_header
+
+
+def up(t, v) -> bytes:
+    return LedgerUpgrade(t, v).to_xdr()
+
+
+def test_create_upgrades_only_after_scheduled_time():
+    p = UpgradeParameters()
+    p.upgrade_time = 1000
+    p.base_fee = 250
+    u = Upgrades(p)
+    h = make_header()
+    assert u.create_upgrades_for(h, close_time=999) == []
+    got = u.create_upgrades_for(h, close_time=1000)
+    assert got == [up(LedgerUpgradeType.LEDGER_UPGRADE_BASE_FEE, 250)]
+    # already at the target: nothing to nominate
+    h.baseFee = 250
+    assert u.create_upgrades_for(h, close_time=1000) == []
+
+
+def test_nomination_votes_only_for_armed_values():
+    p = UpgradeParameters()
+    p.upgrade_time = 0
+    p.protocol_version = 13
+    u = Upgrades(p)
+    h = make_header()
+    h.ledgerVersion = 12
+    good = up(LedgerUpgradeType.LEDGER_UPGRADE_VERSION, 13)
+    other = up(LedgerUpgradeType.LEDGER_UPGRADE_VERSION, 14)
+    fee = up(LedgerUpgradeType.LEDGER_UPGRADE_BASE_FEE, 9)
+    assert u.is_valid_for_nomination(good, h, 0)
+    assert not u.is_valid_for_nomination(other, h, 0)
+    assert not u.is_valid_for_nomination(fee, h, 0)   # not armed
+    assert not u.is_valid_for_nomination(b"\x99" * 3, h, 0)  # garbage
+
+
+def test_apply_validity_rules():
+    h = make_header()
+    h.ledgerVersion = 12
+    # downgrades are never applicable; upgrades are
+    assert not Upgrades.is_valid_for_apply(
+        up(LedgerUpgradeType.LEDGER_UPGRADE_VERSION, 11), h)
+    assert Upgrades.is_valid_for_apply(
+        up(LedgerUpgradeType.LEDGER_UPGRADE_VERSION, 13), h)
+    # zero values are structurally invalid
+    assert not Upgrades.is_valid_for_apply(
+        up(LedgerUpgradeType.LEDGER_UPGRADE_BASE_FEE, 0), h)
+    assert Upgrades.is_valid_for_apply(
+        up(LedgerUpgradeType.LEDGER_UPGRADE_BASE_RESERVE, 1), h)
+    kept = Upgrades.remove_upgrades(
+        [up(LedgerUpgradeType.LEDGER_UPGRADE_VERSION, 11),
+         up(LedgerUpgradeType.LEDGER_UPGRADE_BASE_FEE, 7)], h)
+    assert kept == [up(LedgerUpgradeType.LEDGER_UPGRADE_BASE_FEE, 7)]
+
+
+@pytest.fixture
+def app(tmp_path):
+    cfg = Config.test_config(0)
+    cfg.DATABASE = "sqlite3://:memory:"
+    a = Application(VirtualClock(ClockMode.VIRTUAL_TIME), cfg)
+    a.enable_buckets(str(tmp_path / "b"))
+    a.start()
+    return a
+
+
+def test_armed_upgrades_apply_through_consensus(app):
+    """Arm fee+version upgrades on a standalone node: the next closes
+    nominate and APPLY them — header changes and future txs pay the new
+    fee (reference Upgrades applied after txs at close)."""
+    p = UpgradeParameters()
+    p.upgrade_time = 0
+    p.base_fee = 123
+    p.protocol_version = 13
+    app.herder.upgrades.set_parameters(p)
+    adapter = AppLedgerAdapter(app)
+    root = adapter.root_account()
+    alice = root.create(10**9)
+    app.manual_close()
+    h = adapter.header()
+    assert h.baseFee == 123
+    assert h.ledgerVersion == 13
+    # a new tx built against the upgraded header bids the new base fee
+    f = alice.tx([alice.op_payment(root.account_id, 10)])
+    assert f.fee_bid == 123
+    before = alice.balance()
+    app.submit_transaction(f)
+    app.manual_close()
+    assert alice.balance() == before - 10 - 123

@@ -1,0 +1,193 @@
+"""Work-engine tests (reference src/work/test/WorkTests.cpp role): the
+BasicWork state machine (success, failure, retry schedules, abort), Work
+trees, WorkSequence ordering, BatchWork bounded concurrency, and
+ConditionalWork gating — all cranked on a virtual clock."""
+
+from typing import List, Optional
+
+import pytest
+
+from stellar_core_tpu.util.timer import ClockMode, VirtualClock
+from stellar_core_tpu.work.basic_work import BasicWork, State
+from stellar_core_tpu.work.work import (
+    BatchWork, ConditionalWork, Work, WorkSequence,
+)
+
+
+class StepWork(BasicWork):
+    """Succeeds after N cranks, optionally failing first `fails` times."""
+
+    def __init__(self, clock, name="step", steps=1, fails=0,
+                 max_retries=5):
+        super().__init__(clock, name, max_retries=max_retries)
+        self.steps = steps
+        self.fails = fails
+        self.runs = 0
+        self.resets = 0
+
+    def on_reset(self):
+        self.resets += 1
+        self._left = self.steps
+
+    def on_run(self):
+        self.runs += 1
+        if self.fails > 0:
+            self.fails -= 1
+            return State.FAILURE
+        self._left -= 1
+        return State.SUCCESS if self._left <= 0 else State.RUNNING
+
+
+def crank(clock, works, max_cranks=10000):
+    for _ in range(max_cranks):
+        if all(w.is_done() for w in works):
+            return True
+        for w in works:
+            if not w.is_done():
+                w.crank_work()
+        clock.crank(False)
+    return all(w.is_done() for w in works)
+
+
+def test_basic_success():
+    clock = VirtualClock(ClockMode.VIRTUAL_TIME)
+    w = StepWork(clock, steps=3)
+    w.start()
+    assert crank(clock, [w])
+    assert w.state == State.SUCCESS
+    assert w.runs == 3
+
+
+def test_retry_then_success():
+    clock = VirtualClock(ClockMode.VIRTUAL_TIME)
+    w = StepWork(clock, fails=2, max_retries=5)
+    w.start()
+    assert crank(clock, [w])
+    assert w.state == State.SUCCESS
+    assert w.resets >= 3   # initial + 2 retries
+
+
+def test_retries_exhausted_is_failure():
+    clock = VirtualClock(ClockMode.VIRTUAL_TIME)
+    w = StepWork(clock, fails=99, max_retries=2)
+    w.start()
+    assert crank(clock, [w])
+    assert w.state == State.FAILURE
+    assert w.resets == 3   # initial + 2 retries
+
+
+def test_work_tree_child_failure_fails_parent():
+    clock = VirtualClock(ClockMode.VIRTUAL_TIME)
+
+    class Parent(Work):
+        def do_reset(self):
+            self.ok = self.add_work(StepWork(clock, "ok", steps=1))
+            self.bad = self.add_work(
+                StepWork(clock, "bad", fails=99, max_retries=0))
+
+    p = Parent(clock, "parent", max_retries=0)
+    p.start()
+    assert crank(clock, [p])
+    assert p.state == State.FAILURE
+
+
+def test_work_sequence_runs_in_order():
+    clock = VirtualClock(ClockMode.VIRTUAL_TIME)
+    log: List[str] = []
+
+    class LogWork(BasicWork):
+        def __init__(self, name):
+            super().__init__(clock, name)
+
+        def on_run(self):
+            log.append(self.name)
+            return State.SUCCESS
+
+    seq = WorkSequence(clock, "seq",
+                       [LogWork("a"), LogWork("b"), LogWork("c")])
+    seq.start()
+    assert crank(clock, [seq])
+    assert seq.state == State.SUCCESS
+    assert log == ["a", "b", "c"]
+
+
+def test_work_sequence_stops_on_failure():
+    clock = VirtualClock(ClockMode.VIRTUAL_TIME)
+    ran: List[str] = []
+
+    class F(BasicWork):
+        def __init__(self, name, st):
+            super().__init__(clock, name, max_retries=0)
+            self.st = st
+
+        def on_run(self):
+            ran.append(self.name)
+            return self.st
+
+    seq = WorkSequence(clock, "seq",
+                       [F("a", State.SUCCESS), F("b", State.FAILURE),
+                        F("c", State.SUCCESS)], max_retries=0)
+    seq.start()
+    assert crank(clock, [seq])
+    assert seq.state == State.FAILURE
+    assert "c" not in ran
+
+
+def test_batch_work_bounded_concurrency():
+    clock = VirtualClock(ClockMode.VIRTUAL_TIME)
+    live = [0]
+    peak = [0]
+
+    class Slot(BasicWork):
+        def __init__(self, i):
+            super().__init__(clock, "slot-%d" % i)
+            self.ticks = 2
+
+        def on_reset(self):
+            self.started = False
+
+        def on_run(self):
+            if not self.started:
+                self.started = True
+                live[0] += 1
+                peak[0] = max(peak[0], live[0])
+            self.ticks -= 1
+            if self.ticks <= 0:
+                live[0] -= 1
+                return State.SUCCESS
+            return State.RUNNING
+
+    class B(BatchWork):
+        def __init__(self):
+            super().__init__(clock, "batch", max_concurrent=3)
+            self.spawned = 0
+
+        def yield_more_work(self) -> Optional[BasicWork]:
+            if self.spawned >= 10:
+                return None
+            self.spawned += 1
+            return Slot(self.spawned)
+
+    b = B()
+    b.start()
+    assert crank(clock, [b])
+    assert b.state == State.SUCCESS
+    assert b.spawned == 10
+    assert peak[0] <= 3, "batch exceeded its concurrency bound"
+
+
+def test_conditional_work_waits_for_predicate():
+    clock = VirtualClock(ClockMode.VIRTUAL_TIME)
+    gate = [False]
+    inner = StepWork(clock, "inner", steps=1)
+    c = ConditionalWork(clock, "cond", lambda: gate[0], inner)
+    c.start()
+    for _ in range(50):
+        c.crank_work()
+        clock.crank(False)
+    assert not c.is_done()
+    assert inner.runs == 0
+    gate[0] = True
+    assert crank(clock, [c])
+    assert c.state == State.SUCCESS
+    assert inner.runs == 1
