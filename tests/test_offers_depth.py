@@ -1,0 +1,166 @@
+"""Offer semantics depth (reference OfferTests.cpp crossing matrix subset):
+passive offers, buy offers, multi-offer book walks in price order, and
+herder value validation (closeTime rules) from HerderTests."""
+
+import pytest
+
+import stellar_core_tpu.xdr as X
+from stellar_core_tpu.testing import (
+    TestAccount, TestLedger, root_secret_key,
+)
+from stellar_core_tpu.xdr import Asset
+
+XLM = Asset.native()
+
+
+@pytest.fixture
+def market():
+    led = TestLedger()
+    root = TestAccount(led, root_secret_key())
+    issuer = root.create(10**10)
+    usd = Asset.credit("USD", issuer.account_id)
+    a = root.create(10**10)
+    b = root.create(10**10)
+    c = root.create(10**10)
+    for acct in (a, b, c):
+        assert acct.change_trust(usd, 10**12)
+        assert issuer.pay(acct, 10**9, usd)
+    return led, root, issuer, usd, a, b, c
+
+
+def _op_buy(acct, selling, buying, amount, n, d, offer_id=0):
+    from stellar_core_tpu.xdr import ManageBuyOfferOp, Price
+    return acct.op(X.OperationBody(
+        X.OperationType.MANAGE_BUY_OFFER,
+        ManageBuyOfferOp(selling=selling, buying=buying,
+                         buyAmount=amount, price=Price(n=n, d=d),
+                         offerID=offer_id)))
+
+
+def _op_passive(acct, selling, buying, amount, n, d):
+    from stellar_core_tpu.xdr import CreatePassiveSellOfferOp, Price
+    return acct.op(X.OperationBody(
+        X.OperationType.CREATE_PASSIVE_SELL_OFFER,
+        CreatePassiveSellOfferOp(selling=selling, buying=buying,
+                                 amount=amount, price=Price(n=n, d=d))))
+
+
+def test_passive_offer_does_not_cross_equal_price(market):
+    """A passive sell at exactly the opposing price RESTS instead of
+    crossing (reference createPassiveSellOffer semantics)."""
+    led, root, issuer, usd, a, b, c = market
+    assert led.apply_frame(
+        a.tx([a.op_manage_sell_offer(XLM, usd, 1000, 1, 1)]))
+    f = b.tx([_op_passive(b, usd, XLM, 500, 1, 1)])
+    assert led.apply_frame(f), f.result
+    succ = f.result.op_results[0].value.value.value
+    assert len(succ.offersClaimed) == 0      # no trade at equal price
+    assert succ.offer.disc == 0              # rests on the book
+    # a's offer untouched
+    rem = led.root.get_entry(X.LedgerKey.offer(a.account_id, 1))
+    assert rem.data.value.amount == 1000
+
+
+def test_passive_offer_still_crosses_better_price(market):
+    led, root, issuer, usd, a, b, c = market
+    # a sells XLM at 0.5 USD (good deal for a USD seller)
+    assert led.apply_frame(
+        a.tx([a.op_manage_sell_offer(XLM, usd, 1000, 1, 2)]))
+    f = b.tx([_op_passive(b, usd, XLM, 100, 1, 1)])
+    assert led.apply_frame(f), f.result
+    succ = f.result.op_results[0].value.value.value
+    assert len(succ.offersClaimed) == 1      # strictly-better price crosses
+
+
+def test_buy_offer_acquires_exact_buy_amount(market):
+    """ManageBuyOffer expresses the amount to BUY; crossing delivers
+    exactly that much of the buying asset."""
+    led, root, issuer, usd, a, b, c = market
+    assert led.apply_frame(
+        a.tx([a.op_manage_sell_offer(XLM, usd, 1000, 1, 1)]))
+    before = b.balance()
+    f = b.tx([_op_buy(b, usd, XLM, 300, 1, 1)])   # buy 300 XLM with USD
+    assert led.apply_frame(f), f.result
+    fee = led.header().baseFee
+    assert b.balance() == before + 300 - fee
+    rem = led.root.get_entry(X.LedgerKey.offer(a.account_id, 1))
+    assert rem.data.value.amount == 700
+
+
+def test_crossing_walks_book_in_price_order(market):
+    """A large taker consumes multiple offers best-price-first, partially
+    filling the worst one (the OfferTests crossing-matrix core)."""
+    led, root, issuer, usd, a, b, c = market
+    assert led.apply_frame(
+        a.tx([a.op_manage_sell_offer(XLM, usd, 100, 2, 1)]))   # 2.0 (worst)
+    assert led.apply_frame(
+        b.tx([b.op_manage_sell_offer(XLM, usd, 100, 1, 1)]))   # 1.0 (best)
+    assert led.apply_frame(
+        c.tx([c.op_manage_sell_offer(XLM, usd, 100, 3, 2)]))   # 1.5
+    taker = root.create(10**10)
+    assert taker.change_trust(usd, 10**12)
+    assert issuer.pay(taker, 10**9, usd)
+    # buy 250 XLM paying up to 2.0 USD each
+    f = taker.tx([taker.op_manage_sell_offer(usd, XLM, 500, 1, 2)])
+    assert led.apply_frame(f), f.result
+    succ = f.result.op_results[0].value.value.value
+    claimed = [(atom.sellerID.key_bytes, atom.amountSold)
+               for atom in succ.offersClaimed]
+    # price order: b (1.0) fully, c (1.5) fully, a (2.0) partially
+    assert claimed[0] == (b.account_id.key_bytes, 100)
+    assert claimed[1] == (c.account_id.key_bytes, 100)
+    assert claimed[2][0] == a.account_id.key_bytes
+    assert 0 < claimed[2][1] <= 100
+
+
+def test_update_offer_preserves_passive_flag(market):
+    led, root, issuer, usd, a, b, c = market
+    f = a.tx([_op_passive(a, XLM, usd, 1000, 2, 1)])
+    assert led.apply_frame(f)
+    oid = f.result.op_results[0].value.value.value.offer.value.offerID
+    # update amount through manage_sell_offer keeps PASSIVE_FLAG
+    f2 = a.tx([a.op_manage_sell_offer(XLM, usd, 500, 2, 1, oid)])
+    assert led.apply_frame(f2)
+    e = led.root.get_entry(X.LedgerKey.offer(a.account_id, oid))
+    from stellar_core_tpu.transactions.offers import OfferEntryFlags
+    assert e.data.value.flags & OfferEntryFlags.PASSIVE_FLAG
+    assert e.data.value.amount == 500
+
+
+# ------------------------------------------------ herder value validation
+
+def test_herder_rejects_bad_close_times():
+    """HerderSCPDriver.validate_value: closeTime must advance past the LCL
+    and stay within the +60s drift window (HerderTests closeTime rules)."""
+    from stellar_core_tpu.main.application import Application
+    from stellar_core_tpu.main.config import Config
+    from stellar_core_tpu.scp.driver import ValidationLevel
+    from stellar_core_tpu.util.timer import ClockMode, VirtualClock
+    from stellar_core_tpu.xdr import StellarValue, StellarValueExt
+
+    cfg = Config.test_config(0)
+    app = Application(VirtualClock(ClockMode.VIRTUAL_TIME), cfg)
+    app.start()
+    app.manual_close()
+    drv = app.herder.scp_driver
+    lm = app.ledger_manager
+    slot = lm.lcl_header.ledgerSeq + 1
+    lcl_ct = lm.lcl_header.scpValue.closeTime
+    now = int(app.clock.system_now())
+
+    def sv(ct):
+        return StellarValue(txSetHash=b"\x11" * 32, closeTime=ct,
+                            upgrades=[], ext=StellarValueExt(0, None)).to_xdr()
+
+    # not after the LCL close time → invalid
+    assert drv.validate_value(slot, sv(lcl_ct), False) == \
+        ValidationLevel.INVALID
+    # implausibly far future → invalid
+    assert drv.validate_value(slot, sv(now + 3600), False) == \
+        ValidationLevel.INVALID
+    # sane close time but unknown txset → MAYBE_VALID specifically
+    assert drv.validate_value(slot, sv(max(lcl_ct + 1, now)), False) == \
+        ValidationLevel.MAYBE_VALID
+    # garbage value bytes → invalid
+    assert drv.validate_value(slot, b"\x01\x02", False) == \
+        ValidationLevel.INVALID
