@@ -322,3 +322,102 @@ def test_core3_quorum_votes_b1_then_commits_a1():
     assert len(h.envs) == 4
     h.verify_confirm(h.envs[3], 2, A1, 1, 1)
     assert not h.has_ballot_timer_upcoming()
+
+
+# -------------------------- <1,z>: cross-value cases where B sorts BELOW A
+
+def _z_confirm_prepared_base():
+    s = S1X(a=Z, b=X)
+    s.prepared_A1()
+    s.bump_prepared_A2()
+    s.confirm_prepared_A2()
+    return s
+
+
+def test_z_conflicting_prepared_b_same_counter_ignored():
+    # SCPTests.cpp:1594-1601: B2 < A2, so a quorum preparing B2 moves
+    # nothing (unlike <1,x> where it switches p)
+    s = _z_confirm_prepared_base()
+    h = s.h
+    h.recv_quorum_checks(h.prepare_gen(s.B2, s.B2), False, False)
+    assert len(h.envs) == 5
+    assert not h.has_ballot_timer_upcoming()
+
+
+def test_z_conflicting_prepared_b_higher_counter():
+    # SCPTests.cpp:1602-1621: higher-counter B3 bumps the counter with
+    # p=A2 kept and B2 demoted to p'; a delayed quorum then commits B
+    s = _z_confirm_prepared_base()
+    h = s.h
+    h.recv_vblocking(h.prepare_gen(s.B3, s.B2, 2, 2))
+    assert len(h.envs) == 6
+    h.verify_prepare(h.envs[5], s.A3, p=s.A2, nC=2, nH=2, pp=s.B2)
+    assert not h.has_ballot_timer()
+    h.recv_quorum_checks_ex(h.prepare_gen(s.B3, s.B2, 2, 2), True, True,
+                            True)
+    assert len(h.envs) == 7
+    h.verify_confirm(h.envs[6], 3, s.B3, 2, 2)
+
+
+def test_z_confirm_prepared_mixed():
+    # SCPTests.cpp:1624-1679: p=A2 with p'=B2; a quorum on A2 sets h=c=A2,
+    # while B2 confirmations are no-ops (computed h incompatible with b)
+    s = S1X(a=Z, b=X)
+    s.prepared_A1()
+    s.bump_prepared_A2()
+    h = s.h
+    h.recv_vblocking(h.prepare_gen(s.A2, s.A2, 0, 0, s.B2))
+    assert len(h.envs) == 5
+    h.verify_prepare(h.envs[4], s.A2, p=s.A2, nC=0, nH=0, pp=s.B2)
+    assert not h.has_ballot_timer_upcoming()
+
+    # mixed A2: quorum confirms A2 prepared -> h=c=A2
+    h.bump_timer_offset()
+    h.recv(h.make_prepare(3, s.A2, s.A2))
+    assert len(h.envs) == 6
+    h.verify_prepare(h.envs[5], s.A2, p=s.A2, nC=2, nH=2, pp=s.B2)
+    assert not h.has_ballot_timer_upcoming()
+    h.bump_timer_offset()
+    h.recv(h.make_prepare(4, s.A2, s.A2))
+    assert len(h.envs) == 6
+
+
+def test_z_confirm_prepared_mixed_b2_noop():
+    s = S1X(a=Z, b=X)
+    s.prepared_A1()
+    s.bump_prepared_A2()
+    h = s.h
+    h.recv_vblocking(h.prepare_gen(s.A2, s.A2, 0, 0, s.B2))
+    assert len(h.envs) == 5
+    h.bump_timer_offset()
+    h.recv(h.make_prepare(3, s.A2, s.B2))
+    assert len(h.envs) == 5
+    h.bump_timer_offset()
+    h.recv(h.make_prepare(4, s.B2, s.B2))
+    assert len(h.envs) == 5
+    assert not h.has_ballot_timer_upcoming()
+
+
+def test_z_cannot_switch_prepared_down_to_b1():
+    # SCPTests.cpp:1673-1680 "switch prepared B1 from A1": with B below A
+    # the prepared ballot cannot move down — quorum on B1 is ignored
+    s = S1X(a=Z, b=X)
+    s.prepared_A1()
+    h = s.h
+    h.recv_quorum_checks(h.prepare_gen(s.B1, s.B1), False, False)
+    assert len(h.envs) == 2
+    assert not h.has_ballot_timer_upcoming()
+
+
+def test_z_vblocking_prepared_a3_plus_b3():
+    # <1,z> variant of prepared A3+B3: preparedPrime carries the LOWER B3
+    s = S1X(a=Z, b=X)
+    s.prepared_A1()
+    s.bump_prepared_A2()
+    s.confirm_prepared_A2()
+    s.accept_commit_quorum_A2()
+    h = s.h
+    h.recv_vblocking(h.prepare_gen(s.A3, s.A3, 2, 2, s.B3))
+    assert len(h.envs) == 7
+    h.verify_confirm(h.envs[6], 3, s.A3, 2, 2)
+    assert not h.has_ballot_timer()
