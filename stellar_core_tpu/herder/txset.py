@@ -235,16 +235,14 @@ class TxSetFrame:
             return
         if len(self.frames) <= 1:
             return
+        from ..transactions.transaction_frame import frames_sig_triples
         ltx = LedgerTxn(ltx_parent)
         try:
-            seen = {}
-            for f in self.frames:
-                for t in f.candidate_sig_triples(ltx):
-                    seen[t] = None
+            triples = frames_sig_triples(ltx, self.frames)
         finally:
             ltx.rollback()
-        if seen:
-            verifier.prewarm_many(list(seen))
+        if triples:
+            verifier.prewarm_many(triples)
 
     def trim_invalid(self, ltx_parent, verifier=None) -> List[AnyFrame]:
         _, removed = self.check_or_trim(ltx_parent, verifier, trim=True)

@@ -28,8 +28,7 @@ from ..util.xdrstream import XDRInputFileStream
 from ..work.basic_work import (FAILURE, RETRY_NEVER, RUNNING, SUCCESS,
                                BasicWork, State)
 from ..work.work import BatchWork, ConditionalWork, WorkSequence
-from ..xdr import (LedgerHeaderHistoryEntry, PublicKeyType,
-                   TransactionHistoryEntry)
+from ..xdr import LedgerHeaderHistoryEntry, TransactionHistoryEntry
 from .works import GetAndUnzipRemoteFileWork
 
 log = get_logger("History")
@@ -112,23 +111,18 @@ class ApplyBucketsWork(BasicWork):
         return SUCCESS
 
 
-def checkpoint_verify_triples(frames) -> List[Tuple]:
-    """Collect (key32, sig, payload) triples for a batch of tx frames —
-    the whole-ledger/checkpoint drain of SURVEY.md §2.2. Keys are matched
-    to signatures by hint, source-account first (multisig signers beyond
-    the source resolve through ledger state at apply time and simply miss
-    the cache)."""
-    triples = []
-    for f in frames:
-        payload = f.signature_payload()
-        src = f.source_account_id()
-        if src.disc != PublicKeyType.PUBLIC_KEY_TYPE_ED25519:
-            continue
-        hint = src.key_bytes[-4:]
-        for sig in f.signatures:
-            if sig.hint == hint:
-                triples.append((src.key_bytes, sig.signature, payload))
-    return triples
+def checkpoint_verify_triples(frames, ltx) -> List[Tuple]:
+    """Collect (key32, sig, contents-HASH) triples for a batch of tx
+    frames — the whole-ledger/checkpoint drain of SURVEY.md §2.2. The
+    message is the tx contents hash, exactly what SignatureChecker later
+    verifies over (reference signs/verifies sha256(networkID‖envType‖tx),
+    SignatureUtils.cpp:27-36), so the prewarmed cache entries are the ones
+    the apply path hits. Signer sets (master + account signers of every
+    tx/op source) resolve through ledger state, so multisig txs prewarm
+    too; signers added within the same checkpoint miss the cache and fall
+    back to the sync path."""
+    from ..transactions.transaction_frame import frames_sig_triples
+    return frames_sig_triples(ltx, frames)
 
 
 class ApplyCheckpointWork(BasicWork):
@@ -190,7 +184,12 @@ class ApplyCheckpointWork(BasicWork):
             fr = TxSetFrame.from_wire(net, ts)
             self._frames[seq] = fr       # reused at apply: parse once
             frames.extend(fr.frames)
-        triples = checkpoint_verify_triples(frames)
+        from ..ledger.ledgertxn import LedgerTxn
+        ltx = LedgerTxn(self.app.ledger_manager.ltx_root())
+        try:
+            triples = checkpoint_verify_triples(frames, ltx)
+        finally:
+            ltx.rollback()
         if triples:
             verifier.prewarm_many(triples)
             log.debug("prewarmed %d sigs for checkpoint %08x",

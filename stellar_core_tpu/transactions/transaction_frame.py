@@ -37,29 +37,60 @@ from . import operations as _ops  # noqa: F401  (populates the op registry)
 from . import offers as _offers   # noqa: F401
 
 
+def _signer_keys_of(ltx, acc_id: bytes,
+                    cache: Optional[dict] = None) -> frozenset:
+    """Ed25519 signer-key set of one account: master key + account
+    signers (reference SignatureChecker scans the same set). `cache`
+    memoizes per account so batch collection over many frames loads and
+    parses each account entry once."""
+    if cache is not None:
+        got = cache.get(acc_id)
+        if got is not None:
+            return got
+    from ..xdr import SignerKeyType
+    keys = {acc_id}  # master key; also the missing-account case
+    entry = ltx.load_without_record(
+        LedgerKey.account(PublicKey.ed25519(acc_id)))
+    if entry is not None:
+        for s in entry.data.value.signers:
+            if s.key.disc == SignerKeyType.SIGNER_KEY_TYPE_ED25519:
+                keys.add(s.key.value)
+    out = frozenset(keys)
+    if cache is not None:
+        cache[acc_id] = out
+    return out
+
+
 def collect_sig_triples(ltx, account_ids, signatures,
-                        contents_hash: bytes
+                        contents_hash: bytes,
+                        signer_cache: Optional[dict] = None
                         ) -> List[Tuple[bytes, bytes, bytes]]:
     """Hint-matching (ed25519-key, signature, contents-hash) pairs against
     the signer sets (master key + account signers) of `account_ids`.
     Shared by the tx and fee-bump frames' candidate_sig_triples — the
     collection half of TxSetFrame's two-phase prewarm."""
-    from ..xdr import SignerKeyType
     keys = set()
     for acc_id in account_ids:
-        keys.add(acc_id)  # master key; also the missing-account case
-        entry = ltx.load_without_record(
-            LedgerKey.account(PublicKey.ed25519(acc_id)))
-        if entry is not None:
-            for s in entry.data.value.signers:
-                if s.key.disc == SignerKeyType.SIGNER_KEY_TYPE_ED25519:
-                    keys.add(s.key.value)
+        keys |= _signer_keys_of(ltx, acc_id, signer_cache)
     out = []
     for ds in signatures:
         for kb in keys:
             if ds.hint == kb[-4:]:
                 out.append((kb, ds.signature, contents_hash))
     return out
+
+
+def frames_sig_triples(ltx, frames) -> List[Tuple[bytes, bytes, bytes]]:
+    """Deduped candidate triples for a BATCH of frames — the shared
+    collection step of both prewarm sites (TxSetFrame.check_or_trim and
+    catchup's whole-checkpoint drain). One signer-set resolution per
+    distinct account across the whole batch."""
+    seen: dict = {}
+    signer_cache: dict = {}
+    for f in frames:
+        for t in f.candidate_sig_triples(ltx, signer_cache):
+            seen[t] = None
+    return list(seen)
 
 
 def _make_result(fee_charged: int, code: int,
@@ -133,7 +164,8 @@ class TransactionFrame:
             secret_key.sign_decorated(self.contents_hash()))
 
     # -- batched signature collection ----------------------------------------
-    def candidate_sig_triples(self, ltx) -> List[Tuple[bytes, bytes, bytes]]:
+    def candidate_sig_triples(self, ltx, signer_cache: Optional[dict] = None
+                              ) -> List[Tuple[bytes, bytes, bytes]]:
         """Every (ed25519-key, signature, contents-hash) pair a
         SignatureChecker over this tx could end up verifying: hint-matching
         pairs against the signer sets (master key + account signers) of the
@@ -145,7 +177,7 @@ class TransactionFrame:
         for f in self.op_frames:
             accs.add(f.source_account_id().key_bytes)
         return collect_sig_triples(ltx, accs, self.signatures,
-                                   self.contents_hash())
+                                   self.contents_hash(), signer_cache)
 
     # -- fees ---------------------------------------------------------------
     def min_fee(self, header) -> int:
@@ -388,13 +420,14 @@ class FeeBumpTransactionFrame:
         self.signatures.append(
             secret_key.sign_decorated(self.contents_hash()))
 
-    def candidate_sig_triples(self, ltx) -> List[Tuple[bytes, bytes, bytes]]:
+    def candidate_sig_triples(self, ltx, signer_cache: Optional[dict] = None
+                              ) -> List[Tuple[bytes, bytes, bytes]]:
         """Fee-bump outer signatures (fee source signers) + the inner tx's
         triples; see TransactionFrame.candidate_sig_triples."""
         out = collect_sig_triples(
             ltx, {self.source_account_id().key_bytes}, self.signatures,
-            self.contents_hash())
-        out.extend(self.inner.candidate_sig_triples(ltx))
+            self.contents_hash(), signer_cache)
+        out.extend(self.inner.candidate_sig_triples(ltx, signer_cache))
         return out
 
     def min_fee(self, header) -> int:

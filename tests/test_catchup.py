@@ -300,9 +300,25 @@ def test_prewarm_batches_checkpoint_sigs(publisher):
     app_b = make_app(tmp_path, 5, archive_root, writable=False)
     cv = CountingVerifier()
     app_b.sig_verifier = cv
-    work = app_b.catchup_manager.start_catchup(
-        CatchupConfiguration.complete())
-    assert run_work(app_b, work) == State.SUCCESS
+
+    # the prewarm must cache under the exact (key, sig, contents-hash)
+    # the apply-time SignatureChecker looks up: after the per-checkpoint
+    # prewarm dispatch, NO further raw verifies happen (regression: a
+    # wrong message in the triples made every sig verify twice and, under
+    # the TPU backend, dispatched a tiny device batch per tx)
+    from stellar_core_tpu.crypto import keys as _keys
+    _keys.flush_verify_cache()
+    raw_calls = [0]
+    orig_raw = _keys.raw_verify
+    _keys.raw_verify = lambda k, s, m: (
+        raw_calls.__setitem__(0, raw_calls[0] + 1) or orig_raw(k, s, m))
+    try:
+        work = app_b.catchup_manager.start_catchup(
+            CatchupConfiguration.complete())
+        assert run_work(app_b, work) == State.SUCCESS
+    finally:
+        _keys.raw_verify = orig_raw
     # one batch per checkpoint, each covering many ledgers' signatures
     assert len(cv.batches) >= 2
     assert max(cv.batches) > 1
+    assert raw_calls[0] == sum(cv.batches)
