@@ -87,14 +87,16 @@ def device_bench(batch: int = 8192, iters: int = 10) -> dict:
 
 
 def replay_bench(backend: str, n_checkpoints: int = 4,
-                 txs_per_ledger: int = 48) -> dict:
+                 txs_per_ledger: int = 48, sigs_per_tx: int = 3) -> dict:
     """Catchup-replay benchmark: the second north-star metric
     (BASELINE.md: >=5x pubnet replay vs libsodium CPU; reference
     methodology /root/reference/performance-eval/performance-eval.md:52-66).
 
     Publishes a dense synthetic history (txs_per_ledger payments per
-    ledger) to a tmpdir file archive, then times a fresh node replaying it
-    with the given SIG_VERIFY_BACKEND. Runs in a child process."""
+    ledger, each from a sigs_per_tx-of-N multisig account — the pubnet
+    mixed-load shape where signature checking dominates checkValid) to a
+    tmpdir file archive, then times a fresh node replaying it with the
+    given SIG_VERIFY_BACKEND. Runs in a child process."""
     import shutil
     import tempfile
 
@@ -135,6 +137,17 @@ def replay_bench(backend: str, n_checkpoints: int = 4,
         # each create() closes a ledger, so anchor the dense range AFTER
         # account setup and aim for n_checkpoints more checkpoint files
         senders = [root.create(10**10) for _ in range(txs_per_ledger)]
+        extra_signers = {}
+        if sigs_per_tx > 1:
+            from stellar_core_tpu.crypto.keys import SecretKey
+            for i, s in enumerate(senders):
+                ks = [SecretKey.from_seed(bytes([201 + j, i & 0xFF] + [7] * 30))
+                      for j in range(sigs_per_tx - 1)]
+                ops = [s.op_add_signer(k.public_key.key_bytes) for k in ks]
+                ops.append(s.op_set_options(med=sigs_per_tx))
+                pub.submit_transaction(s.tx(ops))
+                extra_signers[i] = ks
+            pub.manual_close()   # one ledger arms every sender's multisig
         # keep virtual time ahead of ledger closeTime (it advances 1s per
         # close; the herder rejects values >60s ahead of the local clock —
         # reference MAXIMUM_LEDGER_CLOSETIME_DRIFT behavior)
@@ -145,9 +158,10 @@ def replay_bench(backend: str, n_checkpoints: int = 4,
             n_checkpoints
         dense = 0
         while pub.history_manager.published_checkpoints < target_cps:
-            for s in senders:
+            for i, s in enumerate(senders):
                 pub.submit_transaction(
-                    s.tx([s.op_payment(root.account_id, 1000)]))
+                    s.tx([s.op_payment(root.account_id, 1000)],
+                         extra_signers=extra_signers.get(i)))
             pub.clock.set_virtual_time(pub.clock.now() + 1.0)
             pub.manual_close()
             dense += 1
@@ -193,7 +207,8 @@ def replay_bench(backend: str, n_checkpoints: int = 4,
                 "dense_ledgers": dense, "wall_s": round(wall, 3),
                 "ledgers_per_sec": round(n_ledgers / wall, 2),
                 "txs_per_sec": round(n_txs / wall, 1),
-                "txs_per_ledger": txs_per_ledger}
+                "txs_per_ledger": txs_per_ledger,
+                "sigs_per_tx": sigs_per_tx}
     finally:
         shutil.rmtree(tmp, ignore_errors=True)
 

@@ -121,11 +121,18 @@ class AccountSubEntriesCountIsValid(Invariant):
                 pv = prev.data.value.numSubEntries if prev else 0
                 cv = cur.data.value.numSubEntries if cur else 0
                 d_declared[acc] = d_declared.get(acc, 0) + cv - pv
+                # signers live inside the account entry but count as
+                # subentries (reference AccountSubEntriesCountIsValid
+                # counts signers.size() alongside owned entries)
+                ps = len(prev.data.value.signers) if prev else 0
+                cs = len(cur.data.value.signers) if cur else 0
+                d_sub[acc] = d_sub.get(acc, 0) + cs - ps
                 if cur is None:
                     # merged account must have no subentries
-                    if prev.data.value.numSubEntries != 0:
+                    if prev.data.value.numSubEntries != ps:
                         return "account removed with subentries"
                     d_declared.pop(acc, None)
+                    d_sub.pop(acc, None)
             elif t in (LedgerEntryType.TRUSTLINE, LedgerEntryType.DATA):
                 e = (cur or prev).data.value
                 acc = e.accountID.key_bytes

@@ -21,23 +21,42 @@ from ..xdr import (
     Asset, LedgerEntry, LedgerEntryType, LedgerHeader, LedgerKey, OfferEntry,
     ledger_entry_key,
 )
+from ..xdr import fastcodec
 from ..crypto import strkey
 
 
 def _kb(key: LedgerKey) -> bytes:
-    return key.to_xdr()
+    """LedgerKey → canonical bytes (the txn tree's map key), memoized on
+    the instance — keys are treated as immutable once built, and the same
+    key object flows through load/commit/delta several times per access."""
+    kb = key.__dict__.get("_kb")
+    if kb is None:
+        kb = key.to_xdr()
+        key.__dict__["_kb"] = kb
+    return kb
 
 
-def _copy_entry(e: LedgerEntry) -> LedgerEntry:
-    return LedgerEntry.from_xdr(e.to_xdr())
+# copy-on-write primitives: compiled structural copies (xdr/fastcodec.py),
+# ~4x cheaper than the pack+unpack round-trip (replay profile: entry/header
+# copies were ~14% of catchup CPU)
+_copy_entry = fastcodec.compile_copy(LedgerEntry)
+_copy_header = fastcodec.compile_copy(LedgerHeader)
 
 
-def _copy_header(h: LedgerHeader) -> LedgerHeader:
-    return LedgerHeader.from_xdr(h.to_xdr())
+_acc_str_cache: Dict[bytes, str] = {}
 
 
 def _acc_str(account_id) -> str:
-    return strkey.encode_public_key(account_id.key_bytes)
+    """strkey encoding for SQL row keys, memoized — a busy account's key
+    is re-encoded on every load/commit otherwise (CRC16 per call)."""
+    kb = account_id.key_bytes
+    s = _acc_str_cache.get(kb)
+    if s is None:
+        if len(_acc_str_cache) > 0x10000:
+            _acc_str_cache.clear()
+        s = strkey.encode_public_key(kb)
+        _acc_str_cache[kb] = s
+    return s
 
 
 def _asset_str(asset: Asset) -> str:

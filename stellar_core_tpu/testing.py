@@ -220,6 +220,24 @@ class TestAccount:
             ManageSellOfferOp(selling=selling, buying=buying, amount=amount,
                               price=Price(n=n, d=d), offerID=offer_id)))
 
+    def op_set_options(self, inflation_dest=None, clear_flags=None,
+                       set_flags=None, master_weight=None, low=None,
+                       med=None, high=None, home_domain=None,
+                       signer=None) -> Operation:
+        from .xdr import SetOptionsOp
+        return self.op(OperationBody(
+            OperationType.SET_OPTIONS,
+            SetOptionsOp(inflationDest=inflation_dest,
+                         clearFlags=clear_flags, setFlags=set_flags,
+                         masterWeight=master_weight, lowThreshold=low,
+                         medThreshold=med, highThreshold=high,
+                         homeDomain=home_domain, signer=signer)))
+
+    def op_add_signer(self, key_bytes32: bytes, weight: int = 1) -> Operation:
+        from .xdr import Signer, SignerKey
+        return self.op_set_options(
+            signer=Signer(key=SignerKey.ed25519(key_bytes32), weight=weight))
+
     def op_manage_data(self, name: str,
                        value: Optional[bytes]) -> Operation:
         from .xdr import ManageDataOp

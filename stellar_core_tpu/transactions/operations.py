@@ -412,7 +412,10 @@ class AccountMergeOpFrame(OperationFrame):
         acc = src.data.value
         if is_immutable_auth(acc):
             return self.set_inner(AccountMergeResultCode.IMMUTABLE_SET)
-        if acc.numSubEntries != 0:
+        # signers live inside the account entry and die with it; only
+        # OWNED subentries (trustlines/offers/data) block a merge
+        # (reference MergeOpFrame.cpp:95: numSubEntries != signers.size())
+        if acc.numSubEntries != len(acc.signers):
             return self.set_inner(AccountMergeResultCode.HAS_SUB_ENTRIES)
         # replay protection (reference: seqnum in current ledger's range)
         if acc.seqNum >= starting_sequence_number(header):

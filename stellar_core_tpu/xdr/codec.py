@@ -229,7 +229,10 @@ class XdrStruct:
         for n in names:
             if n not in kw:
                 raise TypeError("%s missing field %s" % (type(self).__name__, n))
-            setattr(self, n, kw.pop(n))
+            v = kw.pop(n)
+            if type(v) is tuple:  # normalize so field-wise __eq__ is exact
+                v = list(v)
+            setattr(self, n, v)
         if kw:
             raise TypeError("%s unknown fields %s" % (type(self).__name__, list(kw)))
 
@@ -254,7 +257,15 @@ class XdrStruct:
         return xdr_from(cls, b)
 
     def __eq__(self, other: Any) -> bool:
-        return type(self) is type(other) and self.to_xdr() == other.to_xdr()
+        # field-wise (values are ints/bytes/lists/nested XDR, where ==
+        # recurses) — equivalent to comparing canonical bytes, without
+        # serializing both sides
+        if type(self) is not type(other):
+            return False
+        for n, _t in self.xdr_fields:
+            if getattr(self, n) != getattr(other, n):
+                return False
+        return True
 
     def __hash__(self) -> int:
         return hash((type(self).__name__, self.to_xdr()))
@@ -276,6 +287,8 @@ class XdrUnion:
 
     def __init__(self, disc: int, value: Any = None) -> None:
         self.disc = disc
+        if type(value) is tuple:  # normalize so field-wise __eq__ is exact
+            value = list(value)
         self.value = value
 
     @classmethod
@@ -310,7 +323,9 @@ class XdrUnion:
         return xdr_from(cls, b)
 
     def __eq__(self, other: Any) -> bool:
-        return type(self) is type(other) and self.to_xdr() == other.to_xdr()
+        # structural, like XdrStruct.__eq__
+        return (type(self) is type(other) and self.disc == other.disc
+                and self.value == other.value)
 
     def __hash__(self) -> int:
         return hash((type(self).__name__, self.to_xdr()))
@@ -320,16 +335,25 @@ class XdrUnion:
         return "%s(%s=%r)" % (type(self).__name__, name, self.value)
 
 
+_fastcodec = None  # lazy module ref (fastcodec imports this module)
+
+
 def xdr_bytes(t: Any, v: Any) -> bytes:
-    from . import fastcodec
+    global _fastcodec
+    if _fastcodec is None:
+        from . import fastcodec as _fc
+        _fastcodec = _fc
     out: list[bytes] = []
-    fastcodec.compile_pack(t)(out.append, v)
+    _fastcodec.compile_pack(t)(out.append, v)
     return b"".join(out)
 
 
 def xdr_from(t: Any, b: bytes) -> Any:
-    from . import fastcodec
-    v, pos = fastcodec.compile_unpack(t)(b, 0)
+    global _fastcodec
+    if _fastcodec is None:
+        from . import fastcodec as _fc
+        _fastcodec = _fc
+    v, pos = _fastcodec.compile_unpack(t)(b, 0)
     if pos != len(b):
         raise XdrError("XDR trailing bytes: %d left" % (len(b) - pos))
     return v

@@ -426,3 +426,44 @@ def test_fee_bump(ledger, root):
     # sponsor paid the fee, not a
     assert sponsor.balance() < bal_sponsor
     assert ledger.balance(a.account_id) == bal_a - 1
+
+
+def test_merge_allowed_with_signers_blocked_by_trustline(ledger, root):
+    """Reference MergeOpFrame.cpp:95: signers die with the account; only
+    owned subentries (trustline/offer/data) block a merge."""
+    a = root.create(10**9)
+    b = root.create(10**9)
+    other = SecretKey.pseudo_random_for_testing()
+    assert ledger.apply_frame(
+        a.tx([a.op_add_signer(other.public_key.key_bytes, weight=1)]))
+    merge = a.op(OperationBody(OperationType.ACCOUNT_MERGE, b.muxed))
+    f = a.tx([merge])
+    assert ledger.apply_frame(f), f.result
+    assert not ledger.account_exists(a.account_id)
+
+    # a trustline is an owned subentry: merge must fail
+    c = root.create(10**9)
+    issuer = root.create(10**9)
+    usd = Asset.credit("USD", issuer.account_id)
+    assert ledger.apply_frame(c.tx([c.op_change_trust(usd, 10**6)]))
+    f2 = c.tx([c.op(OperationBody(OperationType.ACCOUNT_MERGE, b.muxed))])
+    assert not ledger.apply_frame(f2)
+    assert inner_code(f2) == AccountMergeResultCode.HAS_SUB_ENTRIES
+
+
+def test_multisig_payment_meets_med_threshold(ledger, root):
+    """3-of-3 multisig: master + two added signers, medThreshold=3."""
+    a = root.create(10**9)
+    b = root.create(10**9)
+    k1 = SecretKey.pseudo_random_for_testing()
+    k2 = SecretKey.pseudo_random_for_testing()
+    assert ledger.apply_frame(a.tx([
+        a.op_add_signer(k1.public_key.key_bytes),
+        a.op_add_signer(k2.public_key.key_bytes),
+        a.op_set_options(med=3)]))
+    # one signature is no longer enough
+    f_bad = a.tx([a.op_payment(b.account_id, 100)])
+    assert not ledger.apply_frame(f_bad)
+    # all three signatures clear the threshold
+    f_ok = a.tx([a.op_payment(b.account_id, 100)], extra_signers=[k1, k2])
+    assert ledger.apply_frame(f_ok), f_ok.result

@@ -111,3 +111,65 @@ def test_trailing_bytes_rejected():
     a = X.Asset.native()
     with pytest.raises(XdrError):
         X.Asset.from_xdr(a.to_xdr() + b"\x00\x00\x00\x00")
+
+
+# ---------------------------------------------------------- compiled copy
+
+def _ext_v0():
+    from stellar_core_tpu.xdr.ledger_entries import _Ext
+    return _Ext.v0()
+
+
+def _sample_account_entry():
+    a = X.AccountEntry(
+        accountID=acc(1), balance=500, seqNum=7, numSubEntries=1,
+        inflationDest=acc(2), flags=0, homeDomain="example.com",
+        thresholds=bytes([1, 0, 0, 0]),
+        signers=[X.Signer(key=X.SignerKey.ed25519(bytes([9] * 32)),
+                          weight=5)],
+        ext=X.AccountEntryExt.v0())
+    return X.LedgerEntry(lastModifiedLedgerSeq=3,
+                         data=X.LedgerEntryData(X.LedgerEntryType.ACCOUNT, a),
+                         ext=_ext_v0())
+
+
+def test_compile_copy_equals_and_is_deep():
+    from stellar_core_tpu.xdr import fastcodec
+    e = _sample_account_entry()
+    cp = fastcodec.compile_copy(X.LedgerEntry)(e)
+    assert cp is not e
+    assert cp.to_xdr() == e.to_xdr()
+    # deep: mutating the copy's nested struct/list leaves the original alone
+    cp.data.value.balance = 123
+    cp.data.value.signers[0].weight = 99
+    cp.data.value.signers.append(
+        X.Signer(key=X.SignerKey.ed25519(bytes([8] * 32)), weight=1))
+    cp.lastModifiedLedgerSeq = 44
+    assert e.data.value.balance == 500
+    assert e.data.value.signers[0].weight == 5
+    assert len(e.data.value.signers) == 1
+    assert e.lastModifiedLedgerSeq == 3
+
+
+def test_compile_copy_void_arm_and_optional_none():
+    from stellar_core_tpu.xdr import fastcodec
+    ext = _ext_v0()                      # void union arm
+    cpx = fastcodec.compile_copy(type(ext))(ext)
+    assert cpx.disc == ext.disc and cpx.value is None
+    a = _sample_account_entry().data.value
+    a.inflationDest = None               # optional absent
+    cpa = fastcodec.compile_copy(X.AccountEntry)(a)
+    assert cpa.inflationDest is None
+    assert cpa.to_xdr() == a.to_xdr()
+
+
+def test_compile_copy_matches_roundtrip_on_header():
+    from stellar_core_tpu.xdr import fastcodec
+    from stellar_core_tpu.testing import genesis_header
+    h = genesis_header()
+    cp = fastcodec.compile_copy(X.LedgerHeader)(h)
+    assert cp.to_xdr() == h.to_xdr()
+    cp.ledgerSeq += 1
+    cp.skipList[0] = b"\x01" * 32
+    assert cp.to_xdr() != h.to_xdr()
+    assert h.skipList[0] != b"\x01" * 32
