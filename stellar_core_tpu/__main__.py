@@ -1,8 +1,15 @@
 """`python -m stellar_core_tpu` entry point (reference src/main/main.cpp)."""
 
+import signal
 import sys
 
 from .main.commandline import main
 
 if __name__ == "__main__":
+    # die quietly when a downstream pipe (head, less) closes, like any
+    # well-behaved unix CLI
+    try:
+        signal.signal(signal.SIGPIPE, signal.SIG_DFL)
+    except (AttributeError, ValueError):
+        pass
     sys.exit(main())

@@ -126,12 +126,17 @@ class BucketManager:
         as the current bucket list, then restart merges (reference
         BucketManagerImpl::assumeState)."""
         assert len(level_hashes) == K_NUM_LEVELS
+        # resolve every bucket BEFORE mutating any level: a missing file
+        # must not leave the list half-adopted
+        resolved = []
         for i, lh in enumerate(level_hashes):
-            lev = self.bucket_list.get_level(i)
             curr = self.get_bucket_by_hash(lh["curr"])
             snap = self.get_bucket_by_hash(lh["snap"])
             if curr is None or snap is None:
                 raise KeyError("missing bucket for level %d" % i)
+            resolved.append((curr, snap))
+        for i, (curr, snap) in enumerate(resolved):
+            lev = self.bucket_list.get_level(i)
             lev.curr = curr
             lev.snap = snap
             lev.next.clear()

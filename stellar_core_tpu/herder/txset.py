@@ -259,6 +259,6 @@ class TxSetFrame:
             h = SHA256()
             h.add(self.previous_ledger_hash)
             for f in self.sorted_for_hash():
-                h.add(f.envelope.to_xdr())
+                h.add(f.envelope_bytes())
             self._hash = h.finish()
         return self._hash

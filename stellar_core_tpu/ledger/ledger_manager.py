@@ -35,6 +35,11 @@ log = get_logger("Ledger")
 
 GENESIS_LEDGER_SEQ = 1
 
+# compiled structural copy (xdr/fastcodec.py) — close_ledger snapshots the
+# previous header once per close
+from ..xdr import fastcodec as _fastcodec  # noqa: E402
+_copy_header_fast = _fastcodec.compile_copy(LedgerHeader)
+
 
 class LedgerManagerState:
     LM_BOOTING_STATE = 0
@@ -108,6 +113,7 @@ class LedgerManager:
         self.root.set_header(header)
         self.lcl_hash = bytes.fromhex(row[0])
         self.state = LedgerManagerState.LM_SYNCED_STATE
+        self._restore_bucket_list()
         return True
 
     def set_last_closed_ledger(self, header: LedgerHeader,
@@ -163,7 +169,7 @@ class LedgerManager:
 
     # -- the close ----------------------------------------------------------
     def close_ledger(self, lcd: LedgerCloseData) -> None:
-        header_prev = LedgerHeader.from_xdr(self.lcl_header.to_xdr())
+        header_prev = _copy_header_fast(self.lcl_header)
         assert lcd.ledger_seq == header_prev.ledgerSeq + 1, "non-sequential"
         assert lcd.tx_set.previous_ledger_hash == self.lcl_hash, \
             "txset based on wrong ledger"
@@ -245,6 +251,7 @@ class LedgerManager:
         self.lcl_hash = sha256(self.root.get_header().to_xdr())
         self._store_header(self.root.get_header())
         self._store_txs(lcd, frames, result_pairs)
+        self._store_local_has()
         hm = getattr(self.app, "history_manager", None)
         if hm is not None:
             hm.maybe_queue_checkpoint(self)
@@ -253,6 +260,44 @@ class LedgerManager:
 
     def _bucket_manager(self):
         return getattr(self.app, "bucket_manager", None)
+
+    def _store_local_has(self) -> None:
+        """Persist the local bucket-list manifest so a restarted node can
+        re-adopt its bucket files (reference keeps kHistoryArchiveState in
+        PersistentState and assumeState()s it at startup)."""
+        ps = getattr(self.app, "persistent_state", None)
+        bm = self._bucket_manager()
+        if ps is None or bm is None:
+            return
+        from ..history.archive_state import HistoryArchiveState
+        has = HistoryArchiveState.from_bucket_list(
+            self.lcl_header.ledgerSeq, bm.bucket_list)
+        ps.set_state(ps.kHistoryArchiveState, has.to_json())
+
+    def _restore_bucket_list(self) -> None:
+        """Re-adopt the persisted bucket-list state after a restart
+        (reference ApplicationImpl loadLastKnownLedger →
+        BucketManagerImpl::assumeState)."""
+        ps = getattr(self.app, "persistent_state", None)
+        bm = self._bucket_manager()
+        if ps is None or bm is None:
+            return
+        s = ps.get_state(ps.kHistoryArchiveState)
+        if not s:
+            return
+        from ..history.archive_state import HistoryArchiveState
+        try:
+            has = HistoryArchiveState.from_json(s)
+            header = self.lcl_header
+            bm.assume_state(
+                [{"curr": bytes.fromhex(lv.curr),
+                  "snap": bytes.fromhex(lv.snap)} for lv in has.levels],
+                header.ledgerSeq, header.ledgerVersion)
+            log.info("restored bucket list at ledger %d from local HAS",
+                     header.ledgerSeq)
+        except Exception as e:  # corrupt HAS / missing files: degrade to an
+            # empty bucket list rather than failing startup (catchup heals)
+            log.warning("bucket-list restore failed: %s", e)
 
     def _apply_upgrade(self, header: LedgerHeader,
                        up: LedgerUpgrade) -> None:
@@ -271,13 +316,14 @@ class LedgerManager:
         db = getattr(self.app, "database", None)
         if db is None:
             return
+        hb = header.to_xdr()
         db.execute(
             "INSERT OR REPLACE INTO ledgerheaders (ledgerhash, prevhash, "
             "bucketlisthash, ledgerseq, closetime, data) VALUES "
             "(?,?,?,?,?,?)",
-            (sha256(header.to_xdr()).hex(),
+            (sha256(hb).hex(),
              header.previousLedgerHash.hex(), header.bucketListHash.hex(),
-             header.ledgerSeq, header.scpValue.closeTime, header.to_xdr()))
+             header.ledgerSeq, header.scpValue.closeTime, hb))
         db.commit()
 
     def _store_txs(self, lcd: LedgerCloseData, frames,
@@ -290,5 +336,5 @@ class LedgerManager:
                 "INSERT OR REPLACE INTO txhistory (txid, ledgerseq, "
                 "txindex, txbody, txresult, txmeta) VALUES (?,?,?,?,?,?)",
                 (f.contents_hash().hex(), lcd.ledger_seq, i,
-                 f.envelope.to_xdr(), rp.to_xdr(), b""))
+                 f.envelope_bytes(), rp.to_xdr(), b""))
         db.commit()

@@ -283,6 +283,22 @@ def cmd_test(args) -> int:
     return pytest.main(["-q"] + (args.pytest_args or []))
 
 
+def _harvest_inferred_quorum(cfg, first: int, last: int):
+    """Shared harvest loop of infer-quorum/write-quorum: mine SCP history
+    from every readable configured archive."""
+    from ..history.archive import HistoryArchive
+    from ..history.inferred_quorum import InferredQuorum
+    iq = InferredQuorum()
+    total = 0
+    for name, d in cfg.HISTORY.items():
+        arch = HistoryArchive.from_config(name, d)
+        if not arch.has_get():
+            continue
+        total += iq.harvest_archive(arch, first, last,
+                                    cfg.CHECKPOINT_FREQUENCY)
+    return iq, total
+
+
 def cmd_infer_quorum(args) -> int:
     """Mine quorum sets from published SCP history (reference infer-quorum,
     src/history/InferredQuorum.cpp)."""
@@ -293,14 +309,7 @@ def cmd_infer_quorum(args) -> int:
     from .config import Config
 
     cfg = Config.from_toml(args.conf) if args.conf else Config()
-    iq = InferredQuorum()
-    total = 0
-    for name, d in cfg.HISTORY.items():
-        arch = HistoryArchive.from_config(name, d)
-        if not arch.has_get():
-            continue
-        total += iq.harvest_archive(arch, args.first, args.last,
-                                    cfg.CHECKPOINT_FREQUENCY)
+    iq, total = _harvest_inferred_quorum(cfg, args.first, args.last)
     out = iq.to_json()
     out["entries"] = total
     out["quorum_intersection"] = iq.check_quorum_intersection()
@@ -310,15 +319,234 @@ def cmd_infer_quorum(args) -> int:
 
 def cmd_fuzz(args) -> int:
     """Mutational fuzz run over an untrusted intake surface (reference
-    `fuzz` AFL mode, src/test/FuzzerImpl.cpp; docs/fuzzing.md)."""
+    `fuzz` AFL mode, src/test/FuzzerImpl.cpp; docs/fuzzing.md). With
+    --input, runs that single input and exits (the reference `fuzz`
+    contract for AFL integration)."""
     import json
     import logging
 
-    from .fuzz import fuzz_overlay, fuzz_tx
+    from .fuzz import fuzz_overlay, fuzz_tx, run_one
     logging.disable(logging.ERROR)
-    fn = fuzz_tx if args.mode == "tx" else fuzz_overlay
-    stats = fn(iterations=args.iterations, seed=args.seed)
+    if args.input:
+        data = open(args.input, "rb").read()
+        stats = run_one(args.mode, data)
+    else:
+        fn = fuzz_tx if args.mode == "tx" else fuzz_overlay
+        stats = fn(iterations=args.iterations, seed=args.seed)
     print(json.dumps({"mode": args.mode, **stats}))
+    return 0
+
+
+def cmd_gen_fuzz(args) -> int:
+    """Write a random fuzzer input file (reference `gen-fuzz`)."""
+    from .fuzz import gen_input
+    data = gen_input(args.mode, args.seed)
+    with open(args.output, "wb") as f:
+        f.write(data)
+    print("wrote %d-byte %s fuzz input to %s"
+          % (len(data), args.mode, args.output))
+    return 0
+
+
+def cmd_check_quorum(args) -> int:
+    """Check quorum intersection of the last network activity (reference
+    `check-quorum`): builds the node→qset map from the newest SCP history
+    rows in the local DB and runs the enumeration checker
+    (QuorumIntersectionCheckerImpl role)."""
+    from ..herder.pending_envelopes import statement_qset_hash
+    from ..herder.quorum_intersection import QuorumIntersectionChecker
+    from ..xdr import SCPEnvelope, SCPQuorumSet
+
+    cfg = _load_config(args)
+    app = _make_app(cfg, real_time=False)
+    db = getattr(app, "database", None)
+    if db is None:
+        print("check-quorum needs a persistent database", file=sys.stderr)
+        return 1
+    row = db.execute("SELECT MAX(ledgerseq) FROM scphistory").fetchone()
+    if row is None or row[0] is None:
+        print(json.dumps({"error": "no SCP history rows"}))
+        return 1
+    seq = row[0]
+    qmap = {}
+    for (blob,) in db.execute(
+            "SELECT envelope FROM scphistory WHERE ledgerseq = ?", (seq,)):
+        env = SCPEnvelope.from_xdr(blob)
+        node = env.statement.nodeID.key_bytes
+        qh = statement_qset_hash(env.statement)
+        qrow = db.execute("SELECT qset FROM scpquorums WHERE qsethash = ?",
+                          (qh.hex(),)).fetchone()
+        qmap[node] = SCPQuorumSet.from_xdr(qrow[0]) if qrow else None
+    checker = QuorumIntersectionChecker(qmap)
+    ok = checker.network_enjoys_quorum_intersection()
+    print(json.dumps({"ledger": seq, "nodes": len(qmap),
+                      "intersection": bool(ok)}, indent=1))
+    return 0 if ok else 2
+
+
+def cmd_write_quorum(args) -> int:
+    """Print the quorum graph mined from history (reference
+    `write-quorum`): per-node qsets in jsonable form."""
+    cfg = _load_config(args)
+    iq, _total = _harvest_inferred_quorum(cfg, args.first, args.last)
+    from ..crypto.strkey import encode_public_key
+    out = iq.to_json()
+    out["graph"] = {encode_public_key(node): _xdr_to_jsonable(
+                        iq.get_qset(node))
+                    for node in sorted(iq.node_qset)}
+    print(json.dumps(out, indent=1))
+    return 0
+
+
+def cmd_dump_xdr(args) -> int:
+    """Dump a STREAM FILE of XDR records, one JSON document per record
+    (reference `dump-xdr`; print-xdr handles single values)."""
+    import stellar_core_tpu.xdr as X
+    from ..util.xdrstream import XDRInputFileStream
+
+    t = getattr(X, args.filetype, None)
+    if t is None:
+        print("unknown XDR type %r" % args.filetype, file=sys.stderr)
+        return 1
+    n = 0
+    with XDRInputFileStream(args.file) as ins:
+        for rec in ins.read_all(t):
+            print(json.dumps(_xdr_to_jsonable(rec)))
+            n += 1
+    print("-- %d record(s)" % n, file=sys.stderr)
+    return 0
+
+
+def cmd_report_last_history_checkpoint(args) -> int:
+    """Fetch and print the most recent HistoryArchiveState from each
+    readable archive (reference `report-last-history-checkpoint`)."""
+    import os
+    import tempfile
+
+    from ..history.archive import HistoryArchive, WELL_KNOWN
+
+    cfg = _load_config(args)
+    ok = False
+    for name, d in cfg.HISTORY.items():
+        arch = HistoryArchive.from_config(name, d)
+        if not arch.has_get():
+            continue
+        fd, tmp = tempfile.mkstemp(suffix=".json")
+        os.close(fd)
+        try:
+            if arch.get_file_sync(WELL_KNOWN, tmp):
+                print(json.dumps({"archive": name,
+                                  "state": json.load(open(tmp))}, indent=1))
+                ok = True
+            else:
+                print("archive %s: fetch failed" % name, file=sys.stderr)
+        finally:
+            os.unlink(tmp)
+    return 0 if ok else 1
+
+
+def cmd_upgrade_db(args) -> int:
+    """Apply any pending DB schema migrations (reference `upgrade-db`);
+    opening the database runs the migration hook."""
+    cfg = _load_config(args)
+    app = _make_app(cfg, real_time=False)
+    db = getattr(app, "database", None)
+    if db is None:
+        print("no persistent database configured", file=sys.stderr)
+        return 1
+    print("database schema at version %s" % db.get_state("databaseschema"))
+    return 0
+
+
+def cmd_load_xdr(args) -> int:
+    """Load an XDR bucket file directly into the ledger DB, for debugging
+    (reference `load-xdr`)."""
+    from ..bucket.applicator import BucketApplicator
+    from ..bucket.bucket import Bucket
+
+    cfg = _load_config(args)
+    app = _make_app(cfg, real_time=False)
+    app.ledger_manager.load_last_known_ledger()
+    b = Bucket.read_from(args.file)
+    applicator = BucketApplicator(app.ledger_manager.ltx_root(), b)
+    n = 0
+    while applicator:
+        n += applicator.advance()
+    print("applied %d entr%s from %s (bucket hash %s)"
+          % (n, "y" if n == 1 else "ies", args.file,
+             b.get_hash().hex()[:16]))
+    return 0
+
+
+def cmd_rebuild_ledger_from_buckets(args) -> int:
+    """Rebuild the SQL ledger state from the current bucket files
+    (reference `rebuild-ledger-from-buckets`): clears entry tables, then
+    streams the bucket list newest-first (level 0 curr, snap, level 1 …)
+    into the DB — the first bucket to mention a key wins."""
+    from ..bucket.applicator import apply_buckets
+
+    cfg = _load_config(args)
+    app = _make_app(cfg, real_time=False)
+    if not app.ledger_manager.load_last_known_ledger():
+        print("no last-known ledger in DB", file=sys.stderr)
+        return 1
+    bm = getattr(app, "bucket_manager", None)
+    db = getattr(app, "database", None)
+    if bm is None or db is None:
+        print("needs bucket directory + persistent DB", file=sys.stderr)
+        return 1
+    root = app.ledger_manager.ltx_root()
+    for table in ("accounts", "trustlines", "offers", "accountdata"):
+        db.execute("DELETE FROM %s" % table)
+    db.commit()
+    root._cache.clear()   # raw DELETEs bypassed the root's entry cache
+    buckets = []
+    for lev in bm.bucket_list.levels:
+        buckets.append(lev.curr)
+        buckets.append(lev.snap)
+    n = apply_buckets(root, buckets)
+    print("rebuilt %d ledger entr%s from %d bucket level(s)"
+          % (n, "y" if n == 1 else "ies", len(bm.bucket_list.levels)))
+    return 0
+
+
+def cmd_simulate(args) -> int:
+    """Simulate applying synthetic payment ledgers offline and report the
+    close rate (reference `simulate`)."""
+    from ..crypto.keys import SecretKey
+    from ..testing import AppLedgerAdapter
+    cfg = _load_config(args)
+    cfg.RUN_STANDALONE = True
+    cfg.MANUAL_CLOSE = True
+    cfg.FORCE_SCP = True
+    cfg.UNSAFE_QUORUM = True
+    cfg.DATABASE = "in-memory"
+    if cfg.NODE_SEED is None:
+        import os as _os
+        cfg.NODE_SEED = SecretKey.from_seed(_os.urandom(32))
+    cfg.QUORUM_SET = cfg.self_qset()
+    import tempfile
+    cfg.BUCKET_DIR_PATH = tempfile.mkdtemp(prefix="sct-simulate-")
+    app = _make_app(cfg, real_time=False)
+    app.start()
+    ad = AppLedgerAdapter(app)
+    root = ad.root_account()
+    senders = [root.create(10**10) for _ in range(args.txs)]
+    app.clock.set_virtual_time(
+        app.clock.now() + app.ledger_manager.last_closed_ledger_num())
+    t0 = time.perf_counter()
+    for _ in range(args.ledgers):
+        for s in senders:
+            app.submit_transaction(
+                s.tx([s.op_payment(root.account_id, 1)]))
+        app.clock.set_virtual_time(app.clock.now() + 1.0)
+        app.manual_close()
+    dt = time.perf_counter() - t0
+    print(json.dumps({
+        "ledgers": args.ledgers, "txs_per_ledger": args.txs,
+        "wall_s": round(dt, 3),
+        "ledgers_per_sec": round(args.ledgers / dt, 2),
+        "txs_per_sec": round(args.ledgers * args.txs / dt, 1)}))
     return 0
 
 
@@ -343,6 +571,35 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--mode", choices=("tx", "overlay"), default="tx")
     p.add_argument("--iterations", type=int, default=10000)
     p.add_argument("--seed", type=int, default=1)
+    p.add_argument("--input", help="run this single input file and exit")
+    p = add("gen-fuzz", cmd_gen_fuzz, "generate a random fuzzer input",
+            conf=False)
+    p.add_argument("output")
+    p.add_argument("--mode", choices=("tx", "overlay"), default="tx")
+    p.add_argument("--seed", type=int, default=1)
+    add("check-quorum", cmd_check_quorum,
+        "check quorum intersection of last network activity")
+    p = add("write-quorum", cmd_write_quorum,
+            "print a quorum graph mined from history")
+    p.add_argument("--first", type=int, default=1)
+    p.add_argument("--last", type=int, default=2**31 - 1)
+    p = add("dump-xdr", cmd_dump_xdr, "dump an XDR stream file",
+            conf=False)
+    p.add_argument("file")
+    p.add_argument("--filetype", default="LedgerHeaderHistoryEntry")
+    add("report-last-history-checkpoint",
+        cmd_report_last_history_checkpoint,
+        "print each archive's latest HistoryArchiveState")
+    add("upgrade-db", cmd_upgrade_db,
+        "upgrade database schema to the current version")
+    p = add("load-xdr", cmd_load_xdr,
+            "load an XDR bucket file into the DB, for testing")
+    p.add_argument("file")
+    add("rebuild-ledger-from-buckets", cmd_rebuild_ledger_from_buckets,
+        "rebuild SQL ledger state from the current bucket files")
+    p = add("simulate", cmd_simulate, "simulate applying ledgers")
+    p.add_argument("--ledgers", type=int, default=32)
+    p.add_argument("--txs", type=int, default=16)
     add("new-db", cmd_new_db, "reset DB to the genesis ledger")
     p = add("force-scp", cmd_force_scp,
             "start SCP from the LCL on next run")

@@ -119,6 +119,9 @@ class TransactionFrame:
             0, TransactionResultCode.txSUCCESS,
             [None] * len(self.op_frames))
         self._contents_hash: Optional[bytes] = None
+        self._env_bytes: Optional[bytes] = None
+        self._full_hash: Optional[bytes] = None
+        self._env_sig_fp: tuple = ()
 
     # -- identity -----------------------------------------------------------
     @classmethod
@@ -153,13 +156,35 @@ class TransactionFrame:
             self._contents_hash = sha256(self.signature_payload())
         return self._contents_hash
 
+    def _sig_fingerprint(self) -> tuple:
+        return tuple((ds.hint, ds.signature) for ds in self.signatures)
+
+    def envelope_bytes(self) -> bytes:
+        """Canonical wire bytes of the signed envelope, cached —
+        serialized once per frame for hashing, txset hashing, history
+        rows, and flood messages. The cache is guarded by a fingerprint
+        of the signature list (the one surface callers mutate directly,
+        e.g. test harnesses and the fuzz corpus), so any signature change
+        recomputes."""
+        fp = self._sig_fingerprint()
+        if self._env_bytes is None or fp != self._env_sig_fp:
+            self._env_bytes = self.envelope.to_xdr()
+            self._full_hash = None
+            self._env_sig_fp = fp
+        return self._env_bytes
+
     def full_hash(self) -> bytes:
         """Hash of the whole signed envelope (identity in txsets)."""
-        return sha256(self.envelope.to_xdr())
+        b = self.envelope_bytes()   # revalidates the signature fingerprint
+        if self._full_hash is None:
+            self._full_hash = sha256(b)
+        return self._full_hash
 
     def add_signature(self, secret_key) -> None:
         """Sign the CONTENTS HASH (reference SignatureUtils::sign signs
         sha256(signature payload), not the raw payload)."""
+        self._env_bytes = None
+        self._full_hash = None
         self.signatures.append(
             secret_key.sign_decorated(self.contents_hash()))
 
@@ -386,6 +411,9 @@ class FeeBumpTransactionFrame:
         self.result: TransactionResult = _make_result(
             0, TransactionResultCode.txFEE_BUMP_INNER_SUCCESS)
         self._contents_hash: Optional[bytes] = None
+        self._env_bytes: Optional[bytes] = None
+        self._full_hash: Optional[bytes] = None
+        self._env_sig_fp: tuple = ()
 
     def source_account_id(self) -> PublicKey:
         return self.fee_bump.feeSource.account_id
@@ -413,10 +441,27 @@ class FeeBumpTransactionFrame:
             self._contents_hash = sha256(self.signature_payload())
         return self._contents_hash
 
+    def _sig_fingerprint(self) -> tuple:
+        return (tuple((ds.hint, ds.signature) for ds in self.signatures),
+                self.inner._sig_fingerprint())
+
+    def envelope_bytes(self) -> bytes:
+        fp = self._sig_fingerprint()
+        if self._env_bytes is None or fp != self._env_sig_fp:
+            self._env_bytes = self.envelope.to_xdr()
+            self._full_hash = None
+            self._env_sig_fp = fp
+        return self._env_bytes
+
     def full_hash(self) -> bytes:
-        return sha256(self.envelope.to_xdr())
+        b = self.envelope_bytes()
+        if self._full_hash is None:
+            self._full_hash = sha256(b)
+        return self._full_hash
 
     def add_signature(self, secret_key) -> None:
+        self._env_bytes = None
+        self._full_hash = None
         self.signatures.append(
             secret_key.sign_decorated(self.contents_hash()))
 

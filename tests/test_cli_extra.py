@@ -1,0 +1,187 @@
+"""CLI parity tests for the second tranche of subcommands
+(reference src/main/CommandLine.cpp:1040-1093: check-quorum, dump-xdr,
+report-last-history-checkpoint, upgrade-db, load-xdr,
+rebuild-ledger-from-buckets, gen-fuzz, simulate, write-quorum)."""
+
+import json
+import os
+
+import pytest
+
+from stellar_core_tpu.crypto import strkey
+from stellar_core_tpu.crypto.hashing import sha256
+from stellar_core_tpu.crypto.keys import SecretKey
+from stellar_core_tpu.main.application import Application
+from stellar_core_tpu.main.commandline import main as cli_main
+from stellar_core_tpu.main.config import Config
+from stellar_core_tpu.testing import AppLedgerAdapter
+from stellar_core_tpu.util.timer import ClockMode, VirtualClock
+
+
+def _node_conf(tmp_path, with_archive=False):
+    seed = strkey.encode_seed(
+        SecretKey.from_seed(sha256(b"cli-extra-node")).seed)
+    lines = [
+        'DATABASE = "sqlite3://%s"' % (tmp_path / "node.db"),
+        'NODE_SEED = "%s"' % seed,
+        'BUCKET_DIR_PATH = "%s"' % (tmp_path / "buckets"),
+        'RUN_STANDALONE = true',
+        'MANUAL_CLOSE = true',
+        'FORCE_SCP = true',
+        'UNSAFE_QUORUM = true',
+        'CHECKPOINT_FREQUENCY = 8',
+    ]
+    if with_archive:
+        ar = tmp_path / "archive"
+        os.makedirs(ar, exist_ok=True)
+        lines += [
+            '[HISTORY.local]',
+            'get = "cp %s/{0} {1}"' % ar,
+            'put = "cp {0} %s/{1}"' % ar,
+            'mkdir = "mkdir -p %s/{0}"' % ar,
+        ]
+    conf = tmp_path / "node.toml"
+    conf.write_text("\n".join(lines) + "\n")
+    return str(conf)
+
+
+def _run_node(tmp_path, conf, n_ledgers=10):
+    """Close a few traffic-bearing ledgers against the conf's DB/buckets,
+    draining publishes; returns the final LCL."""
+    cfg = Config.from_toml(conf)
+    cfg.QUORUM_SET = cfg.self_qset()
+    cfg.INVARIANT_CHECKS = [".*"]
+    app = Application(VirtualClock(ClockMode.VIRTUAL_TIME), cfg)
+    app.enable_buckets()
+    app.start()
+    ad = AppLedgerAdapter(app)
+    root = ad.root_account()
+    alice = root.create(10**10)
+    app.clock.set_virtual_time(
+        app.clock.now() + app.ledger_manager.last_closed_ledger_num())
+    while app.ledger_manager.last_closed_ledger_num() < n_ledgers:
+        app.submit_transaction(
+            alice.tx([alice.op_payment(root.account_id, 100)]))
+        app.clock.set_virtual_time(app.clock.now() + 1.0)
+        app.manual_close()
+    app.crank_until(lambda: app.history_manager.publish_queue() == [],
+                    max_cranks=20000)
+    lcl = app.ledger_manager.last_closed_ledger_num()
+    app.stop()
+    return lcl, alice.account_id
+
+
+def test_simulate(capsys):
+    assert cli_main(["simulate", "--ledgers", "3", "--txs", "2"]) == 0
+    out = json.loads(capsys.readouterr().out)
+    assert out["ledgers"] == 3 and out["ledgers_per_sec"] > 0
+
+
+def test_upgrade_db(tmp_path, capsys):
+    conf = _node_conf(tmp_path)
+    assert cli_main(["new-db", "--conf", conf]) == 0
+    capsys.readouterr()
+    assert cli_main(["upgrade-db", "--conf", conf]) == 0
+    assert "schema at version" in capsys.readouterr().out
+
+
+def test_check_quorum_from_db(tmp_path, capsys):
+    conf = _node_conf(tmp_path)
+    _run_node(tmp_path, conf, n_ledgers=5)
+    assert cli_main(["check-quorum", "--conf", conf]) == 0
+    out = json.loads(capsys.readouterr().out)
+    assert out["intersection"] is True and out["nodes"] >= 1
+
+
+def test_dump_xdr_stream(tmp_path, capsys):
+    from stellar_core_tpu.util.xdrstream import XDROutputFileStream
+    from stellar_core_tpu.xdr import LedgerHeaderHistoryEntry
+    from stellar_core_tpu.testing import genesis_header
+    h = genesis_header()
+    path = tmp_path / "headers.xdr"
+    with XDROutputFileStream(str(path)) as outs:
+        for _ in range(3):
+            outs.write_one(LedgerHeaderHistoryEntry, LedgerHeaderHistoryEntry(
+                hash=sha256(h.to_xdr()), header=h,
+                ext=LedgerHeaderHistoryEntry.xdr_fields[2][1].v0()))
+    assert cli_main(["dump-xdr", str(path),
+                     "--filetype", "LedgerHeaderHistoryEntry"]) == 0
+    lines = [l for l in capsys.readouterr().out.splitlines() if l.strip()]
+    assert len(lines) == 3
+    rec = json.loads(lines[0])
+    assert rec["header"]["ledgerSeq"] == h.ledgerSeq
+
+
+def test_report_last_history_checkpoint_and_write_quorum(tmp_path, capsys):
+    conf = _node_conf(tmp_path, with_archive=True)
+    _run_node(tmp_path, conf, n_ledgers=18)  # past two checkpoints (freq 8)
+    assert cli_main(["report-last-history-checkpoint",
+                     "--conf", conf]) == 0
+    out = json.loads(capsys.readouterr().out)
+    assert out["state"]["currentLedger"] >= 7
+    assert cli_main(["write-quorum", "--conf", conf]) == 0
+    g = json.loads(capsys.readouterr().out)
+    assert g["graph"], "quorum graph mined from history"
+    (qs,) = g["graph"].values()
+    assert qs["threshold"] == 1
+
+
+def test_load_xdr_bucket_file(tmp_path, capsys):
+    from stellar_core_tpu.bucket.bucket import Bucket
+    from stellar_core_tpu.transactions.account_helpers import (
+        make_account_entry,
+    )
+    from stellar_core_tpu.xdr import BucketEntry, BucketEntryType, PublicKey
+
+    conf = _node_conf(tmp_path)
+    assert cli_main(["new-db", "--conf", conf]) == 0
+    capsys.readouterr()
+    ghost = SecretKey.from_seed(b"\x77" * 32).public_key
+    entry = make_account_entry(ghost, 123456789, 0, last_modified=1)
+    b = Bucket([BucketEntry(BucketEntryType.LIVEENTRY, entry)])
+    path = tmp_path / "b.xdr"
+    b.write_to(str(path))
+    assert cli_main(["load-xdr", str(path), "--conf", conf]) == 0
+    assert "applied 1 entry" in capsys.readouterr().out
+    # the entry is now visible to an offline app over the same DB
+    cfg = Config.from_toml(conf)
+    app = Application(VirtualClock(ClockMode.VIRTUAL_TIME), cfg)
+    app.enable_buckets()
+    app.ledger_manager.load_last_known_ledger()
+    assert AppLedgerAdapter(app).balance(ghost) == 123456789
+
+
+def test_rebuild_ledger_from_buckets(tmp_path, capsys):
+    import sqlite3
+
+    conf = _node_conf(tmp_path)
+    _lcl, alice_id = _run_node(tmp_path, conf, n_ledgers=6)
+    # sabotage the SQL state behind the node's back
+    db = sqlite3.connect(str(tmp_path / "node.db"))
+    n_before = db.execute("SELECT COUNT(*) FROM accounts").fetchone()[0]
+    db.execute("DELETE FROM accounts")
+    db.commit()
+    db.close()
+    assert cli_main(["rebuild-ledger-from-buckets", "--conf", conf]) == 0
+    out = capsys.readouterr().out
+    assert "rebuilt" in out
+    db = sqlite3.connect(str(tmp_path / "node.db"))
+    n_after = db.execute("SELECT COUNT(*) FROM accounts").fetchone()[0]
+    db.close()
+    assert n_after == n_before
+    # and the rebuilt state serves reads
+    cfg = Config.from_toml(conf)
+    app = Application(VirtualClock(ClockMode.VIRTUAL_TIME), cfg)
+    app.enable_buckets()
+    app.ledger_manager.load_last_known_ledger()
+    assert AppLedgerAdapter(app).balance(alice_id) > 0
+
+
+def test_gen_fuzz_then_single_input(tmp_path, capsys):
+    p = tmp_path / "input.bin"
+    assert cli_main(["gen-fuzz", str(p), "--mode", "tx",
+                     "--seed", "7"]) == 0
+    capsys.readouterr()
+    assert cli_main(["fuzz", "--mode", "tx", "--input", str(p)]) == 0
+    out = json.loads(capsys.readouterr().out)
+    assert out["iterations"] == 1
