@@ -108,6 +108,8 @@ class ApplyBucketsWork(BasicWork):
                             header.ledgerVersion)
 
         lm.set_last_closed_ledger(header, self.header_entry.hash)
+        lm._store_local_has()   # restart between here and the next close
+        # must re-adopt THIS bucket list, not the pre-catchup one
         return SUCCESS
 
 

@@ -293,10 +293,22 @@ class LedgerManager:
                 [{"curr": bytes.fromhex(lv.curr),
                   "snap": bytes.fromhex(lv.snap)} for lv in has.levels],
                 header.ledgerSeq, header.ledgerVersion)
+            # the adopted list must hash to what the LCL header committed
+            # to — a stale HAS (e.g. written before a bucket-apply catchup
+            # fast-forwarded the LCL) silently forks the chain otherwise
+            if bm.get_hash() != header.bucketListHash:
+                raise ValueError(
+                    "restored bucket list hash %s != header %s" %
+                    (bm.get_hash().hex()[:16],
+                     header.bucketListHash.hex()[:16]))
             log.info("restored bucket list at ledger %d from local HAS",
                      header.ledgerSeq)
-        except Exception as e:  # corrupt HAS / missing files: degrade to an
-            # empty bucket list rather than failing startup (catchup heals)
+        except Exception as e:  # corrupt/stale HAS or missing files:
+            # degrade to an empty bucket list rather than failing startup
+            # or running on wrong state (catchup heals)
+            from ..bucket.bucket_list import BucketList
+            bm.bucket_list = BucketList(bm._executor,
+                                        adopt=bm.adopt_bucket)
             log.warning("bucket-list restore failed: %s", e)
 
     def _apply_upgrade(self, header: LedgerHeader,

@@ -304,8 +304,6 @@ def cmd_infer_quorum(args) -> int:
     src/history/InferredQuorum.cpp)."""
     import json
 
-    from ..history.archive import HistoryArchive
-    from ..history.inferred_quorum import InferredQuorum
     from .config import Config
 
     cfg = Config.from_toml(args.conf) if args.conf else Config()
@@ -494,6 +492,15 @@ def cmd_rebuild_ledger_from_buckets(args) -> int:
     db = getattr(app, "database", None)
     if bm is None or db is None:
         print("needs bucket directory + persistent DB", file=sys.stderr)
+        return 1
+    # refuse to wipe the SQL state unless the on-disk bucket list hashes
+    # to exactly what the LCL header committed to — an empty or stale list
+    # would otherwise destroy the only copy of the ledger
+    header = app.ledger_manager.lcl_header
+    if bm.get_hash() != header.bucketListHash:
+        print("bucket list hash %s does not match header %s; refusing"
+              % (bm.get_hash().hex()[:16],
+                 header.bucketListHash.hex()[:16]), file=sys.stderr)
         return 1
     root = app.ledger_manager.ltx_root()
     for table in ("accounts", "trustlines", "offers", "accountdata"):

@@ -185,3 +185,39 @@ def test_gen_fuzz_then_single_input(tmp_path, capsys):
     assert cli_main(["fuzz", "--mode", "tx", "--input", str(p)]) == 0
     out = json.loads(capsys.readouterr().out)
     assert out["iterations"] == 1
+
+
+def test_bucket_list_restore_verified_on_restart(tmp_path, capsys):
+    """Restart re-adopts the persisted bucket list and verifies it against
+    the LCL header's bucketListHash; a corrupt/stale HAS degrades to an
+    empty list and makes rebuild-from-buckets refuse its destructive step."""
+    import sqlite3
+
+    conf = _node_conf(tmp_path)
+    _run_node(tmp_path, conf, n_ledgers=6)
+
+    cfg = Config.from_toml(conf)
+    app = Application(VirtualClock(ClockMode.VIRTUAL_TIME), cfg)
+    app.enable_buckets()
+    assert app.ledger_manager.load_last_known_ledger()
+    assert app.bucket_manager.get_hash() == \
+        app.ledger_manager.lcl_header.bucketListHash
+
+    # sabotage the persisted HAS: restore must degrade, not run on it
+    db = sqlite3.connect(str(tmp_path / "node.db"))
+    db.execute("UPDATE storestate SET state = '{\"broken\": 1}' "
+               "WHERE statename = 'historyarchivestate'")
+    db.commit()
+    db.close()
+    app2 = Application(VirtualClock(ClockMode.VIRTUAL_TIME), cfg)
+    app2.enable_buckets()
+    assert app2.ledger_manager.load_last_known_ledger()
+    assert app2.bucket_manager.get_hash() != \
+        app2.ledger_manager.lcl_header.bucketListHash
+
+    # and the rebuild command refuses to wipe the SQL state
+    assert cli_main(["rebuild-ledger-from-buckets", "--conf", conf]) == 1
+    db = sqlite3.connect(str(tmp_path / "node.db"))
+    n = db.execute("SELECT COUNT(*) FROM accounts").fetchone()[0]
+    db.close()
+    assert n > 0, "entry tables untouched after refusal"

@@ -1,0 +1,83 @@
+"""Quorum churn: consensus survives runtime quorum-set reconfiguration and
+validator loss (BASELINE.md measurement config "multi-node simulation
+under quorum churn"; reference analog: HerderTests' qset updates +
+Simulation node removal)."""
+
+from stellar_core_tpu.simulation import topologies
+from stellar_core_tpu.xdr import SCPQuorumSet
+
+
+def _lcl(node):
+    return node.app.ledger_manager.last_closed_ledger_num()
+
+
+def _hash_at(node, seq):
+    db = node.app.database
+    if db is None:
+        return None
+    row = db.execute(
+        "SELECT ledgerhash FROM ledgerheaders WHERE ledgerseq = ?",
+        (seq,)).fetchone()
+    return row[0] if row else None
+
+
+def test_quorum_reconfig_and_validator_loss():
+    sim = topologies.core(4, 3)
+    sim.start_all_nodes()
+    assert sim.crank_until(lambda: sim.have_all_externalized(3), 30000)
+
+    names = list(sim.nodes)
+    dropped = names[-1]
+    rest = names[:-1]
+
+    # runtime churn: surviving nodes adopt a 2-of-3 qset without `dropped`
+    new_qset = SCPQuorumSet(
+        threshold=2,
+        validators=[sim.nodes[n].app.config.NODE_SEED.public_key
+                    for n in rest],
+        innerSets=[])
+    for n in rest:
+        sim.nodes[n].app.config.QUORUM_SET = new_qset
+
+    # the dropped validator goes dark: the net drops every message to or
+    # from it (a crash fault, not a byzantine one)
+    orig_deliver = sim._deliver
+
+    def deliver(to, frm, raw):
+        if to != dropped and frm != dropped:
+            orig_deliver(to, frm, raw)
+
+    sim._deliver = deliver
+
+    target = max(_lcl(sim.nodes[n]) for n in rest) + 3
+    assert sim.crank_until(
+        lambda: all(_lcl(sim.nodes[n]) >= target for n in rest), 60000), \
+        {n: _lcl(sim.nodes[n]) for n in rest}
+
+    # chain agreement at a common height among survivors
+    common = min(_lcl(sim.nodes[n]) for n in rest)
+    hashes = {sim.nodes[n].app.ledger_manager.lcl_header.previousLedgerHash
+              if _lcl(sim.nodes[n]) == common else None for n in rest}
+    hashes.discard(None)
+    assert len(hashes) <= 1
+    sim.stop_all_nodes()
+
+
+def test_quorum_threshold_raise_still_live():
+    """Raising the threshold to n-of-n mid-run keeps the net live while
+    all validators stay up."""
+    sim = topologies.core(3, 2)
+    sim.start_all_nodes()
+    assert sim.crank_until(lambda: sim.have_all_externalized(2), 30000)
+    full = SCPQuorumSet(
+        threshold=3,
+        validators=[sim.nodes[n].app.config.NODE_SEED.public_key
+                    for n in sim.nodes],
+        innerSets=[])
+    for n in sim.nodes:
+        sim.nodes[n].app.config.QUORUM_SET = full
+    target = max(_lcl(v) for v in sim.nodes.values()) + 3
+    assert sim.crank_until(
+        lambda: all(_lcl(v) >= target for v in sim.nodes.values()),
+        60000), {n: _lcl(v) for n, v in sim.nodes.items()}
+    sim.stop_all_nodes()
