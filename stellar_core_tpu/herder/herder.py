@@ -241,9 +241,18 @@ class Herder:
         return self.app.ledger_manager.last_closed_ledger_num() + 1
 
     # -- transaction intake --------------------------------------------------
+    def _metrics(self):
+        return getattr(self.app, "metrics", None)
+
     def recv_transaction(self, frame) -> int:
         """HOT CALLER #2 via TransactionQueue.try_add → checkValid."""
-        return self.tx_queue.try_add(frame)
+        m = self._metrics()
+        if m is not None:
+            m.new_meter("herder.tx.received").mark()
+        status = self.tx_queue.try_add(frame)
+        if m is not None and status == 0:
+            m.new_meter("herder.tx.accepted").mark()
+        return status
 
     # -- SCP envelope intake -------------------------------------------------
     def recv_scp_envelope(self, envelope: SCPEnvelope,
@@ -254,6 +263,9 @@ class Herder:
         loop (the PendingEnvelopes 'verifying' state — async analog of the
         reference's fetch-before-feed buffering). `on_verified(ok)` fires
         when the decision lands (immediately on the sync backend)."""
+        m = self._metrics()
+        if m is not None:
+            m.new_meter("scp.envelope.receive").mark()
         st = envelope.statement
         slot = st.slotIndex
         cur = self.current_slot()
@@ -354,6 +366,9 @@ class Herder:
         # persist our pledges BEFORE they hit the wire: a crash mid-slot
         # must not forget ballots other nodes may hold us to (reference
         # persistSCPState in emitEnvelope, HerderImpl.cpp:302)
+        m = self._metrics()
+        if m is not None:
+            m.new_meter("scp.envelope.emit").mark()
         self.persist_latest_scp_state(envelope.statement.slotIndex)
         overlay = getattr(self.app, "overlay_manager", None)
         if overlay is not None:
@@ -399,6 +414,9 @@ class Herder:
 
     # -- externalization -----------------------------------------------------
     def value_externalized(self, slot_index: int, value: bytes) -> None:
+        m = self._metrics()
+        if m is not None:
+            m.new_meter("scp.value.externalized").mark()
         sv = StellarValue.from_xdr(value)
         txset = self.pending.get_tx_set(sv.txSetHash)
         assert txset is not None, "externalized unknown txset"
@@ -413,6 +431,9 @@ class Herder:
         # tx queue maintenance
         self.tx_queue.remove_applied(list(txset.frames))
         self.tx_queue.shift()
+        if m is not None:
+            m.new_counter("herder.pending-ops.count").set_count(
+                self.tx_queue.size_ops())
 
         # GC old slots + pending state + overlay flood records
         keep_from = max(1, slot_index -

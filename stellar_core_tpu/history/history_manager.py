@@ -128,6 +128,9 @@ class HistoryManager:
             ok = self._put_snapshot(archive, checkpoint, has, files)
             ok_all = ok_all and ok
             if ok:
+                m = getattr(self.app, "metrics", None)
+                if m is not None:
+                    m.new_meter("history.publish.success").mark()
                 log.info("published checkpoint %d to %s", checkpoint,
                          archive.name)
         return ok_all

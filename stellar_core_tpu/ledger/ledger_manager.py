@@ -177,9 +177,19 @@ class LedgerManager:
             "value/txset hash mismatch"
 
         verifier = getattr(self.app, "sig_verifier", None)
+        metrics = getattr(self.app, "metrics", None)
+        import contextlib
+        timer = (metrics.new_timer("ledger.ledger.close").time()
+                 if metrics is not None else contextlib.nullcontext())
         ltx = LedgerTxn(self.root)
         try:
-            self._close_ledger_in(ltx, lcd, header_prev, verifier)
+            with timer:
+                self._close_ledger_in(ltx, lcd, header_prev, verifier)
+            if metrics is not None:
+                metrics.new_meter("ledger.transaction.apply").mark(
+                    len(lcd.tx_set.frames))
+                metrics.new_counter("ledger.ledger.num").set_count(
+                    lcd.ledger_seq)
         except BaseException:
             if ltx._open:
                 ltx.rollback()   # drop children too: no dangling state

@@ -67,7 +67,20 @@ class CommandHandler:
         return info
 
     def cmd_metrics(self, params) -> dict:
-        return self.app.metrics.to_json()
+        out = self.app.metrics.to_json()
+        # crypto-boundary metrics live outside the registry (global cache,
+        # per-verifier counters); merge them in medida-style names
+        from ..crypto import keys as _keys
+        cache = _keys.verify_cache_stats()
+        out["crypto.verify.cache-hit"] = {"count": cache["hits"]}
+        out["crypto.verify.cache-miss"] = {"count": cache["misses"]}
+        v = getattr(self.app, "sig_verifier", None)
+        inner = getattr(v, "inner", v)
+        if inner is not None and hasattr(inner, "batches_dispatched"):
+            out["crypto.verify.batch-dispatch"] = {
+                "count": inner.batches_dispatched}
+            out["crypto.verify.sigs"] = {"count": inner.sigs_verified}
+        return out
 
     def cmd_peers(self, params) -> dict:
         om = self.app.overlay_manager

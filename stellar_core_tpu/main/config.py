@@ -154,7 +154,12 @@ class Config:
         validators = [PublicKey.ed25519(strkey.decode_public_key(v))
                       for v in d.get("VALIDATORS", [])]
         inner = [Config._parse_qset(i) for i in d.get("INNER_SETS", [])]
-        threshold = d.get("THRESHOLD", (len(validators) + len(inner)))
+        n = len(validators) + len(inner)
+        if "THRESHOLD_PERCENT" in d:   # reference config convention
+            pct = int(d["THRESHOLD_PERCENT"])
+            threshold = max(1, -(-n * pct // 100))  # ceil
+        else:
+            threshold = d.get("THRESHOLD", n)
         return SCPQuorumSet(threshold=threshold, validators=validators,
                             innerSets=inner)
 

@@ -205,6 +205,11 @@ class OverlayManager:
         if peer in self.pending_peers:
             self.pending_peers.remove(peer)
         self.authenticated_peers[key] = peer
+        m = getattr(self.app, "metrics", None)
+        if m is not None:
+            m.new_meter("overlay.connection.authenticated").mark()
+            m.new_counter("overlay.connection.count").set_count(
+                len(self.authenticated_peers))
         log.debug("peer %s authenticated (%d total)", peer.id_str(),
                   len(self.authenticated_peers))
         return True
@@ -244,6 +249,9 @@ class OverlayManager:
 
     def broadcast_message(self, msg: StellarMessage,
                           force: bool = False) -> int:
+        m = getattr(self.app, "metrics", None)
+        if m is not None:
+            m.new_meter("overlay.message.broadcast").mark()
         return self.floodgate.broadcast(
             msg, force, self.authenticated_peers,
             self._current_ledger_seq())

@@ -10,7 +10,8 @@ from __future__ import annotations
 
 import bisect
 import time
-from typing import Callable, Dict, List
+from collections import deque
+from typing import Deque, Callable, Dict, List
 
 
 class Counter:
@@ -31,22 +32,31 @@ class Counter:
 
 
 class Meter:
+    """Event-rate meter. Events aggregate into per-second buckets held in
+    a deque, so mark() is O(1) amortized and memory is bounded by the
+    15-minute window regardless of event rate — these sit on hot paths
+    (tx intake, SCP receive, flood)."""
+
     def __init__(self, now_fn: Callable[[], float]) -> None:
         self._now = now_fn
         self.count = 0
-        self._events: List[tuple[float, int]] = []
+        self._buckets: Deque[tuple[int, int]] = deque()  # (sec, n)
 
     def mark(self, n: int = 1) -> None:
         self.count += n
-        t = self._now()
-        self._events.append((t, n))
-        cutoff = t - 900.0
-        while self._events and self._events[0][0] < cutoff:
-            self._events.pop(0)
+        sec = int(self._now())
+        b = self._buckets
+        if b and b[-1][0] == sec:
+            b[-1] = (sec, b[-1][1] + n)
+        else:
+            b.append((sec, n))
+            cutoff = sec - 900
+            while b and b[0][0] < cutoff:
+                b.popleft()
 
     def rate(self, window: float) -> float:
         t = self._now()
-        total = sum(n for (ts, n) in self._events if ts >= t - window)
+        total = sum(n for (sec, n) in self._buckets if sec >= t - window)
         return total / window if window > 0 else 0.0
 
     def one_minute_rate(self) -> float:

@@ -210,3 +210,23 @@ def test_cli_print_xdr_and_sign(tmp_path, capsys):
     from stellar_core_tpu.xdr import TransactionEnvelope
     env = TransactionEnvelope.from_xdr(bytes.fromhex(signed_hex))
     assert len(env.value.signatures) == 2
+
+
+def test_metrics_instrumented_after_closes(app):
+    """The medida-style catalog (docs/metrics.md) is populated by real
+    activity: ledger close timer, tx meters, SCP meters, crypto cache."""
+    adapter = AppLedgerAdapter(app)
+    root = adapter.root_account()
+    a = root.create(10**9)
+    app.submit_transaction(a.tx([a.op_payment(root.account_id, 5)]))
+    app.manual_close()
+    st, m = cmd(app, "metrics")
+    assert st == 200
+    assert m["ledger.ledger.close"]["count"] >= 2
+    assert m["ledger.transaction.apply"]["count"] >= 2
+    assert m["herder.tx.received"]["count"] >= 2
+    assert m["scp.envelope.emit"]["count"] >= 1
+    assert m["scp.value.externalized"]["count"] >= 2
+    assert "crypto.verify.cache-hit" in m
+    assert m["ledger.ledger.num"]["count"] == \
+        app.ledger_manager.last_closed_ledger_num()
