@@ -121,8 +121,9 @@ def checkpoint_verify_triples(frames, ltx) -> List[Tuple]:
     SignatureUtils.cpp:27-36), so the prewarmed cache entries are the ones
     the apply path hits. Signer sets (master + account signers of every
     tx/op source) resolve through ledger state, so multisig txs prewarm
-    too; signers added within the same checkpoint miss the cache and fall
-    back to the sync path."""
+    too; signers added mid-checkpoint are caught by the per-ledger
+    incremental prewarm (only signers added within the SAME ledger fall
+    back to the sync path)."""
     from ..transactions.transaction_frame import frames_sig_triples
     return frames_sig_triples(ltx, frames)
 
@@ -147,6 +148,7 @@ class ApplyCheckpointWork(BasicWork):
         self._txsets: Dict[int, object] = {}
         self._frames: Dict[int, object] = {}   # seq -> TxSetFrame
         self._next: int = first_seq
+        self._sig_state_dirty = False   # a signer set changed mid-checkpoint
 
     def on_reset(self) -> None:
         self._loaded = False
@@ -154,6 +156,7 @@ class ApplyCheckpointWork(BasicWork):
         self._txsets.clear()
         self._frames.clear()
         self._next = self.first_seq
+        self._sig_state_dirty = False
 
     def _load(self) -> bool:
         lpath = os.path.join(self.download_dir,
@@ -171,12 +174,25 @@ class ApplyCheckpointWork(BasicWork):
                     self._txsets[t.ledgerSeq] = t.txSet
         return True
 
+    def _prewarm_frames(self, frames) -> None:
+        """Collect candidate triples against CURRENT ledger state and
+        drain them through the batch verifier (cached triples are skipped
+        inside prewarm_many — a fully-covered call dispatches nothing)."""
+        verifier = getattr(self.app, "sig_verifier", None)
+        if verifier is None or not frames:
+            return
+        from ..ledger.ledgertxn import LedgerTxn
+        ltx = LedgerTxn(self.app.ledger_manager.ltx_root())
+        try:
+            triples = checkpoint_verify_triples(frames, ltx)
+        finally:
+            ltx.rollback()
+        if triples:
+            verifier.prewarm_many(triples)
+
     def _prewarm(self) -> None:
         """One device batch for the whole checkpoint's signatures."""
         from ..herder.txset import TxSetFrame
-        verifier = getattr(self.app, "sig_verifier", None)
-        if verifier is None:
-            return
         net = self.app.config.network_id
         frames = []
         for seq in range(self.first_seq, self.last_seq + 1):
@@ -186,16 +202,34 @@ class ApplyCheckpointWork(BasicWork):
             fr = TxSetFrame.from_wire(net, ts)
             self._frames[seq] = fr       # reused at apply: parse once
             frames.extend(fr.frames)
-        from ..ledger.ledgertxn import LedgerTxn
-        ltx = LedgerTxn(self.app.ledger_manager.ltx_root())
-        try:
-            triples = checkpoint_verify_triples(frames, ltx)
-        finally:
-            ltx.rollback()
-        if triples:
-            verifier.prewarm_many(triples)
-            log.debug("prewarmed %d sigs for checkpoint %08x",
-                      len(triples), self.checkpoint)
+        self._prewarm_frames(frames)
+        log.debug("prewarmed checkpoint %08x (%d txs)",
+                  self.checkpoint, len(frames))
+
+    @staticmethod
+    def _mutates_signers(txset) -> bool:
+        """Does any op in the set change a signer set? (SET_OPTIONS is
+        the only op that ADDS verification pairs; creations/merges only
+        add/remove master keys, which the master-key candidate rule
+        already covers.)"""
+        from ..xdr import OperationType
+        for f in txset.frames:
+            tx = getattr(f, "tx", None) or f.inner.tx
+            for op in tx.operations:
+                if op.body.disc == OperationType.SET_OPTIONS:
+                    return True
+        return False
+
+    def _prewarm_ledger(self, txset) -> None:
+        """Incremental prewarm right before one ledger applies, run only
+        after some earlier ledger IN THIS CHECKPOINT mutated a signer
+        set: the whole-checkpoint prewarm resolved signer sets at
+        checkpoint start, so signatures from signers added mid-checkpoint
+        missed it, and each miss would otherwise dispatch a tiny padded
+        device batch from inside check_signature. The common case (no
+        signer changes) skips collection entirely."""
+        if self._sig_state_dirty and txset.frames:
+            self._prewarm_frames(txset.frames)
 
     def on_run(self) -> State:
         from ..herder.txset import TxSetFrame
@@ -225,8 +259,11 @@ class ApplyCheckpointWork(BasicWork):
             ts = self._txsets.get(seq)
             txset = (TxSetFrame.from_wire(net, ts) if ts is not None else
                      TxSetFrame(net, entry.header.previousLedgerHash, []))
+        self._prewarm_ledger(txset)
         lcd = LedgerCloseData(seq, txset, entry.header.scpValue)
         lm.close_ledger(lcd)
+        if not self._sig_state_dirty and self._mutates_signers(txset):
+            self._sig_state_dirty = True
         if lm.lcl_hash != entry.hash:
             log.error("replay diverged at ledger %d: %s != %s", seq,
                       lm.lcl_hash.hex()[:8], entry.hash.hex()[:8])

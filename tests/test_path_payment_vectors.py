@@ -1,0 +1,309 @@
+"""Path-payment edge vectors, ported scenario-for-scenario from the
+reference's PathPaymentTests.cpp / PathPaymentStrictSendTests.cpp result
+matrix (src/transactions/test/): malformed inputs, every failure code,
+multi-hop crossing with exact amounts, partial consumption across price
+levels, and self-cross rejection."""
+
+import pytest
+
+from stellar_core_tpu.crypto.keys import SecretKey
+from stellar_core_tpu.testing import TestAccount, TestLedger
+from stellar_core_tpu.transactions.offers import PathPaymentResultCode
+from stellar_core_tpu.xdr import (
+    AccountFlags, AllowTrustAsset, AllowTrustOp, Asset, OperationBody,
+    OperationType, PathPaymentStrictReceiveOp, PathPaymentStrictSendOp,
+)
+
+XLM = Asset.native()
+
+
+@pytest.fixture
+def ledger():
+    return TestLedger()
+
+
+@pytest.fixture
+def root(ledger):
+    from stellar_core_tpu.testing import root_secret_key
+    return TestAccount(ledger, root_secret_key())
+
+
+def inner_code(frame):
+    opr = frame.result.op_results[0]
+    return opr.value.value.disc
+
+
+def recv_op(src, dst, send_asset, send_max, dest_asset, dest_amount,
+            path=()):
+    return src.op(OperationBody(
+        OperationType.PATH_PAYMENT_STRICT_RECEIVE,
+        PathPaymentStrictReceiveOp(
+            sendAsset=send_asset, sendMax=send_max, destination=dst.muxed,
+            destAsset=dest_asset, destAmount=dest_amount,
+            path=list(path))))
+
+
+def send_op(src, dst, send_asset, send_amount, dest_asset, dest_min,
+            path=()):
+    return src.op(OperationBody(
+        OperationType.PATH_PAYMENT_STRICT_SEND,
+        PathPaymentStrictSendOp(
+            sendAsset=send_asset, sendAmount=send_amount,
+            destination=dst.muxed, destAsset=dest_asset,
+            destMin=dest_min, path=list(path))))
+
+
+def market(root, n_assets=1):
+    """issuer + market maker holding each credit asset, books unopened."""
+    issuer = root.create(10**10)
+    mm = root.create(10**10)
+    assets = []
+    for i in range(n_assets):
+        code = ("AS%d" % i).encode().ljust(4, b"\x00")[:4].decode("ascii")
+        a = Asset.credit(code.rstrip("\x00"), issuer.account_id)
+        assert mm.change_trust(a, 10**14)
+        assert issuer.pay(mm, 10**8, a)
+        assets.append(a)
+    return issuer, mm, assets
+
+
+# ------------------------------------------------------- validity failures
+
+def test_malformed_amounts(ledger, root):
+    a = root.create(10**9)
+    b = root.create(10**9)
+    for op in (recv_op(a, b, XLM, 10, XLM, 0),
+               recv_op(a, b, XLM, 0, XLM, 10),
+               send_op(a, b, XLM, 0, XLM, 1)):
+        f = a.tx([op])
+        assert not ledger.apply_frame(f)
+        assert inner_code(f) == PathPaymentResultCode.MALFORMED
+
+
+def test_path_too_long_rejected_at_wire(ledger, root):
+    """The 5-hop path maximum is enforced by the XDR layer itself
+    (path is array<Asset, 5> on the wire) — an oversized path cannot
+    even be encoded, matching the reference's xdrpp bound."""
+    from stellar_core_tpu.xdr.codec import XdrError
+    issuer, mm, assets = market(root, 1)
+    a = root.create(10**9)
+    b = root.create(10**9)
+    path = [assets[0]] * 6
+    with pytest.raises(XdrError):
+        a.tx([recv_op(a, b, XLM, 100, XLM, 10, path)])
+
+
+def test_no_destination(ledger, root):
+    a = root.create(10**9)
+    ghost = TestAccount(ledger, SecretKey.pseudo_random_for_testing())
+    f = a.tx([recv_op(a, ghost, XLM, 100, XLM, 10)])
+    assert not ledger.apply_frame(f)
+    assert inner_code(f) == PathPaymentResultCode.NO_DESTINATION
+
+
+def test_src_no_trust(ledger, root):
+    issuer, mm, (usd,) = market(root)
+    a = root.create(10**9)
+    b = root.create(10**9)
+    assert b.change_trust(usd, 10**12)
+    f = a.tx([recv_op(a, b, usd, 100, usd, 10)])
+    assert not ledger.apply_frame(f)
+    assert inner_code(f) == PathPaymentResultCode.SRC_NO_TRUST
+
+
+def test_dest_no_trust(ledger, root):
+    issuer, mm, (usd,) = market(root)
+    a = root.create(10**9)
+    b = root.create(10**9)
+    assert a.change_trust(usd, 10**12)
+    assert issuer.pay(a, 1000, usd)
+    f = a.tx([recv_op(a, b, usd, 100, usd, 10)])
+    assert not ledger.apply_frame(f)
+    assert inner_code(f) == PathPaymentResultCode.NO_TRUST
+
+
+def test_not_authorized_both_sides(ledger, root):
+    issuer = root.create(10**9)
+    a = root.create(10**9)
+    b = root.create(10**9)
+    usd = Asset.credit("USD", issuer.account_id)
+    assert ledger.apply_frame(issuer.tx([issuer.op_set_options(
+        set_flags=AccountFlags.AUTH_REQUIRED_FLAG |
+        AccountFlags.AUTH_REVOCABLE_FLAG)]))
+    for acct in (a, b):
+        assert acct.change_trust(usd, 10**12)
+
+    def allow(acct, yes):
+        return issuer.op(OperationBody(
+            OperationType.ALLOW_TRUST,
+            AllowTrustOp(trustor=acct.account_id,
+                         asset=AllowTrustAsset(1, b"USD\x00"),
+                         authorize=1 if yes else 0)))
+
+    # only the source authorized → dest NOT_AUTHORIZED (strict receive
+    # resolves the destination leg first)
+    assert ledger.apply_frame(issuer.tx([allow(a, True)]))
+    assert issuer.pay(a, 1000, usd)
+    f = a.tx([recv_op(a, b, usd, 100, usd, 10)])
+    assert not ledger.apply_frame(f)
+    assert inner_code(f) == PathPaymentResultCode.NOT_AUTHORIZED
+    # dest authorized, source revoked → SRC_NOT_AUTHORIZED
+    assert ledger.apply_frame(issuer.tx([allow(b, True)]))
+    assert ledger.apply_frame(issuer.tx([allow(a, False)]))
+    f = a.tx([recv_op(a, b, usd, 100, usd, 10)])
+    assert not ledger.apply_frame(f)
+    assert inner_code(f) == PathPaymentResultCode.SRC_NOT_AUTHORIZED
+
+
+def test_line_full_on_destination(ledger, root):
+    issuer, mm, (usd,) = market(root)
+    a = root.create(10**9)
+    b = root.create(10**9)
+    assert a.change_trust(usd, 10**12)
+    assert issuer.pay(a, 1000, usd)
+    assert b.change_trust(usd, 50)     # tiny limit
+    f = a.tx([recv_op(a, b, usd, 100, usd, 60)])
+    assert not ledger.apply_frame(f)
+    assert inner_code(f) == PathPaymentResultCode.LINE_FULL
+
+
+def test_no_issuer(ledger, root):
+    a = root.create(10**9)
+    b = root.create(10**9)
+    ghost = SecretKey.pseudo_random_for_testing()
+    bad = Asset.credit("BAD", ghost.public_key)
+    f = a.tx([recv_op(a, b, bad, 100, bad, 10)])
+    assert not ledger.apply_frame(f)
+    assert inner_code(f) == PathPaymentResultCode.NO_ISSUER
+
+
+def test_underfunded_native(ledger, root):
+    a = root.create(2 * 10**7)   # barely above reserve
+    b = root.create(10**9)
+    f = a.tx([send_op(a, b, XLM, 10**9, XLM, 1)])
+    assert not ledger.apply_frame(f)
+    assert inner_code(f) == PathPaymentResultCode.UNDERFUNDED
+
+
+# ------------------------------------------------------- book interactions
+
+def test_too_few_offers_empty_book(ledger, root):
+    issuer, mm, (usd,) = market(root)
+    a = root.create(10**9)
+    b = root.create(10**9)
+    assert b.change_trust(usd, 10**12)
+    # no offers selling USD for XLM exist
+    f = a.tx([recv_op(a, b, XLM, 10**6, usd, 100)])
+    assert not ledger.apply_frame(f)
+    assert inner_code(f) == PathPaymentResultCode.TOO_FEW_OFFERS
+
+
+def test_over_sendmax_and_under_destmin(ledger, root):
+    issuer, mm, (usd,) = market(root)
+    a = root.create(10**9)
+    b = root.create(10**9)
+    assert b.change_trust(usd, 10**12)
+    assert ledger.apply_frame(
+        mm.tx([mm.op_manage_sell_offer(usd, XLM, 10**6, 2, 1)]))
+    f = a.tx([recv_op(a, b, XLM, 199, usd, 100)])   # needs 200 XLM
+    assert not ledger.apply_frame(f)
+    assert inner_code(f) == PathPaymentResultCode.OVER_SENDMAX
+    f = a.tx([send_op(a, b, XLM, 200, usd, 101)])   # yields 100 USD
+    assert not ledger.apply_frame(f)
+    assert inner_code(f) == PathPaymentResultCode.UNDER_DESTMIN
+
+
+def test_two_hop_path_exact_amounts(ledger, root):
+    """XLM → AS0 → AS1: walk two books; reference PathPaymentTests
+    multi-hop success case. 1 AS1 = 1 AS0 = 2 XLM."""
+    issuer, mm, (as0, as1) = market(root, 2)
+    a = root.create(10**10)
+    b = root.create(10**10)
+    assert b.change_trust(as1, 10**12)
+    assert ledger.apply_frame(
+        mm.tx([mm.op_manage_sell_offer(as0, XLM, 10**6, 2, 1)]))
+    assert ledger.apply_frame(
+        mm.tx([mm.op_manage_sell_offer(as1, as0, 10**6, 1, 1)]))
+    f = a.tx([recv_op(a, b, XLM, 10**6, as1, 500, path=[as0])])
+    assert ledger.apply_frame(f), f.result
+    assert ledger.trust_balance(b.account_id, as1) == 500
+    succ = f.result.op_results[0].value.value.value
+    assert succ.last.amount == 500
+    # two offers crossed, one per hop
+    assert len(succ.offers) == 2
+    # mm's inventories moved: sold 500 AS1, received 500 AS0; sold 500
+    # AS0, received 1000 XLM
+    assert ledger.trust_balance(mm.account_id, as1) == 10**8 - 500
+
+
+def test_partial_consumption_across_price_levels(ledger, root):
+    """Strict receive walks the best price first and partially consumes
+    the worse offer (reference partial-cross cases)."""
+    issuer, mm, (usd,) = market(root)
+    mm2 = root.create(10**10)
+    assert mm2.change_trust(usd, 10**14)
+    assert issuer.pay(mm2, 10**8, usd)
+    a = root.create(10**10)
+    b = root.create(10**10)
+    assert b.change_trust(usd, 10**12)
+    # best: 100 USD at 1 XLM each; worse: at 3 XLM each
+    assert ledger.apply_frame(
+        mm.tx([mm.op_manage_sell_offer(usd, XLM, 100, 1, 1)]))
+    assert ledger.apply_frame(
+        mm2.tx([mm2.op_manage_sell_offer(usd, XLM, 10**6, 3, 1)]))
+    f = a.tx([recv_op(a, b, XLM, 10**6, usd, 150)])
+    assert ledger.apply_frame(f), f.result
+    succ = f.result.op_results[0].value.value.value
+    assert ledger.trust_balance(b.account_id, usd) == 150
+    # 100 at price 1 + 50 at price 3 = 250 XLM spent
+    assert len(succ.offers) == 2
+    total_xlm = sum(o.amountBought for o in succ.offers)
+    assert total_xlm == 100 * 1 + 50 * 3
+    # the worse offer survives partially
+    assert ledger.trust_balance(mm2.account_id, usd) == 10**8 - 50
+
+
+def test_offer_cross_self_rejected(ledger, root):
+    """A path payment that would cross the source's own offer fails
+    (reference offerCrossSelf semantics)."""
+    issuer, mm, (usd,) = market(root)
+    a = root.create(10**10)
+    b = root.create(10**10)
+    assert a.change_trust(usd, 10**12)
+    assert b.change_trust(usd, 10**12)
+    assert issuer.pay(a, 10**6, usd)
+    # a's own offer is the only one in the book
+    assert ledger.apply_frame(
+        a.tx([a.op_manage_sell_offer(usd, XLM, 10**5, 1, 1)]))
+    f = a.tx([recv_op(a, b, XLM, 10**6, usd, 100)])
+    assert not ledger.apply_frame(f)
+    assert inner_code(f) == PathPaymentResultCode.OFFER_CROSS_SELF
+
+
+def test_same_asset_no_book_is_direct_transfer(ledger, root):
+    issuer, mm, (usd,) = market(root)
+    a = root.create(10**9)
+    b = root.create(10**9)
+    for acct in (a, b):
+        assert acct.change_trust(usd, 10**12)
+    assert issuer.pay(a, 1000, usd)
+    f = a.tx([recv_op(a, b, usd, 100, usd, 100)])
+    assert ledger.apply_frame(f), f.result
+    assert ledger.trust_balance(b.account_id, usd) == 100
+    assert ledger.trust_balance(a.account_id, usd) == 900
+
+
+def test_strict_send_sweeps_multiple_offers(ledger, root):
+    issuer, mm, (usd,) = market(root)
+    a = root.create(10**10)
+    b = root.create(10**10)
+    assert b.change_trust(usd, 10**12)
+    for price_n in (1, 2, 4):
+        assert ledger.apply_frame(
+            mm.tx([mm.op_manage_sell_offer(usd, XLM, 100, price_n, 1)]))
+    # spend exactly 100*1 + 100*2 = 300 XLM → 200 USD
+    f = a.tx([send_op(a, b, XLM, 300, usd, 1)])
+    assert ledger.apply_frame(f), f.result
+    assert ledger.trust_balance(b.account_id, usd) == 200
+    succ = f.result.op_results[0].value.value.value
+    assert succ.last.amount == 200

@@ -93,3 +93,34 @@ def test_load_generator_standalone():
     st = lg.status()
     assert st["failed"] == 0, st
     assert app.ledger_manager.last_closed_ledger_num() >= 3
+
+
+def test_generateload_flood_sustained():
+    """Sustained generateload flood through the TransactionQueue path
+    (BASELINE.md measurement config: standalone config + generateload
+    flood): 20 ledgers of mixed account-creation + payment load, no
+    failures, queue drained, metrics accumulate."""
+    import stellar_core_tpu.main.application as A
+    import stellar_core_tpu.main.config as C
+    from stellar_core_tpu.util.timer import ClockMode, VirtualClock
+    cfg = C.Config.test_config(8)
+    app = A.Application(VirtualClock(ClockMode.VIRTUAL_TIME), cfg)
+    app.start()
+    lg = LoadGenerator(app)
+    lg.generate_accounts(30)
+    app.manual_close()
+    app.clock.set_virtual_time(app.clock.now() + 1.0)
+    for _ in range(20):
+        lg.generate_payments(25)
+        app.clock.set_virtual_time(app.clock.now() + 1.0)
+        app.manual_close()
+    st = lg.status()
+    assert st["failed"] == 0, st
+    assert st["submitted"] >= 500
+    m = app.metrics.to_json()
+    assert m["herder.tx.accepted"]["count"] >= 500
+    assert m["ledger.transaction.apply"]["count"] >= 500
+    assert m["herder.pending-ops.count"]["count"] == 0
+    # every submitted payment applied: balances conserved is checked by
+    # the ConservationOfLumens invariant on each close (test config
+    # enables all invariants)
