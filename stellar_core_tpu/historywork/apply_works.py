@@ -208,28 +208,37 @@ class ApplyCheckpointWork(BasicWork):
 
     @staticmethod
     def _mutates_signers(txset) -> bool:
-        """Does any op in the set change a signer set? (SET_OPTIONS is
-        the only op that ADDS verification pairs; creations/merges only
-        add/remove master keys, which the master-key candidate rule
-        already covers.)"""
+        """Does any op in the set ADD verification pairs? Only a
+        SET_OPTIONS carrying a signer does (flags/threshold/home-domain
+        changes and master-weight edits don't: the master key is always
+        a candidate; creations/merges only add/remove master keys)."""
         from ..xdr import OperationType
         for f in txset.frames:
             tx = getattr(f, "tx", None) or f.inner.tx
             for op in tx.operations:
-                if op.body.disc == OperationType.SET_OPTIONS:
+                if op.body.disc == OperationType.SET_OPTIONS and                         op.body.value.signer is not None:
                     return True
         return False
 
     def _prewarm_ledger(self, txset) -> None:
-        """Incremental prewarm right before one ledger applies, run only
-        after some earlier ledger IN THIS CHECKPOINT mutated a signer
-        set: the whole-checkpoint prewarm resolved signer sets at
-        checkpoint start, so signatures from signers added mid-checkpoint
-        missed it, and each miss would otherwise dispatch a tiny padded
-        device batch from inside check_signature. The common case (no
-        signer changes) skips collection entirely."""
-        if self._sig_state_dirty and txset.frames:
-            self._prewarm_frames(txset.frames)
+        """Re-prewarm after a signer-set mutation: the whole-checkpoint
+        prewarm resolved signer sets at checkpoint start, so signatures
+        from signers added mid-checkpoint missed it, and each miss would
+        otherwise dispatch a tiny padded device batch from inside
+        check_signature. When the dirty flag flips, ALL remaining
+        checkpoint frames re-collect against current state in ONE batch
+        and the flag clears (a later mutation re-arms it) — the common
+        no-mutation case skips collection entirely."""
+        del txset
+        if not self._sig_state_dirty:
+            return
+        self._sig_state_dirty = False
+        frames = []
+        for seq in range(self._next, self.last_seq + 1):
+            fr = self._frames.get(seq)
+            if fr is not None:
+                frames.extend(fr.frames)
+        self._prewarm_frames(frames)
 
     def on_run(self) -> State:
         from ..herder.txset import TxSetFrame

@@ -1,0 +1,243 @@
+"""Fee-bump envelope vectors, ported from the reference's
+FeeBumpTransactionTests.cpp section matrix (validity codes, fee
+processing, inner-failure reporting)."""
+
+import pytest
+
+from stellar_core_tpu.crypto.keys import SecretKey
+from stellar_core_tpu.testing import TestAccount, TestLedger, root_secret_key
+from stellar_core_tpu.transactions.transaction_frame import (
+    FeeBumpTransactionFrame,
+)
+from stellar_core_tpu.xdr import (
+    EnvelopeType, FeeBumpTransaction, FeeBumpTransactionEnvelope,
+    TransactionEnvelope, TransactionResultCode, _Ext,
+)
+from stellar_core_tpu.xdr.transaction import _InnerTxEnvelope
+
+
+@pytest.fixture
+def ledger():
+    return TestLedger()
+
+
+@pytest.fixture
+def root(ledger):
+    return TestAccount(ledger, root_secret_key())
+
+
+def bump(ledger, sponsor, inner_frame, fee=1000, sign=True,
+         signers=None):
+    fb = FeeBumpTransaction(
+        feeSource=sponsor.muxed, fee=fee,
+        innerTx=_InnerTxEnvelope(EnvelopeType.ENVELOPE_TYPE_TX,
+                                 inner_frame.envelope.value),
+        ext=_Ext.v0())
+    env = TransactionEnvelope(
+        EnvelopeType.ENVELOPE_TYPE_TX_FEE_BUMP,
+        FeeBumpTransactionEnvelope(tx=fb, signatures=[]))
+    frame = FeeBumpTransactionFrame(ledger.network_id, env)
+    for sk in (signers if signers is not None
+               else ([sponsor.sk] if sign else [])):
+        frame.add_signature(sk)
+    return frame
+
+
+def test_insufficient_fee_below_min(ledger, root):
+    a = root.create(10**9)
+    sponsor = root.create(10**9)
+    inner = a.tx([a.op_payment(root.account_id, 1)], fee=100)
+    # fee must cover (inner ops + 1) * baseFee = 200
+    f = bump(ledger, sponsor, inner, fee=199)
+    assert not ledger.apply_frame(f)
+    assert f.result.code == TransactionResultCode.txINSUFFICIENT_FEE
+
+
+def test_insufficient_fee_rate_below_inner_bid(ledger, root):
+    a = root.create(10**9)
+    sponsor = root.create(10**9)
+    inner = a.tx([a.op_payment(root.account_id, 1)], fee=900)
+    # outer fee below the inner bid is an invalid replacement
+    f = bump(ledger, sponsor, inner, fee=400)
+    assert not ledger.apply_frame(f)
+    assert f.result.code == TransactionResultCode.txINSUFFICIENT_FEE
+
+
+def test_fee_source_missing(ledger, root):
+    a = root.create(10**9)
+    ghost = TestAccount(ledger, SecretKey.pseudo_random_for_testing())
+    inner = a.tx([a.op_payment(root.account_id, 1)], fee=100)
+    f = bump(ledger, ghost, inner)
+    assert not ledger.apply_frame(f)
+    assert f.result.code == TransactionResultCode.txNO_ACCOUNT
+
+
+def test_bad_signatures_missing_and_wrong(ledger, root):
+    a = root.create(10**9)
+    sponsor = root.create(10**9)
+    inner = a.tx([a.op_payment(root.account_id, 1)], fee=100)
+    f = bump(ledger, sponsor, inner, sign=False)
+    assert not ledger.apply_frame(f)
+    assert f.result.code == TransactionResultCode.txBAD_AUTH
+    wrong = SecretKey.pseudo_random_for_testing()
+    f = bump(ledger, sponsor, inner, signers=[wrong])
+    assert not ledger.apply_frame(f)
+    assert f.result.code == TransactionResultCode.txBAD_AUTH
+
+
+def test_extra_signatures_rejected(ledger, root):
+    a = root.create(10**9)
+    sponsor = root.create(10**9)
+    inner = a.tx([a.op_payment(root.account_id, 1)], fee=100)
+    extra = SecretKey.pseudo_random_for_testing()
+    f = bump(ledger, sponsor, inner, signers=[sponsor.sk, extra])
+    assert not ledger.apply_frame(f)
+    assert f.result.code == TransactionResultCode.txBAD_AUTH_EXTRA
+
+
+def test_insufficient_balance_on_fee_source(ledger, root):
+    a = root.create(10**9)
+    sponsor = root.create(10**7)   # two reserves, nothing spare
+    inner = a.tx([a.op_payment(root.account_id, 1)], fee=100)
+    f = bump(ledger, sponsor, inner, fee=10**7)
+    assert not ledger.apply_frame(f)
+    assert f.result.code == TransactionResultCode.txINSUFFICIENT_BALANCE
+
+
+def test_inner_invalid_reports_inner_pair(ledger, root):
+    a = root.create(10**9)
+    sponsor = root.create(10**9)
+    # inner bad seq: invalid at transaction level
+    inner = a.tx([a.op_payment(root.account_id, 1)], fee=100,
+                 seq=a.next_seq() + 50)
+    f = bump(ledger, sponsor, inner)
+    assert not ledger.apply_frame(f)
+    assert f.result.code == TransactionResultCode.txFEE_BUMP_INNER_FAILED
+    pair = f.result.result.value
+    assert pair.transactionHash == f.inner.contents_hash()
+    assert pair.result.code == TransactionResultCode.txBAD_SEQ
+
+
+def test_inner_op_failure_fee_still_charged_to_sponsor(ledger, root):
+    """Inner operation fails at apply: the sponsor pays the fee, the
+    inner source pays nothing, and the result carries the inner pair
+    (reference 'inner transaction fails, operation level')."""
+    a = root.create(10**9)
+    sponsor = root.create(10**9)
+    ghost = SecretKey.pseudo_random_for_testing()
+    inner = a.tx([a.op_payment(ghost.public_key, 5)], fee=100)  # NO_DEST
+    f = bump(ledger, sponsor, inner, fee=1000)
+    bal_sponsor = sponsor.balance()
+    bal_a = a.balance()
+    seq_a = ledger.seq_num(a.account_id)
+    assert not ledger.apply_frame(f)
+    assert f.result.code == TransactionResultCode.txFEE_BUMP_INNER_FAILED
+    # sponsor paid the (effective) fee; inner source untouched except seq
+    assert sponsor.balance() == bal_sponsor - f.fee_charged(ledger.header())
+    assert ledger.balance(a.account_id) == bal_a
+    assert ledger.seq_num(a.account_id) == seq_a + 1  # inner seq consumed
+
+
+def test_fee_charged_capped_at_effective_base_fee(ledger, root):
+    """feeCharged = min(bid, baseFee * (ops+1)) — the bid is a ceiling,
+    not the charge (reference 'fee processing')."""
+    a = root.create(10**9)
+    sponsor = root.create(10**9)
+    inner = a.tx([a.op_payment(root.account_id, 1)], fee=100)
+    f = bump(ledger, sponsor, inner, fee=10**6)
+    bal_sponsor = sponsor.balance()
+    assert ledger.apply_frame(f), f.result
+    charged = bal_sponsor - sponsor.balance()
+    assert charged == 2 * ledger.header().baseFee
+    assert f.result.code == TransactionResultCode.txFEE_BUMP_INNER_SUCCESS
+    pair = f.result.result.value
+    assert pair.result.code == TransactionResultCode.txSUCCESS
+
+
+# --------------------------------------------- set-options / change-trust
+# (reference SetOptionsTests.cpp / ChangeTrustTests.cpp key scenarios)
+
+from stellar_core_tpu.transactions.operations import (  # noqa: E402
+    ChangeTrustResultCode, SetOptionsResultCode,
+)
+from stellar_core_tpu.xdr import Asset  # noqa: E402
+
+
+def op_code(frame):
+    return frame.result.op_results[0].value.value.disc
+
+
+def test_set_options_signer_cap(ledger, root):
+    a = root.create(10**10)
+    for i in range(20):
+        k = SecretKey.from_seed(bytes([60 + i]) + b"\x01" * 31)
+        f = a.tx([a.op_add_signer(k.public_key.key_bytes)])
+        assert ledger.apply_frame(f), (i, f.result)
+    k = SecretKey.from_seed(bytes([90]) + b"\x01" * 31)
+    f = a.tx([a.op_add_signer(k.public_key.key_bytes)])
+    assert not ledger.apply_frame(f)
+    assert op_code(f) == SetOptionsResultCode.TOO_MANY_SIGNERS
+
+
+def test_set_options_signer_remove_and_master_lockout(ledger, root):
+    a = root.create(10**9)
+    b = root.create(10**9)
+    k = SecretKey.from_seed(b"\x41" * 32)
+    assert ledger.apply_frame(a.tx([a.op_add_signer(k.public_key.key_bytes)]))
+    # weight 0 removes the signer
+    assert ledger.apply_frame(
+        a.tx([a.op_add_signer(k.public_key.key_bytes, weight=0)]))
+    # master weight 0 with no other signers: account can no longer sign
+    assert ledger.apply_frame(a.tx([a.op_set_options(master_weight=0)]))
+    f = a.tx([a.op_payment(b.account_id, 1)])
+    assert not ledger.apply_frame(f)
+    assert f.result.code == TransactionResultCode.txBAD_AUTH
+
+
+def test_set_options_bad_signer_is_self(ledger, root):
+    a = root.create(10**9)
+    f = a.tx([a.op_add_signer(a.account_id.key_bytes)])
+    assert not ledger.apply_frame(f)
+    assert op_code(f) == SetOptionsResultCode.BAD_SIGNER
+
+
+def test_set_options_threshold_range_and_flags(ledger, root):
+    a = root.create(10**9)
+    f = a.tx([a.op_set_options(med=256)])
+    assert not ledger.apply_frame(f)
+    assert op_code(f) == SetOptionsResultCode.THRESHOLD_OUT_OF_RANGE
+    f = a.tx([a.op_set_options(set_flags=1, clear_flags=1)])
+    assert not ledger.apply_frame(f)
+    assert op_code(f) == SetOptionsResultCode.BAD_FLAGS
+    f = a.tx([a.op_set_options(set_flags=0x100)])
+    assert not ledger.apply_frame(f)
+    assert op_code(f) == SetOptionsResultCode.UNKNOWN_FLAG
+
+
+def test_change_trust_limits(ledger, root):
+    issuer = root.create(10**9)
+    a = root.create(10**9)
+    usd = Asset.credit("USD", issuer.account_id)
+    assert a.change_trust(usd, 1000)
+    assert issuer.pay(a, 500, usd)
+    # reducing the limit below the balance is invalid
+    f = a.tx([a.op_change_trust(usd, 400)])
+    assert not ledger.apply_frame(f)
+    assert op_code(f) == ChangeTrustResultCode.INVALID_LIMIT
+    # deleting (limit 0) with a balance is invalid; after paying it back
+    # the line deletes and frees the subentry
+    f = a.tx([a.op_change_trust(usd, 0)])
+    assert not ledger.apply_frame(f)
+    assert a.pay(issuer, 500, usd)
+    assert ledger.apply_frame(a.tx([a.op_change_trust(usd, 0)]))
+    from stellar_core_tpu.xdr import LedgerKey
+    assert ledger.root.get_entry(
+        LedgerKey.trustline(a.account_id, usd)) is None  # line deleted
+
+
+def test_change_trust_self_not_allowed(ledger, root):
+    issuer = root.create(10**9)
+    own = Asset.credit("OWN", issuer.account_id)
+    f = issuer.tx([issuer.op_change_trust(own, 1000)])
+    assert not ledger.apply_frame(f)
+    assert op_code(f) == ChangeTrustResultCode.SELF_NOT_ALLOWED

@@ -85,10 +85,9 @@ def test_path_too_long_rejected_at_wire(ledger, root):
     (path is array<Asset, 5> on the wire) — an oversized path cannot
     even be encoded, matching the reference's xdrpp bound."""
     from stellar_core_tpu.xdr.codec import XdrError
-    issuer, mm, assets = market(root, 1)
     a = root.create(10**9)
     b = root.create(10**9)
-    path = [assets[0]] * 6
+    path = [Asset.credit("AS0", a.account_id)] * 6
     with pytest.raises(XdrError):
         a.tx([recv_op(a, b, XLM, 100, XLM, 10, path)])
 
