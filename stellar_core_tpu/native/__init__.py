@@ -29,9 +29,31 @@ _LIB: Optional[ctypes.CDLL] = None
 _TRIED = False
 
 
+def _cc_build(src_path: str, so_path: str, include_dir: str) -> bool:
+    """Try cc/gcc/g++ -O2 -shared -fPIC; atomic-rename into so_path.
+    Shared by the prep library and the XDR extension builds."""
+    import tempfile
+    for cc in ("cc", "gcc", "g++"):
+        tmp = tempfile.NamedTemporaryFile(
+            dir=_BUILD, suffix=".so", delete=False)
+        tmp.close()
+        try:
+            r = subprocess.run(
+                [cc, "-O2", "-shared", "-fPIC", "-I", include_dir,
+                 "-o", tmp.name, src_path],
+                capture_output=True, text=True, timeout=120)
+        except (OSError, subprocess.TimeoutExpired):
+            os.unlink(tmp.name)
+            continue
+        if r.returncode == 0:
+            os.rename(tmp.name, so_path)  # atomic: concurrent builders ok
+            return True
+        os.unlink(tmp.name)
+    return False
+
+
 def _compile() -> Optional[str]:
     import hashlib
-    import tempfile
 
     os.makedirs(_BUILD, exist_ok=True)
     src = os.path.join(_DIR, "prep.c")
@@ -50,23 +72,7 @@ def _compile() -> Optional[str]:
     hdr = os.path.join(_BUILD, "prep_constants.h")
     with open(hdr, "w") as fh:
         fh.write(header)
-    for cc in ("cc", "gcc", "g++"):
-        tmp = tempfile.NamedTemporaryFile(
-            dir=_BUILD, suffix=".so", delete=False)
-        tmp.close()
-        try:
-            r = subprocess.run(
-                [cc, "-O2", "-shared", "-fPIC", "-I", _BUILD,
-                 "-o", tmp.name, src],
-                capture_output=True, text=True, timeout=120)
-        except (OSError, subprocess.TimeoutExpired):
-            os.unlink(tmp.name)
-            continue
-        if r.returncode == 0:
-            os.rename(tmp.name, so)  # atomic: concurrent builders race-free
-            return so
-        os.unlink(tmp.name)
-    return None
+    return so if _cc_build(src, so, _BUILD) else None
 
 
 def _load() -> Optional[ctypes.CDLL]:
@@ -129,3 +135,126 @@ def prepare_batch_native(pub_arr: np.ndarray, sig_arr: np.ndarray,
     return {"ay": ay, "a_sign": a_sign, "ry": ry, "r_sign": r_sign,
             "s_nibs": s_nibs, "k_nibs": k_nibs,
             "pre_ok": pre_ok.astype(bool)}
+
+
+# --------------------------------------------------------------------------
+# Native XDR serializer (_sctxdr extension): compiles codec type trees into
+# flat programs interpreted in C. xdr_bytes() prefers this engine; the
+# pure-Python fastcodec stays the fallback and the behavioral oracle.
+
+_XDR_MOD = None
+_XDR_TRIED = False
+
+
+def _compile_xdr_ext() -> None:
+    """Build native/xdrc.c into an importable CPython extension, cached
+    under build/ keyed by (source hash, interpreter ABI tag) — extension
+    modules are not ABI-stable across CPython versions, so a cached build
+    must never be reused by a different interpreter."""
+    global _XDR_MOD, _XDR_TRIED
+    with _LOCK:
+        if _XDR_TRIED:
+            return
+        if os.environ.get("SCT_NATIVE_XDR", "1") == "0":
+            _XDR_TRIED = True
+            return
+        import hashlib
+        import importlib.util
+        import sysconfig
+
+        os.makedirs(_BUILD, exist_ok=True)
+        src = os.path.join(_DIR, "xdrc.c")
+        with open(src, "rb") as fh:
+            digest = hashlib.sha256(fh.read()).hexdigest()[:16]
+        tag = getattr(sys.implementation, "cache_tag", "py")
+        so = os.path.join(_BUILD, "_sctxdr-%s-%s.so" % (tag, digest))
+        if not os.path.exists(so):
+            inc = sysconfig.get_paths()["include"]
+            if not _cc_build(src, so, inc):
+                _XDR_TRIED = True
+                return
+        try:
+            spec = importlib.util.spec_from_file_location("_sctxdr", so)
+            mod = importlib.util.module_from_spec(spec)
+            spec.loader.exec_module(mod)
+            _XDR_MOD = mod
+        except Exception:
+            _XDR_MOD = None
+        _XDR_TRIED = True
+
+
+def _build_xdr_spec(t, nodes, memo):
+    """Flatten a codec type combinator into the C program's node list;
+    returns the node index. `memo` breaks recursion (SCPQuorumSet nests
+    itself) by reserving an index before children compile."""
+    from ..xdr import codec as C
+
+    key = id(t)
+    if key in memo:
+        return memo[key]
+    idx = len(nodes)
+    memo[key] = idx
+    nodes.append(None)  # reserve
+
+    if isinstance(t, C._Int):
+        size = t._s.size
+        signed = 1 if t._lo < 0 else 0
+        nodes[idx] = (0, size, signed)
+    elif isinstance(t, C._Bool):
+        nodes[idx] = (1, 0, 0)
+    elif isinstance(t, C.Opaque):
+        nodes[idx] = (2, t.n, 0)
+    elif isinstance(t, C.VarOpaque):
+        nodes[idx] = (3, t.maxn, 0)
+    elif isinstance(t, C.XdrString):
+        nodes[idx] = (4, t._o.maxn, 0)
+    elif isinstance(t, C.FixedArray):
+        c = _build_xdr_spec(t.elem, nodes, memo)
+        nodes[idx] = (5, t.n, c)
+    elif isinstance(t, C.VarArray):
+        c = _build_xdr_spec(t.elem, nodes, memo)
+        nodes[idx] = (6, t.maxn, c)
+    elif isinstance(t, C.OptionalT):
+        c = _build_xdr_spec(t.elem, nodes, memo)
+        nodes[idx] = (7, 0, c)
+    elif isinstance(t, C.EnumT):
+        nodes[idx] = (8, 0, 0, tuple(sorted(t.values)))
+    elif isinstance(t, type) and issubclass(t, C.XdrStruct):
+        fields = tuple(
+            (n, _build_xdr_spec(ft, nodes, memo)) for n, ft in t.xdr_fields)
+        nodes[idx] = (9, 0, 0, fields)
+    elif isinstance(t, type) and issubclass(t, C.XdrUnion):
+        sw = _build_xdr_spec(t.xdr_switch_type, nodes, memo)
+        arms = tuple(
+            (d, -1 if at is None else _build_xdr_spec(at, nodes, memo))
+            for d, (an, at) in t.xdr_arms.items())
+        if t.xdr_default is None:
+            default = -2
+        elif t.xdr_default[1] is None:
+            default = -1
+        else:
+            default = _build_xdr_spec(t.xdr_default[1], nodes, memo)
+        nodes[idx] = (10, sw, 0, (arms, default))
+    else:
+        raise TypeError("no native program for %r" % (t,))
+    return idx
+
+
+def xdr_pack_fn(t):
+    """Native pack function for a codec type, or None when the extension
+    is unavailable or the type has a combinator the program can't express
+    (callers fall back to fastcodec)."""
+    _compile_xdr_ext()
+    if _XDR_MOD is None:
+        return None
+    try:
+        nodes = []
+        _build_xdr_spec(t, nodes, {})
+        prog = _XDR_MOD.compile(tuple(nodes))
+    except TypeError:
+        return None
+    pack = _XDR_MOD.pack
+
+    def f(v, prog=prog, pack=pack):
+        return pack(prog, v)
+    return f

@@ -1,0 +1,531 @@
+/* Native XDR serializer: a flat-program interpreter over Python objects.
+ *
+ * Role parity: the reference gets generated C++ marshalling from xdrpp
+ * (xdrc codegen); this module is that serializer for the TPU stack's
+ * runtime — the declarative Python codec (xdr/codec.py) stays the source
+ * of truth, compiles each type ONCE into a flat node program (see
+ * native/__init__.py:_build_xdr_spec), and this extension walks values
+ * against the program in C. Byte output and validation behavior are
+ * bit-identical to xdr/fastcodec.py (property-tested across the whole
+ * wire vocabulary in tests/test_native_xdr.py); fastcodec remains the
+ * fallback when compilation is unavailable.
+ *
+ * Program node ops (built in native/__init__.py):
+ *   0 INT    a=size(4|8)  b=signed(0|1)
+ *   1 BOOL
+ *   2 OPQF   a=n
+ *   3 OPQV   a=max
+ *   4 STR    a=max
+ *   5 ARRF   a=n    b=child
+ *   6 ARRV   a=max  b=child
+ *   7 OPT    b=child
+ *   8 ENUM   aux=sorted tuple of permitted ints
+ *   9 STRUCT aux=tuple of (attr-name str, child) pairs
+ *  10 UNION  a=switch-child  aux=(((disc, child|-1)...), default|-2)
+ */
+#define PY_SSIZE_T_CLEAN
+#include <Python.h>
+#include <stdint.h>
+#include <string.h>
+
+static PyObject *XdrError; /* set at module init from xdr.codec */
+
+/* ---------------------------------------------------------------- buffer */
+
+typedef struct {
+    char *data;
+    Py_ssize_t len, cap;
+} Buf;
+
+static int buf_grow(Buf *b, Py_ssize_t need)
+{
+    Py_ssize_t cap = b->cap ? b->cap : 256;
+    while (cap < b->len + need)
+        cap *= 2;
+    if (cap != b->cap) {
+        char *p = PyMem_Realloc(b->data, cap);
+        if (!p)
+            return -1;
+        b->data = p;
+        b->cap = cap;
+    }
+    return 0;
+}
+
+static int buf_put(Buf *b, const void *src, Py_ssize_t n)
+{
+    if (b->len + n > b->cap && buf_grow(b, n) < 0)
+        return -1;
+    memcpy(b->data + b->len, src, n);
+    b->len += n;
+    return 0;
+}
+
+static int buf_u32(Buf *b, uint32_t v)
+{
+    unsigned char w[4] = {(unsigned char)(v >> 24), (unsigned char)(v >> 16),
+                          (unsigned char)(v >> 8), (unsigned char)v};
+    return buf_put(b, w, 4);
+}
+
+static int buf_u64(Buf *b, uint64_t v)
+{
+    unsigned char w[8];
+    int i;
+    for (i = 0; i < 8; i++)
+        w[i] = (unsigned char)(v >> (56 - 8 * i));
+    return buf_put(b, w, 8);
+}
+
+static const char zeros[4] = {0, 0, 0, 0};
+
+/* --------------------------------------------------------------- program */
+
+typedef struct {
+    int op;
+    long long a;
+    long long b; /* child index for containers */
+    /* ENUM */
+    long long *enum_vals;
+    Py_ssize_t n_enum;
+    /* STRUCT */
+    PyObject **names; /* interned attr names (owned refs) */
+    long long *children;
+    Py_ssize_t n_fields;
+    /* UNION */
+    long long *arm_disc;
+    long long *arm_child; /* -1 = void arm */
+    Py_ssize_t n_arms;
+    long long default_child; /* -1 void default, -2 no default */
+} Node;
+
+typedef struct {
+    Node *nodes;
+    Py_ssize_t n;
+} Prog;
+
+static void prog_free(Prog *p)
+{
+    Py_ssize_t i, j;
+    if (!p)
+        return;
+    for (i = 0; i < p->n; i++) {
+        Node *nd = &p->nodes[i];
+        if (nd->names) {
+            for (j = 0; j < nd->n_fields; j++)
+                Py_XDECREF(nd->names[j]);
+            PyMem_Free(nd->names);
+        }
+        PyMem_Free(nd->children);
+        PyMem_Free(nd->enum_vals);
+        PyMem_Free(nd->arm_disc);
+        PyMem_Free(nd->arm_child);
+    }
+    PyMem_Free(p->nodes);
+    PyMem_Free(p);
+}
+
+static void capsule_destructor(PyObject *cap)
+{
+    prog_free((Prog *)PyCapsule_GetPointer(cap, "sct.xdrprog"));
+}
+
+/* ----------------------------------------------------------------- pack */
+
+static PyObject *str_disc, *str_value; /* interned at module init */
+
+#define SCT_MAX_DEPTH 200 /* real wire types nest < 20 deep; adversarial
+                             * self-nesting must raise, not smash the
+                             * C stack (fastcodec raises RecursionError) */
+
+static int pack_node(const Prog *p, long long idx, PyObject *v, Buf *b,
+                     int depth);
+
+static int pack_int(const Node *nd, PyObject *v, Buf *b)
+{
+    if (nd->a == 4) {
+        long long x = PyLong_AsLongLong(v);
+        if (x == -1 && PyErr_Occurred())
+            goto bad;
+        if (nd->b ? (x < INT32_MIN || x > INT32_MAX) : (x < 0 || x > (long long)UINT32_MAX))
+            goto bad;
+        return buf_u32(b, (uint32_t)x);
+    }
+    if (nd->b) { /* signed 64 */
+        long long x = PyLong_AsLongLong(v);
+        if (x == -1 && PyErr_Occurred())
+            goto bad;
+        return buf_u64(b, (uint64_t)x);
+    } else {
+        unsigned long long x = PyLong_AsUnsignedLongLong(v);
+        if (x == (unsigned long long)-1 && PyErr_Occurred())
+            goto bad;
+        return buf_u64(b, (uint64_t)x);
+    }
+bad:
+    PyErr_Clear();
+    PyErr_Format(XdrError, "int out of range: %R", v);
+    return -1;
+}
+
+static int pack_opaque(const Node *nd, PyObject *v, Buf *b, int fixed)
+{
+    char *data;
+    Py_ssize_t n;
+    if (PyBytes_Check(v)) {
+        data = PyBytes_AS_STRING(v);
+        n = PyBytes_GET_SIZE(v);
+    } else if (PyByteArray_Check(v)) {
+        data = PyByteArray_AS_STRING(v);
+        n = PyByteArray_GET_SIZE(v);
+    } else {
+        PyErr_Format(XdrError, "opaque needs bytes, got %R", v);
+        return -1;
+    }
+    if (fixed) {
+        if (n != nd->a) {
+            PyErr_Format(XdrError, "opaque[%lld] got %zd bytes", nd->a, n);
+            return -1;
+        }
+    } else {
+        if (n > nd->a) {
+            PyErr_Format(XdrError, "opaque<%lld> got %zd bytes", nd->a, n);
+            return -1;
+        }
+        if (buf_u32(b, (uint32_t)n) < 0)
+            return -1;
+    }
+    if (buf_put(b, data, n) < 0)
+        return -1;
+    if (n % 4)
+        return buf_put(b, zeros, 4 - n % 4);
+    return 0;
+}
+
+static int pack_union(const Prog *p, const Node *nd, PyObject *v, Buf *b,
+                      int depth)
+{
+    PyObject *dv, *vv, *dnum;
+    long long disc, child = -3;
+    Py_ssize_t i;
+    int rc;
+    dv = PyObject_GetAttr(v, str_disc);
+    if (!dv)
+        return -1;
+    disc = PyLong_AsLongLong(dv);
+    Py_DECREF(dv);
+    if (disc == -1 && PyErr_Occurred()) {
+        PyErr_Clear();
+        PyErr_SetString(XdrError, "bad discriminant");
+        return -1;
+    }
+    for (i = 0; i < nd->n_arms; i++) {
+        if (nd->arm_disc[i] == disc) {
+            child = nd->arm_child[i];
+            break;
+        }
+    }
+    if (child == -3) {
+        if (nd->default_child == -2) {
+            PyErr_Format(XdrError, "bad discriminant %lld", disc);
+            return -1;
+        }
+        child = nd->default_child;
+    }
+    /* switch encode (validates enum membership when the switch is one) */
+    dnum = PyLong_FromLongLong(disc);
+    if (!dnum)
+        return -1;
+    rc = pack_node(p, nd->a, dnum, b, depth);
+    Py_DECREF(dnum);
+    if (rc < 0)
+        return -1;
+    if (child == -1)
+        return 0; /* void arm */
+    vv = PyObject_GetAttr(v, str_value);
+    if (!vv)
+        return -1;
+    rc = pack_node(p, child, vv, b, depth);
+    Py_DECREF(vv);
+    return rc;
+}
+
+static int pack_node(const Prog *p, long long idx, PyObject *v, Buf *b,
+                     int depth)
+{
+    const Node *nd = &p->nodes[idx];
+    if (++depth > SCT_MAX_DEPTH) {
+        PyErr_SetString(XdrError, "XDR value nested too deeply");
+        return -1;
+    }
+    switch (nd->op) {
+    case 0:
+        return pack_int(nd, v, b);
+    case 1: {
+        int t = PyObject_IsTrue(v);
+        if (t < 0)
+            return -1;
+        return buf_u32(b, t ? 1u : 0u);
+    }
+    case 2:
+        return pack_opaque(nd, v, b, 1);
+    case 3:
+        return pack_opaque(nd, v, b, 0);
+    case 4: { /* string: utf-8, bounded by a */
+        Py_ssize_t n;
+        const char *s = PyUnicode_AsUTF8AndSize(v, &n);
+        if (!s)
+            return -1;
+        if (n > nd->a) {
+            PyErr_Format(XdrError, "opaque<%lld> got %zd bytes", nd->a, n);
+            return -1;
+        }
+        if (buf_u32(b, (uint32_t)n) < 0 || buf_put(b, s, n) < 0)
+            return -1;
+        if (n % 4)
+            return buf_put(b, zeros, 4 - n % 4);
+        return 0;
+    }
+    case 5:   /* fixed array */
+    case 6: { /* var array */
+        PyObject *fast = PySequence_Fast(v, "XDR array needs a sequence");
+        Py_ssize_t n, i;
+        if (!fast)
+            return -1;
+        n = PySequence_Fast_GET_SIZE(fast);
+        if (nd->op == 5 && n != nd->a) {
+            Py_DECREF(fast);
+            PyErr_Format(XdrError, "array[%lld] got %zd", nd->a, n);
+            return -1;
+        }
+        if (nd->op == 6) {
+            if (n > nd->a) {
+                Py_DECREF(fast);
+                PyErr_Format(XdrError, "array<%lld> got %zd", nd->a, n);
+                return -1;
+            }
+            if (buf_u32(b, (uint32_t)n) < 0) {
+                Py_DECREF(fast);
+                return -1;
+            }
+        }
+        for (i = 0; i < n; i++) {
+            if (pack_node(p, nd->b, PySequence_Fast_GET_ITEM(fast, i), b, depth) < 0) {
+                Py_DECREF(fast);
+                return -1;
+            }
+        }
+        Py_DECREF(fast);
+        return 0;
+    }
+    case 7: /* optional */
+        if (v == Py_None)
+            return buf_u32(b, 0u);
+        if (buf_u32(b, 1u) < 0)
+            return -1;
+        return pack_node(p, nd->b, v, b, depth);
+    case 8: { /* enum: membership then int32 */
+        long long x = PyLong_AsLongLong(v);
+        Py_ssize_t i;
+        if (x == -1 && PyErr_Occurred()) {
+            PyErr_Clear();
+            PyErr_Format(XdrError, "bad enum value %R", v);
+            return -1;
+        }
+        for (i = 0; i < nd->n_enum; i++)
+            if (nd->enum_vals[i] == x)
+                return buf_u32(b, (uint32_t)(int32_t)x);
+        PyErr_Format(XdrError, "bad enum value %R", v);
+        return -1;
+    }
+    case 9: { /* struct */
+        Py_ssize_t i;
+        for (i = 0; i < nd->n_fields; i++) {
+            PyObject *fv = PyObject_GetAttr(v, nd->names[i]);
+            int rc;
+            if (!fv)
+                return -1;
+            rc = pack_node(p, nd->children[i], fv, b, depth);
+            Py_DECREF(fv);
+            if (rc < 0)
+                return -1;
+        }
+        return 0;
+    }
+    case 10:
+        return pack_union(p, nd, v, b, depth);
+    default:
+        PyErr_SetString(XdrError, "corrupt XDR program");
+        return -1;
+    }
+}
+
+/* ------------------------------------------------------------ module API */
+
+static PyObject *py_compile(PyObject *self, PyObject *arg)
+{
+    /* arg: tuple of node tuples as documented in the header comment */
+    Py_ssize_t n, i, j;
+    Prog *p;
+    PyObject *cap;
+    if (!PyTuple_Check(arg)) {
+        PyErr_SetString(PyExc_TypeError, "program must be a tuple");
+        return NULL;
+    }
+    n = PyTuple_GET_SIZE(arg);
+    p = PyMem_Calloc(1, sizeof(Prog));
+    if (!p)
+        return PyErr_NoMemory();
+    p->nodes = PyMem_Calloc(n ? n : 1, sizeof(Node));
+    if (!p->nodes) {
+        PyMem_Free(p);
+        return PyErr_NoMemory();
+    }
+    p->n = n;
+    for (i = 0; i < n; i++) {
+        PyObject *t = PyTuple_GET_ITEM(arg, i);
+        Node *nd = &p->nodes[i];
+        long long op;
+        PyObject *aux = NULL;
+        if (!PyTuple_Check(t) || PyTuple_GET_SIZE(t) < 3)
+            goto bad;
+        op = PyLong_AsLongLong(PyTuple_GET_ITEM(t, 0));
+        nd->op = (int)op;
+        nd->a = PyLong_AsLongLong(PyTuple_GET_ITEM(t, 1));
+        nd->b = PyLong_AsLongLong(PyTuple_GET_ITEM(t, 2));
+        nd->default_child = -2;
+        if (PyTuple_GET_SIZE(t) > 3)
+            aux = PyTuple_GET_ITEM(t, 3);
+        if (PyErr_Occurred())
+            goto bad;
+        if (op == 8) { /* enum */
+            if (!aux || !PyTuple_Check(aux))
+                goto bad;
+            nd->n_enum = PyTuple_GET_SIZE(aux);
+            nd->enum_vals = PyMem_Calloc(nd->n_enum ? nd->n_enum : 1,
+                                         sizeof(long long));
+            if (!nd->enum_vals)
+                goto nomem;
+            for (j = 0; j < nd->n_enum; j++) {
+                nd->enum_vals[j] =
+                    PyLong_AsLongLong(PyTuple_GET_ITEM(aux, j));
+                if (PyErr_Occurred())
+                    goto bad;
+            }
+        } else if (op == 9) { /* struct */
+            if (!aux || !PyTuple_Check(aux))
+                goto bad;
+            nd->n_fields = PyTuple_GET_SIZE(aux);
+            nd->names = PyMem_Calloc(nd->n_fields ? nd->n_fields : 1,
+                                     sizeof(PyObject *));
+            nd->children = PyMem_Calloc(nd->n_fields ? nd->n_fields : 1,
+                                        sizeof(long long));
+            if (!nd->names || !nd->children)
+                goto nomem;
+            for (j = 0; j < nd->n_fields; j++) {
+                PyObject *pair = PyTuple_GET_ITEM(aux, j);
+                PyObject *name;
+                if (!PyTuple_Check(pair) || PyTuple_GET_SIZE(pair) != 2)
+                    goto bad;
+                name = PyTuple_GET_ITEM(pair, 0);
+                Py_INCREF(name);
+                PyUnicode_InternInPlace(&name);
+                nd->names[j] = name;
+                nd->children[j] =
+                    PyLong_AsLongLong(PyTuple_GET_ITEM(pair, 1));
+                if (PyErr_Occurred())
+                    goto bad;
+            }
+        } else if (op == 10) { /* union */
+            PyObject *arms, *dflt;
+            if (!aux || !PyTuple_Check(aux) || PyTuple_GET_SIZE(aux) != 2)
+                goto bad;
+            arms = PyTuple_GET_ITEM(aux, 0);
+            dflt = PyTuple_GET_ITEM(aux, 1);
+            if (!PyTuple_Check(arms))
+                goto bad;
+            nd->n_arms = PyTuple_GET_SIZE(arms);
+            nd->arm_disc = PyMem_Calloc(nd->n_arms ? nd->n_arms : 1,
+                                        sizeof(long long));
+            nd->arm_child = PyMem_Calloc(nd->n_arms ? nd->n_arms : 1,
+                                         sizeof(long long));
+            if (!nd->arm_disc || !nd->arm_child)
+                goto nomem;
+            for (j = 0; j < nd->n_arms; j++) {
+                PyObject *pair = PyTuple_GET_ITEM(arms, j);
+                if (!PyTuple_Check(pair) || PyTuple_GET_SIZE(pair) != 2)
+                    goto bad;
+                nd->arm_disc[j] =
+                    PyLong_AsLongLong(PyTuple_GET_ITEM(pair, 0));
+                nd->arm_child[j] =
+                    PyLong_AsLongLong(PyTuple_GET_ITEM(pair, 1));
+                if (PyErr_Occurred())
+                    goto bad;
+            }
+            nd->default_child = PyLong_AsLongLong(dflt);
+            if (PyErr_Occurred())
+                goto bad;
+        }
+    }
+    cap = PyCapsule_New(p, "sct.xdrprog", capsule_destructor);
+    if (!cap) {
+        prog_free(p);
+        return NULL;
+    }
+    return cap;
+bad:
+    if (!PyErr_Occurred())
+        PyErr_SetString(PyExc_ValueError, "malformed XDR program spec");
+    prog_free(p);
+    return NULL;
+nomem:
+    prog_free(p);
+    return PyErr_NoMemory();
+}
+
+static PyObject *py_pack(PyObject *self, PyObject *args)
+{
+    PyObject *cap, *value, *out;
+    Prog *p;
+    Buf b = {NULL, 0, 0};
+    if (!PyArg_ParseTuple(args, "OO", &cap, &value))
+        return NULL;
+    p = PyCapsule_GetPointer(cap, "sct.xdrprog");
+    if (!p)
+        return NULL;
+    if (pack_node(p, 0, value, &b, 0) < 0) {
+        PyMem_Free(b.data);
+        return NULL;
+    }
+    out = PyBytes_FromStringAndSize(b.data, b.len);
+    PyMem_Free(b.data);
+    return out;
+}
+
+static PyMethodDef methods[] = {
+    {"compile", py_compile, METH_O, "compile a flat XDR program spec"},
+    {"pack", py_pack, METH_VARARGS, "serialize a value against a program"},
+    {NULL, NULL, 0, NULL},
+};
+
+static struct PyModuleDef moduledef = {
+    PyModuleDef_HEAD_INIT, "_sctxdr", NULL, -1, methods,
+};
+
+PyMODINIT_FUNC PyInit__sctxdr(void)
+{
+    PyObject *m, *codec;
+    str_disc = PyUnicode_InternFromString("disc");
+    str_value = PyUnicode_InternFromString("value");
+    if (!str_disc || !str_value)
+        return NULL;
+    codec = PyImport_ImportModule("stellar_core_tpu.xdr.codec");
+    if (!codec)
+        return NULL;
+    XdrError = PyObject_GetAttrString(codec, "XdrError");
+    Py_DECREF(codec);
+    if (!XdrError)
+        return NULL;
+    m = PyModule_Create(&moduledef);
+    return m;
+}

@@ -336,9 +336,35 @@ class XdrUnion:
 
 
 _fastcodec = None  # lazy module ref (fastcodec imports this module)
+_native_xdr = None  # lazy: stellar_core_tpu.native.xdr_pack_fn or False
+
+
+def _native_pack_of(t: Any):
+    """Per-type native serializer (C extension), cached on the class;
+    False marks types the native engine can't express."""
+    global _native_xdr
+    if _native_xdr is None:
+        try:
+            from ..native import xdr_pack_fn as _native_xdr
+        except Exception:
+            _native_xdr = False
+    if _native_xdr is False:
+        return None
+    cached = t.__dict__.get("_native_pack") if isinstance(t, type) \
+        else getattr(t, "_native_pack", None)
+    if cached is None:
+        cached = _native_xdr(t) or False
+        try:
+            t._native_pack = cached
+        except (AttributeError, TypeError):
+            return cached or None
+    return cached or None
 
 
 def xdr_bytes(t: Any, v: Any) -> bytes:
+    nf = _native_pack_of(t)
+    if nf is not None:
+        return nf(v)
     global _fastcodec
     if _fastcodec is None:
         from . import fastcodec as _fc
