@@ -222,7 +222,7 @@ def _build_xdr_spec(t, nodes, memo):
     elif isinstance(t, type) and issubclass(t, C.XdrStruct):
         fields = tuple(
             (n, _build_xdr_spec(ft, nodes, memo)) for n, ft in t.xdr_fields)
-        nodes[idx] = (9, 0, 0, fields)
+        nodes[idx] = (9, 0, 0, fields, t)
     elif isinstance(t, type) and issubclass(t, C.XdrUnion):
         sw = _build_xdr_spec(t.xdr_switch_type, nodes, memo)
         arms = tuple(
@@ -234,27 +234,56 @@ def _build_xdr_spec(t, nodes, memo):
             default = -1
         else:
             default = _build_xdr_spec(t.xdr_default[1], nodes, memo)
-        nodes[idx] = (10, sw, 0, (arms, default))
+        nodes[idx] = (10, sw, 0, (arms, default), t)
     else:
         raise TypeError("no native program for %r" % (t,))
     return idx
+
+
+def _xdr_program(t):
+    """Compiled program for a type, memoized on the class (pack and
+    unpack share one program)."""
+    _compile_xdr_ext()
+    if _XDR_MOD is None:
+        return None
+    cached = t.__dict__.get("_native_prog") if isinstance(t, type) \
+        else getattr(t, "_native_prog", None)
+    if cached is not None:
+        return cached or None
+    try:
+        nodes = []
+        _build_xdr_spec(t, nodes, {})
+        prog = _XDR_MOD.compile(tuple(nodes))
+    except TypeError:
+        prog = None
+    try:
+        t._native_prog = prog if prog is not None else False
+    except (AttributeError, TypeError):
+        pass
+    return prog
 
 
 def xdr_pack_fn(t):
     """Native pack function for a codec type, or None when the extension
     is unavailable or the type has a combinator the program can't express
     (callers fall back to fastcodec)."""
-    _compile_xdr_ext()
-    if _XDR_MOD is None:
-        return None
-    try:
-        nodes = []
-        _build_xdr_spec(t, nodes, {})
-        prog = _XDR_MOD.compile(tuple(nodes))
-    except TypeError:
+    prog = _xdr_program(t)
+    if prog is None:
         return None
     pack = _XDR_MOD.pack
 
     def f(v, prog=prog, pack=pack):
         return pack(prog, v)
+    return f
+
+
+def xdr_unpack_fn(t):
+    """Native unpack: f(buf, pos=0) -> (value, end), or None (fallback)."""
+    prog = _xdr_program(t)
+    if prog is None:
+        return None
+    unpack = _XDR_MOD.unpack
+
+    def f(buf, pos=0, prog=prog, unpack=unpack):
+        return unpack(prog, buf, pos)
     return f

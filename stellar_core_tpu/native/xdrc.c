@@ -97,6 +97,7 @@ typedef struct {
     long long *arm_child; /* -1 = void arm */
     Py_ssize_t n_arms;
     long long default_child; /* -1 void default, -2 no default */
+    PyObject *cls; /* STRUCT/UNION: class to instantiate on unpack */
 } Node;
 
 typedef struct {
@@ -120,6 +121,7 @@ static void prog_free(Prog *p)
         PyMem_Free(nd->enum_vals);
         PyMem_Free(nd->arm_disc);
         PyMem_Free(nd->arm_child);
+        Py_XDECREF(nd->cls);
     }
     PyMem_Free(p->nodes);
     PyMem_Free(p);
@@ -360,6 +362,302 @@ static int pack_node(const Prog *p, long long idx, PyObject *v, Buf *b,
     }
 }
 
+
+/* ---------------------------------------------------------------- unpack */
+
+typedef struct {
+    const unsigned char *data;
+    Py_ssize_t len, pos;
+} Rdr;
+
+static int rd_need(Rdr *r, Py_ssize_t n)
+{
+    if (r->pos + n > r->len) {
+        PyErr_Format(XdrError, "XDR underflow at %zd", r->pos);
+        return -1;
+    }
+    return 0;
+}
+
+static int rd_u32(Rdr *r, uint32_t *out)
+{
+    const unsigned char *p;
+    if (rd_need(r, 4) < 0)
+        return -1;
+    p = r->data + r->pos;
+    *out = ((uint32_t)p[0] << 24) | ((uint32_t)p[1] << 16) |
+           ((uint32_t)p[2] << 8) | (uint32_t)p[3];
+    r->pos += 4;
+    return 0;
+}
+
+static int rd_pad(Rdr *r, Py_ssize_t n)
+{
+    Py_ssize_t padn = (4 - n % 4) % 4, i;
+    if (rd_need(r, padn) < 0)
+        return -1;
+    for (i = 0; i < padn; i++) {
+        if (r->data[r->pos + i] != 0) {
+            PyErr_SetString(XdrError, "nonzero padding");
+            return -1;
+        }
+    }
+    r->pos += padn;
+    return 0;
+}
+
+static PyObject *unpack_node(const Prog *p, long long idx, Rdr *r,
+                             int depth);
+
+static PyObject *unpack_union(const Prog *p, const Node *nd, Rdr *r,
+                              int depth)
+{
+    PyObject *dnum, *obj, *val;
+    long long disc, child = -3;
+    Py_ssize_t i;
+    dnum = unpack_node(p, nd->a, r, depth); /* validates enum switches */
+    if (!dnum)
+        return NULL;
+    disc = PyLong_AsLongLong(dnum);
+    if (disc == -1 && PyErr_Occurred()) {
+        Py_DECREF(dnum);
+        return NULL;
+    }
+    for (i = 0; i < nd->n_arms; i++) {
+        if (nd->arm_disc[i] == disc) {
+            child = nd->arm_child[i];
+            break;
+        }
+    }
+    if (child == -3) {
+        if (nd->default_child == -2) {
+            Py_DECREF(dnum);
+            PyErr_Format(XdrError, "bad discriminant %lld", disc);
+            return NULL;
+        }
+        child = nd->default_child;
+    }
+    if (child == -1) {
+        val = Py_None;
+        Py_INCREF(val);
+    } else {
+        val = unpack_node(p, child, r, depth);
+        if (!val) {
+            Py_DECREF(dnum);
+            return NULL;
+        }
+    }
+    obj = ((PyTypeObject *)nd->cls)->tp_alloc((PyTypeObject *)nd->cls, 0);
+    if (!obj) {
+        Py_DECREF(dnum);
+        Py_DECREF(val);
+        return NULL;
+    }
+    if (PyObject_SetAttr(obj, str_disc, dnum) < 0 ||
+        PyObject_SetAttr(obj, str_value, val) < 0) {
+        Py_DECREF(dnum);
+        Py_DECREF(val);
+        Py_DECREF(obj);
+        return NULL;
+    }
+    Py_DECREF(dnum);
+    Py_DECREF(val);
+    return obj;
+}
+
+static PyObject *unpack_node(const Prog *p, long long idx, Rdr *r,
+                             int depth)
+{
+    const Node *nd = &p->nodes[idx];
+    if (++depth > SCT_MAX_DEPTH) {
+        PyErr_SetString(XdrError, "XDR value nested too deeply");
+        return NULL;
+    }
+    switch (nd->op) {
+    case 0: { /* int */
+        if (nd->a == 4) {
+            uint32_t w;
+            if (rd_u32(r, &w) < 0)
+                return NULL;
+            if (nd->b)
+                return PyLong_FromLong((long)(int32_t)w);
+            return PyLong_FromUnsignedLong(w);
+        } else {
+            uint64_t v = 0;
+            int i;
+            if (rd_need(r, 8) < 0)
+                return NULL;
+            for (i = 0; i < 8; i++)
+                v = (v << 8) | r->data[r->pos + i];
+            r->pos += 8;
+            if (nd->b)
+                return PyLong_FromLongLong((long long)v);
+            return PyLong_FromUnsignedLongLong(v);
+        }
+    }
+    case 1: { /* bool */
+        uint32_t w;
+        if (rd_u32(r, &w) < 0)
+            return NULL;
+        if (w == 0)
+            Py_RETURN_FALSE;
+        if (w == 1)
+            Py_RETURN_TRUE;
+        PyErr_SetString(XdrError, "bad bool");
+        return NULL;
+    }
+    case 2: { /* fixed opaque */
+        PyObject *out;
+        if (rd_need(r, nd->a) < 0)
+            return NULL;
+        out = PyBytes_FromStringAndSize((const char *)r->data + r->pos,
+                                        nd->a);
+        if (!out)
+            return NULL;
+        r->pos += nd->a;
+        if (rd_pad(r, nd->a) < 0) {
+            Py_DECREF(out);
+            return NULL;
+        }
+        return out;
+    }
+    case 3:   /* var opaque */
+    case 4: { /* string */
+        uint32_t n;
+        PyObject *out;
+        if (rd_u32(r, &n) < 0)
+            return NULL;
+        if ((long long)n > nd->a) {
+            PyErr_Format(XdrError, nd->op == 3 ?
+                         "opaque<%lld> wire len %u" : "string<%lld> wire len %u",
+                         nd->a, n);
+            return NULL;
+        }
+        if (rd_need(r, n) < 0)
+            return NULL;
+        if (nd->op == 3)
+            out = PyBytes_FromStringAndSize(
+                (const char *)r->data + r->pos, n);
+        else
+            out = PyUnicode_DecodeUTF8(
+                (const char *)r->data + r->pos, n, NULL);
+        if (!out)
+            return NULL;
+        r->pos += n;
+        if (rd_pad(r, n) < 0) {
+            Py_DECREF(out);
+            return NULL;
+        }
+        return out;
+    }
+    case 5:   /* fixed array */
+    case 6: { /* var array */
+        long long n = nd->a;
+        PyObject *out;
+        long long i;
+        if (nd->op == 6) {
+            uint32_t w;
+            if (rd_u32(r, &w) < 0)
+                return NULL;
+            if ((long long)w > nd->a) {
+                PyErr_Format(XdrError, "array<%lld> wire len %u", nd->a, w);
+                return NULL;
+            }
+            n = w;
+        }
+        out = PyList_New(n);
+        if (!out)
+            return NULL;
+        for (i = 0; i < n; i++) {
+            PyObject *e = unpack_node(p, nd->b, r, depth);
+            if (!e) {
+                Py_DECREF(out);
+                return NULL;
+            }
+            PyList_SET_ITEM(out, i, e);
+        }
+        return out;
+    }
+    case 7: { /* optional */
+        uint32_t w;
+        if (rd_u32(r, &w) < 0)
+            return NULL;
+        if (w == 0)
+            Py_RETURN_NONE;
+        if (w != 1) {
+            PyErr_SetString(XdrError, "bad optional flag");
+            return NULL;
+        }
+        return unpack_node(p, nd->b, r, depth);
+    }
+    case 8: { /* enum */
+        uint32_t w;
+        long long x;
+        Py_ssize_t i;
+        if (rd_u32(r, &w) < 0)
+            return NULL;
+        x = (long long)(int32_t)w;
+        for (i = 0; i < nd->n_enum; i++)
+            if (nd->enum_vals[i] == x)
+                return PyLong_FromLongLong(x);
+        PyErr_Format(XdrError, "bad enum value %lld", x);
+        return NULL;
+    }
+    case 9: { /* struct */
+        PyObject *obj =
+            ((PyTypeObject *)nd->cls)->tp_alloc((PyTypeObject *)nd->cls, 0);
+        Py_ssize_t i;
+        if (!obj)
+            return NULL;
+        for (i = 0; i < nd->n_fields; i++) {
+            PyObject *fv = unpack_node(p, nd->children[i], r, depth);
+            if (!fv || PyObject_SetAttr(obj, nd->names[i], fv) < 0) {
+                Py_XDECREF(fv);
+                Py_DECREF(obj);
+                return NULL;
+            }
+            Py_DECREF(fv);
+        }
+        return obj;
+    }
+    case 10:
+        return unpack_union(p, nd, r, depth);
+    default:
+        PyErr_SetString(XdrError, "corrupt XDR program");
+        return NULL;
+    }
+}
+
+static PyObject *py_unpack(PyObject *self, PyObject *args)
+{
+    PyObject *cap, *val, *out;
+    Py_buffer view;
+    Prog *p;
+    Rdr r;
+    Py_ssize_t start = 0;
+    if (!PyArg_ParseTuple(args, "Oy*|n", &cap, &view, &start))
+        return NULL;
+    p = PyCapsule_GetPointer(cap, "sct.xdrprog");
+    if (!p) {
+        PyBuffer_Release(&view);
+        return NULL;
+    }
+    if (start < 0 || start > view.len) {
+        PyBuffer_Release(&view);
+        PyErr_Format(XdrError, "bad start offset %zd", start);
+        return NULL;
+    }
+    r.data = view.buf;
+    r.len = view.len;
+    r.pos = start;
+    val = unpack_node(p, 0, &r, 0);
+    PyBuffer_Release(&view);
+    if (!val)
+        return NULL;
+    out = Py_BuildValue("(Nn)", val, r.pos);
+    return out;
+}
+
 /* ------------------------------------------------------------ module API */
 
 static PyObject *py_compile(PyObject *self, PyObject *arg)
@@ -413,6 +711,11 @@ static PyObject *py_compile(PyObject *self, PyObject *arg)
                     goto bad;
             }
         } else if (op == 9) { /* struct */
+            if (PyTuple_GET_SIZE(t) < 5 ||
+                !PyType_Check(PyTuple_GET_ITEM(t, 4)))
+                goto bad;
+            nd->cls = PyTuple_GET_ITEM(t, 4);
+            Py_INCREF(nd->cls);
             if (!aux || !PyTuple_Check(aux))
                 goto bad;
             nd->n_fields = PyTuple_GET_SIZE(aux);
@@ -438,6 +741,11 @@ static PyObject *py_compile(PyObject *self, PyObject *arg)
             }
         } else if (op == 10) { /* union */
             PyObject *arms, *dflt;
+            if (PyTuple_GET_SIZE(t) < 5 ||
+                !PyType_Check(PyTuple_GET_ITEM(t, 4)))
+                goto bad;
+            nd->cls = PyTuple_GET_ITEM(t, 4);
+            Py_INCREF(nd->cls);
             if (!aux || !PyTuple_Check(aux) || PyTuple_GET_SIZE(aux) != 2)
                 goto bad;
             arms = PyTuple_GET_ITEM(aux, 0);
@@ -505,6 +813,8 @@ static PyObject *py_pack(PyObject *self, PyObject *args)
 static PyMethodDef methods[] = {
     {"compile", py_compile, METH_O, "compile a flat XDR program spec"},
     {"pack", py_pack, METH_VARARGS, "serialize a value against a program"},
+    {"unpack", py_unpack, METH_VARARGS,
+     "parse (program, buffer[, start]) -> (value, end)"},
     {NULL, NULL, 0, NULL},
 };
 

@@ -336,29 +336,35 @@ class XdrUnion:
 
 
 _fastcodec = None  # lazy module ref (fastcodec imports this module)
-_native_xdr = None  # lazy: stellar_core_tpu.native.xdr_pack_fn or False
+_native_xdr = None  # lazy: the stellar_core_tpu.native MODULE, or False
 
 
-def _native_pack_of(t: Any):
-    """Per-type native serializer (C extension), cached on the class;
-    False marks types the native engine can't express."""
+def _native_of(t: Any, attr: str):
+    """Per-type native (de)serializer (C extension), cached on the class;
+    False marks types the engine can't express."""
     global _native_xdr
     if _native_xdr is None:
         try:
-            from ..native import xdr_pack_fn as _native_xdr
+            from .. import native as _native_xdr
         except Exception:
             _native_xdr = False
     if _native_xdr is False:
         return None
-    cached = t.__dict__.get("_native_pack") if isinstance(t, type) \
-        else getattr(t, "_native_pack", None)
+    slot = "_native_" + attr
+    cached = t.__dict__.get(slot) if isinstance(t, type) \
+        else getattr(t, slot, None)
     if cached is None:
-        cached = _native_xdr(t) or False
+        maker = getattr(_native_xdr, "xdr_%s_fn" % attr)
+        cached = maker(t) or False
         try:
-            t._native_pack = cached
+            setattr(t, slot, cached)
         except (AttributeError, TypeError):
             return cached or None
     return cached or None
+
+
+def _native_pack_of(t: Any):
+    return _native_of(t, "pack")
 
 
 def xdr_bytes(t: Any, v: Any) -> bytes:
@@ -375,11 +381,15 @@ def xdr_bytes(t: Any, v: Any) -> bytes:
 
 
 def xdr_from(t: Any, b: bytes) -> Any:
-    global _fastcodec
-    if _fastcodec is None:
-        from . import fastcodec as _fc
-        _fastcodec = _fc
-    v, pos = _fastcodec.compile_unpack(t)(b, 0)
+    nf = _native_of(t, "unpack")
+    if nf is not None:
+        v, pos = nf(b)
+    else:
+        global _fastcodec
+        if _fastcodec is None:
+            from . import fastcodec as _fc
+            _fastcodec = _fc
+        v, pos = _fastcodec.compile_unpack(t)(b, 0)
     if pos != len(b):
         raise XdrError("XDR trailing bytes: %d left" % (len(b) - pos))
     return v

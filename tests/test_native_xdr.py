@@ -131,3 +131,48 @@ def test_native_depth_limit_raises_not_crashes():
     nf = native_fn(X.SCPQuorumSet)
     with pytest.raises(C.XdrError):
         nf(q)
+
+
+def test_native_unpack_matches_fastcodec():
+    from stellar_core_tpu.native import xdr_unpack_fn
+    for t, v in _sample_values():
+        nf = xdr_unpack_fn(t)
+        if nf is None:
+            pytest.skip("native XDR engine unavailable")
+        wire = fast_bytes(t, v)
+        got, end = nf(wire)
+        assert end == len(wire)
+        ref, end2 = fastcodec.compile_unpack(t)(wire, 0)
+        assert end2 == end
+        assert got == ref == v, t
+
+
+def test_native_unpack_rejections():
+    from stellar_core_tpu.native import xdr_unpack_fn
+    nf = xdr_unpack_fn(X.AccountEntry)
+    if nf is None:
+        pytest.skip("native XDR engine unavailable")
+    t, v = _sample_values()[0]
+    wire = fast_bytes(t, v)
+    for bad in (wire[:-3], b""):                   # underflow
+        with pytest.raises(C.XdrError):
+            nf(bad)
+    with pytest.raises(C.XdrError):                # bad start offsets
+        nf(wire, -40)
+    with pytest.raises(C.XdrError):
+        nf(wire, len(wire) + 4)
+    # struct of two uint64s: truncated → underflow
+    tb = xdr_unpack_fn(X.TimeBounds)
+    with pytest.raises(C.XdrError):
+        tb(b"\x00" * 7)
+    # bad enum value: LedgerKey disc 999 is no arm
+    lk = xdr_unpack_fn(X.LedgerKey)
+    with pytest.raises(C.XdrError):
+        lk(b"\x00\x00\x03\xe7" + b"\x00" * 36)
+    # bad optional flag: AccountEntry.inflationDest flag must be 0/1 —
+    # corrupt it in a real wire image (flag sits right after the first
+    # 32+4+8+8+4 bytes of AccountEntry)
+    off = 4 + 32 + 8 + 8 + 4
+    bad_opt = wire[:off] + b"\x00\x00\x00\x02" + wire[off + 4:]
+    with pytest.raises(C.XdrError):
+        nf(bad_opt)
