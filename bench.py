@@ -331,6 +331,16 @@ def main() -> None:
             p.kill()
     if res is None and cpu_res is None and "cpu_jax" not in errors:
         errors["cpu_jax"] = "killed at deadline"
+    cache_path = os.path.join(_REPO, ".bench_device_cache.json")
+    if res is not None and res.get("platform") in ("tpu", "axon"):
+        # record the real-device measurement: if a later run can't reach
+        # the (single-tenant, tunnel-backed) device, the result is still
+        # reported — clearly labeled as cached, with its timestamp
+        try:
+            with open(cache_path, "w") as fh:
+                json.dump({"at_unix": int(t_start), **res}, fh)
+        except OSError:
+            pass
     if res is None and cpu_res is not None:
         # No device: report the framework's best CPU-mode rate — the
         # synchronous OpenSSL backend is the default CPU path and usually
@@ -340,6 +350,12 @@ def main() -> None:
             cpu_res = {"rate": rate, "platform": "openssl-cpu-backend",
                        "batch": 4000, "init_s": 0.0, "compile_s": 0.0}
         res = cpu_res
+    if res is None or res.get("platform") not in ("tpu", "axon"):
+        try:
+            with open(cache_path) as fh:
+                errors["last_real_device_result"] = json.load(fh)
+        except (OSError, ValueError):
+            pass
 
     out = {
         "metric": "ed25519_verifies_per_sec_per_chip",
