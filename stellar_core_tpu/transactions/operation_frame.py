@@ -77,12 +77,25 @@ class OperationFrame:
     def check_valid(self, ltx) -> bool:
         """Ledger-independent checks (amounts, codes). `ltx` gives header
         access for version gating only."""
-        return self.do_check_valid(ltx.get_header())
+        header = ltx.get_header()
+        if not self.is_version_supported(header.ledgerVersion):
+            # reference OperationFrame::checkValid → opNOT_SUPPORTED
+            return self.set_code(OperationResultCode.opNOT_SUPPORTED)
+        return self.do_check_valid(header)
 
     def apply(self, ltx) -> bool:
+        # version gate holds at apply too: replayed history can reach
+        # apply without this process having run checkValid
+        if not self.is_version_supported(ltx.get_header().ledgerVersion):
+            return self.set_code(OperationResultCode.opNOT_SUPPORTED)
         return self.do_apply(ltx)
 
     # subclass hooks
+    def is_version_supported(self, ledger_version: int) -> bool:
+        """Ops retired by protocol upgrades override this (reference
+        OperationFrame::isVersionSupported)."""
+        return True
+
     def do_check_valid(self, header) -> bool:
         raise NotImplementedError
 

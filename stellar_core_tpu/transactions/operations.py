@@ -435,27 +435,31 @@ class InflationOpFrame(OperationFrame):
     INFLATION_FREQUENCY = 7 * 24 * 60 * 60  # weekly
     INFLATION_RATE_TRILLIONTHS = 190721000
     INFLATION_WIN_MIN_PERCENT = 500000000  # 0.05% in trillionths
+    INFLATION_NUM_WINNERS = 2000
 
     def threshold_level(self) -> int:
         return ThresholdLevel.LOW
+
+    def is_version_supported(self, ledger_version: int) -> bool:
+        # inflation retired by protocol 12 (reference
+        # InflationOpFrame::isVersionSupported: version < 12 →
+        # opNOT_SUPPORTED afterwards, NOT a success-noop)
+        return ledger_version < 12
 
     def do_check_valid(self, header) -> bool:
         return self.set_inner(InflationResultCode.SUCCESS, [])
 
     def do_apply(self, ltx) -> bool:
-        from ..xdr import InflationPayout
+        from ..xdr import AccountID, InflationPayout
+        from .account_helpers import max_amount_receive
         header = ltx.load_header()
         close_time = header.scpValue.closeTime
         seq = header.inflationSeq
         next_time = (seq + 1) * self.INFLATION_FREQUENCY
         if close_time < next_time:
             return self.set_inner(InflationResultCode.NOT_TIME)
-        if header.ledgerVersion >= 12:
-            # inflation disabled by protocol 12 (CAP-0026): bump the seq,
-            # pay nothing
-            header.inflationSeq += 1
-            return self.set_inner(InflationResultCode.SUCCESS, [])
-        # classic mechanism: tally inflationDest votes weighted by balance
+        # classic mechanism (reference InflationOpFrame::doApply): tally
+        # inflationDest votes weighted by balance; winners over 0.05%
         votes: dict[bytes, int] = {}
         total = header.totalCoins
         for e in self._all_accounts(ltx):
@@ -464,28 +468,50 @@ class InflationOpFrame(OperationFrame):
                 k = acc.inflationDest.to_xdr()
                 votes[k] = votes.get(k, 0) + acc.balance
         min_votes = total * self.INFLATION_WIN_MIN_PERCENT // 10**12
-        winners = [(k, v) for k, v in votes.items() if v >= min_votes]
-        amount = total * self.INFLATION_RATE_TRILLIONTHS // 10**12
-        amount += header.feePool
-        payouts = []
-        if winners:
-            total_win = sum(v for _, v in winners)
-            delta_coins = 0
-            for k, v in sorted(winners):
-                share = amount * v // total_win
-                from ..xdr import PublicKey as _PK, AccountID
-                dest_id = AccountID.from_xdr(k)
-                dest = load_account(ltx, dest_id)
-                if dest is None:
-                    continue
-                if add_balance(header, dest, share):
-                    payouts.append(InflationPayout(destination=dest_id,
-                                                   amount=share))
-                    delta_coins += share
-            header.feePool = 0
-            header.totalCoins += delta_coins - min(amount, delta_coins)
-            header.totalCoins = header.totalCoins  # fee pool folded in
+        # reference winner order: votes descending, strkey descending on
+        # ties (LedgerTxn.cpp queryInflationWinners sort), capped at
+        # INFLATION_NUM_WINNERS
+        from ..crypto import strkey as _sk
+        winners = sorted(
+            ((k, v) for k, v in votes.items() if v >= min_votes),
+            key=lambda kv: (-kv[1], tuple(
+                -c for c in _sk.encode_public_key(
+                    AccountID.from_xdr(kv[0]).key_bytes).encode())))
+        winners = winners[:self.INFLATION_NUM_WINNERS]
+        inflation_amount = total * self.INFLATION_RATE_TRILLIONTHS // 10**12
+        amount_to_dole = inflation_amount + header.feePool
+        header.feePool = 0
         header.inflationSeq += 1
+        left = amount_to_dole
+        payouts = []
+        for k, v in winners:
+            # each winner's share is its fraction of ALL coins, not of
+            # the winning votes (reference bigDivide(amountToDole,
+            # w.votes, totalVotes) with totalVotes = lh.totalCoins) —
+            # the unclaimed remainder stays in the fee pool
+            share = amount_to_dole * v // total
+            if share == 0:
+                continue
+            dest_id = AccountID.from_xdr(k)
+            dest = load_account(ltx, dest_id)
+            if dest is None:
+                continue  # missing winner: nothing doled (v>=10 rule)
+            share = min(share, max_amount_receive(header, dest))
+            if share == 0:
+                continue
+            if not add_balance(header, dest, share):
+                raise RuntimeError("inflation overflowed winner balance")
+            left -= share
+            if header.ledgerVersion <= 7:
+                header.totalCoins += share
+            payouts.append(InflationPayout(destination=dest_id,
+                                           amount=share))
+        # unclaimed funds return to the fee pool; from protocol 8 the
+        # minted coins enter circulation regardless of how much was
+        # claimed (reference InflationOpFrame.cpp:110-114)
+        header.feePool += left
+        if header.ledgerVersion > 7:
+            header.totalCoins += inflation_amount
         return self.set_inner(InflationResultCode.SUCCESS, payouts)
 
     def _all_accounts(self, ltx):

@@ -1,0 +1,204 @@
+"""Crash/restart continuity: a node stopped mid-run resumes from its
+persistent state (LCL, SCP state, bucket list) and keeps closing ledgers
+on the same hash chain (reference ApplicationImpl::start →
+loadLastKnownLedger + Herder::restoreState)."""
+
+import sqlite3
+
+from stellar_core_tpu.crypto import strkey
+from stellar_core_tpu.crypto.hashing import sha256
+from stellar_core_tpu.crypto.keys import SecretKey
+from stellar_core_tpu.main.application import Application
+from stellar_core_tpu.main.config import Config
+from stellar_core_tpu.testing import AppLedgerAdapter
+from stellar_core_tpu.util.timer import ClockMode, VirtualClock
+
+
+def _cfg(tmp_path):
+    cfg = Config.test_config(0)
+    cfg.NODE_SEED = SecretKey.from_seed(sha256(b"restart-node"))
+    cfg.DATABASE = "sqlite3://%s" % (tmp_path / "node.db")
+    cfg.BUCKET_DIR_PATH = str(tmp_path / "buckets")
+    cfg.QUORUM_SET = cfg.self_qset()
+    return cfg
+
+
+def _mk(tmp_path):
+    app = Application(VirtualClock(ClockMode.VIRTUAL_TIME), _cfg(tmp_path))
+    app.enable_buckets()
+    app.start()
+    return app
+
+
+def test_restart_resumes_chain_and_state(tmp_path):
+    app = _mk(tmp_path)
+    ad = AppLedgerAdapter(app)
+    root = ad.root_account()
+    alice = root.create(10**10)
+    app.clock.set_virtual_time(app.clock.now() + 5)
+    for _ in range(6):
+        app.submit_transaction(
+            alice.tx([alice.op_payment(root.account_id, 777)]))
+        app.clock.set_virtual_time(app.clock.now() + 1.0)
+        app.manual_close()
+    lcl = app.ledger_manager.last_closed_ledger_num()
+    lcl_hash = app.ledger_manager.lcl_hash
+    bal = ad.balance(alice.account_id)
+    bl_hash = app.bucket_manager.get_hash()
+    app.stop()
+    del app
+
+    # "crash" over; a fresh process image over the same files
+    app2 = _mk(tmp_path)
+    lm = app2.ledger_manager
+    assert lm.last_closed_ledger_num() == lcl
+    assert lm.lcl_hash == lcl_hash
+    assert app2.bucket_manager.get_hash() == bl_hash
+    ad2 = AppLedgerAdapter(app2)
+    assert ad2.balance(alice.account_id) == bal
+
+    # and the chain continues: new closes link to the restored LCL
+    alice2 = ad2.root_account().create(10**9)
+    app2.clock.set_virtual_time(app2.clock.now() + lcl + 10)
+    for _ in range(3):
+        app2.submit_transaction(
+            alice2.tx([alice2.op_payment(alice.account_id, 1)]))
+        app2.clock.set_virtual_time(app2.clock.now() + 1.0)
+        app2.manual_close()
+    assert lm.last_closed_ledger_num() == lcl + 4  # +1 create, +3 closes
+
+    # hash chain intact across the restart boundary
+    db = sqlite3.connect(str(tmp_path / "node.db"))
+    rows = db.execute(
+        "SELECT ledgerseq, ledgerhash, prevhash FROM ledgerheaders "
+        "ORDER BY ledgerseq").fetchall()
+    db.close()
+    by_seq = {r[0]: r for r in rows}
+    for seq in range(2, lm.last_closed_ledger_num() + 1):
+        assert by_seq[seq][2] == by_seq[seq - 1][1], \
+            "chain broken at %d" % seq
+
+
+def test_restart_preserves_scp_state_rows(tmp_path):
+    app = _mk(tmp_path)
+    ad = AppLedgerAdapter(app)
+    root = ad.root_account()
+    a = root.create(10**9)
+    app.clock.set_virtual_time(app.clock.now() + 5)
+    app.submit_transaction(a.tx([a.op_payment(root.account_id, 1)]))
+    app.manual_close()
+    slot = app.herder.current_slot() - 1
+    app.stop()
+
+    app2 = _mk(tmp_path)
+    # persisted SCP envelopes for the last externalized slot survive and
+    # feed history publication after restart
+    rows = app2.database.execute(
+        "SELECT COUNT(*) FROM scphistory WHERE ledgerseq = ?",
+        (slot,)).fetchone()
+    assert rows[0] >= 1
+    assert app2.herder.current_slot() == slot + 1
+
+
+# ------------------------------------------------- inflation op vectors
+# (reference InflationTests.cpp: timing gate, vote threshold, payouts,
+# totalCoins/feePool conservation, protocol-12 no-op)
+
+from stellar_core_tpu.testing import TestAccount, TestLedger, \
+    root_secret_key  # noqa: E402
+from stellar_core_tpu.transactions.operations import (  # noqa: E402
+    InflationOpFrame, InflationResultCode,
+)
+from stellar_core_tpu.xdr import OperationBody, OperationType  # noqa: E402
+
+
+def _inflation_net(version=11):
+    led = TestLedger()
+    led.header().ledgerVersion = version
+    root = TestAccount(led, root_secret_key())
+    return led, root
+
+
+def _run_inflation(led, acct):
+    op = acct.op(OperationBody(OperationType.INFLATION, None))
+    f = acct.tx([op])
+    ok = led.apply_frame(f)
+    return ok, f
+
+
+def test_inflation_not_time(monkeypatch=None):
+    led, root = _inflation_net()
+    # closeTime 0 < first weekly boundary
+    ok, f = _run_inflation(led, root)
+    assert not ok
+    assert f.result.op_results[0].value.value.disc == \
+        InflationResultCode.NOT_TIME
+
+
+def test_inflation_pays_winners_and_conserves_coins():
+    led, root = _inflation_net(version=11)
+    h = led.header()
+    h.scpValue.closeTime = InflationOpFrame.INFLATION_FREQUENCY + 1
+    a = root.create(10**15)        # large voter
+    b = root.create(10**9)
+    dest = root.create(10**9)
+    # a votes for dest with a balance over the 0.05% threshold
+    assert led.apply_frame(a.tx([a.op_set_options(
+        inflation_dest=dest.account_id)]))
+    total_before = led.header().totalCoins
+    fee_pool_before = led.header().feePool
+    dest_before = led.balance(dest.account_id)
+    ok, f = _run_inflation(led, b)
+    assert ok, f.result
+    payouts = f.result.op_results[0].value.value.value
+    assert len(payouts) == 1
+    assert payouts[0].destination == dest.account_id
+    paid = led.balance(dest.account_id) - dest_before
+    assert paid == payouts[0].amount
+    # reference accounting: totalCoins grows by exactly the minted
+    # inflation amount; unclaimed funds return to the fee pool
+    minted = led.header().totalCoins - total_before
+    expect_minted = total_before * \
+        InflationOpFrame.INFLATION_RATE_TRILLIONTHS // 10**12
+    assert minted == expect_minted
+    # b paid a 100-stroop tx fee into the pool after the sweep
+    assert led.header().feePool == \
+        (expect_minted + fee_pool_before - paid) + 100
+    assert led.header().inflationSeq == 1
+
+
+def test_inflation_no_winner_mints_into_fee_pool():
+    led, root = _inflation_net(version=11)
+    led.header().scpValue.closeTime = \
+        InflationOpFrame.INFLATION_FREQUENCY + 1
+    tiny = root.create(10**8)      # far below 0.05% of totalCoins
+    dest = root.create(10**9)
+    assert led.apply_frame(tiny.tx([tiny.op_set_options(
+        inflation_dest=dest.account_id)]))
+    before = led.balance(dest.account_id)
+    total_before = led.header().totalCoins
+    ok, f = _run_inflation(led, root)
+    assert ok
+    assert f.result.op_results[0].value.value.value == []
+    assert led.balance(dest.account_id) == before
+    # no winner: the minted coins land in the fee pool, not nowhere
+    minted = led.header().totalCoins - total_before
+    assert minted == total_before * \
+        InflationOpFrame.INFLATION_RATE_TRILLIONTHS // 10**12
+    assert led.header().feePool >= minted
+    assert led.header().inflationSeq == 1
+
+
+def test_inflation_not_supported_from_protocol_12():
+    from stellar_core_tpu.xdr import OperationResultCode
+    led, root = _inflation_net(version=12)
+    led.header().scpValue.closeTime = \
+        InflationOpFrame.INFLATION_FREQUENCY + 1
+    total_before = led.header().totalCoins
+    ok, f = _run_inflation(led, root)
+    # reference retires the op at protocol 12: opNOT_SUPPORTED, tx fails
+    assert not ok
+    assert f.result.op_results[0].disc == \
+        OperationResultCode.opNOT_SUPPORTED
+    assert led.header().totalCoins == total_before
+    assert led.header().inflationSeq == 0
