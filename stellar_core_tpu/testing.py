@@ -238,6 +238,14 @@ class TestAccount:
         return self.op_set_options(
             signer=Signer(key=SignerKey.ed25519(key_bytes32), weight=weight))
 
+    def op_allow_trust(self, trustor: PublicKey, code: bytes = b"USD\x00",
+                       authorize: int = 1) -> Operation:
+        from .xdr import AllowTrustAsset, AllowTrustOp
+        return self.op(OperationBody(
+            OperationType.ALLOW_TRUST,
+            AllowTrustOp(trustor=trustor, asset=AllowTrustAsset(1, code),
+                         authorize=authorize)))
+
     def op_manage_data(self, name: str,
                        value: Optional[bytes]) -> Operation:
         from .xdr import ManageDataOp

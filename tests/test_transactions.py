@@ -467,3 +467,64 @@ def test_multisig_payment_meets_med_threshold(ledger, root):
     # all three signatures clear the threshold
     f_ok = a.tx([a.op_payment(b.account_id, 100)], extra_signers=[k1, k2])
     assert ledger.apply_frame(f_ok), f_ok.result
+
+
+def test_allow_trust_result_codes(ledger, root):
+    """AllowTrustTests result matrix: malformed code, self-trustor,
+    TRUST_NOT_REQUIRED, CANT_REVOKE without AUTH_REVOCABLE, missing
+    trustline."""
+    from stellar_core_tpu.xdr import AccountFlags
+
+    issuer = root.create(10**9)
+    alice = root.create(10**9)
+
+    # malformed: empty asset code
+    f = issuer.tx([issuer.op_allow_trust(alice.account_id,
+                                         code=b"\x00" * 4)])
+    assert not ledger.apply_frame(f)
+    assert inner_code(f) == AllowTrustResultCode.MALFORMED
+    # trust not required (flag unset on issuer)
+    f = issuer.tx([issuer.op_allow_trust(alice.account_id)])
+    assert not ledger.apply_frame(f)
+    assert inner_code(f) == AllowTrustResultCode.TRUST_NOT_REQUIRED
+    # arm AUTH_REQUIRED only (no revocable)
+    assert ledger.apply_frame(issuer.tx([issuer.op_set_options(
+        set_flags=AccountFlags.AUTH_REQUIRED_FLAG)]))
+    # self not allowed
+    f = issuer.tx([issuer.op_allow_trust(issuer.account_id)])
+    assert not ledger.apply_frame(f)
+    assert inner_code(f) == AllowTrustResultCode.SELF_NOT_ALLOWED
+    # no trustline yet
+    f = issuer.tx([issuer.op_allow_trust(alice.account_id)])
+    assert not ledger.apply_frame(f)
+    assert inner_code(f) == AllowTrustResultCode.NO_TRUST_LINE
+    # trustline exists; authorize works, revoke is blocked (not revocable)
+    usd = Asset.credit("USD", issuer.account_id)
+    assert alice.change_trust(usd, 10**6)
+    assert ledger.apply_frame(
+        issuer.tx([issuer.op_allow_trust(alice.account_id, authorize=1)]))
+    f = issuer.tx([issuer.op_allow_trust(alice.account_id, authorize=0)])
+    assert not ledger.apply_frame(f)
+    assert inner_code(f) == AllowTrustResultCode.CANT_REVOKE
+
+
+def test_manage_data_and_bump_seq_codes(ledger, root):
+    from stellar_core_tpu.transactions.operations import (
+        BumpSequenceResultCode,
+    )
+    from stellar_core_tpu.xdr import BumpSequenceOp
+
+    a = root.create(10**9)
+    # invalid name (empty)
+    f = a.tx([a.op_manage_data("", b"v")])
+    assert not ledger.apply_frame(f)
+    assert inner_code(f) == ManageDataResultCode.INVALID_NAME
+    # bump backwards is a success no-op; negative target is BAD_SEQ
+    cur = ledger.seq_num(a.account_id)
+    assert ledger.apply_frame(a.tx([a.op(OperationBody(
+        OperationType.BUMP_SEQUENCE, BumpSequenceOp(bumpTo=1)))]))
+    assert ledger.seq_num(a.account_id) == cur + 1
+    f = a.tx([a.op(OperationBody(
+        OperationType.BUMP_SEQUENCE, BumpSequenceOp(bumpTo=-5)))])
+    assert not ledger.apply_frame(f)
+    assert inner_code(f) == BumpSequenceResultCode.BAD_SEQ
