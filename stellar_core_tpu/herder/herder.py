@@ -332,10 +332,14 @@ class Herder:
                         statement_qset_hash(env.statement))
         return None
 
-    def check_quorum_intersection(self) -> dict:
+    def check_quorum_intersection(self, critical: bool = False) -> dict:
         """Run the intersection checker over the transitive quorum map
-        (reference HerderImpl::checkAndMaybeReanalyzeQuorumMap)."""
-        from .quorum_intersection import QuorumIntersectionChecker
+        (reference HerderImpl::checkAndMaybeReanalyzeQuorumMap); with
+        critical=True also search for intersection-critical groups
+        (reference getIntersectionCriticalGroups)."""
+        from .quorum_intersection import (
+            QuorumIntersectionChecker, intersection_critical_groups_strkey,
+        )
         qmap = self.quorum_tracker.get_quorum()
         checker = QuorumIntersectionChecker(qmap)
         ok = checker.network_enjoys_quorum_intersection()
@@ -347,6 +351,9 @@ class Herder:
         if checker.last_split is not None:
             out["last_good_split"] = [
                 [x.hex() for x in side] for side in checker.last_split]
+        if critical:
+            out["intersection_critical"] = \
+                intersection_critical_groups_strkey(qmap)
         self.last_quorum_intersection = out
         return out
 

@@ -292,3 +292,77 @@ class QuorumTracker:
 
     def get_quorum(self) -> Dict[bytes, Optional[SCPQuorumSet]]:
         return self._quorum
+
+
+# -- intersection-critical group analysis ------------------------------------
+
+def _points_to_any(qs: SCPQuorumSet, group: frozenset) -> bool:
+    """Single traversal: does qs reference any member of `group`?"""
+    for v in qs.validators:
+        if v.key_bytes in group:
+            return True
+    return any(_points_to_any(i, group) for i in qs.innerSets)
+
+
+def _criticality_candidates(qs: SCPQuorumSet, out: set, root: bool) -> None:
+    """Reference findCriticalityCandidates: every validator as a
+    singleton, plus every non-root LEAF innerSet as a group."""
+    for v in qs.validators:
+        out.add(frozenset((v.key_bytes,)))
+    if not root and not qs.innerSets:
+        out.add(frozenset(v.key_bytes for v in qs.validators))
+    for i in qs.innerSets:
+        _criticality_candidates(i, out, False)
+
+
+def intersection_critical_groups(
+        qmap: Dict[bytes, Optional[SCPQuorumSet]]) -> List[set]:
+    """Find "intersection-critical" node groups (reference
+    QuorumIntersectionChecker::getIntersectionCriticalGroups): for each
+    candidate group (leaf innerSets + singletons), install a "fickle"
+    qset — threshold 2 over {the group itself, anyone pointing at the
+    group} so the group goes along with anyone — and re-check
+    intersection. Groups whose fickleness splits the network are the
+    operators to watch."""
+    candidates: set = set()
+    for qs in qmap.values():
+        if qs is not None:
+            _criticality_candidates(qs, candidates, True)
+    log.info("examining %d node groups for intersection-criticality",
+             len(candidates))
+    critical: List[set] = []
+    # frozenset ordering is subset partial order — sort by element lists
+    # for deterministic output across runs
+    for group in sorted(candidates, key=sorted):
+        group_qset = SCPQuorumSet(
+            threshold=len(group),
+            validators=[PublicKey.ed25519(k) for k in sorted(group)],
+            innerSets=[])
+        points_to = sorted(
+            node for node, qs in qmap.items()
+            if node not in group and qs is not None and
+            _points_to_any(qs, group))
+        fickle = SCPQuorumSet(
+            threshold=2,
+            validators=[],
+            innerSets=[group_qset,
+                       SCPQuorumSet(threshold=1,
+                                    validators=[PublicKey.ed25519(k)
+                                                for k in points_to],
+                                    innerSets=[])])
+        test_qmap = dict(qmap)
+        for k in group:
+            test_qmap[k] = fickle
+        checker = QuorumIntersectionChecker(test_qmap)
+        if not checker.network_enjoys_quorum_intersection():
+            critical.append(set(group))
+    return critical
+
+
+def intersection_critical_groups_strkey(
+        qmap: Dict[bytes, Optional[SCPQuorumSet]]) -> List[List[str]]:
+    """Criticality report in operator form (strkey lists) — shared by the
+    HTTP checkquorum endpoint and the check-quorum CLI."""
+    from ..crypto.strkey import encode_public_key
+    return [sorted(encode_public_key(k) for k in group)
+            for group in intersection_critical_groups(qmap)]

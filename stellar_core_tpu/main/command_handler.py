@@ -91,8 +91,10 @@ class CommandHandler:
 
     def cmd_checkquorum(self, params) -> dict:
         """Run the quorum-intersection checker over the transitive quorum
-        map (reference `check-quorum` / periodic reanalysis)."""
-        return self.app.herder.check_quorum_intersection()
+        map (reference `check-quorum` / periodic reanalysis); pass
+        critical=true to also list intersection-critical groups."""
+        crit = params.get("critical", "") in ("true", "1")
+        return self.app.herder.check_quorum_intersection(critical=crit)
 
     def cmd_scp(self, params) -> dict:
         h = self.app.herder

@@ -377,8 +377,14 @@ def cmd_check_quorum(args) -> int:
         qmap[node] = SCPQuorumSet.from_xdr(qrow[0]) if qrow else None
     checker = QuorumIntersectionChecker(qmap)
     ok = checker.network_enjoys_quorum_intersection()
-    print(json.dumps({"ledger": seq, "nodes": len(qmap),
-                      "intersection": bool(ok)}, indent=1))
+    out = {"ledger": seq, "nodes": len(qmap), "intersection": bool(ok)}
+    if getattr(args, "critical", False):
+        from ..herder.quorum_intersection import (
+            intersection_critical_groups_strkey,
+        )
+        out["intersection_critical"] = \
+            intersection_critical_groups_strkey(qmap)
+    print(json.dumps(out, indent=1))
     return 0 if ok else 2
 
 
@@ -584,8 +590,10 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("output")
     p.add_argument("--mode", choices=("tx", "overlay"), default="tx")
     p.add_argument("--seed", type=int, default=1)
-    add("check-quorum", cmd_check_quorum,
-        "check quorum intersection of last network activity")
+    p = add("check-quorum", cmd_check_quorum,
+            "check quorum intersection of last network activity")
+    p.add_argument("--critical", action="store_true",
+                   help="also search for intersection-critical groups")
     p = add("write-quorum", cmd_write_quorum,
             "print a quorum graph mined from history")
     p.add_argument("--first", type=int, default=1)

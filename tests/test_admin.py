@@ -230,3 +230,12 @@ def test_metrics_instrumented_after_closes(app):
     assert "crypto.verify.cache-hit" in m
     assert m["ledger.ledger.num"]["count"] == \
         app.ledger_manager.last_closed_ledger_num()
+
+
+def test_checkquorum_critical_param(app):
+    st, out = cmd(app, "checkquorum", critical="true")
+    assert st == 200
+    assert out["intersection"] is True
+    # standalone self-quorum: the single validator is trivially critical
+    # or the list is empty — either way the field is present and a list
+    assert isinstance(out["intersection_critical"], list)

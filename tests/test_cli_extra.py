@@ -88,9 +88,10 @@ def test_upgrade_db(tmp_path, capsys):
 def test_check_quorum_from_db(tmp_path, capsys):
     conf = _node_conf(tmp_path)
     _run_node(tmp_path, conf, n_ledgers=5)
-    assert cli_main(["check-quorum", "--conf", conf]) == 0
+    assert cli_main(["check-quorum", "--conf", conf, "--critical"]) == 0
     out = json.loads(capsys.readouterr().out)
     assert out["intersection"] is True and out["nodes"] >= 1
+    assert isinstance(out["intersection_critical"], list)
 
 
 def test_dump_xdr_stream(tmp_path, capsys):

@@ -165,3 +165,41 @@ def test_herder_tracker_via_simulation():
         assert res["intersection"] is True
         assert res["node_count"] == 4
     sim.stop_all_nodes()
+
+
+def test_intersection_critical_groups():
+    """Reference 'quorum intersection criticality' scenario
+    (QuorumIntersectionTests.cpp:824-880): two org groups {0,1,2} and
+    {4,5,6} bridged by org3; the graph enjoys intersection in good
+    configuration, and exactly org3 is intersection-critical."""
+    import math
+
+    from stellar_core_tpu.herder.quorum_intersection import (
+        QuorumIntersectionChecker, intersection_critical_groups,
+    )
+    from stellar_core_tpu.xdr import PublicKey, SCPQuorumSet
+
+    def nid(i):
+        return bytes([i + 1]) * 32
+
+    def pk(i):
+        return PublicKey.ed25519(nid(i))
+
+    links = [(0, 1), (1, 2), (4, 5), (4, 6), (5, 6),
+             (0, 3), (1, 3), (2, 3), (4, 3), (6, 3)]
+    neigh = {i: {i} for i in range(7)}
+    for a, b in links:
+        neigh[a].add(b)
+        neigh[b].add(a)
+
+    def qset(i):
+        members = sorted(neigh[i])
+        return SCPQuorumSet(
+            threshold=math.ceil(0.67 * len(members)),
+            validators=[pk(m) for m in members], innerSets=[])
+
+    qmap = {nid(i): qset(i) for i in range(7)}
+    assert QuorumIntersectionChecker(
+        qmap).network_enjoys_quorum_intersection()
+    crit = intersection_critical_groups(qmap)
+    assert crit == [{nid(3)}], crit
