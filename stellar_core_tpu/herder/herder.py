@@ -207,6 +207,7 @@ class Herder:
         from .quorum_intersection import QuorumTracker
         self.quorum_tracker = QuorumTracker(
             cfg.node_id(), lambda: self.app.config.QUORUM_SET)
+        self._nominate_started: dict = {}
         self.last_quorum_intersection: Optional[dict] = None
 
     # -- state machine -------------------------------------------------------
@@ -408,6 +409,10 @@ class Herder:
                              upgrades=upgrades,
                              ext=StellarValueExt(0, None))
         prev = lcl.scpValue.to_xdr()
+        self._nominate_started[slot] = self.app.clock.now()
+        m = self._metrics()
+        if m is not None:
+            m.new_meter("scp.value.nominated").mark()
         self.scp.nominate(slot, value.to_xdr(), prev)
 
     def _arm_trigger_timer(self) -> None:
@@ -421,9 +426,18 @@ class Herder:
 
     # -- externalization -----------------------------------------------------
     def value_externalized(self, slot_index: int, value: bytes) -> None:
+        t0 = self._nominate_started.pop(slot_index, None)
+        self._nominate_started = {
+            s: t for s, t in self._nominate_started.items()
+            if s > slot_index}   # drop stale never-externalized slots
         m = self._metrics()
         if m is not None:
             m.new_meter("scp.value.externalized").mark()
+            if t0 is not None:
+                # reference scp.timing.externalized: nomination-start →
+                # externalize latency per slot
+                m.new_timer("scp.timing.externalized").update(
+                    max(0.0, self.app.clock.now() - t0))
         sv = StellarValue.from_xdr(value)
         txset = self.pending.get_tx_set(sv.txSetHash)
         assert txset is not None, "externalized unknown txset"

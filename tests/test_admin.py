@@ -228,6 +228,8 @@ def test_metrics_instrumented_after_closes(app):
     assert m["scp.envelope.emit"]["count"] >= 1
     assert m["scp.value.externalized"]["count"] >= 2
     assert "crypto.verify.cache-hit" in m
+    assert m["scp.timing.externalized"]["count"] >= 1
+    assert m["scp.value.nominated"]["count"] >= 1
     assert m["ledger.ledger.num"]["count"] == \
         app.ledger_manager.last_closed_ledger_num()
 
