@@ -15,7 +15,7 @@ from ..util.log import get_logger
 
 log = get_logger("Database")
 
-SCHEMA_VERSION = 1
+SCHEMA_VERSION = 2
 
 _SCHEMA = [
     """CREATE TABLE IF NOT EXISTS storestate (
@@ -44,6 +44,9 @@ _SCHEMA = [
     """CREATE TABLE IF NOT EXISTS txhistory (
         txid TEXT, ledgerseq INTEGER, txindex INTEGER, txbody BLOB,
         txresult BLOB, txmeta BLOB, PRIMARY KEY (ledgerseq, txindex))""",
+    """CREATE TABLE IF NOT EXISTS txfeehistory (
+        txid TEXT, ledgerseq INTEGER, txindex INTEGER, txchanges BLOB,
+        PRIMARY KEY (ledgerseq, txindex))""",
     """CREATE TABLE IF NOT EXISTS scphistory (
         nodeid TEXT, ledgerseq INTEGER, envelope BLOB)""",
     """CREATE TABLE IF NOT EXISTS scpquorums (
@@ -81,7 +84,9 @@ class Database:
             v = int(row[0])
             if v > SCHEMA_VERSION:
                 raise RuntimeError("database schema %d newer than binary" % v)
-            # upgrade hook: apply migrations v -> SCHEMA_VERSION here
+            # migrations v -> SCHEMA_VERSION (reference Database::upgrade)
+            # v1 -> v2: the txfeehistory table — created above by the
+            # CREATE IF NOT EXISTS pass, so the step is just the bump
             self.set_state("databaseschema", str(SCHEMA_VERSION))
         self._conn.commit()
 

@@ -205,9 +205,20 @@ class LedgerManager:
         frames = lcd.tx_set.sort_for_apply()
         base_fee = lcd.tx_set.base_fee(header)
 
-        # phase 1: fees + seq nums for every tx
+        # phase 1: fees + seq nums for every tx, each in a nested txn so
+        # the per-tx fee-processing changes become txfeehistory meta
+        # (reference saves these LedgerEntryChanges per tx)
+        from ..ledger.ledgertxn import delta_to_changes
         for f in frames:
-            f.process_fee_seq_num(ltx, base_fee)
+            fee_ltx = LedgerTxn(ltx)
+            try:
+                f.process_fee_seq_num(fee_ltx, base_fee)
+                f.fee_meta = delta_to_changes(fee_ltx.get_delta())
+                fee_ltx.commit()
+            except BaseException:
+                if fee_ltx._open:
+                    fee_ltx.rollback()
+                raise
         # phase 2: apply, collecting results (+ invariant checks per tx)
         result_pairs: List[TransactionResultPair] = []
         for f in frames:
@@ -353,10 +364,17 @@ class LedgerManager:
         db = getattr(self.app, "database", None)
         if db is None:
             return
+        from ..xdr import LedgerEntryChanges as _LEC
+        from ..xdr.codec import xdr_bytes as _xb
         for i, (f, rp) in enumerate(zip(frames, result_pairs)):
             db.execute(
                 "INSERT OR REPLACE INTO txhistory (txid, ledgerseq, "
                 "txindex, txbody, txresult, txmeta) VALUES (?,?,?,?,?,?)",
                 (f.contents_hash().hex(), lcd.ledger_seq, i,
-                 f.envelope_bytes(), rp.to_xdr(), b""))
+                 f.envelope_bytes(), rp.to_xdr(), f.tx_meta().to_xdr()))
+            db.execute(
+                "INSERT OR REPLACE INTO txfeehistory (txid, ledgerseq, "
+                "txindex, txchanges) VALUES (?,?,?,?)",
+                (f.contents_hash().hex(), lcd.ledger_seq, i,
+                 _xb(_LEC, f.fee_meta)))
         db.commit()

@@ -521,3 +521,21 @@ class LedgerTxnRoot(AbstractLedgerTxnParent):
             for (blob,) in self._db.execute(
                     "SELECT entry FROM %s" % table).fetchall():
                 yield LedgerEntry.from_xdr(blob)
+
+
+def delta_to_changes(delta) -> list:
+    """LedgerTxn delta triples → LedgerEntryChanges wire form (reference
+    meta convention: CREATED alone; STATE pre-image before
+    UPDATED/REMOVED). Feeds TransactionMeta and txfeehistory rows."""
+    from ..xdr import LedgerEntryChange, LedgerEntryChangeType as CT
+    out = []
+    for key, prev, cur in delta:
+        if prev is None and cur is not None:
+            out.append(LedgerEntryChange(CT.LEDGER_ENTRY_CREATED, cur))
+        elif cur is None:
+            out.append(LedgerEntryChange(CT.LEDGER_ENTRY_STATE, prev))
+            out.append(LedgerEntryChange(CT.LEDGER_ENTRY_REMOVED, key))
+        else:
+            out.append(LedgerEntryChange(CT.LEDGER_ENTRY_STATE, prev))
+            out.append(LedgerEntryChange(CT.LEDGER_ENTRY_UPDATED, cur))
+    return out

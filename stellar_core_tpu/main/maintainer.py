@@ -1,7 +1,7 @@
 """Maintainer + ExternalQueue: bounded retention of historical rows.
 
 Role parity: reference `src/main/Maintainer.{h,cpp}` (periodic deletion
-of old `scphistory`/`txhistory` rows, timer-driven by
+of old `scphistory`/`txhistory`/`txfeehistory` rows, timer-driven by
 AUTOMATIC_MAINTENANCE_PERIOD/COUNT) and `src/main/ExternalQueue.{h,cpp}`
 (the `pubsub` cursor table: downstream consumers advance a cursor per
 resource id, and maintenance never deletes rows a consumer has not
@@ -112,7 +112,7 @@ class Maintainer:
             return 0
         bound = self._retention_bound()
         deleted = 0
-        for table in ("scphistory", "txhistory"):
+        for table in ("scphistory", "txhistory", "txfeehistory"):
             cur = db.execute(
                 "DELETE FROM %s WHERE ledgerseq < ? AND ledgerseq IN "
                 "(SELECT ledgerseq FROM %s WHERE ledgerseq < ? "

@@ -31,6 +31,7 @@ from .account_helpers import (
     ThresholdLevel, account_available_balance, account_threshold,
     account_master_weight, load_account,
 )
+from ..ledger.ledgertxn import delta_to_changes
 from .operation_frame import make_operation_frame
 from .signature_checker import SignatureChecker
 from . import operations as _ops  # noqa: F401  (populates the op registry)
@@ -122,6 +123,8 @@ class TransactionFrame:
         self._env_bytes: Optional[bytes] = None
         self._full_hash: Optional[bytes] = None
         self._env_sig_fp: tuple = ()
+        self.op_metas: List[list] = []     # per-op LedgerEntryChanges
+        self.fee_meta: list = []           # fee/seq processing changes
 
     # -- identity -----------------------------------------------------------
     @classmethod
@@ -189,6 +192,14 @@ class TransactionFrame:
             secret_key.sign_decorated(self.contents_hash()))
 
     # -- batched signature collection ----------------------------------------
+    def tx_meta(self):
+        """TransactionMeta v1 for the last apply (reference txmeta column;
+        downstream-consumer form — not part of any consensus hash)."""
+        from ..xdr import OperationMeta, TransactionMeta, TransactionMetaV1
+        return TransactionMeta(1, TransactionMetaV1(
+            txChanges=[],
+            operations=[OperationMeta(changes=ch) for ch in self.op_metas]))
+
     def candidate_sig_triples(self, ltx, signer_cache: Optional[dict] = None
                               ) -> List[Tuple[bytes, bytes, bytes]]:
         """Every (ed25519-key, signature, contents-hash) pair a
@@ -362,18 +373,22 @@ class TransactionFrame:
             # applyOperations semantics
             ok = True
             op_results = []
+            op_metas = []
             for f in self.op_frames:
                 op_ltx = LedgerTxn(ltx)
                 try:
                     if f.apply(op_ltx):
+                        op_metas.append(delta_to_changes(op_ltx.get_delta()))
                         op_ltx.commit()
                     else:
                         ok = False
+                        op_metas.append([])
                         op_ltx.rollback()
                 except Exception:
                     op_ltx.rollback()
                     raise
                 op_results.append(f.result)
+            self.op_metas = op_metas if ok else [[] for _ in op_results]
             if ok:
                 self.result = _make_result(
                     fee, TransactionResultCode.txSUCCESS, op_results)
@@ -386,6 +401,9 @@ class TransactionFrame:
         except Exception:
             self.result = _make_result(
                 fee, TransactionResultCode.txINTERNAL_ERROR)
+            if ltx._open:
+                ltx.rollback()   # never leave the nested txn registered:
+                # the NEXT frame's LedgerTxn(parent) would assert
             return False
 
     def result_pair(self) -> TransactionResultPair:
@@ -414,6 +432,14 @@ class FeeBumpTransactionFrame:
         self._env_bytes: Optional[bytes] = None
         self._full_hash: Optional[bytes] = None
         self._env_sig_fp: tuple = ()
+        self.fee_meta: list = []
+
+    @property
+    def op_metas(self):
+        return self.inner.op_metas
+
+    def tx_meta(self):
+        return self.inner.tx_meta()
 
     def source_account_id(self) -> PublicKey:
         return self.fee_bump.feeSource.account_id

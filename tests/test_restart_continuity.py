@@ -202,3 +202,63 @@ def test_inflation_not_supported_from_protocol_12():
         OperationResultCode.opNOT_SUPPORTED
     assert led.header().totalCoins == total_before
     assert led.header().inflationSeq == 0
+
+
+# ------------------------------------------------- transaction meta rows
+
+def test_txmeta_and_feehistory_rows(tmp_path):
+    """Closes persist TransactionMeta (per-op LedgerEntryChanges) and the
+    fee-processing changes (reference txhistory.txmeta + txfeehistory)."""
+    from stellar_core_tpu.xdr import (
+        LedgerEntryChangeType as CT, LedgerEntryChanges, TransactionMeta,
+    )
+    from stellar_core_tpu.xdr.codec import xdr_from
+
+    app = _mk(tmp_path)
+    ad = AppLedgerAdapter(app)
+    root = ad.root_account()
+    alice = root.create(10**9)
+    app.clock.set_virtual_time(app.clock.now() + 5)
+    app.submit_transaction(
+        alice.tx([alice.op_payment(root.account_id, 250)]))
+    app.manual_close()
+    seq = app.ledger_manager.last_closed_ledger_num()
+    row = app.database.execute(
+        "SELECT txmeta FROM txhistory WHERE ledgerseq = ?", (seq,)
+    ).fetchone()
+    meta = TransactionMeta.from_xdr(row[0])
+    assert meta.disc == 1
+    (opm,) = meta.value.operations
+    kinds = [c.disc for c in opm.changes]
+    # payment: STATE+UPDATED for each of the two touched accounts
+    assert kinds == [CT.LEDGER_ENTRY_STATE, CT.LEDGER_ENTRY_UPDATED,
+                     CT.LEDGER_ENTRY_STATE, CT.LEDGER_ENTRY_UPDATED]
+    frow = app.database.execute(
+        "SELECT txchanges FROM txfeehistory WHERE ledgerseq = ?", (seq,)
+    ).fetchone()
+    changes = xdr_from(LedgerEntryChanges, frow[0])
+    # fee+seq consume: STATE + UPDATED on the source account
+    assert [c.disc for c in changes] == [CT.LEDGER_ENTRY_STATE,
+                                         CT.LEDGER_ENTRY_UPDATED]
+    st = changes[0].value.data.value
+    up = changes[1].value.data.value
+    assert up.balance == st.balance - 100      # fee charged
+    assert up.seqNum == st.seqNum + 1          # seq consumed
+
+
+def test_schema_v1_migrates_to_v2(tmp_path):
+    """A v1 database (no txfeehistory) upgrades in place on open."""
+    import sqlite3
+
+    from stellar_core_tpu.database.database import SCHEMA_VERSION, Database
+
+    path = str(tmp_path / "old.db")
+    db = sqlite3.connect(path)
+    db.execute("CREATE TABLE storestate (statename TEXT PRIMARY KEY, "
+               "state TEXT)")
+    db.execute("INSERT INTO storestate VALUES ('databaseschema', '1')")
+    db.commit()
+    db.close()
+    d = Database(path)
+    assert d.get_state("databaseschema") == str(SCHEMA_VERSION)
+    d.execute("SELECT COUNT(*) FROM txfeehistory")  # table exists now
