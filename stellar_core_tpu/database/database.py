@@ -113,7 +113,11 @@ class Database:
         return cur
 
     def executemany(self, sql: str, rows) -> None:
+        t0 = time.perf_counter()
         self._conn.executemany(sql, rows)
+        if self._metrics is not None:
+            self._metrics.new_timer("database.query.exec").update(
+                time.perf_counter() - t0)
 
     def commit(self) -> None:
         self._conn.commit()

@@ -366,15 +366,17 @@ class LedgerManager:
             return
         from ..xdr import LedgerEntryChanges as _LEC
         from ..xdr.codec import xdr_bytes as _xb
+        tx_rows, fee_rows = [], []
         for i, (f, rp) in enumerate(zip(frames, result_pairs)):
-            db.execute(
-                "INSERT OR REPLACE INTO txhistory (txid, ledgerseq, "
-                "txindex, txbody, txresult, txmeta) VALUES (?,?,?,?,?,?)",
-                (f.contents_hash().hex(), lcd.ledger_seq, i,
-                 f.envelope_bytes(), rp.to_xdr(), f.tx_meta().to_xdr()))
-            db.execute(
-                "INSERT OR REPLACE INTO txfeehistory (txid, ledgerseq, "
-                "txindex, txchanges) VALUES (?,?,?,?)",
-                (f.contents_hash().hex(), lcd.ledger_seq, i,
-                 _xb(_LEC, f.fee_meta)))
+            h = f.contents_hash().hex()
+            tx_rows.append((h, lcd.ledger_seq, i, f.envelope_bytes(),
+                            rp.to_xdr(), f.tx_meta().to_xdr()))
+            fee_rows.append((h, lcd.ledger_seq, i, _xb(_LEC, f.fee_meta)))
+        db.executemany(
+            "INSERT OR REPLACE INTO txhistory (txid, ledgerseq, "
+            "txindex, txbody, txresult, txmeta) VALUES (?,?,?,?,?,?)",
+            tx_rows)
+        db.executemany(
+            "INSERT OR REPLACE INTO txfeehistory (txid, ledgerseq, "
+            "txindex, txchanges) VALUES (?,?,?,?)", fee_rows)
         db.commit()
