@@ -66,7 +66,7 @@ class TransactionQueue:
         if self.size_ops() + frame.num_operations() > self.pool_cap_ops():
             return TxQueueResult.ADD_STATUS_TRY_AGAIN_LATER
 
-        acc = frame.source_account_id().key_bytes
+        acc = frame.seq_account_id().key_bytes
         chain = self._pending.get(acc, [])
         # replace-by-fee: same seqnum present?
         replace_idx = None
@@ -117,7 +117,7 @@ class TransactionQueue:
             acc = self._known_hashes.pop(h, None)
             if acc is None:
                 # also drop any pending tx with same (acc, seq<=applied)
-                acc = f.source_account_id().key_bytes
+                acc = f.seq_account_id().key_bytes
             chain = self._pending.get(acc)
             if not chain:
                 continue

@@ -54,16 +54,23 @@ class TxSetFrame:
     def sorted_for_hash(self) -> List[AnyFrame]:
         return sorted(self.frames, key=lambda f: f.full_hash())
 
+    @staticmethod
+    def _chains_by_seq_account(frames) -> Dict[bytes, List[AnyFrame]]:
+        """Per-account chains keyed by the sequence-owning account, each
+        chain in seqNum order — shared by apply ordering, surge pricing,
+        and validation."""
+        by_acc: Dict[bytes, List[AnyFrame]] = {}
+        for f in frames:
+            by_acc.setdefault(f.seq_account_id().key_bytes, []).append(f)
+        for chain in by_acc.values():
+            chain.sort(key=lambda f: f.seq_num)
+        return by_acc
+
     def sort_for_apply(self) -> List[AnyFrame]:
         """Deterministic shuffled apply order: group per source account in
         seq order, then round-robin accounts ordered by
         (account_id XOR set_hash)."""
-        by_acc: Dict[bytes, List[AnyFrame]] = {}
-        for f in self.sorted_for_hash():
-            by_acc.setdefault(f.source_account_id().key_bytes,
-                              []).append(f)
-        for chain in by_acc.values():
-            chain.sort(key=lambda f: f.seq_num)
+        by_acc = self._chains_by_seq_account(self.sorted_for_hash())
         h = self.get_contents_hash()
         order = sorted(by_acc, key=lambda acc: _xor(acc, h))
         out: List[AnyFrame] = []
@@ -130,12 +137,7 @@ class TxSetFrame:
         max_ops = header.maxTxSetSize
         if self.size_for_cap(header) <= max_ops:
             return
-        by_acc: Dict[bytes, List[AnyFrame]] = {}
-        for f in self.frames:
-            by_acc.setdefault(f.source_account_id().key_bytes,
-                              []).append(f)
-        for chain in by_acc.values():
-            chain.sort(key=lambda f: f.seq_num)
+        by_acc = self._chains_by_seq_account(self.frames)
         # a chain's priority is its lowest fee-rate tx (can't include later
         # txs without earlier ones)
         included: List[AnyFrame] = []
@@ -180,13 +182,9 @@ class TxSetFrame:
         dependents); returns (all_valid, trimmed)."""
         removed: List[AnyFrame] = []
         self._prewarm_signatures(ltx_parent, verifier)
-        by_acc: Dict[bytes, List[AnyFrame]] = {}
-        for f in self.frames:
-            by_acc.setdefault(f.source_account_id().key_bytes,
-                              []).append(f)
+        by_acc = self._chains_by_seq_account(self.frames)
         keep: List[AnyFrame] = []
         for acc, chain in sorted(by_acc.items()):
-            chain.sort(key=lambda f: f.seq_num)
             ltx = LedgerTxn(ltx_parent)
             try:
                 from ..xdr import LedgerKey, PublicKey
@@ -196,7 +194,6 @@ class TxSetFrame:
                     removed.extend(chain)
                     continue
                 cur_seq = acc_entry.data.value.seqNum
-                total_fee = 0
                 chain_ok: List[AnyFrame] = []
                 bad = False
                 for f in chain:
@@ -205,25 +202,67 @@ class TxSetFrame:
                         bad = True  # later txs have broken seq chain
                         continue
                     cur_seq = f.seq_num
-                    total_fee += f.fee_charged(ltx.load_header())
                     chain_ok.append(f)
-                if chain_ok:
-                    from ..transactions.account_helpers import (
-                        account_available_balance,
-                    )
-                    avail = account_available_balance(
-                        ltx.load_header(), acc_entry.data.value)
-                    if avail < total_fee:
-                        removed.extend(chain_ok)
-                        chain_ok = []
                 keep.extend(chain_ok)
             finally:
                 ltx.rollback()
+        # whole-set fee balance per FEE SOURCE (reference accountFeeMap
+        # keyed by getFeeSourceID — for fee bumps the sponsor, which can
+        # differ from the seq account; reference TxSetFrame.cpp:325-356)
+        keep = self._check_fee_balances(ltx_parent, keep, removed)
         if trim:
             self.frames = keep
             self._hash = None
             return (not removed), removed
         return (not removed), removed
+
+    def _check_fee_balances(self, ltx_parent, keep: List[AnyFrame],
+                            removed: List[AnyFrame]) -> List[AnyFrame]:
+        """Drop every tx whose fee source cannot cover the SUM of fees it
+        sponsors across the set."""
+        from ..transactions.account_helpers import (
+            account_available_balance,
+        )
+        from ..xdr import LedgerKey, PublicKey
+        ltx = LedgerTxn(ltx_parent)
+        try:
+            header = ltx.load_header()
+            fees: Dict[bytes, int] = {}
+            for f in keep:
+                k = f.fee_account_id().key_bytes
+                fees[k] = fees.get(k, 0) + f.fee_charged(header)
+            bad_sources = set()
+            for k, total in fees.items():
+                entry = ltx.load_without_record(
+                    LedgerKey.account(PublicKey.ed25519(k)))
+                if entry is None or account_available_balance(
+                        header, entry.data.value) < total:
+                    bad_sources.add(k)
+            if not bad_sources:
+                return keep
+            out = []
+            broken_chains: Dict[bytes, int] = {}  # seq acc -> first bad seq
+            for f in keep:
+                if f.fee_account_id().key_bytes in bad_sources:
+                    removed.append(f)
+                    k = f.seq_account_id().key_bytes
+                    broken_chains[k] = min(
+                        broken_chains.get(k, f.seq_num), f.seq_num)
+                else:
+                    out.append(f)
+            if broken_chains:
+                # later-seq txs of a broken chain can no longer apply
+                out2 = []
+                for f in out:
+                    k = f.seq_account_id().key_bytes
+                    if k in broken_chains and                             f.seq_num > broken_chains[k]:
+                        removed.append(f)
+                    else:
+                        out2.append(f)
+                out = out2
+            return out
+        finally:
+            ltx.rollback()
 
     def _prewarm_signatures(self, ltx_parent, verifier) -> None:
         """Two-phase validation (TPU batch hot caller #3): collect every

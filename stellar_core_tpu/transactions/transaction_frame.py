@@ -136,6 +136,16 @@ class TransactionFrame:
     def source_account_id(self) -> PublicKey:
         return self.tx.sourceAccount.account_id
 
+    def seq_account_id(self) -> PublicKey:
+        """The account whose sequence number this envelope consumes —
+        the queue/txset chain key (reference getSourceID; for fee bumps
+        the INNER source, not the fee source)."""
+        return self.source_account_id()
+
+    def fee_account_id(self) -> PublicKey:
+        """The account the fee is charged to (reference getFeeSourceID)."""
+        return self.source_account_id()
+
     @property
     def seq_num(self) -> int:
         return self.tx.seqNum
@@ -442,6 +452,15 @@ class FeeBumpTransactionFrame:
         return self.inner.tx_meta()
 
     def source_account_id(self) -> PublicKey:
+        return self.fee_bump.feeSource.account_id
+
+    def seq_account_id(self) -> PublicKey:
+        """Chain key = the inner tx's source (whose seqNum is consumed),
+        NOT the fee source (reference FeeBumpTransactionFrame::
+        getSourceID returns the inner source)."""
+        return self.inner.source_account_id()
+
+    def fee_account_id(self) -> PublicKey:
         return self.fee_bump.feeSource.account_id
 
     @property

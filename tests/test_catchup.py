@@ -327,3 +327,51 @@ def test_prewarm_batches_checkpoint_sigs(publisher):
     # every DISTINCT signature triple raw-verifies exactly once — the
     # apply path and the incremental prewarms all hit the cache
     assert raw_calls[0] == len(cv.distinct)
+
+
+def test_replay_history_containing_fee_bump(publisher):
+    """A fee-bump envelope in published history replays byte-exactly
+    (checkpoint prewarm collects outer fee-source + inner signatures)."""
+    from stellar_core_tpu.transactions.transaction_frame import (
+        FeeBumpTransactionFrame,
+    )
+    from stellar_core_tpu.xdr import (
+        EnvelopeType, FeeBumpTransaction, FeeBumpTransactionEnvelope,
+        TransactionEnvelope, _Ext,
+    )
+    from stellar_core_tpu.xdr.transaction import _InnerTxEnvelope
+
+    app_a, tmp_path, archive_root = publisher
+    ad = AppLedgerAdapter(app_a)
+    root = ad.root_account()
+    payer = root.create(10**9)
+    sponsor = root.create(10**9)
+    inner = payer.tx([payer.op_payment(root.account_id, 77)], fee=100)
+    fb = FeeBumpTransaction(
+        feeSource=sponsor.muxed, fee=1000,
+        innerTx=_InnerTxEnvelope(EnvelopeType.ENVELOPE_TYPE_TX,
+                                 inner.envelope.value),
+        ext=_Ext.v0())
+    env = TransactionEnvelope(
+        EnvelopeType.ENVELOPE_TYPE_TX_FEE_BUMP,
+        FeeBumpTransactionEnvelope(tx=fb, signatures=[]))
+    frame = FeeBumpTransactionFrame(app_a.config.network_id, env)
+    frame.add_signature(sponsor.sk)
+    assert app_a.submit_transaction(frame) == 0
+    app_a.manual_close()
+    # run to the next checkpoint boundary and publish it
+    while (app_a.ledger_manager.last_closed_ledger_num() + 1) % FREQ:
+        app_a.manual_close()
+    app_a.crank_until(lambda: app_a.history_manager.publish_queue() == [],
+                      max_cranks=5000)
+
+    app_b = make_app(tmp_path, 9, archive_root, writable=False)
+    work = app_b.catchup_manager.start_catchup(
+        CatchupConfiguration.complete())
+    assert run_work(app_b, work) == State.SUCCESS
+    lm_b = app_b.ledger_manager
+    assert lm_b.lcl_hash.hex() == app_a.database.execute(
+        "SELECT ledgerhash FROM ledgerheaders WHERE ledgerseq = ?",
+        (lm_b.last_closed_ledger_num(),)).fetchone()[0]
+    assert AppLedgerAdapter(app_b).balance(payer.account_id) == \
+        ad.balance(payer.account_id)

@@ -147,3 +147,66 @@ def test_to_txset_orders_chains(env):
     order = [f.seq_num for f in applied
              if f.source_account_id().key_bytes == a.account_id.key_bytes]
     assert order == [a1.seq_num, a2.seq_num]
+
+
+def test_txset_fee_balance_keyed_by_fee_source():
+    """A fee bump's fee counts against the SPONSOR's balance across the
+    set (reference accountFeeMap by getFeeSourceID), and a sponsored tx
+    dropped for sponsor insolvency takes its seq-chain dependents along."""
+    from stellar_core_tpu.herder.txset import TxSetFrame
+    from stellar_core_tpu.testing import TestAccount, TestLedger, \
+        root_secret_key
+    from stellar_core_tpu.transactions.transaction_frame import (
+        FeeBumpTransactionFrame,
+    )
+    from stellar_core_tpu.xdr import (
+        EnvelopeType, FeeBumpTransaction, FeeBumpTransactionEnvelope,
+        TransactionEnvelope, _Ext,
+    )
+    from stellar_core_tpu.xdr.transaction import _InnerTxEnvelope
+
+    led = TestLedger()
+    root = TestAccount(led, root_secret_key())
+    a = root.create(10**9)
+    # sponsor holds only the reserve: cannot pay any fee
+    broke = root.create(10**7)
+
+    inner1 = a.tx([a.op_payment(root.account_id, 1)], fee=100,
+                  seq=a.next_seq())
+    fb = FeeBumpTransaction(
+        feeSource=broke.muxed, fee=10**6,
+        innerTx=_InnerTxEnvelope(EnvelopeType.ENVELOPE_TYPE_TX,
+                                 inner1.envelope.value),
+        ext=_Ext.v0())
+    env = TransactionEnvelope(
+        EnvelopeType.ENVELOPE_TYPE_TX_FEE_BUMP,
+        FeeBumpTransactionEnvelope(tx=fb, signatures=[]))
+    bump = FeeBumpTransactionFrame(led.network_id, env)
+    bump.add_signature(broke.sk)
+    # a's follow-up tx depends on the bumped tx's seq
+    follow = a.tx([a.op_payment(root.account_id, 2)], fee=100,
+                  seq=a.next_seq() + 1)
+
+    ts = TxSetFrame(led.network_id, b"\x00" * 32, [bump, follow])
+    ok, removed_list = ts.check_or_trim(led.root, None, trim=True)
+    assert not ok
+    # both the sponsored tx and its dependent fell out
+    assert bump in removed_list and follow in removed_list
+    assert ts.frames == []
+
+    # rich sponsor: the same set validates even though `a` could not have
+    # paid the bump fee itself
+    rich = root.create(10**12)
+    fb2 = FeeBumpTransaction(
+        feeSource=rich.muxed, fee=10**6,
+        innerTx=_InnerTxEnvelope(EnvelopeType.ENVELOPE_TYPE_TX,
+                                 inner1.envelope.value),
+        ext=_Ext.v0())
+    env2 = TransactionEnvelope(
+        EnvelopeType.ENVELOPE_TYPE_TX_FEE_BUMP,
+        FeeBumpTransactionEnvelope(tx=fb2, signatures=[]))
+    bump2 = FeeBumpTransactionFrame(led.network_id, env2)
+    bump2.add_signature(rich.sk)
+    ts2 = TxSetFrame(led.network_id, b"\x00" * 32, [bump2, follow])
+    ok2, removed2 = ts2.check_or_trim(led.root, None, trim=True)
+    assert ok2, removed2
