@@ -44,6 +44,21 @@ class TransactionQueue:
         self._pending: Dict[bytes, List[Tuple[int, object]]] = {}
         self._known_hashes: Dict[bytes, bytes] = {}  # full hash -> acc
         self._banned: List[set] = [set() for _ in range(ban_depth)]
+        # running fee-bid total per FEE source (reference per-account
+        # mTotalFees): O(1) admission checks instead of pool scans
+        self._fee_totals: Dict[bytes, int] = {}
+
+    def _note_add(self, frame) -> None:
+        k = frame.fee_account_id().key_bytes
+        self._fee_totals[k] = self._fee_totals.get(k, 0) + frame.fee_bid
+
+    def _note_remove(self, frame) -> None:
+        k = frame.fee_account_id().key_bytes
+        left = self._fee_totals.get(k, 0) - frame.fee_bid
+        if left > 0:
+            self._fee_totals[k] = left
+        else:
+            self._fee_totals.pop(k, None)
 
     # -- queries ------------------------------------------------------------
     def size_ops(self) -> int:
@@ -89,6 +104,27 @@ class TransactionQueue:
             seq_base = frame.seq_num - 1
             if not frame.check_valid(ltx, seq_base, self.verifier):
                 return TxQueueResult.ADD_STATUS_ERROR
+            # the fee source must cover this full fee BID on top of every
+            # bid it already sponsors in the pool (reference
+            # TransactionQueue.cpp:196-205 accumulates fee bids; fee
+            # source != seq account for fee bumps). A replacement nets
+            # out the bid of the tx it replaces.
+            header = ltx.load_header()
+            fee_acc = frame.fee_account_id().key_bytes
+            pending_fees = self._fee_totals.get(fee_acc, 0) + frame.fee_bid
+            if replace_idx is not None:
+                old = chain[replace_idx][1]
+                if old.fee_account_id().key_bytes == fee_acc:
+                    pending_fees -= old.fee_bid
+            from ..xdr import LedgerKey, PublicKey
+            from ..transactions.account_helpers import (
+                account_available_balance,
+            )
+            entry = ltx.load_without_record(
+                LedgerKey.account(PublicKey.ed25519(fee_acc)))
+            if entry is None or account_available_balance(
+                    header, entry.data.value) < pending_fees:
+                return TxQueueResult.ADD_STATUS_ERROR
         finally:
             ltx.rollback()
 
@@ -96,12 +132,14 @@ class TransactionQueue:
             old = chain[replace_idx][1]
             del self._known_hashes[old.full_hash()]
             self.ban([old.full_hash()])
+            self._note_remove(old)
             chain[replace_idx] = (0, frame)
         else:
             chain.append((0, frame))
             chain.sort(key=lambda t: t[1].seq_num)
         self._pending[acc] = chain
         self._known_hashes[h] = acc
+        self._note_add(frame)
         return TxQueueResult.ADD_STATUS_PENDING
 
     def _account_seq(self, acc: bytes) -> int:
@@ -124,8 +162,10 @@ class TransactionQueue:
             new_chain = [(age, g) for age, g in chain
                          if g.seq_num > f.seq_num]
             for age, g in chain:
-                if g.seq_num <= f.seq_num and g.full_hash() != h:
-                    self._known_hashes.pop(g.full_hash(), None)
+                if g.seq_num <= f.seq_num:
+                    self._note_remove(g)
+                    if g.full_hash() != h:
+                        self._known_hashes.pop(g.full_hash(), None)
             if new_chain:
                 self._pending[acc] = new_chain
             else:
@@ -144,6 +184,7 @@ class TransactionQueue:
                 if age >= self.pending_depth:
                     self._banned[0].add(f.full_hash())
                     self._known_hashes.pop(f.full_hash(), None)
+                    self._note_remove(f)
                 else:
                     new_chain.append((age, f))
             if new_chain:

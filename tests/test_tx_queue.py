@@ -210,3 +210,32 @@ def test_txset_fee_balance_keyed_by_fee_source():
     ts2 = TxSetFrame(led.network_id, b"\x00" * 32, [bump2, follow])
     ok2, removed2 = ts2.check_or_trim(led.root, None, trim=True)
     assert ok2, removed2
+
+
+def test_queue_caps_total_fees_per_fee_source():
+    """Admission sums fee BIDS per fee source across the pool (reference
+    TransactionQueue.cpp:196-205): a sponsor with balance for one fee
+    cannot sponsor unbounded pending txs."""
+    led = TestLedger()
+    root = TestAccount(led, root_secret_key())
+    # spare above the reserve covers ~3 base fees only
+    a = root.create(10**7 + 350)
+    b = root.create(10**9)
+    q = TransactionQueue(_LM(led))
+    for i in range(3):
+        f = a.tx([a.op_payment(b.account_id, 1)], seq=a.next_seq() + i)
+        assert q.try_add(f) == PENDING, i
+    f4 = a.tx([a.op_payment(b.account_id, 1)], seq=a.next_seq() + 3)
+    assert q.try_add(f4) == ERR, \
+        "4th fee bid exceeds the sponsor's spare balance"
+    # replacement nets out the replaced bid: sponsor spare 1250 holds
+    # two 100-stroop bids; a 1000-bid replacement totals 200-100+1000 =
+    # 1100 <= 1250 and is admitted — double-counting the replaced tx
+    # (1300) would wrongly reject it
+    c = root.create(10**7 + 1250)
+    for i in range(2):
+        f = c.tx([c.op_payment(b.account_id, 1)], seq=c.next_seq() + i)
+        assert q.try_add(f) == PENDING, i
+    head = c.tx([c.op_payment(b.account_id, 2)], seq=c.next_seq(),
+                fee=1000)
+    assert q.try_add(head) == PENDING
