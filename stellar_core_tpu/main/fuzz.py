@@ -52,6 +52,7 @@ def _tx_corpus(led, root) -> List[bytes]:
     ledger, so unmutated inputs reach apply (and mutated ones exercise
     checkValid/fee/seq/apply, not just the missing-account early-out)."""
     alice = root.create(10**9)
+    sponsor = root.create(10**9)
     sk = SecretKey.from_seed(b"\x21" * 32)
     frames = [
         alice.tx([alice.op_payment(root.account_id, 1234)], seq=alice.next_seq()),
@@ -61,6 +62,23 @@ def _tx_corpus(led, root) -> List[bytes]:
                   alice.op_payment(root.account_id, 1)],
                  seq=alice.next_seq()),
     ]
+    # fee-bump envelope: the outer-union decode path mutates differently
+    from ..transactions.transaction_frame import FeeBumpTransactionFrame
+    from ..xdr import (EnvelopeType, FeeBumpTransaction,
+                       FeeBumpTransactionEnvelope, _Ext)
+    from ..xdr.transaction import _InnerTxEnvelope
+    inner = alice.tx([alice.op_payment(root.account_id, 9)],
+                     seq=alice.next_seq())
+    fb = FeeBumpTransaction(
+        feeSource=sponsor.muxed, fee=1000,
+        innerTx=_InnerTxEnvelope(EnvelopeType.ENVELOPE_TYPE_TX,
+                                 inner.envelope.value),
+        ext=_Ext.v0())
+    bump = FeeBumpTransactionFrame(led.network_id, TransactionEnvelope(
+        EnvelopeType.ENVELOPE_TYPE_TX_FEE_BUMP,
+        FeeBumpTransactionEnvelope(tx=fb, signatures=[])))
+    bump.add_signature(sponsor.sk)
+    frames.append(bump)
     return [f.envelope.to_xdr() for f in frames]
 
 
@@ -83,8 +101,10 @@ def fuzz_tx(iterations: int = 10000, seed: int = 1) -> Dict[str, int]:
             if i % 64 == 0:
                 # periodically refresh the corpus with a currently-valid
                 # payment so the full fee/seq/apply path stays reachable as
-                # the fuzz ledger's sequence numbers advance
-                corpus[i // 64 % len(corpus)] = root.tx(
+                # the fuzz ledger's sequence numbers advance — but never
+                # evict the fee-bump seed (last slot), which covers the
+                # outer-union decode path
+                corpus[i // 64 % (len(corpus) - 1)] = root.tx(
                     [root.op_payment(root.account_id, 1)]).envelope.to_xdr()
             raw = _mutate(r, r.choice(corpus))
             try:
