@@ -254,9 +254,13 @@ class TransactionFrame:
         if src is None:
             return TransactionResultCode.txNO_ACCOUNT
         acc = src.data.value
-        seq = current_seq if current_seq != 0 else acc.seqNum
-        if self.tx.seqNum != seq + 1:
-            return TransactionResultCode.txBAD_SEQ
+        if not applying:
+            # at apply the sequence number was already consumed by the
+            # close's fee/seq phase (reference commonValid skips the seq
+            # check when applying from protocol 10)
+            seq = current_seq if current_seq != 0 else acc.seqNum
+            if self.tx.seqNum != seq + 1:
+                return TransactionResultCode.txBAD_SEQ
         if not self._check_signature(checker, acc, ThresholdLevel.LOW):
             return TransactionResultCode.txBAD_AUTH
         # fee must come from the AVAILABLE balance (net of reserve and
@@ -369,10 +373,15 @@ class TransactionFrame:
         try:
             # re-verify seq/auth at apply time (state may have changed since
             # nomination; reference commonValid(applying=true) path)
-            src = load_account(ltx, self.source_account_id())
-            if src is None:
-                self.result = _make_result(
-                    fee, TransactionResultCode.txNO_ACCOUNT)
+            # full commonValid in applying mode against the SAME checker
+            # as the per-op checks (reference apply → commonValid(checker)
+            # before processSignatures): re-checks time bounds and auth at
+            # the applying ledger — and consumes the tx source's
+            # signature, so checkAllSignaturesUsed doesn't flag it as
+            # dangling when every op has its own source account
+            code = self._common_valid(checker, ltx, 0, True)
+            if code != TransactionResultCode.txSUCCESS:
+                self.result = _make_result(fee, code)
                 ltx.rollback()
                 return False
             if not self.process_signatures(checker, ltx):

@@ -528,3 +528,45 @@ def test_manage_data_and_bump_seq_codes(ledger, root):
         OperationType.BUMP_SEQUENCE, BumpSequenceOp(bumpTo=-5)))])
     assert not ledger.apply_frame(f)
     assert inner_code(f) == BumpSequenceResultCode.BAD_SEQ
+
+
+def test_op_level_source_account(ledger, root):
+    """An operation with its own sourceAccount executes against that
+    account and requires ITS signature (reference TxEnvelopeTests: per-op
+    signature checks; OperationFrame::checkSignature)."""
+    from stellar_core_tpu.xdr import OperationResultCode
+
+    a = root.create(10**9)
+    b = root.create(10**9)
+    c = root.create(10**9)
+    # a's tx, but the payment is sourced by b
+    op = a.op(OperationBody(
+        OperationType.PAYMENT,
+        X.PaymentOp(destination=X.MuxedAccount.from_account_id(c.account_id),
+                    asset=Asset.native(), amount=5000)), source=b.account_id)
+    f_unsigned = a.tx([op])
+    assert not ledger.apply_frame(f_unsigned)
+    assert f_unsigned.result.op_results[0].disc == \
+        OperationResultCode.opBAD_AUTH
+
+    bal_b = ledger.balance(b.account_id)
+    bal_c = ledger.balance(c.account_id)
+    f = a.tx([op], extra_signers=[b.sk])
+    assert ledger.apply_frame(f), f.result
+    # funds moved from B (the op source), fee paid by A (the tx source)
+    assert ledger.balance(b.account_id) == bal_b - 5000
+    assert ledger.balance(c.account_id) == bal_c + 5000
+
+
+def test_expired_tx_fails_at_apply_too_late(ledger, root):
+    """commonValid re-runs at apply: a tx whose maxTime passed between
+    validation and apply fails txTOO_LATE (reference
+    commonValid(applying=true))."""
+    a = root.create(10**9)
+    close = ledger.header().scpValue.closeTime
+    f = a.tx([a.op_payment(root.account_id, 1)],
+             time_bounds=TimeBounds(minTime=0, maxTime=close + 6))
+    # valid now, but advance_ledger (+5s) twice pushes past maxTime
+    ledger.advance_ledger()
+    assert not ledger.apply_frame(f)   # second advance inside apply_frame
+    assert f.result.code == TransactionResultCode.txTOO_LATE
