@@ -542,46 +542,48 @@ class FeeBumpTransactionFrame:
             transactionHash=self.inner.contents_hash(),
             result=self.inner.result)
 
+    def _common_valid(self, checker: SignatureChecker, ltx,
+                      applying: bool) -> int:
+        """Outer-envelope checks shared by check_valid and apply
+        (reference FeeBumpTransactionFrame::commonValid): fee floors,
+        fee-source existence, LOW-threshold auth, all-signatures-used,
+        and (when not applying) the fee-source balance."""
+        header = ltx.load_header()
+        if self.fee_bid < self.min_fee(header) or \
+                self.fee_bid < self.inner.fee_bid:
+            return TransactionResultCode.txINSUFFICIENT_FEE
+        src = load_account(ltx, self.source_account_id())
+        if src is None:
+            return TransactionResultCode.txNO_ACCOUNT
+        acc = src.data.value
+        from ..xdr import Signer, SignerKey
+        signers = list(acc.signers)
+        mw = account_master_weight(acc)
+        if mw > 0:
+            signers.append(Signer(
+                key=SignerKey.ed25519(acc.accountID.key_bytes),
+                weight=mw))
+        if not checker.check_signature(
+                signers, account_threshold(acc, ThresholdLevel.LOW)):
+            return TransactionResultCode.txBAD_AUTH
+        if not checker.check_all_signatures_used():
+            return TransactionResultCode.txBAD_AUTH_EXTRA
+        if not applying and account_available_balance(header, acc) < \
+                self.fee_charged(header):
+            return TransactionResultCode.txINSUFFICIENT_BALANCE
+        return TransactionResultCode.txSUCCESS
+
     def check_valid(self, ltx_parent, current_seq: int = 0,
                     verifier=None) -> bool:
         from ..ledger.ledgertxn import LedgerTxn
         verifier = verifier or CpuSigVerifier()
         ltx = LedgerTxn(ltx_parent)
         try:
-            header = ltx.load_header()
-            if self.fee_bid < self.min_fee(header) or \
-                    self.fee_bid < self.inner.fee_bid:
-                self.result = _make_result(
-                    0, TransactionResultCode.txINSUFFICIENT_FEE)
-                return False
-            src = load_account(ltx, self.source_account_id())
-            if src is None:
-                self.result = _make_result(
-                    0, TransactionResultCode.txNO_ACCOUNT)
-                return False
             checker = SignatureChecker(self.contents_hash(),
                                        self.signatures, verifier)
-            acc = src.data.value
-            from ..xdr import Signer, SignerKey
-            signers = list(acc.signers)
-            mw = account_master_weight(acc)
-            if mw > 0:
-                signers.append(Signer(
-                    key=SignerKey.ed25519(acc.accountID.key_bytes),
-                    weight=mw))
-            if not checker.check_signature(
-                    signers, account_threshold(acc, ThresholdLevel.LOW)):
-                self.result = _make_result(
-                    0, TransactionResultCode.txBAD_AUTH)
-                return False
-            if not checker.check_all_signatures_used():
-                self.result = _make_result(
-                    0, TransactionResultCode.txBAD_AUTH_EXTRA)
-                return False
-            if account_available_balance(header, acc) < \
-                    self.fee_charged(header):
-                self.result = _make_result(
-                    0, TransactionResultCode.txINSUFFICIENT_BALANCE)
+            code = self._common_valid(checker, ltx, False)
+            if code != TransactionResultCode.txSUCCESS:
+                self.result = _make_result(0, code)
                 return False
         finally:
             ltx.rollback()
@@ -621,6 +623,21 @@ class FeeBumpTransactionFrame:
             ext=_Ext.v0())
 
     def apply(self, ltx_parent, verifier=None) -> bool:
+        # re-check the OUTER envelope at apply like the reference
+        # (FeeBumpTransactionFrame::apply → commonValid + processSignatures
+        # over the outer signatures): fee-source auth may have changed
+        # since validation, and every outer signature must be used
+        from ..ledger.ledgertxn import LedgerTxn
+        checker = SignatureChecker(self.contents_hash(), self.signatures,
+                                   verifier or CpuSigVerifier())
+        ltx = LedgerTxn(ltx_parent)
+        try:
+            code = self._common_valid(checker, ltx, True)
+            if code != TransactionResultCode.txSUCCESS:
+                self.result = _make_result(self.result.feeCharged, code)
+                return False
+        finally:
+            ltx.rollback()
         self.inner.result = _make_result(
             0, TransactionResultCode.txSUCCESS,
             [None] * len(self.inner.op_frames))

@@ -241,3 +241,28 @@ def test_change_trust_self_not_allowed(ledger, root):
     f = issuer.tx([issuer.op_change_trust(own, 1000)])
     assert not ledger.apply_frame(f)
     assert op_code(f) == ChangeTrustResultCode.SELF_NOT_ALLOWED
+
+
+def test_outer_auth_rechecked_at_apply(ledger, root):
+    """The outer envelope re-validates at apply (reference fee-bump apply
+    runs commonValid + processSignatures over the outer sigs): revoking
+    the sponsor's master key between validation and apply fails the bump
+    with txBAD_AUTH while still charging the fee."""
+    a = root.create(10**9)
+    sponsor = root.create(10**9)
+    inner = a.tx([a.op_payment(root.account_id, 1)], fee=100)
+    f = bump(ledger, sponsor, inner, fee=1000)
+    # validate now (passes), then the sponsor locks itself out
+    from stellar_core_tpu.ledger.ledgertxn import LedgerTxn
+    ltx = LedgerTxn(ledger.root)
+    assert f.check_valid(ltx, 0, None)
+    ltx.rollback()
+    assert ledger.apply_frame(
+        sponsor.tx([sponsor.op_set_options(master_weight=0)]))
+    bal = sponsor.balance()
+    # replay-shaped close: fees/seqs are consumed, then apply re-checks
+    # the outer auth and fails the bump
+    (ok,) = ledger.close_with([f])
+    assert not ok
+    assert f.result.code == TransactionResultCode.txBAD_AUTH
+    assert sponsor.balance() == bal - f.fee_charged(ledger.header())
