@@ -241,3 +241,27 @@ def test_checkquorum_critical_param(app):
     # standalone self-quorum: the single validator is trivially critical
     # or the list is empty — either way the field is present and a list
     assert isinstance(out["intersection_critical"], list)
+
+
+def test_generateload_endpoint():
+    cfg = Config.test_config(9)
+    cfg.DATABASE = "sqlite3://:memory:"
+    cfg.ARTIFICIALLY_GENERATE_LOAD_FOR_TESTING = True
+    a = Application(VirtualClock(ClockMode.VIRTUAL_TIME), cfg)
+    a.start()
+    st, out = cmd(a, "generateload", accounts=5, txs=0)
+    assert st == 200 and "error" not in out, out
+    a.manual_close()
+    st, out = cmd(a, "generateload", accounts=0, txs=8)
+    assert st == 200 and "error" not in out, out
+    a.manual_close()
+    m = a.metrics.to_json()
+    # 5 creates may batch into fewer txs; the 8 payments are 1 tx each
+    assert m["herder.tx.accepted"]["count"] >= 9
+    assert m["ledger.transaction.apply"]["count"] >= 9
+    a.stop()
+
+
+def test_generateload_requires_testing_flag(app):
+    st, out = cmd(app, "generateload", accounts=1, txs=1)
+    assert "error" in out
