@@ -257,15 +257,16 @@ class TestAccount:
     def tx(self, ops: List[Operation], seq: Optional[int] = None,
            fee: Optional[int] = None,
            time_bounds: Optional[TimeBounds] = None,
-           extra_signers: Optional[List[SecretKey]] = None
+           extra_signers: Optional[List[SecretKey]] = None,
+           memo: Optional[Memo] = None,
            ) -> TransactionFrame:
         header = self.ledger.header()
         t = Transaction(
             sourceAccount=self.muxed,
             fee=fee if fee is not None else header.baseFee * len(ops),
             seqNum=seq if seq is not None else self.next_seq(),
-            timeBounds=time_bounds, memo=Memo.none(), operations=ops,
-            ext=_Ext.v0())
+            timeBounds=time_bounds, memo=memo or Memo.none(),
+            operations=ops, ext=_Ext.v0())
         frame = TransactionFrame(
             self.ledger.network_id, TransactionEnvelope.for_tx(t))
         frame.add_signature(self.sk)

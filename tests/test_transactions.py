@@ -570,3 +570,38 @@ def test_expired_tx_fails_at_apply_too_late(ledger, root):
     ledger.advance_ledger()
     assert not ledger.apply_frame(f)   # second advance inside apply_frame
     assert f.result.code == TransactionResultCode.txTOO_LATE
+
+
+def test_muxed_destination_and_memo_types(ledger, root):
+    """Muxed (med25519) destinations resolve to the underlying account
+    and every memo arm survives the wire (reference TxEnvelopeTests memo
+    and muxed coverage)."""
+    from stellar_core_tpu.xdr import (
+        CryptoKeyType, Memo, MemoType, MuxedAccount, MuxedAccountMed25519,
+        PaymentOp, TransactionEnvelope,
+    )
+
+    a = root.create(10**9)
+    b = root.create(10**9)
+    # payment to b through a muxed reference with sub-account id 77
+    muxed_b = MuxedAccount(
+        CryptoKeyType.KEY_TYPE_MUXED_ED25519,
+        MuxedAccountMed25519(id=77, ed25519=b.account_id.key_bytes))
+    for memo in (Memo(MemoType.MEMO_NONE),
+                 Memo(MemoType.MEMO_TEXT, "hello röund 3"),
+                 Memo(MemoType.MEMO_ID, 2**63),
+                 Memo(MemoType.MEMO_HASH, b"\x05" * 32),
+                 Memo(MemoType.MEMO_RETURN, b"\x06" * 32)):
+        bal_b = ledger.balance(b.account_id)
+        frame = a.tx([a.op(OperationBody(
+            OperationType.PAYMENT,
+            PaymentOp(destination=muxed_b, asset=Asset.native(),
+                      amount=111)))], memo=memo)
+        # wire round-trip preserves the memo and muxed id exactly
+        redec = TransactionEnvelope.from_xdr(frame.envelope_bytes())
+        assert redec == frame.envelope
+        assert redec.value.tx.memo == memo
+        assert redec.value.tx.operations[0].body.value.destination \
+            .value.id == 77
+        assert ledger.apply_frame(frame), (memo.disc, frame.result)
+        assert ledger.balance(b.account_id) == bal_b + 111
