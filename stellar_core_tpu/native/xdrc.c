@@ -564,6 +564,35 @@ static PyObject *unpack_node(const Prog *p, long long idx, Rdr *r,
                 return NULL;
             }
             n = w;
+            /* The wire count is attacker-controlled; every XDR item
+               encodes to >= 4 bytes, so a count that cannot fit in the
+               remaining buffer must not drive the list pre-allocation.
+               Grow incrementally instead — decoding then fails with a
+               normal underflow without ever allocating n slots. */
+            if (n > (r->len - r->pos) / 4) {
+                out = PyList_New(0);
+                if (!out)
+                    return NULL;
+                for (i = 0; i < n; i++) {
+                    Py_ssize_t before = r->pos;
+                    PyObject *e = unpack_node(p, nd->b, r, depth);
+                    if (!e || PyList_Append(out, e) < 0) {
+                        Py_XDECREF(e);
+                        Py_DECREF(out);
+                        return NULL;
+                    }
+                    Py_DECREF(e);
+                    if (r->pos == before) {
+                        /* zero-byte element x oversized claimed count:
+                           refuse to spin the full count */
+                        Py_DECREF(out);
+                        PyErr_Format(XdrError, "XDR underflow at %zd",
+                                     r->pos);
+                        return NULL;
+                    }
+                }
+                return out;
+            }
         }
         out = PyList_New(n);
         if (!out)
@@ -688,6 +717,8 @@ static PyObject *py_compile(PyObject *self, PyObject *arg)
         if (!PyTuple_Check(t) || PyTuple_GET_SIZE(t) < 3)
             goto bad;
         op = PyLong_AsLongLong(PyTuple_GET_ITEM(t, 0));
+        if (!PyErr_Occurred() && (op < 0 || op > 10))
+            goto bad; /* reject before the (int) narrowing can alias */
         nd->op = (int)op;
         nd->a = PyLong_AsLongLong(PyTuple_GET_ITEM(t, 1));
         nd->b = PyLong_AsLongLong(PyTuple_GET_ITEM(t, 2));
@@ -773,6 +804,55 @@ static PyObject *py_compile(PyObject *self, PyObject *arg)
             nd->default_child = PyLong_AsLongLong(dflt);
             if (PyErr_Occurred())
                 goto bad;
+        }
+    }
+    /* Second pass: compile() is the memory-safety boundary of the
+       extension — validate every node/child index here so pack_node and
+       unpack_node may index p->nodes unchecked. Sentinels: -1 = void arm,
+       -2 = no default arm; anything else must land in [0, n). */
+    if (n < 1)
+        goto bad;
+    for (i = 0; i < n; i++) {
+        Node *nd = &p->nodes[i];
+        if (nd->op < 0 || nd->op > 10)
+            goto bad;
+        switch (nd->op) {
+        case 0:
+            if (nd->a != 4 && nd->a != 8)
+                goto bad;
+            break;
+        case 2:
+        case 3:
+        case 4:
+            if (nd->a < 0)
+                goto bad;
+            break;
+        case 5:
+        case 6:
+            if (nd->a < 0 || nd->b < 0 || nd->b >= n)
+                goto bad;
+            break;
+        case 7:
+            if (nd->b < 0 || nd->b >= n)
+                goto bad;
+            break;
+        case 9:
+            for (j = 0; j < nd->n_fields; j++)
+                if (nd->children[j] < 0 || nd->children[j] >= n)
+                    goto bad;
+            break;
+        case 10:
+            if (nd->a < 0 || nd->a >= n)
+                goto bad;
+            for (j = 0; j < nd->n_arms; j++)
+                if (nd->arm_child[j] >= n ||
+                    (nd->arm_child[j] < 0 && nd->arm_child[j] != -1))
+                    goto bad;
+            if (nd->default_child >= n ||
+                (nd->default_child < 0 && nd->default_child != -1 &&
+                 nd->default_child != -2))
+                goto bad;
+            break;
         }
     }
     cap = PyCapsule_New(p, "sct.xdrprog", capsule_destructor);

@@ -176,3 +176,77 @@ def test_native_unpack_rejections():
     bad_opt = wire[:off] + b"\x00\x00\x00\x02" + wire[off + 4:]
     with pytest.raises(C.XdrError):
         nf(bad_opt)
+
+
+def test_native_unpack_huge_array_claim_is_cheap():
+    """A 4-byte adversarial message claiming a ~2^30-element array must be
+    rejected without pre-allocating the claimed list (remote-DoS guard on
+    wire-reachable unbounded arrays such as TransactionSet.txs and
+    SCPQuorumSet.validators)."""
+    import time
+    from stellar_core_tpu.native import xdr_unpack_fn
+    nf = xdr_unpack_fn(X.SCPQuorumSet)
+    if nf is None:
+        pytest.skip("native XDR engine unavailable")
+    # threshold=1, validators count = 0x3FFFFFFF, no element bytes
+    wire = b"\x00\x00\x00\x01" + b"\x3f\xff\xff\xff"
+    t0 = time.monotonic()
+    with pytest.raises(C.XdrError):
+        nf(wire)
+    assert time.monotonic() - t0 < 2.0
+    # same shape against the fastcodec oracle: also rejected
+    with pytest.raises(C.XdrError):
+        fastcodec.compile_unpack(X.SCPQuorumSet)(wire, 0)
+    # a legitimate large-but-plausible array still decodes
+    q = X.SCPQuorumSet(
+        threshold=3,
+        validators=[X.PublicKey.ed25519(i.to_bytes(4, "big") * 8)
+                    for i in range(600)],
+        innerSets=[])
+    wire2 = fast_bytes(X.SCPQuorumSet, q)
+    got, end = nf(wire2)
+    assert end == len(wire2) and got == q
+
+
+def test_native_compile_rejects_bad_programs():
+    """compile() is the memory-safety boundary: malformed node/child
+    indices must be rejected at compile time, never dereferenced at
+    pack/unpack time."""
+    from stellar_core_tpu import native
+    native._compile_xdr_ext()
+    mod = native._XDR_MOD
+    if mod is None:
+        pytest.skip("native XDR engine unavailable")
+    good_int = (0, 4, 0)
+    bad_programs = [
+        (),                                        # empty program
+        ((6, 10, 5), good_int),                    # array child out of range
+        ((6, 10, -1), good_int),                   # array child negative
+        ((5, -1, 1), good_int),                    # fixed array negative len
+        ((7, 0, 99),),                             # optional child OOB
+        ((2, -4, 0),),                             # negative opaque size
+        ((99, 0, 0),),                             # unknown opcode
+        ((9, 0, 0, (("f", 7),), _DummyCls),        # struct field OOB
+         good_int),
+        ((10, 5, 0, (((0, 1),), -2), _DummyCls),   # union switch OOB
+         good_int),
+        ((10, 1, 0, (((0, 44),), -2), _DummyCls),  # union arm OOB
+         good_int),
+        ((10, 1, 0, (((0, -1),), 44), _DummyCls),  # union default OOB
+         good_int),
+        ((10, 1, 0, (((0, -2),), -2), _DummyCls),  # arm uses -2 sentinel
+         good_int),
+        ((2**32 + 9, 0, 0),),                      # opcode that truncates
+        ((-(2**32) + 3, 0, 0),),                   # to 9 / 3 via (int) cast
+        ((0, 2, 0),),                              # int size not 4/8
+    ]
+    for spec in bad_programs:
+        with pytest.raises(ValueError):
+            mod.compile(spec)
+    # sanity: the sentinels -1 (void arm) and -2 (no default) still compile
+    ok = mod.compile(((10, 1, 0, (((0, -1),), -2), _DummyCls), good_int))
+    assert ok is not None
+
+
+class _DummyCls:
+    pass
