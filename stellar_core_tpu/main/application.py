@@ -133,10 +133,12 @@ class Application:
         return n
 
     def crank_until(self, pred, max_cranks: int = 100000) -> bool:
+        # every crank path must flush the batch verifier: an enqueue site
+        # that doesn't self-flush would otherwise never complete here
         for _ in range(max_cranks):
             if pred():
                 return True
-            self.clock.crank(False)
+            self.crank(False)
         return pred()
 
     def stop(self) -> None:

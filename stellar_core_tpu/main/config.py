@@ -59,6 +59,8 @@ class Config:
         self.PEER_STRAGGLER_TIMEOUT = 120.0
         self.MAX_BATCH_WRITE_COUNT = 1024
         self.MAX_BATCH_WRITE_BYTES = 1024 * 1024
+        # queued-but-unsent cap per peer; overflowing drops the connection
+        self.PEER_SEND_QUEUE_LIMIT_BYTES = 32 * 1024 * 1024
 
         # herder
         self.EXPECTED_LEDGER_CLOSE_TIME = 5.0
@@ -135,6 +137,9 @@ class Config:
             "MAX_CONCURRENT_SUBPROCESSES", "SIG_VERIFY_BACKEND",
             "SIG_VERIFY_MAX_BATCH", "CHECKPOINT_FREQUENCY",
             "CATCHUP_COMPLETE", "CATCHUP_RECENT",
+            "PEER_TIMEOUT", "PEER_STRAGGLER_TIMEOUT",
+            "MAX_BATCH_WRITE_COUNT", "MAX_BATCH_WRITE_BYTES",
+            "PEER_SEND_QUEUE_LIMIT_BYTES",
         ]
         for k in simple_keys:
             if k in data:

@@ -127,6 +127,11 @@ class OverlayManager:
                 # our pings keep last_write fresh; a peer that answers
                 # nothing for the straggler window is dead or stuck
                 p.drop("straggling (no reads)")
+            elif p.transport.oldest_unsent_age() > \
+                    cfg.PEER_STRAGGLER_TIMEOUT:
+                # a peer that won't drain our writes can't keep up
+                # (reference Peer::idleTimerExpired straggler branch)
+                p.drop("straggling (cannot keep up)")
         missing = cfg.TARGET_PEER_CONNECTIONS - self.num_connections()
         if missing > 0 and self._reactor is not None:
             exclude = [(p.address[0], p.remote_listening_port)
@@ -153,12 +158,22 @@ class OverlayManager:
             log.debug("connect to %s:%d failed: %s", host, port, e)
             self.peer_manager.on_connect_failure(host, port)
             return None
+        self._apply_transport_limits(t)
         peer = Peer(self.app, self, t, PeerRole.WE_CALLED_REMOTE,
                     address=(host, port))
         self.pending_peers.append(peer)
-        self.peer_manager.on_connect_success(host, port)
+        # the dial is async (non-blocking connect): success is recorded
+        # when the peer authenticates, failure when it closes pre-auth
+        # (accept_authenticated_peer / remove_peer), keeping the
+        # peer-table backoff accurate
         peer.connect_handshake()
         return peer
+
+    def _apply_transport_limits(self, t) -> None:
+        cfg = self.app.config
+        t.max_batch_write_count = cfg.MAX_BATCH_WRITE_COUNT
+        t.max_batch_write_bytes = cfg.MAX_BATCH_WRITE_BYTES
+        t.send_queue_limit_bytes = cfg.PEER_SEND_QUEUE_LIMIT_BYTES
 
     def _on_inbound_connection(self, transport, addr) -> None:
         if self.num_connections() >= \
@@ -166,6 +181,7 @@ class OverlayManager:
                 self.app.config.TARGET_PEER_CONNECTIONS:
             transport.close()
             return
+        self._apply_transport_limits(transport)
         peer = Peer(self.app, self, transport, PeerRole.REMOTE_CALLED_US,
                     address=(addr[0], addr[1]))
         self.pending_peers.append(peer)
@@ -184,6 +200,10 @@ class OverlayManager:
     def accept_authenticated_peer(self, peer: Peer) -> bool:
         """Handshake finished: move pending → authenticated
         (reference moveToAuthenticated/acceptAuthenticatedPeer)."""
+        # the transport + handshake worked: whatever happens next (ban,
+        # duplicate-connection tiebreak) must NOT count toward the
+        # connect-failure backoff
+        peer.ever_authenticated = True
         key = peer.peer_id.to_xdr()
         if self.ban_manager.is_banned(peer.peer_id):
             peer.drop("banned")
@@ -205,6 +225,8 @@ class OverlayManager:
         if peer in self.pending_peers:
             self.pending_peers.remove(peer)
         self.authenticated_peers[key] = peer
+        if peer.role == PeerRole.WE_CALLED_REMOTE and peer.address:
+            self.peer_manager.on_connect_success(*peer.address)
         m = getattr(self.app, "metrics", None)
         if m is not None:
             m.new_meter("overlay.connection.authenticated").mark()
@@ -217,6 +239,11 @@ class OverlayManager:
     def remove_peer(self, peer: Peer) -> None:
         if peer in self.pending_peers:
             self.pending_peers.remove(peer)
+        if peer.role == PeerRole.WE_CALLED_REMOTE and peer.address and \
+                not peer.ever_authenticated:
+            # an outbound dial that died before authenticating (incl.
+            # async connect failures) counts toward the backoff
+            self.peer_manager.on_connect_failure(*peer.address)
         if peer.peer_id is not None:
             key = peer.peer_id.to_xdr()
             if self.authenticated_peers.get(key) is peer:

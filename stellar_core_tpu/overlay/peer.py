@@ -65,6 +65,7 @@ class Peer:
         self.bytes_written = 0
         self.connected_at = app.clock.now()
         self.dropped = False
+        self.ever_authenticated = False
         transport.on_frame = self._on_frame
         transport.on_closed = self._on_closed
 

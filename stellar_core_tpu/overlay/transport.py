@@ -21,6 +21,7 @@ import errno
 import socket
 import struct
 import threading
+import time
 from collections import deque
 from typing import Callable, Deque, Dict, Optional, Tuple
 
@@ -44,6 +45,12 @@ class Transport:
 
     def close(self) -> None:
         raise NotImplementedError
+
+    def oldest_unsent_age(self) -> float:
+        """Seconds the oldest enqueued-but-unsent frame has been waiting
+        (0 when the send queue is drained). Drives the straggler timeout
+        (reference Peer::idleTimerExpired mEnqueueTimeOfLastWrite check)."""
+        return 0.0
 
 
 class LoopbackTransport(Transport):
@@ -177,7 +184,14 @@ class TCPReactor:
             with self._lock:
                 transports = dict(self._transports)
                 doors = dict(self._doors)
-            rlist = [self._wake_r] + list(doors) + list(transports)
+            # in-progress connects: fail the ones past their deadline; the
+            # rest are watched for writability (= connect completion)
+            now = time.monotonic()
+            for t in transports.values():
+                if t.connecting and now > t.connect_deadline:
+                    t._fail()
+            rlist = [self._wake_r] + list(doors) + \
+                [s for s, t in transports.items() if not t.connecting]
             wlist = [s for s, t in transports.items() if t.wants_write()]
             try:
                 r, w, _ = select.select(rlist, wlist, [], 0.25)
@@ -215,52 +229,156 @@ class TCPReactor:
 
 
 class TCPTransport(Transport):
+    # write batching limits (reference Config MAX_BATCH_WRITE_COUNT/BYTES;
+    # the overlay manager overrides these from its Config)
+    max_batch_write_count = 1024
+    max_batch_write_bytes = 1024 * 1024
+    # hard cap on queued-but-unsent bytes: exceeding it drops the
+    # connection (a peer this far behind is a straggler, and an unbounded
+    # queue lets a stuck reader consume all memory)
+    send_queue_limit_bytes = 32 * 1024 * 1024
+    connect_timeout = 5.0
+
     def __init__(self, reactor: TCPReactor, sock: socket.socket) -> None:
         self.reactor = reactor
         self.sock = sock
         self.on_frame = lambda raw: None
         self.on_closed = lambda: None
         self.closed = False
+        self._failed = False
         self._rbuf = b""
         self._wlock = threading.Lock()
-        self._wqueue: Deque[bytes] = deque()
+        # (framed bytes, enqueue monotonic ts) pairs not yet batched
+        self._wqueue: Deque[Tuple[bytes, float]] = deque()
+        self._wqueue_bytes = 0
+        # coalesced in-flight batch (prefix of the former queue)
+        self._wbatch: Optional[memoryview] = None
+        self._wbatch_head_ts = 0.0
+        self.connecting = False
+        self.connect_deadline = 0.0
 
     @classmethod
     def connect(cls, reactor: TCPReactor, host: str,
                 port: int) -> "TCPTransport":
-        sock = socket.create_connection((host, port), timeout=5.0)
-        sock.setblocking(False)
-        sock.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+        """Begin a NON-blocking connect; the reactor completes it (connect
+        success = writable, failure = SO_ERROR / deadline). Frames queued
+        meanwhile flush once connected. The caller never blocks (reference
+        TCPPeer::initiate asio async_connect)."""
+        # numeric addresses (either family) resolve without blocking; a
+        # hostname falls back to a blocking getaddrinfo, as the previous
+        # create_connection-based dial also did
+        try:
+            infos = socket.getaddrinfo(
+                host, port, type=socket.SOCK_STREAM,
+                flags=socket.AI_NUMERICHOST)
+        except socket.gaierror:
+            infos = socket.getaddrinfo(host, port, type=socket.SOCK_STREAM)
+        # try each resolved address for an immediately-failing dial
+        # (create_connection's fallback role); an address that fails only
+        # asynchronously is retried via the peer-table backoff
+        sock = None
+        err = 0
+        for family, stype, proto, _cn, addr in infos:
+            try:
+                sock = socket.socket(family, stype, proto)
+            except OSError:
+                continue
+            sock.setblocking(False)
+            err = sock.connect_ex(addr)
+            if err in (0, errno.EINPROGRESS, errno.EWOULDBLOCK):
+                break
+            sock.close()
+            sock = None
+        if sock is None:
+            raise OSError(err, "connect to %s:%d: %s"
+                          % (host, port, errno.errorcode.get(err, err)))
         t = cls(reactor, sock)
+        t.connecting = err != 0
+        t.connect_deadline = time.monotonic() + cls.connect_timeout
+        if not t.connecting:
+            t._connected()
         reactor.add_transport(t)
         return t
 
+    def _connected(self) -> None:
+        try:
+            self.sock.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+        except OSError:
+            pass
+
     def wants_write(self) -> bool:
+        if self.connecting:
+            return True
         with self._wlock:
-            return bool(self._wqueue)
+            return self._wbatch is not None or bool(self._wqueue)
+
+    def oldest_unsent_age(self) -> float:
+        with self._wlock:
+            if self._wbatch is not None:
+                return time.monotonic() - self._wbatch_head_ts
+            if self._wqueue:
+                return time.monotonic() - self._wqueue[0][1]
+        return 0.0
 
     def send_frame(self, raw: bytes) -> None:
-        if self.closed:
-            return
+        framed = struct.pack(">I", len(raw) | _LAST_FRAG) + raw
         with self._wlock:
-            self._wqueue.append(struct.pack(">I", len(raw) | _LAST_FRAG) + raw)
+            # closed/_failed must be read under the lock: a frame racing
+            # _fail()'s queue-clear would otherwise pin bytes on a dead
+            # transport forever
+            if self.closed or self._failed:
+                return
+            self._wqueue.append((framed, time.monotonic()))
+            self._wqueue_bytes += len(framed)
+            overflow = self._wqueue_bytes > self.send_queue_limit_bytes
+        if overflow:
+            log.debug("send queue overflow (> %d bytes), dropping peer",
+                      self.send_queue_limit_bytes)
+            self._fail()
+            return
         self.reactor.wake()
 
     def handle_write(self) -> None:
+        if self.connecting:
+            err = self.sock.getsockopt(socket.SOL_SOCKET, socket.SO_ERROR)
+            if err != 0:
+                self._fail()
+                return
+            self.connecting = False
+            self._connected()
+        failed = False
         with self._wlock:
-            while self._wqueue:
-                buf = self._wqueue[0]
+            while not failed:
+                if self._wbatch is None:
+                    if not self._wqueue:
+                        break
+                    # coalesce a queue prefix into ONE send, bounded by
+                    # the batch limits (reference TCPPeer::messageSender
+                    # scatter-gather snapshot, TCPPeer.cpp:225-267)
+                    bufs = []
+                    total = 0
+                    self._wbatch_head_ts = self._wqueue[0][1]
+                    while self._wqueue and \
+                            len(bufs) < self.max_batch_write_count and \
+                            total < self.max_batch_write_bytes:
+                        b, _ts = self._wqueue.popleft()
+                        bufs.append(b)
+                        total += len(b)
+                    self._wqueue_bytes -= total
+                    self._wbatch = memoryview(b"".join(bufs))
                 try:
-                    n = self.sock.send(buf)
+                    n = self.sock.send(self._wbatch)
                 except OSError as e:
                     if e.errno in (errno.EAGAIN, errno.EWOULDBLOCK):
-                        return
-                    self._fail()
-                    return
-                if n < len(buf):
-                    self._wqueue[0] = buf[n:]
-                    return
-                self._wqueue.popleft()
+                        break
+                    failed = True   # _fail() re-takes the lock: call it
+                    break           # only after leaving the locked region
+                if n < len(self._wbatch):
+                    self._wbatch = self._wbatch[n:]
+                    break
+                self._wbatch = None
+        if failed:
+            self._fail()
 
     def handle_read(self) -> None:
         try:
@@ -291,8 +409,17 @@ class TCPTransport(Transport):
                 lambda f=frame: None if self.closed else self.on_frame(f))
 
     def _fail(self) -> None:
-        if self.closed:
-            return
+        with self._wlock:
+            if self.closed or self._failed:
+                return
+            # mark failed immediately (the posted _notify_closed may not
+            # run until the current main-loop handler returns) and release
+            # the buffered backlog — a dead transport must neither accept
+            # nor pin more bytes
+            self._failed = True
+            self._wqueue.clear()
+            self._wqueue_bytes = 0
+            self._wbatch = None
         self.reactor.remove_transport(self)
         try:
             self.sock.close()

@@ -495,10 +495,13 @@ class InflationOpFrame(OperationFrame):
             dest_id = AccountID.from_xdr(k)
             dest = load_account(ltx, dest_id)
             if dest is None:
-                continue  # missing winner: nothing doled (v>=10 rule)
-            share = min(share, max_amount_receive(header, dest))
-            if share == 0:
-                continue
+                continue  # missing winner: nothing doled
+            if header.ledgerVersion >= 10:
+                # pre-10 has no receive clamp: an overflowing payout
+                # throws below (reference InflationOpFrame.cpp:80-100)
+                share = min(share, max_amount_receive(header, dest))
+                if share == 0:
+                    continue
             if not add_balance(header, dest, share):
                 raise RuntimeError("inflation overflowed winner balance")
             left -= share

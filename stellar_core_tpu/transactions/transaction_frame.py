@@ -106,6 +106,20 @@ def _make_result(fee_charged: int, code: int,
                              ext=_Ext.v0())
 
 
+# commonValid failure codes reached BEFORE the sequence-number stage: a tx
+# failing with one of these at apply does NOT consume its seq num
+# (reference ValidationType kInvalid vs kInvalidUpdateSeqNum ladder,
+# TransactionFrame.cpp:443-502)
+_PRE_SEQ_FAILURES = frozenset((
+    TransactionResultCode.txTOO_EARLY,
+    TransactionResultCode.txTOO_LATE,
+    TransactionResultCode.txMISSING_OPERATION,
+    TransactionResultCode.txINSUFFICIENT_FEE,
+    TransactionResultCode.txNO_ACCOUNT,
+    TransactionResultCode.txBAD_SEQ,
+))
+
+
 class TransactionFrame:
     def __init__(self, network_id: bytes,
                  envelope: TransactionEnvelope) -> None:
@@ -125,6 +139,7 @@ class TransactionFrame:
         self._env_sig_fp: tuple = ()
         self.op_metas: List[list] = []     # per-op LedgerEntryChanges
         self.fee_meta: list = []           # fee/seq processing changes
+        self.tx_changes: list = []         # apply-time seq/signer changes
 
     # -- identity -----------------------------------------------------------
     @classmethod
@@ -193,6 +208,16 @@ class TransactionFrame:
             self._full_hash = sha256(b)
         return self._full_hash
 
+    def invalidate_caches(self) -> None:
+        """Drop every cached serialization/hash. Mutating any tx BODY
+        field after first serialization (test/fuzz harnesses do this)
+        must be followed by this call — the envelope_bytes fingerprint
+        only tracks the signature list."""
+        self._contents_hash = None
+        self._env_bytes = None
+        self._full_hash = None
+        self._env_sig_fp = ()
+
     def add_signature(self, secret_key) -> None:
         """Sign the CONTENTS HASH (reference SignatureUtils::sign signs
         sha256(signature payload), not the raw payload)."""
@@ -207,7 +232,7 @@ class TransactionFrame:
         downstream-consumer form — not part of any consensus hash)."""
         from ..xdr import OperationMeta, TransactionMeta, TransactionMetaV1
         return TransactionMeta(1, TransactionMetaV1(
-            txChanges=[],
+            txChanges=list(self.tx_changes),
             operations=[OperationMeta(changes=ch) for ch in self.op_metas]))
 
     def candidate_sig_triples(self, ltx, signer_cache: Optional[dict] = None
@@ -254,12 +279,13 @@ class TransactionFrame:
         if src is None:
             return TransactionResultCode.txNO_ACCOUNT
         acc = src.data.value
-        if not applying:
-            # at apply the sequence number was already consumed by the
-            # close's fee/seq phase (reference commonValid skips the seq
-            # check when applying from protocol 10)
+        if not applying or header.ledgerVersion >= 10:
+            # pre-10 the sequence number was consumed when taking fees, so
+            # the apply-time check is skipped; from v10 it is consumed
+            # during apply and re-checked here (reference commonValid
+            # TransactionFrame.cpp:462-475, isBadSeq:438)
             seq = current_seq if current_seq != 0 else acc.seqNum
-            if self.tx.seqNum != seq + 1:
+            if seq == 2**63 - 1 or self.tx.seqNum != seq + 1:
                 return TransactionResultCode.txBAD_SEQ
         if not self._check_signature(checker, acc, ThresholdLevel.LOW):
             return TransactionResultCode.txBAD_AUTH
@@ -334,10 +360,28 @@ class TransactionFrame:
         acc = src.data.value
         fee = min(fee, max(0, acc.balance))
         acc.balance -= fee
-        acc.seqNum = self.tx.seqNum
+        if header.ledgerVersion <= 9:
+            # older protocols consumed the sequence number when taking
+            # fees; from v10 it is consumed during apply (reference
+            # processFeeSeqNum:530-538 vs processSeqNum:369-379)
+            acc.seqNum = self.tx.seqNum
         header.feePool += fee
         self.result = _make_result(fee, TransactionResultCode.txSUCCESS,
                                    [None] * len(self.op_frames))
+
+    def _process_seq_num(self, ltx) -> None:
+        """Consume the sequence number during apply, protocol >= 10
+        (reference processSeqNum:369-379); runs even when the tx itself
+        fails post-seq-stage validation."""
+        header = ltx.load_header()
+        if header.ledgerVersion < 10:
+            return
+        src = load_account(ltx, self.source_account_id())
+        assert src is not None, "seq processing on missing account"
+        acc = src.data.value
+        if acc.seqNum > self.tx.seqNum:
+            raise RuntimeError("unexpected account state in seq processing")
+        acc.seqNum = self.tx.seqNum
 
     # -- apply --------------------------------------------------------------
     def process_signatures(self, checker: SignatureChecker, ltx) -> bool:
@@ -369,32 +413,48 @@ class TransactionFrame:
         checker = SignatureChecker(self.contents_hash(), self.signatures,
                                    verifier)
         fee = self.result.feeCharged
-        ltx = LedgerTxn(ltx_parent)
+        # phase 1 — tx-level txn: apply-time commonValid re-check (state
+        # may have changed since nomination) against the SAME checker as
+        # the per-op checks, plus the v10+ sequence-number consumption.
+        # This txn COMMITS into the close even when the tx (or later, an
+        # op) fails — a failed tx still burns its seq num (reference
+        # apply:778-835, ltxTx commit :806).
+        ltx_tx = LedgerTxn(ltx_parent)
         try:
-            # re-verify seq/auth at apply time (state may have changed since
-            # nomination; reference commonValid(applying=true) path)
-            # full commonValid in applying mode against the SAME checker
-            # as the per-op checks (reference apply → commonValid(checker)
-            # before processSignatures): re-checks time bounds and auth at
-            # the applying ledger — and consumes the tx source's
-            # signature, so checkAllSignaturesUsed doesn't flag it as
-            # dangling when every op has its own source account
-            code = self._common_valid(checker, ltx, 0, True)
-            if code != TransactionResultCode.txSUCCESS:
-                self.result = _make_result(fee, code)
-                ltx.rollback()
-                return False
-            if not self.process_signatures(checker, ltx):
-                ltx.rollback()
-                return False
-            # apply every op (even after a failure) inside nested txns; the
-            # outer txn rolls back wholesale if any failed — reference
-            # applyOperations semantics
+            code = self._common_valid(checker, ltx_tx, 0, True)
+            if code not in _PRE_SEQ_FAILURES:
+                # validation got past the seq-num stage (reference
+                # cv >= kInvalidUpdateSeqNum → processSeqNum)
+                self._process_seq_num(ltx_tx)
+            sigs_ok = code == TransactionResultCode.txSUCCESS and \
+                self.process_signatures(checker, ltx_tx)
+            self.tx_changes = delta_to_changes(ltx_tx.get_delta())
+            ltx_tx.commit()
+        except Exception:
+            self.result = _make_result(
+                fee, TransactionResultCode.txINTERNAL_ERROR)
+            self.tx_changes = []
+            if ltx_tx._open:
+                ltx_tx.rollback()   # never leave the nested txn
+                # registered: the NEXT frame's LedgerTxn(parent) asserts
+            return False
+        if code != TransactionResultCode.txSUCCESS:
+            self.result = _make_result(fee, code)
+            return False
+        if not sigs_ok:
+            # process_signatures set the result
+            return False
+        # phase 2 — apply every op (even after a failure) inside nested
+        # txns; the ops-level txn rolls back wholesale if any failed —
+        # reference applyOperations semantics — while the committed seq
+        # consumption above survives, including on internal errors
+        ops_ltx = LedgerTxn(ltx_parent)
+        try:
             ok = True
             op_results = []
             op_metas = []
             for f in self.op_frames:
-                op_ltx = LedgerTxn(ltx)
+                op_ltx = LedgerTxn(ops_ltx)
                 try:
                     if f.apply(op_ltx):
                         op_metas.append(delta_to_changes(op_ltx.get_delta()))
@@ -411,18 +471,17 @@ class TransactionFrame:
             if ok:
                 self.result = _make_result(
                     fee, TransactionResultCode.txSUCCESS, op_results)
-                ltx.commit()
-                return True
-            self.result = _make_result(
-                fee, TransactionResultCode.txFAILED, op_results)
-            ltx.rollback()
-            return False
+                ops_ltx.commit()
+            else:
+                self.result = _make_result(
+                    fee, TransactionResultCode.txFAILED, op_results)
+                ops_ltx.rollback()
+            return ok
         except Exception:
             self.result = _make_result(
                 fee, TransactionResultCode.txINTERNAL_ERROR)
-            if ltx._open:
-                ltx.rollback()   # never leave the nested txn registered:
-                # the NEXT frame's LedgerTxn(parent) would assert
+            if ops_ltx._open:
+                ops_ltx.rollback()
             return False
 
     def result_pair(self) -> TransactionResultPair:
@@ -611,10 +670,10 @@ class FeeBumpTransactionFrame:
         fee = min(fee, max(0, acc.balance))
         acc.balance -= fee
         header.feePool += fee
-        # inner seq num is consumed too
-        inner_src = load_account(ltx, self.inner.source_account_id())
-        if inner_src is not None:
-            inner_src.data.value.seqNum = self.inner.seq_num
+        # the inner seq num is NOT consumed here: fee bumps exist only at
+        # protocol >= 13, where sequence numbers are consumed during the
+        # inner tx's apply (reference FeeBumpTransactionFrame
+        # processFeeSeqNum:343-367 charges the fee source only)
         self.result = TransactionResult(
             feeCharged=fee,
             result=_TxResultResult(

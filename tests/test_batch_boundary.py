@@ -195,3 +195,39 @@ def test_aot_warmup_compiles_all_buckets():
     assert res == [True]
     if cache_size_fn:
         assert cache_size_fn() == before, "flush after warmup recompiled"
+
+
+def test_crank_until_flushes_pending_verifies():
+    """crank_until must route through the same flush-bearing crank path as
+    crank(): an enqueue site that does NOT self-flush (here: a raw
+    sig_verifier.enqueue) still completes under crank_until. Regression for
+    the crank_until loop bypassing Application.crank's verifier flush."""
+    import time
+
+    from stellar_core_tpu.main.application import Application
+    from stellar_core_tpu.main.config import Config
+    from stellar_core_tpu.testing import root_secret_key
+    from stellar_core_tpu.util.timer import ClockMode, VirtualClock
+
+    _clear_verify_cache()
+    cfg = Config.test_config(0, backend="tpu-async")
+    cfg.SIG_VERIFY_WARMUP = False
+    clock = VirtualClock(ClockMode.VIRTUAL_TIME)
+    app = Application(clock, cfg)
+    assert isinstance(app.sig_verifier, ThreadedBatchVerifier)
+    app.sig_verifier.inner.BUCKETS = (32,)
+    app.start()
+
+    sk = root_secret_key()
+    msg = b"crank-until-flush"
+    fut = app.sig_verifier.enqueue(sk.public_key, sk.sign(msg), msg)
+    assert not fut.done()
+
+    # pace the cranks: the worker thread needs wall time for the device
+    # call (CPU-jit compile on first dispatch)
+    def settled():
+        time.sleep(0.002)
+        return fut.done()
+
+    assert app.crank_until(settled, max_cranks=100000)
+    assert fut.result() is True

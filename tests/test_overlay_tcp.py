@@ -192,3 +192,185 @@ def test_tcp_midstream_disconnect_recovers():
         assert ok, "survivors stopped closing ledgers after disconnect"
     finally:
         _shutdown(apps)
+
+
+# ---------------------------------------------------------------- transport
+# Write coalescing / queue bounds / straggler handling
+# (reference TCPPeer.cpp:457-518 messageSender batch limits +
+#  Peer::idleTimerExpired straggler branch, Config MAX_BATCH_WRITE_*)
+
+def _reactor():
+    from stellar_core_tpu.overlay.transport import TCPReactor
+    clock = VirtualClock(ClockMode.REAL_TIME)
+    r = TCPReactor(clock)
+    r.start()
+    return clock, r
+
+
+def test_tcp_transport_write_coalescing_preserves_frames():
+    """Batched writes under MAX_BATCH_WRITE_COUNT/BYTES deliver every
+    frame byte-identically, in order."""
+    from stellar_core_tpu.overlay.transport import TCPTransport
+    clock, reactor = _reactor()
+    srv = socket.socket()
+    srv.bind(("127.0.0.1", 0))
+    srv.listen(1)
+    try:
+        t = TCPTransport.connect(reactor, *srv.getsockname())
+        t.max_batch_write_count = 4       # force many small batches
+        t.max_batch_write_bytes = 64
+        conn, _ = srv.accept()
+        frames = [bytes([i]) * (10 + i) for i in range(30)]
+        for f in frames:
+            t.send_frame(f)
+        expect = b"".join(
+            struct.pack(">I", len(f) | 0x80000000) + f for f in frames)
+        conn.settimeout(10)
+        got = b""
+        while len(got) < len(expect):
+            chunk = conn.recv(65536)
+            assert chunk, "connection closed early"
+            got += chunk
+        assert got == expect
+        conn.close()
+        t.close()
+    finally:
+        reactor.stop()
+        srv.close()
+
+
+def test_tcp_transport_stuck_reader_queue_overflow_drops():
+    """A reader that never drains fills the kernel buffer, then our
+    per-peer queue cap trips and the connection is dropped — the reactor
+    never blocks and memory stays bounded."""
+    from stellar_core_tpu.overlay.transport import TCPTransport
+    clock, reactor = _reactor()
+    srv = socket.socket()
+    srv.bind(("127.0.0.1", 0))
+    srv.listen(1)
+    try:
+        t = TCPTransport.connect(reactor, *srv.getsockname())
+        t.send_queue_limit_bytes = 64 * 1024
+        conn, _ = srv.accept()          # accepted but NEVER read
+        closed = []
+        t.on_closed = lambda: closed.append(1)
+        payload = b"x" * 8192
+        deadline = time.time() + 30
+        while not closed and time.time() < deadline:
+            for _ in range(64):
+                t.send_frame(payload)   # ~512 KiB per burst
+            clock.crank(False)
+            time.sleep(0.002)
+        assert closed, "stuck reader was never dropped"
+        assert t.oldest_unsent_age() == 0.0 or t.closed
+        conn.close()
+    finally:
+        reactor.stop()
+        srv.close()
+
+
+def test_tcp_transport_oldest_unsent_age_tracks_stall():
+    """oldest_unsent_age() grows while a peer refuses to drain writes —
+    the signal the overlay tick uses for straggler disconnects."""
+    from stellar_core_tpu.overlay.transport import TCPTransport
+    clock, reactor = _reactor()
+    srv = socket.socket()
+    srv.bind(("127.0.0.1", 0))
+    srv.listen(1)
+    try:
+        t = TCPTransport.connect(reactor, *srv.getsockname())
+        conn, _ = srv.accept()          # never read
+        payload = b"y" * 65536
+        for _ in range(128):            # 8 MiB >> loopback kernel buffers
+            t.send_frame(payload)
+        time.sleep(0.4)
+        assert t.oldest_unsent_age() >= 0.25
+        conn.close()
+        t.close()
+    finally:
+        reactor.stop()
+        srv.close()
+
+
+def test_tcp_nonblocking_connect_failure_reported_async():
+    """connect() never blocks the caller; a refused/unreachable dial is
+    reported through on_closed by the reactor."""
+    from stellar_core_tpu.overlay.transport import TCPTransport
+    clock, reactor = _reactor()
+    s = socket.socket()
+    s.bind(("127.0.0.1", 0))
+    port = s.getsockname()[1]
+    s.close()                           # nothing listens here now
+    try:
+        t0 = time.time()
+        try:
+            t = TCPTransport.connect(reactor, "127.0.0.1", port)
+        except OSError:
+            return                      # synchronous refusal: also fine
+        assert time.time() - t0 < 0.5, "connect() blocked the caller"
+        closed = []
+        t.on_closed = lambda: closed.append(1)
+        deadline = time.time() + 10
+        while not closed and time.time() < deadline:
+            clock.crank(False)
+            time.sleep(0.002)
+        assert closed, "failed connect never reported"
+    finally:
+        reactor.stop()
+
+
+def test_straggler_peer_dropped_by_tick():
+    """An authenticated peer whose write queue stops draining is dropped
+    with the reference's straggler semantics."""
+    apps = _mesh(2, BASE_PORT + 40)
+    try:
+        assert _crank_all(
+            apps, 30, lambda: all(
+                a.overlay_manager.get_authenticated_peers_count() >= 1
+                for a in apps))
+        om = apps[0].overlay_manager
+        p = next(iter(om.authenticated_peers.values()))
+        p.transport.oldest_unsent_age = lambda: 10**6  # simulate stall
+        assert _crank_all(
+            apps, 15, lambda: p.dropped), "straggler peer was not dropped"
+    finally:
+        _shutdown(apps)
+
+
+def test_tcp_transport_reset_midwrite_no_deadlock():
+    """A peer that RSTs the connection while we're writing must fail the
+    transport (on_closed fires) without deadlocking the reactor thread
+    (regression: _fail() called under the write lock)."""
+    from stellar_core_tpu.overlay.transport import TCPTransport
+    clock, reactor = _reactor()
+    srv = socket.socket()
+    srv.bind(("127.0.0.1", 0))
+    srv.listen(1)
+    try:
+        t = TCPTransport.connect(reactor, *srv.getsockname())
+        conn, _ = srv.accept()
+        # arm RST-on-close, then close: subsequent sends get ECONNRESET
+        conn.setsockopt(socket.SOL_SOCKET, socket.SO_LINGER,
+                        struct.pack("ii", 1, 0))
+        conn.close()
+        closed = []
+        t.on_closed = lambda: closed.append(1)
+        payload = b"z" * 65536
+        deadline = time.time() + 20
+        while not closed and time.time() < deadline:
+            for _ in range(16):
+                t.send_frame(payload)
+            clock.crank(False)
+            time.sleep(0.002)
+        assert closed, "reset connection never reported closed"
+        # reactor thread is still alive and serving: a fresh connect works
+        t2 = TCPTransport.connect(reactor, *srv.getsockname())
+        conn2, _ = srv.accept()
+        t2.send_frame(b"ping")
+        conn2.settimeout(5)
+        assert conn2.recv(8) == struct.pack(">I", 4 | 0x80000000) + b"ping"
+        conn2.close()
+        t2.close()
+    finally:
+        reactor.stop()
+        srv.close()

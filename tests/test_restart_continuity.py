@@ -233,17 +233,25 @@ def test_txmeta_and_feehistory_rows(tmp_path):
     # payment: STATE+UPDATED for each of the two touched accounts
     assert kinds == [CT.LEDGER_ENTRY_STATE, CT.LEDGER_ENTRY_UPDATED,
                      CT.LEDGER_ENTRY_STATE, CT.LEDGER_ENTRY_UPDATED]
+    # v10+: the seq consumption happens at APPLY and lands in the tx
+    # meta's txChanges (reference txChangesBefore), not the fee row
+    tx_kinds = [c.disc for c in meta.value.txChanges]
+    assert tx_kinds == [CT.LEDGER_ENTRY_STATE, CT.LEDGER_ENTRY_UPDATED]
+    tst = meta.value.txChanges[0].value.data.value
+    tup = meta.value.txChanges[1].value.data.value
+    assert tup.seqNum == tst.seqNum + 1        # seq consumed at apply
     frow = app.database.execute(
         "SELECT txchanges FROM txfeehistory WHERE ledgerseq = ?", (seq,)
     ).fetchone()
     changes = xdr_from(LedgerEntryChanges, frow[0])
-    # fee+seq consume: STATE + UPDATED on the source account
+    # fee only: STATE + UPDATED on the source account (v10+ does not
+    # touch the seq num when taking fees)
     assert [c.disc for c in changes] == [CT.LEDGER_ENTRY_STATE,
                                          CT.LEDGER_ENTRY_UPDATED]
     st = changes[0].value.data.value
     up = changes[1].value.data.value
     assert up.balance == st.balance - 100      # fee charged
-    assert up.seqNum == st.seqNum + 1          # seq consumed
+    assert up.seqNum == st.seqNum              # seq untouched at fee time
 
 
 def test_schema_v1_migrates_to_v2(tmp_path):

@@ -605,3 +605,40 @@ def test_muxed_destination_and_memo_types(ledger, root):
             .value.id == 77
         assert ledger.apply_frame(frame), (memo.disc, frame.result)
         assert ledger.balance(b.account_id) == bal_b + 111
+
+
+def test_seq_consumed_at_apply_not_fee_time(ledger, root):
+    """v10+ semantics: sequence numbers are consumed during APPLY, not when
+    taking fees (reference processFeeSeqNum:530-538 consumes only <= v9;
+    processSeqNum:369-379 consumes at apply from v10). A tx whose source
+    seq was bumped past it by an EARLIER tx in the same set fails txBAD_SEQ
+    at apply — fee charged, seq NOT consumed."""
+    from stellar_core_tpu.xdr import BumpSequenceOp
+    a = root.create(10**9)
+    cur = ledger.seq_num(a.account_id)
+    # tx1: root-sourced, bumps a's seq far ahead (op source = a, so a
+    # must co-sign)
+    tx1 = root.tx([root.op(OperationBody(
+        OperationType.BUMP_SEQUENCE, BumpSequenceOp(bumpTo=cur + 50)),
+        source=a.account_id)], extra_signers=[a.sk])
+    # tx2: a's own payment at the seq it would normally use
+    tx2 = a.tx([a.op_payment(root.account_id, 100)], seq=cur + 1)
+    results = ledger.close_with([tx1, tx2])
+    assert results == [True, False]
+    assert tx2.result.result.disc == TransactionResultCode.txBAD_SEQ
+    # the bump survives; tx2's failed apply did not consume cur+1
+    assert ledger.seq_num(a.account_id) == cur + 50
+    # both fees were still charged in the fee phase
+    assert ledger.balance(a.account_id) == 10**9 - 100
+
+
+def test_failed_op_still_consumes_seq(ledger, root):
+    """A tx that passes commonValid at apply but fails in its operations
+    still consumes its seq num (the tx-level child txn commits even when
+    the ops roll back; reference apply ltxTx commit :806)."""
+    a = root.create(10**9)
+    cur = ledger.seq_num(a.account_id)
+    f = a.tx([a.op_payment(root.account_id, 10**12)])  # UNDERFUNDED
+    assert not ledger.apply_frame(f)
+    assert f.result.result.disc == TransactionResultCode.txFAILED
+    assert ledger.seq_num(a.account_id) == cur + 1
