@@ -133,6 +133,21 @@ def cmd_new_hist(args) -> int:
         from ..history.archive import WELL_KNOWN
         from ..history.archive_state import HistoryArchiveState
         import tempfile, os
+        # initializing an EXISTING history store must fail (reference
+        # HistoryTests.cpp:1221 "initialize existing history store fails")
+        fd, probe = tempfile.mkstemp(suffix=".json")
+        os.close(fd)
+        try:
+            exists = arch.has_get() and \
+                arch.get_file_sync(WELL_KNOWN, probe) and \
+                os.path.getsize(probe) > 0
+        finally:
+            os.unlink(probe)
+        if exists:
+            print("archive %r already initialized; refusing to overwrite"
+                  % name, file=sys.stderr)
+            ok = False
+            continue
         has = HistoryArchiveState(
             app.ledger_manager.last_closed_ledger_num())
         with tempfile.NamedTemporaryFile("w", suffix=".json",
