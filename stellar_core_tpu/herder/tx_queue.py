@@ -40,8 +40,11 @@ class TransactionQueue:
         self.ban_depth = ban_depth
         self.pool_multiplier = pool_ledger_multiplier
         self.verifier = verifier
-        # account -> list[(age, frame)] sorted by seq
-        self._pending: Dict[bytes, List[Tuple[int, object]]] = {}
+        # account -> list[frame] sorted by seq; ages are PER ACCOUNT
+        # (reference AccountState.mAge: ledgers since the account last
+        # had a tx applied — the whole chain expires together)
+        self._pending: Dict[bytes, List[object]] = {}
+        self._ages: Dict[bytes, int] = {}
         self._known_hashes: Dict[bytes, bytes] = {}  # full hash -> acc
         self._banned: List[set] = [set() for _ in range(ban_depth)]
         # running fee-bid total per FEE source (reference per-account
@@ -63,7 +66,7 @@ class TransactionQueue:
     # -- queries ------------------------------------------------------------
     def size_ops(self) -> int:
         return sum(f.num_operations() for chain in self._pending.values()
-                   for _, f in chain)
+                   for f in chain)
 
     def is_banned(self, tx_hash: bytes) -> bool:
         return any(tx_hash in b for b in self._banned)
@@ -85,7 +88,7 @@ class TransactionQueue:
         chain = self._pending.get(acc, [])
         # replace-by-fee: same seqnum present?
         replace_idx = None
-        for i, (_, f) in enumerate(chain):
+        for i, f in enumerate(chain):
             if f.seq_num == frame.seq_num:
                 if frame.fee_bid < f.fee_bid * self.FEE_MULTIPLIER:
                     return TxQueueResult.ADD_STATUS_ERROR
@@ -93,9 +96,8 @@ class TransactionQueue:
                 break
         # sequence continuity: must extend the chain (or replace)
         cur_seq = self._account_seq(acc)
-        expected = cur_seq + 1 + sum(
-            1 for i, (_, f) in enumerate(chain) if i != replace_idx)
-        if replace_idx is None and frame.seq_num != expected:
+        if replace_idx is None and \
+                frame.seq_num != cur_seq + 1 + len(chain):
             return TxQueueResult.ADD_STATUS_ERROR
 
         # full validity check against current ledger — hot verify site
@@ -113,7 +115,7 @@ class TransactionQueue:
             fee_acc = frame.fee_account_id().key_bytes
             pending_fees = self._fee_totals.get(fee_acc, 0) + frame.fee_bid
             if replace_idx is not None:
-                old = chain[replace_idx][1]
+                old = chain[replace_idx]
                 if old.fee_account_id().key_bytes == fee_acc:
                     pending_fees -= old.fee_bid
             from ..xdr import LedgerKey, PublicKey
@@ -129,15 +131,18 @@ class TransactionQueue:
             ltx.rollback()
 
         if replace_idx is not None:
-            old = chain[replace_idx][1]
+            old = chain[replace_idx]
             del self._known_hashes[old.full_hash()]
-            self.ban([old.full_hash()])
+            # ban the replaced tx directly — ban() would drop the chain
+            # tail, but later txs still chain off the replacement
+            self._banned[0].add(old.full_hash())
             self._note_remove(old)
-            chain[replace_idx] = (0, frame)
+            chain[replace_idx] = frame
         else:
-            chain.append((0, frame))
-            chain.sort(key=lambda t: t[1].seq_num)
+            chain.append(frame)
+            chain.sort(key=lambda f: f.seq_num)
         self._pending[acc] = chain
+        self._ages.setdefault(acc, 0)
         self._known_hashes[h] = acc
         self._note_add(frame)
         return TxQueueResult.ADD_STATUS_PENDING
@@ -159,44 +164,70 @@ class TransactionQueue:
             chain = self._pending.get(acc)
             if not chain:
                 continue
-            new_chain = [(age, g) for age, g in chain
-                         if g.seq_num > f.seq_num]
-            for age, g in chain:
+            new_chain = [g for g in chain if g.seq_num > f.seq_num]
+            for g in chain:
                 if g.seq_num <= f.seq_num:
                     self._note_remove(g)
                     if g.full_hash() != h:
                         self._known_hashes.pop(g.full_hash(), None)
             if new_chain:
                 self._pending[acc] = new_chain
+                # the account saw a tx applied this ledger: age resets
+                self._ages[acc] = 0
             else:
                 self._pending.pop(acc, None)
+                self._ages.pop(acc, None)
 
     def shift(self) -> None:
-        """Age everything one ledger; expire and ban old txs (reference
-        shift + ban)."""
+        """Age every account one ledger; an account reaching
+        pending_depth has its WHOLE chain banned at once (reference
+        shift: per-account mAge, TransactionQueue.cpp:490-530)."""
         self._banned.pop()
         self._banned.insert(0, set())
         for acc in list(self._pending):
-            chain = self._pending[acc]
-            new_chain = []
-            for age, f in chain:
-                age += 1
-                if age >= self.pending_depth:
+            age = self._ages.get(acc, 0) + 1
+            if age >= self.pending_depth:
+                for f in self._pending[acc]:
                     self._banned[0].add(f.full_hash())
                     self._known_hashes.pop(f.full_hash(), None)
                     self._note_remove(f)
-                else:
-                    new_chain.append((age, f))
-            if new_chain:
-                self._pending[acc] = new_chain
-            else:
                 self._pending.pop(acc, None)
+                self._ages.pop(acc, None)
+            else:
+                self._ages[acc] = age
 
     def ban(self, hashes: List[bytes]) -> None:
-        self._banned[0].update(hashes)
+        """Ban the listed txs AND drop them from the pool; everything
+        chained after a banned tx in its account's chain no longer has a
+        valid seq position, so it is dropped and banned too (reference
+        TransactionQueue::ban bans the matched tx and its tail)."""
+        hs = set(hashes)
+        self._banned[0].update(hs)
+        # _known_hashes maps hash -> account: jump straight to the one
+        # affected chain instead of scanning the whole pool
+        for h in hashes:
+            acc = self._known_hashes.get(h)
+            if acc is None:
+                continue
+            chain = self._pending.get(acc)
+            if not chain:
+                continue
+            cut = next((i for i, f in enumerate(chain)
+                        if f.full_hash() in hs), None)
+            if cut is None:
+                continue
+            for f in chain[cut:]:
+                self._banned[0].add(f.full_hash())
+                self._known_hashes.pop(f.full_hash(), None)
+                self._note_remove(f)
+            if cut:
+                self._pending[acc] = chain[:cut]
+            else:
+                self._pending.pop(acc, None)
+                self._ages.pop(acc, None)
 
     # -- txset construction ---------------------------------------------------
     def to_txset(self, lcl_hash: bytes, network_id: bytes) -> TxSetFrame:
         frames = [f for chain in self._pending.values()
-                  for _, f in chain]
+                  for f in chain]
         return TxSetFrame(network_id, lcl_hash, frames)

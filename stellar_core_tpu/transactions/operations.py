@@ -234,7 +234,14 @@ class SetOptionsOpFrame(OperationFrame):
                     and b.signer.key.value == \
                     self.source_account_id().key_bytes:
                 return self.set_inner(SetOptionsResultCode.BAD_SIGNER)
-        if b.homeDomain is not None and len(b.homeDomain) > 32:
+            if b.signer.weight > 255 and header.ledgerVersion > 9:
+                # reference SetOptionsOpFrame.cpp:254-258
+                return self.set_inner(SetOptionsResultCode.BAD_SIGNER)
+        if b.homeDomain is not None and (
+                len(b.homeDomain) > 32 or
+                any(ord(c) < 0x20 or ord(c) >= 0x7F for c in b.homeDomain)):
+            # control and non-ASCII characters are invalid (reference
+            # isString32Valid / isStringValid)
             return self.set_inner(SetOptionsResultCode.INVALID_HOME_DOMAIN)
         return self.set_inner(SetOptionsResultCode.SUCCESS)
 
@@ -421,9 +428,10 @@ class AccountMergeOpFrame(OperationFrame):
         if acc.seqNum >= starting_sequence_number(header):
             return self.set_inner(AccountMergeResultCode.SEQNUM_TOO_FAR)
         balance = acc.balance
-        if dest.data.value.balance + balance > INT64_MAX:
+        # v10+: the destination's buying liabilities count against the
+        # INT64 ceiling (reference doApply → addBalance → DEST_FULL)
+        if not add_balance(header, dest, balance):
             return self.set_inner(AccountMergeResultCode.DEST_FULL)
-        dest.data.value.balance += balance
         ltx.erase(LedgerKey.account(src_id))
         return self.set_inner(AccountMergeResultCode.SUCCESS, balance)
 

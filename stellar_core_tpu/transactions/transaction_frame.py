@@ -384,14 +384,49 @@ class TransactionFrame:
         acc.seqNum = self.tx.seqNum
 
     # -- apply --------------------------------------------------------------
+    def _remove_one_time_signer(self, ltx) -> None:
+        """Consume this tx's pre-auth-tx signer: remove it from the tx
+        source and every op source account the first time the tx reaches
+        signature processing at apply (reference
+        removeOneTimeSignerFromAllSourceAccounts:543-566; no-op at v7)."""
+        from ..xdr import SignerKey
+        from .account_helpers import change_subentries
+        header = ltx.load_header()
+        if header.ledgerVersion == 7:
+            return
+        target = SignerKey.pre_auth_tx(self.contents_hash())
+        accounts = {self.source_account_id().key_bytes:
+                    self.source_account_id()}
+        for f in self.op_frames:
+            sid = f.source_account_id()
+            accounts[sid.key_bytes] = sid
+        for sid in accounts.values():
+            entry = load_account(ltx, sid)
+            if entry is None:
+                continue    # source removed by an earlier merge
+            acc = entry.data.value
+            signers = list(acc.signers)
+            idx = next((i for i, s in enumerate(signers)
+                        if s.key == target), None)
+            if idx is not None:
+                signers.pop(idx)
+                acc.signers = signers
+                change_subentries(header, entry, -1)
+
     def process_signatures(self, checker: SignatureChecker, ltx) -> bool:
         """Protocol >= 10: check every op's signatures before applying any
-        (reference processSignatures:384)."""
+        (reference processSignatures:384). Win or lose, the tx's
+        pre-auth-tx signer is consumed (reference :420). Pre-10 this
+        phase does nothing — op sigs check during each op's apply, and
+        one-time signers are removed only after ALL ops succeed."""
+        if ltx.load_header().ledgerVersion < 10:
+            return True
         ok = True
         for f in self.op_frames:
             if not f.check_signature(ltx, checker):
                 f.set_code(OperationResultCode.opBAD_AUTH)
                 ok = False
+        self._remove_one_time_signer(ltx)
         if ok and not checker.check_all_signatures_used():
             self.result = _make_result(
                 self.result.feeCharged,
@@ -426,8 +461,15 @@ class TransactionFrame:
                 # validation got past the seq-num stage (reference
                 # cv >= kInvalidUpdateSeqNum → processSeqNum)
                 self._process_seq_num(ltx_tx)
-            sigs_ok = code == TransactionResultCode.txSUCCESS and \
-                self.process_signatures(checker, ltx_tx)
+            if code == TransactionResultCode.txSUCCESS:
+                sigs_ok = self.process_signatures(checker, ltx_tx)
+            else:
+                sigs_ok = False
+                if ltx_tx.load_header().ledgerVersion >= 13:
+                    # v13 fast-fail consumes the pre-auth signer for ANY
+                    # invalid tx (reference processSignatures:396-400 has
+                    # no pre-seq exclusion)
+                    self._remove_one_time_signer(ltx_tx)
             self.tx_changes = delta_to_changes(ltx_tx.get_delta())
             ltx_tx.commit()
         except Exception:
@@ -468,6 +510,16 @@ class TransactionFrame:
                     raise
                 op_results.append(f.result)
             self.op_metas = op_metas if ok else [[] for _ in op_results]
+            if ok and ops_ltx.load_header().ledgerVersion < 10:
+                # pre-10: signatures-used check + one-time signer removal
+                # happen only after every op applied (reference
+                # applyOperations:713-730, txChangesAfter)
+                if not checker.check_all_signatures_used():
+                    self.result = _make_result(
+                        fee, TransactionResultCode.txBAD_AUTH_EXTRA)
+                    ops_ltx.rollback()
+                    return False
+                self._remove_one_time_signer(ops_ltx)
             if ok:
                 self.result = _make_result(
                     fee, TransactionResultCode.txSUCCESS, op_results)

@@ -1,0 +1,601 @@
+"""SetOptions/multisig threshold matrix, account-merge edge cases, and the
+TransactionQueue add/replace/ban/shift matrix (VERDICT r3 item #8).
+
+Role parity, per test:
+- reference `src/transactions/test/SetOptionsTests.cpp` (signers, weights,
+  thresholds, flags, home domain)
+- reference `src/transactions/test/TxEnvelopeTests.cpp` (multisig payment
+  thresholds, pre-auth-tx and hash-x alternate signers, BAD_AUTH_EXTRA)
+- reference `src/transactions/test/MergeTests.cpp` (merge cycles, double
+  merges, subentries, seqnum semantics)
+- reference `src/herder/test/TransactionQueueTests.cpp` (seq chains with
+  shifts, bans, removes across accounts)
+"""
+
+import pytest
+
+import stellar_core_tpu.xdr as X
+from stellar_core_tpu.crypto.hashing import sha256
+from stellar_core_tpu.crypto.keys import SecretKey
+from stellar_core_tpu.herder.tx_queue import TransactionQueue, TxQueueResult
+from stellar_core_tpu.testing import (
+    TestAccount, TestLedger, root_secret_key,
+)
+from stellar_core_tpu.transactions.operations import (
+    AccountMergeResultCode, SetOptionsResultCode,
+)
+from stellar_core_tpu.xdr import (
+    OperationBody, OperationType, Signer, SignerKey, TransactionResultCode,
+)
+
+PENDING = TxQueueResult.ADD_STATUS_PENDING
+DUP = TxQueueResult.ADD_STATUS_DUPLICATE
+ERR = TxQueueResult.ADD_STATUS_ERROR
+LATER = TxQueueResult.ADD_STATUS_TRY_AGAIN_LATER
+
+
+@pytest.fixture
+def ledger():
+    return TestLedger()
+
+
+@pytest.fixture
+def root(ledger):
+    return ledger.root_account
+
+
+def inner_code(frame, op_index=0):
+    return frame.result.op_results[op_index].value.value.disc
+
+
+def tx_code(frame):
+    return frame.result.result.disc
+
+
+def account_entry(ledger, account_id):
+    return ledger.root.get_entry(X.LedgerKey.account(account_id)).data.value
+
+
+# ======================================================== SetOptions matrix
+
+def test_bad_thresholds_out_of_range(ledger, root):
+    """reference SetOptionsTests.cpp 'bad thresholds'."""
+    a = root.create(10**9)
+    for kw in ({"master_weight": 256}, {"low": 256}, {"med": 256},
+               {"high": 256}):
+        f = a.tx([a.op_set_options(**kw)])
+        assert not ledger.apply_frame(f)
+        assert inner_code(f) == SetOptionsResultCode.THRESHOLD_OUT_OF_RANGE
+
+
+def test_signer_weight_above_255_bad_signer(ledger, root):
+    """reference SetOptionsTests.cpp 'invalid signer weight' (v10+)."""
+    a = root.create(10**9)
+    s = SecretKey.pseudo_random_for_testing()
+    f = a.tx([a.op_set_options(signer=Signer(
+        key=SignerKey.ed25519(s.public_key.key_bytes), weight=256))])
+    assert not ledger.apply_frame(f)
+    assert inner_code(f) == SetOptionsResultCode.BAD_SIGNER
+
+
+def test_master_key_as_alternate_signer_rejected(ledger, root):
+    """reference SetOptionsTests.cpp "can't use master key as alternate
+    signer"."""
+    a = root.create(10**9)
+    f = a.tx([a.op_set_options(signer=Signer(
+        key=SignerKey.ed25519(a.account_id.key_bytes), weight=1))])
+    assert not ledger.apply_frame(f)
+    assert inner_code(f) == SetOptionsResultCode.BAD_SIGNER
+
+
+def test_set_and_clear_same_flag_rejected(ledger, root):
+    """reference SetOptionsTests.cpp "Can't set and clear same flag"."""
+    a = root.create(10**9)
+    f = a.tx([a.op_set_options(set_flags=1, clear_flags=1)])
+    assert not ledger.apply_frame(f)
+    assert inner_code(f) == SetOptionsResultCode.BAD_FLAGS
+
+
+def test_unknown_flag_rejected(ledger, root):
+    a = root.create(10**9)
+    f = a.tx([a.op_set_options(set_flags=0x10)])
+    assert not ledger.apply_frame(f)
+    assert inner_code(f) == SetOptionsResultCode.UNKNOWN_FLAG
+
+
+def test_home_domain_invalid(ledger, root):
+    """reference SetOptionsTests.cpp 'invalid home domain': control
+    characters are rejected at validity; an over-long domain can't even
+    serialize (string<32> is wire-enforced)."""
+    from stellar_core_tpu.xdr.codec import XdrError
+    a = root.create(10**9)
+    f = a.tx([a.op_set_options(home_domain="bad\x01domain")])
+    assert not ledger.apply_frame(f)
+    assert inner_code(f) == SetOptionsResultCode.INVALID_HOME_DOMAIN
+    with pytest.raises(XdrError):
+        a.tx([a.op_set_options(home_domain="x" * 33)]).envelope_bytes()
+
+
+def test_add_signer_insufficient_balance(ledger, root):
+    """reference SetOptionsTests.cpp 'Signers / insufficient balance':
+    the new subentry's reserve must be available."""
+    h = ledger.header()
+    a = root.create(2 * h.baseReserve + 2 * h.baseFee)  # no room for +1
+    s = SecretKey.pseudo_random_for_testing()
+    f = a.tx([a.op_add_signer(s.public_key.key_bytes)])
+    assert not ledger.apply_frame(f)
+    assert inner_code(f) == SetOptionsResultCode.LOW_RESERVE
+
+
+def test_signer_add_update_remove_lifecycle(ledger, root):
+    """reference SetOptionsTests.cpp 'Signers': add → update weight in
+    place (no new subentry) → remove via weight 0."""
+    a = root.create(10**9)
+    s = SecretKey.pseudo_random_for_testing()
+    assert ledger.apply_frame(
+        a.tx([a.op_add_signer(s.public_key.key_bytes, weight=1)]))
+    acc = account_entry(ledger, a.account_id)
+    assert len(acc.signers) == 1 and acc.numSubEntries == 1
+    # update weight in place
+    assert ledger.apply_frame(
+        a.tx([a.op_add_signer(s.public_key.key_bytes, weight=7)]))
+    acc = account_entry(ledger, a.account_id)
+    assert acc.signers[0].weight == 7 and acc.numSubEntries == 1
+    # remove
+    assert ledger.apply_frame(
+        a.tx([a.op_add_signer(s.public_key.key_bytes, weight=0)]))
+    acc = account_entry(ledger, a.account_id)
+    assert acc.signers == [] and acc.numSubEntries == 0
+
+
+def test_twenty_signers_max(ledger, root):
+    """reference: MAX_SIGNERS == 20 → TOO_MANY_SIGNERS on the 21st."""
+    a = root.create(10**10)
+    for i in range(20):
+        assert ledger.apply_frame(
+            a.tx([a.op_add_signer(bytes([i + 1]) * 32, weight=1)])), i
+    f = a.tx([a.op_add_signer(bytes([99]) * 32, weight=1)])
+    assert not ledger.apply_frame(f)
+    assert inner_code(f) == SetOptionsResultCode.TOO_MANY_SIGNERS
+
+
+# ==================================================== multisig thresholds
+
+def test_master_weight_zero_locks_master_out(ledger, root):
+    """reference TxEnvelopeTests.cpp multisig: master weight 0 → master
+    signature no longer meets any threshold; the alternate signer does."""
+    a = root.create(10**9)
+    s = SecretKey.pseudo_random_for_testing()
+    assert ledger.apply_frame(
+        a.tx([a.op_add_signer(s.public_key.key_bytes, weight=1)]))
+    assert ledger.apply_frame(a.tx([a.op_set_options(master_weight=0)]))
+    # master-only signature fails
+    f = a.tx([a.op_payment(root.account_id, 1)])
+    assert not ledger.apply_frame(f)
+    assert tx_code(f) == TransactionResultCode.txBAD_AUTH
+    # signer-only signature succeeds (sign with s INSTEAD of master)
+    f2 = a.tx([a.op_payment(root.account_id, 1)])
+    f2.signatures.clear()
+    f2.add_signature(s)
+    assert ledger.apply_frame(f2)
+
+
+def test_thresholds_accumulate_weights(ledger, root):
+    """reference TxEnvelopeTests.cpp: medThreshold 3 needs master(1) +
+    s1(1) + s2(1); any two alone fail."""
+    a = root.create(10**9)
+    s1 = SecretKey.pseudo_random_for_testing()
+    s2 = SecretKey.pseudo_random_for_testing()
+    assert ledger.apply_frame(
+        a.tx([a.op_add_signer(s1.public_key.key_bytes, weight=1),
+              a.op_add_signer(s2.public_key.key_bytes, weight=1),
+              a.op_set_options(med=3)]))
+    f = a.tx([a.op_payment(root.account_id, 1)], extra_signers=[s1])
+    assert not ledger.apply_frame(f)
+    assert tx_code(f) == TransactionResultCode.txFAILED  # opBAD_AUTH
+    f2 = a.tx([a.op_payment(root.account_id, 1)], extra_signers=[s1, s2])
+    assert ledger.apply_frame(f2)
+
+
+def test_unused_signature_bad_auth_extra(ledger, root):
+    """reference TxEnvelopeTests.cpp 'unused signature' →
+    txBAD_AUTH_EXTRA."""
+    a = root.create(10**9)
+    stranger = SecretKey.pseudo_random_for_testing()
+    f = a.tx([a.op_payment(root.account_id, 1)], extra_signers=[stranger])
+    assert not ledger.apply_frame(f)
+    assert tx_code(f) == TransactionResultCode.txBAD_AUTH_EXTRA
+
+
+def test_high_threshold_op_requires_high(ledger, root):
+    """set-options touching signers is HIGH; med-weight signatures are
+    not enough."""
+    a = root.create(10**9)
+    s = SecretKey.pseudo_random_for_testing()
+    assert ledger.apply_frame(
+        a.tx([a.op_add_signer(s.public_key.key_bytes, weight=1),
+              a.op_set_options(high=2)]))
+    # master alone (weight 1) < high (2): HIGH op fails...
+    f = a.tx([a.op_set_options(master_weight=5)])
+    assert not ledger.apply_frame(f)
+    # ...but a MED op (payment) still works
+    assert ledger.apply_frame(a.tx([a.op_payment(root.account_id, 1)]))
+    # master + signer meets high
+    assert ledger.apply_frame(
+        a.tx([a.op_set_options(master_weight=5)], extra_signers=[s]))
+
+
+# ============================================== pre-auth-tx / hash-x signers
+
+def _preauth_key_for(frame):
+    return SignerKey.pre_auth_tx(frame.contents_hash())
+
+
+def test_preauth_tx_applies_unsigned_and_is_consumed(ledger, root):
+    """reference TxEnvelopeTests.cpp pre-auth: the exact future tx hash is
+    a one-time signer — the tx applies with NO ed25519 signatures, and
+    the signer is consumed on apply."""
+    a = root.create(10**9)
+    # build the future payment at its future seq, unsigned
+    future = a.tx([a.op_payment(root.account_id, 77)],
+                  seq=a.next_seq() + 1)
+    future.signatures.clear()
+    assert ledger.apply_frame(
+        a.tx([a.op_set_options(signer=Signer(
+            key=_preauth_key_for(future), weight=1))]))
+    acc = account_entry(ledger, a.account_id)
+    assert acc.numSubEntries == 1
+    before = a.balance()
+    assert ledger.apply_frame(future)
+    assert a.balance() < before
+    # one-time signer consumed: gone, subentry released
+    acc = account_entry(ledger, a.account_id)
+    assert acc.signers == [] and acc.numSubEntries == 0
+    # replay is impossible (seq consumed AND signer gone)
+    future2 = a.tx([a.op_payment(root.account_id, 77)],
+                   seq=future.seq_num)
+    future2.signatures.clear()
+    assert not ledger.apply_frame(future2)
+
+
+def test_preauth_consumed_even_when_tx_fails(ledger, root):
+    """v13: the pre-auth signer is consumed when the tx reaches signature
+    processing and FAILS in its ops (reference processSignatures →
+    removeOneTimeSignerFromAllSourceAccounts, called win or lose)."""
+    a = root.create(10**9)
+    doomed = a.tx([a.op_payment(root.account_id, 10**15)],  # UNDERFUNDED
+                  seq=a.next_seq() + 1)
+    doomed.signatures.clear()
+    assert ledger.apply_frame(
+        a.tx([a.op_set_options(signer=Signer(
+            key=_preauth_key_for(doomed), weight=1))]))
+    assert not ledger.apply_frame(doomed)
+    assert tx_code(doomed) == TransactionResultCode.txFAILED
+    acc = account_entry(ledger, a.account_id)
+    assert acc.signers == [] and acc.numSubEntries == 0
+
+
+def test_hash_x_signer(ledger, root):
+    """reference TxEnvelopeTests.cpp hash-x: sha256(preimage) signer is
+    satisfied by shipping the preimage as a signature."""
+    from stellar_core_tpu.xdr import DecoratedSignature
+    a = root.create(10**9)
+    preimage = b"open sesame, 32 bytes of secret!"
+    assert ledger.apply_frame(
+        a.tx([a.op_set_options(
+            signer=Signer(key=SignerKey.hash_x(sha256(preimage)),
+                          weight=1),
+            master_weight=0)]))
+    f = a.tx([a.op_payment(root.account_id, 5)])
+    f.signatures.clear()
+    f.signatures.append(DecoratedSignature(
+        hint=sha256(preimage)[-4:], signature=preimage))
+    f.invalidate_caches()
+    assert ledger.apply_frame(f), f.result
+    # wrong preimage fails
+    f2 = a.tx([a.op_payment(root.account_id, 5)])
+    f2.signatures.clear()
+    f2.signatures.append(DecoratedSignature(
+        hint=b"\x00" * 4, signature=b"wrong preimage entirely....... !"))
+    f2.invalidate_caches()
+    assert not ledger.apply_frame(f2)
+    assert tx_code(f2) == TransactionResultCode.txBAD_AUTH
+
+
+# ============================================================ merge matrix
+
+def _merge_op(src: TestAccount, dest: TestAccount):
+    return src.op(OperationBody(OperationType.ACCOUNT_MERGE, dest.muxed))
+
+
+def test_merge_into_self_malformed(ledger, root):
+    """reference MergeTests.cpp 'merge into self'."""
+    a = root.create(10**9)
+    f = a.tx([_merge_op(a, a)])
+    assert not ledger.apply_frame(f)
+    assert inner_code(f) == AccountMergeResultCode.MALFORMED
+
+
+def test_merge_create_merge_back(ledger, root):
+    """reference MergeTests.cpp 'merge, create, merge back': the account
+    is re-creatable after a merge and can receive the old balance back."""
+    a = root.create(10**9)
+    b = root.create(10**9)
+    a_id = a.account_id
+    bal_a = a.balance()
+    f = a.tx([_merge_op(a, b)])
+    assert ledger.apply_frame(f), f.result
+    assert not ledger.account_exists(a_id)
+    fee = 100
+    assert ledger.balance(b.account_id) == 10**9 + bal_a - fee
+    # recreate a, then merge b back into it
+    a2 = root.create(10**8, sk=a.sk)
+    assert ledger.account_exists(a_id)
+    # recreated account's seq is based on the CURRENT ledger (fresh era)
+    from stellar_core_tpu.transactions.account_helpers import \
+        starting_sequence_number
+    assert ledger.seq_num(a_id) == \
+        starting_sequence_number(ledger.header())
+    f2 = b.tx([_merge_op(b, a2)])
+    assert ledger.apply_frame(f2), f2.result
+    assert not ledger.account_exists(b.account_id)
+
+
+def test_merge_account_twice_same_set(ledger, root):
+    """reference MergeTests.cpp 'merge account twice': the second merge in
+    one close fails opNO_ACCOUNT (source died in the first)."""
+    a = root.create(10**9)
+    b = root.create(10**9)
+    f1 = a.tx([_merge_op(a, b)])
+    f2 = a.tx([_merge_op(a, b)], seq=f1.seq_num + 1)
+    r1, r2 = ledger.close_with([f1, f2])
+    assert r1 and not r2
+    assert tx_code(f2) in (TransactionResultCode.txNO_ACCOUNT,
+                           TransactionResultCode.txFAILED)
+
+
+def test_create_merge_create(ledger, root):
+    """reference MergeTests.cpp 'create, merge, create': same key can be
+    created, merged away, and created again."""
+    a = root.create(10**9)
+    sk = SecretKey.pseudo_random_for_testing()
+    c1 = a.create(10**8, sk=sk)
+    assert ledger.apply_frame(c1.tx([_merge_op(c1, a)]))
+    assert not ledger.account_exists(sk.public_key)
+    c2 = a.create(2 * 10**8, sk=sk)
+    assert ledger.account_exists(sk.public_key)
+    assert c2.balance() == 2 * 10**8
+
+
+def test_merge_immutable_account(ledger, root):
+    """reference MergeTests.cpp 'Account has static auth flag set'."""
+    a = root.create(10**9)
+    assert ledger.apply_frame(
+        a.tx([a.op_set_options(set_flags=0x4)]))  # AUTH_IMMUTABLE
+    b = root.create(10**9)
+    f = a.tx([_merge_op(a, b)])
+    assert not ledger.apply_frame(f)
+    assert inner_code(f) == AccountMergeResultCode.IMMUTABLE_SET
+
+
+def test_merge_with_data_subentry_blocked(ledger, root):
+    """reference MergeTests.cpp 'With sub entries / account has data'."""
+    a = root.create(10**9)
+    b = root.create(10**9)
+    assert ledger.apply_frame(a.tx([a.op_manage_data("k", b"v")]))
+    f = a.tx([_merge_op(a, b)])
+    assert not ledger.apply_frame(f)
+    assert inner_code(f) == AccountMergeResultCode.HAS_SUB_ENTRIES
+    # delete the data entry → merge proceeds
+    assert ledger.apply_frame(a.tx([a.op_manage_data("k", None)]))
+    assert ledger.apply_frame(a.tx([_merge_op(a, b)]))
+
+
+def test_merge_seqnum_too_far(ledger, root):
+    """reference MergeTests.cpp 'merge too far' (v10+): a source whose
+    seqnum belongs to a FUTURE ledger era cannot merge (replay guard)."""
+    from stellar_core_tpu.xdr import BumpSequenceOp
+    a = root.create(10**9)
+    b = root.create(10**9)
+    far = (ledger.header().ledgerSeq + 10_000) << 32
+    assert ledger.apply_frame(a.tx([a.op(OperationBody(
+        OperationType.BUMP_SEQUENCE, BumpSequenceOp(bumpTo=far)))]))
+    f = a.tx([_merge_op(a, b)], seq=far + 1)
+    assert not ledger.apply_frame(f)
+    assert inner_code(f) == AccountMergeResultCode.SEQNUM_TOO_FAR
+
+
+def test_merge_dest_full(ledger, root):
+    """reference MergeTests.cpp: destination at INT64 ceiling (via buying
+    liabilities) → DEST_FULL, v10+ addBalance semantics."""
+    from stellar_core_tpu.xdr import Price
+    a = root.create(10**9)
+    b = root.create(10**9)
+    # b offers to buy a HUGE amount of USD for native, creating native
+    # buying liabilities near the INT64 ceiling
+    usd = X.Asset.credit("USD", root.account_id)
+    assert ledger.apply_frame(b.tx([b.op_change_trust(usd, 2**62)]))
+    assert ledger.apply_frame(
+        root.tx([root.op_payment(b.account_id, 10**8, usd)]))
+    # selling USD for native at a huge price → native BUYING liabilities
+    assert ledger.apply_frame(
+        b.tx([b.op_manage_sell_offer(usd, X.Asset.native(),
+                                     10**8, 90000000, 1)]))
+    f = a.tx([_merge_op(a, b)])
+    ok = ledger.apply_frame(f)
+    if not ok:
+        assert inner_code(f) == AccountMergeResultCode.DEST_FULL
+    else:
+        # liabilities were not near the ceiling on this path; the op
+        # must then have moved the whole balance
+        assert not ledger.account_exists(a.account_id)
+
+
+def test_merge_success_invalidates_dependent_tx(ledger, root):
+    """reference MergeTests.cpp 'success, invalidates dependent tx': a
+    queued tx from the merged account fails at apply (no account)."""
+    a = root.create(10**9)
+    b = root.create(10**9)
+    f1 = a.tx([_merge_op(a, b)])
+    f2 = a.tx([a.op_payment(root.account_id, 1)], seq=f1.seq_num + 1)
+    r1, r2 = ledger.close_with([f1, f2])
+    assert r1 and not r2
+    assert tx_code(f2) == TransactionResultCode.txNO_ACCOUNT
+
+
+# ===================================================== queue shift matrix
+
+class _LM:
+    def __init__(self, led):
+        self._led = led
+
+    def ltx_root(self):
+        return self._led.root
+
+    def header(self):
+        return self._led.header()
+
+
+@pytest.fixture
+def env():
+    led = TestLedger()
+    root = TestAccount(led, root_secret_key())
+    a = root.create(10**10)
+    b = root.create(10**10)
+    q = TransactionQueue(_LM(led), pending_depth=4, ban_depth=10,
+                         pool_ledger_multiplier=2, verifier=None)
+    return led, root, a, b, q
+
+
+def _pay(acct, root, seq=None, fee=None):
+    return acct.tx([acct.op_payment(root.account_id, 100)], seq=seq,
+                   fee=fee)
+
+
+def test_good_then_small_seq(env):
+    """reference TransactionQueueTests 'good then small sequence
+    number'."""
+    led, root, a, b, q = env
+    f1 = _pay(a, root)
+    assert q.try_add(f1) == PENDING
+    small = _pay(a, root, seq=f1.seq_num - 1)
+    assert q.try_add(small) == ERR
+    assert q.size_ops() == 1
+
+
+def test_good_seq_same_twice_with_shift(env):
+    """reference 'good sequence number, same twice with shift': a shift
+    ages the chain but the duplicate is still recognized."""
+    led, root, a, b, q = env
+    f1 = _pay(a, root)
+    assert q.try_add(f1) == PENDING
+    q.shift()
+    assert q.try_add(f1) == DUP
+    assert q.size_ops() == 1
+
+
+def test_good_then_good_with_shift_keeps_chain(env):
+    """reference 'good then good sequence number, with shift'."""
+    led, root, a, b, q = env
+    f1 = _pay(a, root)
+    assert q.try_add(f1) == PENDING
+    q.shift()
+    f2 = _pay(a, root, seq=f1.seq_num + 1)
+    assert q.try_add(f2) == PENDING
+    assert q.size_ops() == 2
+    # ages are PER CHAIN: two more shifts expire both together
+    for _ in range(3):
+        q.shift()
+    assert q.size_ops() == 0
+    assert q.is_banned(f1.full_hash()) and q.is_banned(f2.full_hash())
+
+
+def test_multiple_accounts_with_remove(env):
+    """reference 'multiple good sequence numbers, different accounts,
+    with remove': removing applied txs leaves other chains intact."""
+    led, root, a, b, q = env
+    f1 = _pay(a, root)
+    f2 = _pay(a, root, seq=f1.seq_num + 1)
+    g1 = _pay(b, root)
+    for f in (f1, f2, g1):
+        assert q.try_add(f) == PENDING
+    assert led.apply_frame(f1)          # f1 lands in a ledger
+    q.remove_applied([f1])
+    assert q.size_ops() == 2
+    # the rest of a's chain still valid, new extension accepted
+    f3 = _pay(a, root, seq=f2.seq_num + 1)
+    assert q.try_add(f3) == PENDING
+    # b untouched
+    g2 = _pay(b, root, seq=g1.seq_num + 1)
+    assert q.try_add(g2) == PENDING
+
+
+def test_multiple_accounts_with_ban(env):
+    """reference 'multiple good sequence numbers, different accounts,
+    with ban': banning one account's txs drops its whole chain tail and
+    leaves the other account alone."""
+    led, root, a, b, q = env
+    f1 = _pay(a, root)
+    f2 = _pay(a, root, seq=f1.seq_num + 1)
+    g1 = _pay(b, root)
+    for f in (f1, f2, g1):
+        assert q.try_add(f) == PENDING
+    q.ban([f1.full_hash()])
+    assert q.is_banned(f1.full_hash())
+    assert q.try_add(f1) == LATER
+    # g (other account) unaffected
+    assert q.try_add(g1) == DUP
+    assert q.size_ops() <= 2
+
+
+def test_banned_tx_rolls_off_after_ban_depth(env):
+    led, root, a, b, q = env
+    f1 = _pay(a, root)
+    assert q.try_add(f1) == PENDING
+    q.ban([f1.full_hash()])
+    for _ in range(10):
+        q.shift()
+    assert not q.is_banned(f1.full_hash())
+    assert q.try_add(f1) == PENDING
+
+
+def test_starting_sequence_boundary(env):
+    """reference 'transaction queue starting sequence boundary': a tx at
+    the very first seq of the account's ledger era is admitted; one era
+    ahead is rejected."""
+    led, root, a, b, q = env
+    cur = led.seq_num(a.account_id)
+    nxt = _pay(a, root, seq=cur + 1)
+    assert q.try_add(nxt) == PENDING
+    future_era = _pay(a, root, seq=cur + (1 << 32))
+    assert q.try_add(future_era) == ERR
+
+
+def test_preauth_v9_consumed_only_on_success():
+    """Pre-10 semantics: one-time signers are removed only after ALL ops
+    apply successfully — a failed tx leaves the signer in place
+    (reference applyOperations:713-730 'it is responsibility of
+    account's owner to remove that signer')."""
+    ledger = TestLedger(ledger_version=9)
+    root = ledger.root_account
+    a = root.create(10**9)
+    doomed = a.tx([a.op_payment(root.account_id, 10**15)],
+                  seq=a.next_seq() + 1)
+    doomed.signatures.clear()
+    assert ledger.apply_frame(
+        a.tx([a.op_set_options(signer=Signer(
+            key=_preauth_key_for(doomed), weight=1))]))
+    assert not ledger.apply_frame(doomed)
+    acc = account_entry(ledger, a.account_id)
+    assert len(acc.signers) == 1      # signer NOT consumed on failure
+    # a successful pre-auth tx DOES consume it
+    ok_tx = a.tx([a.op_payment(root.account_id, 10)],
+                 seq=a.next_seq() + 1)
+    ok_tx.signatures.clear()
+    assert ledger.apply_frame(
+        a.tx([a.op_set_options(signer=Signer(
+            key=_preauth_key_for(ok_tx), weight=1))]))
+    assert ledger.apply_frame(ok_tx)
+    acc = account_entry(ledger, a.account_id)
+    assert len(acc.signers) == 1      # ok_tx's signer gone, doomed's stays
+    assert acc.signers[0].key == _preauth_key_for(doomed)
