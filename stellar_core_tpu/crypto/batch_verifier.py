@@ -26,6 +26,7 @@ cache hits never enqueue.
 from __future__ import annotations
 
 import threading
+import time
 from typing import Callable, List, Optional, Sequence, Tuple
 
 from ..util.log import get_logger
@@ -294,11 +295,16 @@ class ThreadedBatchVerifier(BatchSigVerifier):
 
     name = "threaded"
 
-    def __init__(self, inner: BatchSigVerifier, clock) -> None:
+    def __init__(self, inner: BatchSigVerifier, clock,
+                 metrics=None) -> None:
         self._inner = inner
         self._clock = clock
+        self._metrics = metrics
         self._lock = threading.Lock()
-        self._pending: List[Tuple[Triple, VerifyFuture]] = []
+        # (triple, future, enqueue perf_counter): the timestamp feeds the
+        # crypto.verify.latency enqueue-to-complete timer (the p50/p99
+        # the live SCP path actually feels)
+        self._pending: List[Tuple[Triple, VerifyFuture, float]] = []
         self._inflight = False
 
     @property
@@ -323,7 +329,8 @@ class ThreadedBatchVerifier(BatchSigVerifier):
             f._complete(hit)
             return f
         with self._lock:
-            self._pending.append(((key.key_bytes, sig, msg), f))
+            self._pending.append(
+                ((key.key_bytes, sig, msg), f, time.perf_counter()))
         return f
 
     def pending(self) -> int:
@@ -338,13 +345,18 @@ class ThreadedBatchVerifier(BatchSigVerifier):
             self._inflight = True
 
         def work() -> None:
-            triples = [t for (t, _f) in batch]
+            triples = [t for (t, _f, _t0) in batch]
             results = self._inner.verify_many(triples)
 
             def complete() -> None:
-                for ((k, s, m), f), ok in zip(batch, results):
+                done = time.perf_counter()
+                lat = (self._metrics.new_timer("crypto.verify.latency")
+                       if self._metrics is not None else None)
+                for ((k, s, m), f, t0), ok in zip(batch, results):
                     with _keys._cache_lock:
                         _keys._verify_cache.put(_keys._cache_key(k, s, m), ok)
+                    if lat is not None:
+                        lat.update(done - t0)
                     f._complete(ok)
                 with self._lock:
                     self._inflight = False
@@ -364,8 +376,8 @@ class ThreadedBatchVerifier(BatchSigVerifier):
 
 def make_verifier(backend: str = "cpu", clock=None,
                   max_pending: int = 8192,
-                  compile_cache_dir: Optional[str] = None
-                  ) -> BatchSigVerifier:
+                  compile_cache_dir: Optional[str] = None,
+                  metrics=None) -> BatchSigVerifier:
     """Config-gated backend selection (Config.SIG_VERIFY_BACKEND)."""
     if backend == "cpu":
         return CpuSigVerifier()
@@ -376,5 +388,6 @@ def make_verifier(backend: str = "cpu", clock=None,
         assert clock is not None
         return ThreadedBatchVerifier(
             TpuSigVerifier(max_pending=max_pending,
-                           compile_cache_dir=compile_cache_dir), clock)
+                           compile_cache_dir=compile_cache_dir), clock,
+            metrics=metrics)
     raise ValueError("unknown sig verify backend %r" % backend)

@@ -104,23 +104,32 @@ class Database:
             (name, value))
 
     # -- execution ----------------------------------------------------------
+    # total_query_seconds accumulates ALL SQL time (exec + commit) so
+    # callers can exclude DB time from their own timers (reference
+    # DBTimeExcluder, LedgerManagerImpl.cpp:525)
+    total_query_seconds = 0.0
+
     def execute(self, sql: str, params: Iterable[Any] = ()) -> sqlite3.Cursor:
         t0 = time.perf_counter()
         cur = self._conn.execute(sql, tuple(params))
+        dt = time.perf_counter() - t0
+        self.total_query_seconds += dt
         if self._metrics is not None:
-            self._metrics.new_timer("database.query.exec").update(
-                time.perf_counter() - t0)
+            self._metrics.new_timer("database.query.exec").update(dt)
         return cur
 
     def executemany(self, sql: str, rows) -> None:
         t0 = time.perf_counter()
         self._conn.executemany(sql, rows)
+        dt = time.perf_counter() - t0
+        self.total_query_seconds += dt
         if self._metrics is not None:
-            self._metrics.new_timer("database.query.exec").update(
-                time.perf_counter() - t0)
+            self._metrics.new_timer("database.query.exec").update(dt)
 
     def commit(self) -> None:
+        t0 = time.perf_counter()
         self._conn.commit()
+        self.total_query_seconds += time.perf_counter() - t0
 
     def rollback(self) -> None:
         self._conn.rollback()

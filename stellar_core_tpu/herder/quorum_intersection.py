@@ -39,6 +39,28 @@ class QuorumIntersectionChecker:
         self.interrupted = False
         self.last_split: Optional[Tuple[List[bytes], List[bytes]]] = None
         self.quorums_seen = 0
+        # compiled qset forms: pubnet-scale maps share qset structure
+        # heavily (every org validator carries the same top-level set), so
+        # satisfaction is evaluated per DISTINCT compiled set and memoized
+        # per (set, mask) — this is what makes ~100-org transitive maps
+        # finish (reference compiles to TBitSet structures similarly,
+        # QuorumIntersectionCheckerImpl.h:7-60)
+        self._compiled: List[Tuple[int, int, Tuple[int, ...]]] = []
+        self._compile_by_id: Dict[int, int] = {}
+        self._compile_by_val: Dict[tuple, int] = {}
+        self._compile_keepalive: List[SCPQuorumSet] = []
+        self._node_cq: List[Optional[int]] = [
+            None if qs is None else self._compile_qs(qs)
+            for qs in self._qsets]
+        self._sat_cache: Dict[Tuple[int, int], bool] = {}
+        # nodes grouped by compiled qset: a contraction pass evaluates
+        # each DISTINCT qset once instead of once per node (nodes with no
+        # qset are never satisfied, so they simply have no group)
+        groups: Dict[int, int] = {}
+        for i, ci in enumerate(self._node_cq):
+            if ci is not None:
+                groups[ci] = groups.get(ci, 0) | (1 << i)
+        self._cq_groups: List[Tuple[int, int]] = sorted(groups.items())
 
     # -- qset satisfaction ---------------------------------------------------
     def _dep_mask(self, qs: Optional[SCPQuorumSet]) -> int:
@@ -53,34 +75,66 @@ class QuorumIntersectionChecker:
             m |= self._dep_mask(inner)
         return m
 
-    def _qset_satisfied(self, qs: SCPQuorumSet, mask: int) -> bool:
-        hits = 0
+    def _compile_qs(self, qs: SCPQuorumSet) -> int:
+        key = id(qs)
+        hit = self._compile_by_id.get(key)
+        if hit is not None:
+            return hit
+        # keep the object alive: the id-keyed memo must never serve a
+        # freed object's recycled id to a different qset
+        self._compile_keepalive.append(qs)
+        direct = 0
         for v in qs.validators:
             i = self.index.get(v.key_bytes)
-            if i is not None and (mask >> i) & 1:
-                hits += 1
-        for inner in qs.innerSets:
-            if self._qset_satisfied(inner, mask):
-                hits += 1
-        return hits >= qs.threshold
+            if i is not None:
+                direct |= 1 << i
+        children = tuple(self._compile_qs(inner) for inner in qs.innerSets)
+        vkey = (qs.threshold, direct, children)
+        idx = self._compile_by_val.get(vkey)
+        if idx is None:
+            idx = len(self._compiled)
+            self._compiled.append(vkey)
+            self._compile_by_val[vkey] = idx
+        self._compile_by_id[key] = idx
+        return idx
+
+    def _sat(self, ci: int, mask: int) -> bool:
+        ck = (ci, mask)
+        cached = self._sat_cache.get(ck)
+        if cached is not None:
+            return cached
+        thr, direct, children = self._compiled[ci]
+        hits = (direct & mask).bit_count()
+        if hits < thr:
+            for ch in children:
+                if self._sat(ch, mask):
+                    hits += 1
+                    if hits >= thr:
+                        break
+        r = hits >= thr
+        if len(self._sat_cache) > 4_000_000:
+            self._sat_cache.clear()
+        self._sat_cache[ck] = r
+        return r
+
+    def _qset_satisfied(self, qs: SCPQuorumSet, mask: int) -> bool:
+        return self._sat(self._compile_qs(qs), mask)
 
     def _node_satisfied(self, i: int, mask: int) -> bool:
-        qs = self._qsets[i]
-        return qs is not None and self._qset_satisfied(qs, mask)
+        ci = self._node_cq[i]
+        return ci is not None and self._sat(ci, mask)
 
     # -- quorum machinery (refinement 2) ------------------------------------
     def contract_to_maximal_quorum(self, mask: int) -> int:
         """Largest quorum within `mask`, or 0 (reference
-        contractToMaximalQuorum)."""
+        contractToMaximalQuorum). Each fixpoint pass walks the distinct
+        compiled qsets, not the individual nodes."""
         while True:
             next_mask = 0
-            m = mask
-            while m:
-                low = m & -m
-                i = low.bit_length() - 1
-                if self._node_satisfied(i, mask):
-                    next_mask |= low
-                m ^= low
+            for ci, gmask in self._cq_groups:
+                gm = gmask & mask
+                if gm and self._sat(ci, mask):
+                    next_mask |= gm
             if next_mask == mask:
                 return mask
             mask = next_mask

@@ -103,6 +103,15 @@ class TransactionQueue:
         # full validity check against current ledger — hot verify site
         ltx = LedgerTxn(self._ledger.ltx_root())
         try:
+            if getattr(self.verifier, "wants_prewarm", False):
+                # ONE batched dispatch for every candidate signature pair
+                # of this tx; the per-signer walk inside check_valid then
+                # completes off the warm verify cache (hot caller #2,
+                # batched the TPU way — same gate as txset.py's
+                # check_or_trim). Required for async backends: their
+                # enqueue futures complete on the main loop, never inside
+                # a synchronous admission call.
+                self.verifier.prewarm_many(frame.candidate_sig_triples(ltx))
             seq_base = frame.seq_num - 1
             if not frame.check_valid(ltx, seq_base, self.verifier):
                 return TxQueueResult.ADD_STATUS_ERROR

@@ -178,13 +178,30 @@ class LedgerManager:
 
         verifier = getattr(self.app, "sig_verifier", None)
         metrics = getattr(self.app, "metrics", None)
-        import contextlib
-        timer = (metrics.new_timer("ledger.ledger.close").time()
-                 if metrics is not None else contextlib.nullcontext())
+        from ..util.slow_execution import LogSlowExecution
+        db = getattr(self.app, "database", None)
         ltx = LedgerTxn(self.root)
         try:
-            with timer:
-                self._close_ledger_in(ltx, lcd, header_prev, verifier)
+            # split the close into apply-vs-SQL components (reference
+            # DBTimeExcluder + LogSlowExecution, LedgerManagerImpl:524-528);
+            # the timers record in `finally` so failed closes still
+            # contribute samples
+            import time as _time
+            sql_before = db.total_query_seconds if db is not None else 0.0
+            t0 = _time.perf_counter()
+            try:
+                with LogSlowExecution("ledger close"):
+                    self._close_ledger_in(ltx, lcd, header_prev, verifier)
+            finally:
+                if metrics is not None:
+                    elapsed = _time.perf_counter() - t0
+                    sql_spent = (db.total_query_seconds - sql_before) \
+                        if db is not None else 0.0
+                    metrics.new_timer("ledger.ledger.close").update(elapsed)
+                    metrics.new_timer("ledger.ledger.close.sql").update(
+                        sql_spent)
+                    metrics.new_timer("ledger.ledger.close.apply").update(
+                        max(0.0, elapsed - sql_spent))
             if metrics is not None:
                 metrics.new_meter("ledger.transaction.apply").mark(
                     len(lcd.tx_set.frames))

@@ -56,7 +56,8 @@ class Application:
         self.sig_verifier = make_verifier(
             config.SIG_VERIFY_BACKEND, clock,
             config.SIG_VERIFY_MAX_BATCH,
-            config.SIG_VERIFY_COMPILE_CACHE_DIR)
+            config.SIG_VERIFY_COMPILE_CACHE_DIR,
+            metrics=self.metrics)
 
         self.invariant_manager = InvariantManager(self.metrics)
         for pattern in config.INVARIANT_CHECKS:

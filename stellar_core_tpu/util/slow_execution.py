@@ -1,0 +1,39 @@
+"""LogSlowExecution: warn when a scoped operation overruns its budget.
+
+Role parity: reference `src/util/LogSlowExecution.h` — a scope timer
+that logs on destruction when elapsed time exceeds a threshold, used by
+`LedgerManagerImpl::closeLedger` (:526-528) so operators see slow closes
+in the "Perf" partition without tracing.
+"""
+
+from __future__ import annotations
+
+import time
+
+from .log import get_logger
+
+log = get_logger("Perf")
+
+DEFAULT_THRESHOLD = 1.0  # seconds (reference default: 1s)
+
+
+class LogSlowExecution:
+    """Context manager: `with LogSlowExecution("ledger close"):` logs a
+    warning if the body takes longer than `threshold` seconds."""
+
+    def __init__(self, name: str,
+                 threshold: float = DEFAULT_THRESHOLD) -> None:
+        self.name = name
+        self.threshold = threshold
+        self.elapsed = 0.0
+
+    def __enter__(self) -> "LogSlowExecution":
+        self._t0 = time.perf_counter()
+        return self
+
+    def __exit__(self, *exc) -> bool:
+        self.elapsed = time.perf_counter() - self._t0
+        if self.elapsed > self.threshold:
+            log.warning("%s hung for %.3fs (threshold %.1fs)",
+                        self.name, self.elapsed, self.threshold)
+        return False

@@ -223,6 +223,16 @@ def test_metrics_instrumented_after_closes(app):
     st, m = cmd(app, "metrics")
     assert st == 200
     assert m["ledger.ledger.close"]["count"] >= 2
+    # apply-vs-SQL split (reference DBTimeExcluder): components sum to
+    # (almost exactly) the whole close
+    assert m["ledger.ledger.close.sql"]["count"] == \
+        m["ledger.ledger.close"]["count"]
+    assert m["ledger.ledger.close.apply"]["count"] == \
+        m["ledger.ledger.close"]["count"]
+    total = m["ledger.ledger.close"]["mean"]
+    parts = m["ledger.ledger.close.sql"]["mean"] + \
+        m["ledger.ledger.close.apply"]["mean"]
+    assert parts == pytest.approx(total, rel=0.05, abs=5e-4)
     assert m["ledger.transaction.apply"]["count"] >= 2
     assert m["herder.tx.received"]["count"] >= 2
     assert m["scp.envelope.emit"]["count"] >= 1

@@ -231,3 +231,70 @@ def test_crank_until_flushes_pending_verifies():
 
     assert app.crank_until(settled, max_cranks=100000)
     assert fut.result() is True
+
+
+@pytest.mark.slow
+def test_live_path_latency_slo():
+    """Live-path latency SLO (VERDICT r3 #6): the enqueue→complete verify
+    latency on small (SCP-sized) buckets fits well inside the ~1s SCP
+    timer budget (reference SCPDriver::computeTimeout, SCPDriver.h:66-236)
+    and is exported as crypto.verify.latency p50/p99 in /metrics."""
+    import time
+
+    _clear_verify_cache()
+
+    def tweak(c):
+        c.SIG_VERIFY_BACKEND = "tpu-async"
+        c.SIG_VERIFY_WARMUP = False
+
+    sim = topologies.core(3, 2, cfg_tweak=tweak)
+    apps = [n.app for n in sim.nodes.values()]
+    for a in apps:
+        a.sig_verifier.inner.BUCKETS = (128,)
+    # compile the kernel once up front (process-global jit cache) so the
+    # SLO measures steady state, as a warmed validator runs
+    apps[0].sig_verifier.inner.warmup(wait=True)
+    sim.start_all_nodes()
+
+    # drive traffic: a chained burst of payments submitted to node 0
+    # floods to the others while SCP envelopes verify through the async
+    # batch path
+    ad = AppLedgerAdapter(apps[0])
+    root = ad.root_account()
+    base_seq = ad.seq_num(root.account_id)
+    for i in range(8):
+        f = root.tx([root.op_payment(root.account_id, 1 + i)],
+                    seq=base_seq + 1 + i)
+        apps[0].submit_transaction(f)
+    deadline = time.time() + 420
+    while time.time() < deadline:
+        sim.crank_all_nodes(50)
+        if sim.have_all_externalized(2):
+            break
+        time.sleep(0.001)
+    assert sim.have_all_externalized(2)
+
+    # every node that dispatched batches reports the enqueue→complete
+    # latency timer. The ~1s SCP-budget bound (SCPDriver::computeTimeout)
+    # is a DEVICE property — a 128-batch is milliseconds on the real chip
+    # but seconds on this CPU-jit test backend — so here we assert the
+    # metric's shape and a loose CPU-appropriate ceiling; bench.py
+    # measures the real-device p50/p99 (verify_latency) for the SLO.
+    samples = 0
+    for a in apps:
+        t = a.metrics.to_json().get("crypto.verify.latency")
+        if not t or t["count"] == 0:
+            continue
+        samples += t["count"]
+        assert t["median"] <= t["p99"]
+        assert t["p99"] < 20.0, "p99 %.3fs: async path is wedged" % t["p99"]
+    assert samples > 0, "no latency samples recorded on any node"
+    # the timer is visible through the admin /metrics surface of a node
+    # that recorded samples
+    from tests.test_admin import cmd
+    target = next(a for a in apps
+                  if a.metrics.to_json().get(
+                      "crypto.verify.latency", {}).get("count", 0) > 0)
+    st, m = cmd(target, "metrics")
+    assert st == 200
+    assert m["crypto.verify.latency"]["count"] > 0

@@ -203,3 +203,75 @@ def test_intersection_critical_groups():
         qmap).network_enjoys_quorum_intersection()
     crit = intersection_critical_groups(qmap)
     assert crit == [{nid(3)}], crit
+
+
+def _pubnet_like(norgs=100, per_org=3, tier1=7, tier1_threshold=None):
+    """A pubnet-shaped transitive map: a tier-1 backbone of `tier1` orgs
+    that everyone (including tier-1) builds quorums from, plus
+    `norgs - tier1` dependent orgs. ~norgs*per_org nodes total. This is
+    the real topology shape the reference's SCC pruning exploits
+    (QuorumIntersectionCheckerImpl.h refinement 8)."""
+    orgs = [[SecretKey.from_seed(sha256(b"pub-%d-%d" % (o, i))).public_key
+             for i in range(per_org)] for o in range(norgs)]
+    org_inner = [qs(2, org) for org in orgs]
+    t1 = org_inner[:tier1]
+    thr = tier1_threshold if tier1_threshold is not None \
+        else (2 * tier1 + 2) // 3
+    top = qs(thr, [], inner=t1)
+    return {k.key_bytes: top for org in orgs for k in org}
+
+
+def test_pubnet_scale_intersection_within_budget():
+    """~100 orgs / 300 nodes with a tier-1 backbone: the checker finishes
+    well inside an operator-tolerable budget and reports intersection
+    (reference runs this on a worker thread against pubnet,
+    HerderImpl.cpp:140-144)."""
+    import time
+    qmap = _pubnet_like()
+    assert len(qmap) == 300
+    t0 = time.monotonic()
+    c = QuorumIntersectionChecker(qmap)
+    ok = c.network_enjoys_quorum_intersection()
+    elapsed = time.monotonic() - t0
+    assert ok is True
+    assert elapsed < 45.0, "pubnet-scale check took %.1fs" % elapsed
+
+
+def test_pubnet_scale_split_detected_within_budget():
+    """Same scale with a tier-1 threshold low enough to split (3 of 7):
+    two disjoint tier-1 triples exist and the checker finds them fast."""
+    import time
+    qmap = _pubnet_like(tier1_threshold=3)
+    t0 = time.monotonic()
+    c = QuorumIntersectionChecker(qmap)
+    ok = c.network_enjoys_quorum_intersection()
+    elapsed = time.monotonic() - t0
+    assert ok is False
+    assert c.last_split is not None
+    a, b = c.last_split
+    assert not (set(a) & set(b))
+    assert elapsed < 45.0, "split detection took %.1fs" % elapsed
+
+
+def test_pubnet_scale_interrupt_honored():
+    """The interrupt flag aborts a pubnet-scale run promptly — the hook
+    the herder's worker thread uses (reference HerderImpl.cpp:140-144)."""
+    import threading
+    import time
+    # fully symmetric map: worst case, would run a very long time
+    orgs = [[SecretKey.from_seed(sha256(b"sym-%d-%d" % (o, i))).public_key
+             for i in range(3)] for o in range(40)]
+    org_inner = [qs(2, org) for org in orgs]
+    top = qs(27, [], inner=org_inner)
+    qmap = {k.key_bytes: top for org in orgs for k in org}
+    c = QuorumIntersectionChecker(qmap)
+
+    def interrupt_soon():
+        time.sleep(0.3)
+        c.interrupted = True
+
+    threading.Thread(target=interrupt_soon, daemon=True).start()
+    t0 = time.monotonic()
+    with pytest.raises(InterruptedError):
+        c.network_enjoys_quorum_intersection()
+    assert time.monotonic() - t0 < 5.0

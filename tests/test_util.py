@@ -101,3 +101,32 @@ def test_xdr_stream_roundtrip():
         with XDRInputFileStream(path) as inp:
             got = list(inp.read_all(X.SCPBallot))
         assert got == vals
+
+
+def test_log_slow_execution_warns_only_over_threshold():
+    """LogSlowExecution (reference util/LogSlowExecution.h): silent under
+    the threshold, one Perf-partition warning when exceeded."""
+    import logging
+    import time as _time
+
+    from stellar_core_tpu.util.slow_execution import LogSlowExecution
+
+    records = []
+
+    class _Capture(logging.Handler):
+        def emit(self, r):
+            records.append(r)
+
+    lg = logging.getLogger("stellar.Perf")
+    h = _Capture(level=logging.WARNING)
+    lg.addHandler(h)
+    try:
+        with LogSlowExecution("fast thing", threshold=10.0):
+            pass
+        assert not records
+        with LogSlowExecution("slow thing", threshold=0.005) as s:
+            _time.sleep(0.02)
+        assert s.elapsed >= 0.02
+        assert any("slow thing" in r.getMessage() for r in records)
+    finally:
+        lg.removeHandler(h)
