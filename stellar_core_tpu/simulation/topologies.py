@@ -81,3 +81,61 @@ def branched_core(n_core: int = 3) -> Simulation:
         leaf = sim.add_node(lk, q)
         sim.connect(leaf.name, core_names[i])
     return sim
+
+
+def hierarchical(n_branches: int = 3,
+                 mode: int = Simulation.OVER_LOOPBACK) -> Simulation:
+    """Core-4 top tier + per-branch middle-tier validators whose qsets
+    are {self} + an inner 2-of-4 top-tier set (reference
+    Topologies::hierarchicalQuorum, "Figure 3 from the paper")."""
+    sim = Simulation(mode=mode)
+    core_keys = _keys(4, b"hcore")
+    core_q = SCPQuorumSet(
+        threshold=3, validators=[k.public_key for k in core_keys],
+        innerSets=[])
+    core_names = [sim.add_node(k, core_q).name for k in core_keys]
+    for i in range(4):
+        for j in range(i + 1, 4):
+            sim.connect(core_names[i], core_names[j])
+    top_tier_inner = SCPQuorumSet(
+        threshold=2, validators=[k.public_key for k in core_keys],
+        innerSets=[])
+    mid_keys = _keys(n_branches, b"hmid")
+    for b in range(n_branches):
+        mk = mid_keys[b]
+        q = SCPQuorumSet(threshold=2, validators=[mk.public_key],
+                         innerSets=[top_tier_inner])
+        node = sim.add_node(mk, q)
+        # round-robin connections into the core
+        sim.connect(node.name, core_names[b % 4])
+        sim.connect(node.name, core_names[(b + 1) % 4])
+    return sim
+
+
+def hierarchical_simplified(core_size: int = 4, n_outer: int = 4,
+                            mode: int = Simulation.OVER_LOOPBACK
+                            ) -> Simulation:
+    """Core + outer validators whose flat qsets are {self + core} at
+    Byzantine-safe threshold (reference
+    Topologies::hierarchicalQuorumSimplified)."""
+    sim = Simulation(mode=mode)
+    core_keys = _keys(core_size, b"hsimp")
+    core_q = SCPQuorumSet(
+        threshold=(core_size * 3 + 3) // 4,
+        validators=[k.public_key for k in core_keys], innerSets=[])
+    core_names = [sim.add_node(k, core_q).name for k in core_keys]
+    for i in range(core_size):
+        for j in range(i + 1, core_size):
+            sim.connect(core_names[i], core_names[j])
+    n = core_size + 1
+    outer_keys = _keys(n_outer, b"houter")
+    for i in range(n_outer):
+        ok = outer_keys[i]
+        q = SCPQuorumSet(
+            threshold=n - (n - 1) // 3,
+            validators=[k.public_key for k in core_keys] + [ok.public_key],
+            innerSets=[])
+        node = sim.add_node(ok, q)
+        sim.connect(node.name, core_names[i % core_size])
+        sim.connect(node.name, core_names[(i + 1) % core_size])
+    return sim

@@ -46,6 +46,8 @@ class TestLedger:
     """An in-memory ledger with a funded root account; applies transactions
     directly (fee+seq then apply), without consensus."""
 
+    __test__ = False    # not a pytest collection target
+
     def __init__(self, network_id: bytes = TESTING_NETWORK_ID,
                  verifier=None, ledger_version: int = 13) -> None:
         self.network_id = network_id
@@ -164,6 +166,8 @@ class AppLedgerAdapter:
 
 
 class TestAccount:
+    __test__ = False    # not a pytest collection target
+
     def __init__(self, ledger: TestLedger, sk: SecretKey) -> None:
         self.ledger = ledger
         self.sk = sk

@@ -651,7 +651,7 @@ def _prepared_a1_base():
     return s
 
 
-def test_switch_prepared_b1_from_a1():
+def _switch_prepared_b1_from_a1():
     s = _prepared_a1_base()
     h = s.h
     # (p,p') = (B1, A1) [from (A1, null)]
@@ -673,8 +673,12 @@ def test_switch_prepared_b1_from_a1():
     return s
 
 
+def test_switch_prepared_b1_from_a1():
+    _switch_prepared_b1_from_a1()
+
+
 def test_switch_prepared_vblocking_previous_p():
-    s = test_switch_prepared_b1_from_a1()
+    s = _switch_prepared_b1_from_a1()
     h = s.h
     # v-blocking with n=3 -> bump n
     h.recv_vblocking(h.prepare_gen(s.B3))
@@ -689,7 +693,7 @@ def test_switch_prepared_vblocking_previous_p():
 
 
 def test_switch_prepared_p_prime_to_mid2():
-    s = test_switch_prepared_b1_from_a1()
+    s = _switch_prepared_b1_from_a1()
     h = s.h
     h.recv_vblocking(h.prepare_gen(s.B2, s.B2, 0, 0, s.Mid2))
     assert len(h.envs) == 6
@@ -698,7 +702,7 @@ def test_switch_prepared_p_prime_to_mid2():
 
 
 def test_switch_prepared_again_big2():
-    s = test_switch_prepared_b1_from_a1()
+    s = _switch_prepared_b1_from_a1()
     h = s.h
     # both p and p' get updated: (p,p') = (Big2, B2)
     h.recv_vblocking(h.prepare_gen(s.B2, s.Big2, 0, 0, s.B2))

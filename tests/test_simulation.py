@@ -124,3 +124,32 @@ def test_generateload_flood_sustained():
     # every submitted payment applied: balances conserved is checked by
     # the ConservationOfLumens invariant on each close (test config
     # enables all invariants)
+
+
+def test_hierarchical_topology_externalizes():
+    """reference Topologies::hierarchicalQuorum: top-tier core of 4 plus
+    middle-tier branch validators (self + inner 2-of-4) all externalize
+    the same values."""
+    from stellar_core_tpu.simulation import topologies
+    sim = topologies.hierarchical(3)
+    sim.start_all_nodes()
+    assert sim.crank_until(lambda: sim.have_all_externalized(3), 200000)
+    # byte-identical agreement at a FIXED slot on every node: compare the
+    # externalized VALUE of slot 3 (in-memory sims have no SQL store)
+    values = set()
+    for n in sim.nodes.values():
+        slot = n.app.herder.scp.get_slot(3, False)
+        assert slot is not None, "node missing slot 3"
+        v = slot.externalized_value()
+        assert v is not None, "slot 3 not externalized"
+        values.add(v)
+    assert len(values) == 1, "hierarchical nodes diverged at slot 3"
+
+
+def test_hierarchical_simplified_topology_externalizes():
+    """reference Topologies::hierarchicalQuorumSimplified: outer
+    validators with flat {self + core} qsets follow the core."""
+    from stellar_core_tpu.simulation import topologies
+    sim = topologies.hierarchical_simplified(4, 4)
+    sim.start_all_nodes()
+    assert sim.crank_until(lambda: sim.have_all_externalized(3), 200000)
