@@ -30,7 +30,7 @@ from ..xdr import (
 from ..ledger.ledger_manager import LedgerCloseData
 from .pending_envelopes import PendingEnvelopes, statement_qset_hash
 from .tx_queue import TransactionQueue, TxQueueResult
-from .txset import TxSetFrame
+from .txset import TxSetFrame, _xor
 from .upgrades import Upgrades
 
 log = get_logger("Herder")
@@ -124,18 +124,22 @@ class HerderSCPDriver(SCPDriver):
 
     def combine_candidates(self, slot_index: int,
                            candidates: List[bytes]) -> Optional[bytes]:
-        """Best txset by (ops, fees, hash), max closeTime, merged upgrades
-        (reference HerderSCPDriver::combineCandidates:608)."""
+        """Best LCL-based txset by (size, total fees from v11, xored-hash
+        tiebreak), max closeTime, per-type max of upgrades (reference
+        HerderSCPDriver::combineCandidates:608 + compareTxSets +
+        lessThanXored)."""
         best_sv: Optional[StellarValue] = None
-        best_key = None
         max_close = 0
         merged_upgrades: Dict[int, bytes] = {}
+        candidates_hash = bytes(32)
+        parsed: List[StellarValue] = []
         from ..xdr import LedgerUpgrade
         for raw in candidates:
             try:
                 sv = StellarValue.from_xdr(raw)
             except Exception:
                 continue
+            candidates_hash = _xor(candidates_hash, sha256(raw))
             max_close = max(max_close, sv.closeTime)
             for u in sv.upgrades:
                 try:
@@ -145,12 +149,30 @@ class HerderSCPDriver(SCPDriver):
                 cur = merged_upgrades.get(up.disc)
                 if cur is None or u > cur:
                     merged_upgrades[up.disc] = u
+            parsed.append(sv)
+
+        lm = self.herder.app.ledger_manager
+        header = lm.lcl_header
+
+        def xored(h: bytes) -> bytes:
+            # salting the tiebreak with the candidates hash keeps the
+            # winner unpredictable across rounds (reference lessThanXored)
+            return _xor(h, candidates_hash)
+
+        usable = []
+        for sv in parsed:
             txset = self.herder.pending.get_tx_set(sv.txSetHash)
-            ops = txset.size_ops() if txset is not None else 0
-            key = (ops, sv.txSetHash)
-            if best_key is None or key > best_key:
-                best_key = key
-                best_sv = sv
+            if txset is not None and \
+                    txset.previous_ledger_hash == lm.lcl_hash:
+                fees = txset.total_fees(header)
+                usable.append(((txset.size_for_cap(header), fees,
+                                xored(sv.txSetHash)), sv))
+        if usable:
+            best_sv = max(usable, key=lambda t: t[0])[1]
+        elif parsed:
+            # no candidate txset is known/LCL-based (fetch still in
+            # flight): converge on the highest xored hash
+            best_sv = max(parsed, key=lambda sv: xored(sv.txSetHash))
         if best_sv is None:
             return None
         out = StellarValue(

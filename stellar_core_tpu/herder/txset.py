@@ -125,6 +125,15 @@ class TxSetFrame:
             return lowest
         return None
 
+    def total_fees(self, header) -> int:
+        """Σ feeCharged at this set's effective base fee from protocol 11;
+        pre-11 the full fee bids (reference TxSetFrame::getTotalFees,
+        used by combineCandidates' tiebreak)."""
+        if header.ledgerVersion < 11:
+            return sum(f.fee_bid for f in self.frames)
+        bf = self.base_fee(header)
+        return sum(f.fee_charged(header, bf) for f in self.frames)
+
     def _fee_rate_key(self, f: AnyFrame, header) -> Tuple:
         # higher fee per OPERATION first regardless of protocol (reference
         # SurgeCompare, TxSetFrame.cpp:150-186); tie-break by full hash

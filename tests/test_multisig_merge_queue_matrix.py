@@ -599,3 +599,103 @@ def test_preauth_v9_consumed_only_on_success():
     acc = account_entry(ledger, a.account_id)
     assert len(acc.signers) == 1      # ok_tx's signer gone, doomed's stays
     assert acc.signers[0].key == _preauth_key_for(doomed)
+
+
+# ================================================= fee-bump queue matrix
+# reference src/herder/test/TransactionQueueTests.cpp:736-960
+# ("transaction queue with fee-bump")
+
+def _bump(led, sponsor, inner_frame, fee=2000):
+    from stellar_core_tpu.transactions.transaction_frame import \
+        FeeBumpTransactionFrame
+    from stellar_core_tpu.xdr import (
+        EnvelopeType, FeeBumpTransaction, FeeBumpTransactionEnvelope,
+        TransactionEnvelope, _Ext,
+    )
+    from stellar_core_tpu.xdr.transaction import _InnerTxEnvelope
+    fb = FeeBumpTransaction(
+        feeSource=sponsor.muxed, fee=fee,
+        innerTx=_InnerTxEnvelope(EnvelopeType.ENVELOPE_TYPE_TX,
+                                 inner_frame.envelope.value),
+        ext=_Ext.v0())
+    env = TransactionEnvelope(
+        EnvelopeType.ENVELOPE_TYPE_TX_FEE_BUMP,
+        FeeBumpTransactionEnvelope(tx=fb, signatures=[]))
+    frame = FeeBumpTransactionFrame(led.network_id, env)
+    frame.add_signature(sponsor.sk)
+    return frame
+
+
+def test_fee_bump_same_source_ages_and_bans(env):
+    """reference '1 fee bump, fee source same as source': a fee bump
+    queues under the INNER source's chain, ages with it, and bans."""
+    led, root, a, b, q = env
+    inner = _pay(a, root)
+    fb = _bump(led, a, inner)
+    assert q.try_add(fb) == PENDING
+    # a fee bump counts as inner ops + 1 (reference getNumOperations)
+    assert q.size_ops() == 2
+    for _ in range(4):
+        q.shift()
+    assert q.size_ops() == 0
+    assert q.is_banned(fb.full_hash())
+
+
+def test_fee_bump_distinct_fee_source_chains_by_inner(env):
+    """reference '1 fee bump, fee source distinct from source': the chain
+    key is the inner source; the fee source only sponsors the bid."""
+    led, root, a, b, q = env
+    inner = _pay(a, root)
+    fb = _bump(led, b, inner)
+    assert q.try_add(fb) == PENDING
+    # a's chain continues off the bumped inner seq
+    nxt = _pay(a, root, seq=inner.seq_num + 1)
+    assert q.try_add(nxt) == PENDING
+    # b's own seq chain is untouched by sponsoring
+    own = _pay(b, root)
+    assert q.try_add(own) == PENDING
+    assert q.size_ops() == 4   # fee bump (2) + two plain txs
+
+
+def test_two_fee_bumps_same_sponsor_different_sources(env):
+    """reference '2 fee bumps with same fee source but different source':
+    both queue; the sponsor's balance covers both bids."""
+    led, root, a, b, q = env
+    sponsor = root.create(10**10)
+    fb1 = _bump(led, sponsor, _pay(a, root))
+    fb2 = _bump(led, sponsor, _pay(b, root))
+    assert q.try_add(fb1) == PENDING
+    assert q.try_add(fb2) == PENDING
+    assert q.size_ops() == 4   # two fee bumps, 2 ops each
+
+
+def test_fee_bump_ban_drops_inner_chain_tail(env):
+    """reference 'ban first of two fee bumps with same fee source and
+    source': banning the first drops the dependent second."""
+    led, root, a, b, q = env
+    inner1 = _pay(a, root)
+    fb1 = _bump(led, a, inner1)
+    inner2 = _pay(a, root, seq=inner1.seq_num + 1)
+    fb2 = _bump(led, a, inner2)
+    assert q.try_add(fb1) == PENDING
+    assert q.try_add(fb2) == PENDING
+    q.ban([fb1.full_hash()])
+    assert q.size_ops() == 0
+    assert q.is_banned(fb1.full_hash()) and q.is_banned(fb2.full_hash())
+    assert q.try_add(fb2) == LATER
+
+
+def test_fee_bump_remove_applied_keeps_later(env):
+    """reference 'remove first of two fee bumps': applying the first
+    leaves the second chained correctly."""
+    led, root, a, b, q = env
+    inner1 = _pay(a, root)
+    fb1 = _bump(led, a, inner1)
+    inner2 = _pay(a, root, seq=inner1.seq_num + 1)
+    fb2 = _bump(led, a, inner2)
+    assert q.try_add(fb1) == PENDING
+    assert q.try_add(fb2) == PENDING
+    assert led.apply_frame(fb1)
+    q.remove_applied([fb1])
+    assert q.size_ops() == 2   # fb2 remains (inner ops + 1)
+    assert q.try_add(fb2) == DUP

@@ -164,3 +164,66 @@ def test_herder_rejects_bad_close_times():
     # garbage value bytes → invalid
     assert drv.validate_value(slot, b"\x01\x02", False) == \
         ValidationLevel.INVALID
+
+
+def test_combine_candidates_prefers_size_then_fees():
+    """reference HerderSCPDriver::combineCandidates + compareTxSets: the
+    winning txset has the most capacity units, then (v11+) the highest
+    total fees; closeTime is the max and upgrades merge per-type max."""
+    from stellar_core_tpu.herder.txset import TxSetFrame
+    from stellar_core_tpu.main.application import Application
+    from stellar_core_tpu.main.config import Config
+    from stellar_core_tpu.testing import AppLedgerAdapter
+    from stellar_core_tpu.util.timer import ClockMode, VirtualClock
+    from stellar_core_tpu.xdr import StellarValue, StellarValueExt
+
+    cfg = Config.test_config(0)
+    app = Application(VirtualClock(ClockMode.VIRTUAL_TIME), cfg)
+    app.start()
+    ad = AppLedgerAdapter(app)
+    root = ad.root_account()
+    a = root.create(10**9)
+    b = root.create(10**9)
+    lm = app.ledger_manager
+    drv = app.herder.scp_driver
+    slot = lm.lcl_header.ledgerSeq + 1
+    ct = lm.lcl_header.scpValue.closeTime + 5
+
+    # same size (1 op each), different fee bids
+    low = TxSetFrame(app.config.network_id, lm.lcl_hash,
+                     [a.tx([a.op_payment(root.account_id, 1)], fee=100)])
+    high = TxSetFrame(app.config.network_id, lm.lcl_hash,
+                      [b.tx([b.op_payment(root.account_id, 1)], fee=900)])
+    pend = app.herder.pending
+    pend.add_tx_set(low.get_contents_hash(), low)
+    pend.add_tx_set(high.get_contents_hash(), high)
+
+    def val(ts, close):
+        return StellarValue(txSetHash=ts.get_contents_hash(),
+                            closeTime=close, upgrades=[],
+                            ext=StellarValueExt(0, None)).to_xdr()
+
+    combined = drv.combine_candidates(
+        slot, [val(low, ct), val(high, ct + 3)])
+    got = StellarValue.from_xdr(combined)
+    assert got.txSetHash == high.get_contents_hash()  # higher fees win
+    assert got.closeTime == ct + 3                    # max close time
+
+    # a bigger (2-op) set beats higher fees
+    big = TxSetFrame(app.config.network_id, lm.lcl_hash, [
+        a.tx([a.op_payment(root.account_id, 1),
+              a.op_payment(root.account_id, 2)], fee=200,
+             seq=low.frames[0].seq_num)])
+    pend.add_tx_set(big.get_contents_hash(), big)
+    combined = drv.combine_candidates(slot, [val(big, ct), val(high, ct)])
+    got = StellarValue.from_xdr(combined)
+    assert got.txSetHash == big.get_contents_hash()
+
+    # txsets based on the WRONG previous ledger are excluded
+    stale = TxSetFrame(app.config.network_id, b"\x77" * 32,
+                       [a.tx([a.op_payment(root.account_id, 9)], fee=999,
+                             seq=low.frames[0].seq_num)])
+    pend.add_tx_set(stale.get_contents_hash(), stale)
+    combined = drv.combine_candidates(slot, [val(stale, ct), val(low, ct)])
+    got = StellarValue.from_xdr(combined)
+    assert got.txSetHash == low.get_contents_hash()
