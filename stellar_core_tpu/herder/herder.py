@@ -295,6 +295,15 @@ class Herder:
         if slot < max(1, cur - 1) or \
                 slot > cur + self.LEDGER_VALIDITY_BRACKET:
             return SCP.EnvelopeState.INVALID
+        # in-quorum filtering: envelopes from nodes outside the local
+        # TRANSITIVE quorum are discarded — they can't affect consensus
+        # and dropping them here also saves their signature verifies
+        # (reference PendingEnvelopes::recvSCPEnvelope "not in quorum",
+        # PendingEnvelopes.cpp:268-273; HerderTests "In quorum filtering")
+        if not self.quorum_tracker.is_node_definitely_in_quorum(st.nodeID):
+            log.debug("dropping envelope from %s (not in quorum)",
+                      st.nodeID.value.hex()[:8])
+            return SCP.EnvelopeState.INVALID
         eh = sha256(envelope.to_xdr())
         if not self.pending.begin_verify(envelope, eh):
             # duplicate (processed / discarded / already verifying)

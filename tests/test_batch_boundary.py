@@ -89,26 +89,34 @@ def test_envelope_verifies_accumulate_one_dispatch():
     from stellar_core_tpu.util.timer import ClockMode, VirtualClock
 
     _clear_verify_cache()
+    from stellar_core_tpu.crypto.keys import SecretKey
+    from stellar_core_tpu.crypto.hashing import sha256
+    from stellar_core_tpu.scp.scp import SCP
+    import stellar_core_tpu.xdr as X
+
     cfg = Config.test_config(0, backend="tpu-async")
     cfg.SIG_VERIFY_WARMUP = False
+    # the foreign validators must be IN the local quorum set: envelopes
+    # from outside the transitive quorum are discarded before verify
+    # (reference in-quorum filtering)
+    foreign = [SecretKey.from_seed(bytes([40 + i]) * 32) for i in range(8)]
+    cfg.QUORUM_SET = X.SCPQuorumSet(
+        threshold=9,
+        validators=[cfg.NODE_SEED.public_key] +
+                   [sk.public_key for sk in foreign],
+        innerSets=[])
     clock = VirtualClock(ClockMode.VIRTUAL_TIME)
     app = Application(clock, cfg)
     assert isinstance(app.sig_verifier, ThreadedBatchVerifier)
     app.sig_verifier.inner.BUCKETS = (32,)
     app.start()
 
-    # build envelopes signed by foreign validators for the next slot
-    from stellar_core_tpu.crypto.keys import SecretKey
-    from stellar_core_tpu.crypto.hashing import sha256
-    from stellar_core_tpu.scp.scp import SCP
-    import stellar_core_tpu.xdr as X
-
     slot = app.herder.current_slot()
     qset = cfg.QUORUM_SET
     qh = sha256(qset.to_xdr())
     envs = []
     for i in range(8):
-        sk = SecretKey.from_seed(bytes([40 + i]) * 32)
+        sk = foreign[i]
         sv = X.StellarValue(txSetHash=bytes([i]) * 32, closeTime=123 + i,
                             upgrades=[], ext=X.StellarValueExt(0, None))
         st = X.SCPStatement(

@@ -81,3 +81,56 @@ def test_quorum_threshold_raise_still_live():
         lambda: all(_lcl(v) >= target for v in sim.nodes.values()),
         60000), {n: _lcl(v) for n, v in sim.nodes.items()}
     sim.stop_all_nodes()
+
+
+def test_in_quorum_filtering():
+    """Envelopes from validators OUTSIDE the core's transitive quorum are
+    discarded by core nodes, while the outside validators (who DO track
+    the core) still externalize (reference HerderTests.cpp:1735 'In
+    quorum filtering')."""
+    from stellar_core_tpu.crypto.hashing import sha256
+    from stellar_core_tpu.crypto.keys import SecretKey
+    from stellar_core_tpu.simulation import topologies
+    from stellar_core_tpu.xdr import SCPQuorumSet
+
+    sim = topologies.core(4, 3)
+    sim.start_all_nodes()
+    assert sim.crank_until(lambda: sim.have_all_externalized(2), 60000)
+
+    core_nodes = list(sim.nodes.values())
+    core_ids = {n.app.config.node_id().key_bytes for n in core_nodes}
+    core_qset = core_nodes[0].app.config.QUORUM_SET
+
+    # extra validators E_i: they trust the core, the core ignores them
+    extras = []
+    for i in range(3):
+        sk = SecretKey.from_seed(sha256(b"E_%d" % i))
+        q = SCPQuorumSet(threshold=core_qset.threshold,
+                         validators=list(core_qset.validators),
+                         innerSets=[])
+        node = sim.add_node(sk, q)
+        node.app.start()
+        sim.connect(node.name, core_nodes[0].name)
+        extras.append(node)
+    extra_ids = {e.app.config.node_id().key_bytes for e in extras}
+
+    assert sim.crank_until(
+        lambda: all(n.app.ledger_manager.last_closed_ledger_num() >= 4
+                    for n in core_nodes), 200000)
+
+    # core nodes' SCP state contains NO statements from the extras
+    for n in core_nodes:
+        for seq in (3, 4):
+            slot = n.app.herder.scp.get_slot(seq, False)
+            if slot is None:
+                continue
+            for env in slot.get_current_state():
+                assert env.statement.nodeID.key_bytes not in extra_ids, \
+                    "core node recorded an out-of-quorum statement"
+
+    # ...but the extras DO hear the core (the core is in their quorum)
+    # and track its externalized slots, even though they cannot close
+    # without an archive to catch up from
+    for e in extras:
+        assert (e.app.herder.tracking_slot or 0) >= 3
+    sim.stop_all_nodes()

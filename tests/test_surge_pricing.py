@@ -1,0 +1,136 @@
+"""Surge pricing matrix (reference HerderTests.cpp:1012 'surge pricing'
+and the surgeTest driver at :940-1010): when a candidate set exceeds
+maxTxSetSize, the filter keeps the highest fee-per-unit whole account
+chains, with protocol-versioned capacity units (txs pre-11, ops from 11).
+"""
+
+import pytest
+
+from stellar_core_tpu.herder.txset import TxSetFrame
+from stellar_core_tpu.testing import TestLedger
+
+
+@pytest.fixture
+def ledger():
+    return TestLedger()
+
+
+def _multi_pay(acct, root, n_ops, fee, seq=None):
+    ops = [acct.op_payment(root.account_id, 100 + i) for i in range(n_ops)]
+    return acct.tx(ops, fee=fee, seq=seq)
+
+
+def _mk_set(ledger, frames):
+    return TxSetFrame(ledger.network_id, b"\x00" * 32, frames)
+
+
+def test_surge_basic_single_account(ledger):
+    """reference surgeTest 'basic single account' (protocol current):
+    the kept txs form a seq-ordered PREFIX of the account's chain and the
+    set lands exactly at capacity."""
+    root = ledger.root_account
+    a = root.create(10**10)
+    base = a.next_seq()
+    frames = [_multi_pay(a, root, n + 1, 10000 + 1000 * n, seq=base + n)
+              for n in range(10)]          # 1..10 ops, rising fees
+    ts = _mk_set(ledger, frames)
+    header = ledger.header()
+    header.maxTxSetSize = 15
+    assert ts.size_for_cap(header) == 55
+    ts.surge_pricing_filter(header)
+    assert ts.size_for_cap(header) <= 15
+    kept = sorted(ts.frames, key=lambda f: f.seq_num)
+    # chain constraint: a seq-ordered prefix, no gaps
+    for i, f in enumerate(kept):
+        assert f.seq_num == base + i
+
+
+def test_surge_higher_fee_account_wins(ledger):
+    """reference surgeTest 'one account paying more': when two accounts
+    submit identical shapes, the one bidding more per op survives."""
+    root = ledger.root_account
+    a = root.create(10**10)
+    b = root.create(10**10)
+    sa, sb = a.next_seq(), b.next_seq()
+    frames = []
+    for n in range(5):
+        frames.append(_multi_pay(a, root, 1, 2000, seq=sa + n))
+        frames.append(_multi_pay(b, root, 1, 1999, seq=sb + n))
+    ts = _mk_set(ledger, frames)
+    header = ledger.header()
+    header.maxTxSetSize = 5
+    ts.surge_pricing_filter(header)
+    assert ts.size_for_cap(header) == 5
+    assert all(f.source_account_id() == a.account_id for f in ts.frames)
+
+
+def test_surge_more_ops_same_total_fee_loses(ledger):
+    """reference surgeTest 'one account with more operations but same
+    total fee': fee-per-OP decides, so the bulkier txs lose."""
+    root = ledger.root_account
+    a = root.create(10**10)
+    b = root.create(10**10)
+    sa, sb = a.next_seq(), b.next_seq()
+    frames = []
+    for n in range(5):
+        frames.append(_multi_pay(a, root, 1, 2000, seq=sa + n))
+        frames.append(_multi_pay(b, root, 2, 2000, seq=sb + n))
+    ts = _mk_set(ledger, frames)
+    header = ledger.header()
+    header.maxTxSetSize = 5
+    ts.surge_pricing_filter(header)
+    assert all(f.source_account_id() == a.account_id for f in ts.frames)
+
+
+def test_surge_protocol10_counts_whole_txs():
+    """reference surgeTest(10, ...): pre-11 the capacity unit is a whole
+    TRANSACTION regardless of its op count."""
+    ledger = TestLedger(ledger_version=10)
+    root = ledger.root_account
+    a = root.create(10**10)
+    base = a.next_seq()
+    frames = [_multi_pay(a, root, 3, 10000 + n, seq=base + n)
+              for n in range(10)]          # 3 ops each: irrelevant at v10
+    ts = _mk_set(ledger, frames)
+    header = ledger.header()
+    header.maxTxSetSize = 5
+    assert ts.size_for_cap(header) == 10   # 10 txs
+    ts.surge_pricing_filter(header)
+    assert ts.size_txs() == 5
+    for i, f in enumerate(sorted(ts.frames, key=lambda f: f.seq_num)):
+        assert f.seq_num == base + i
+
+
+def test_surge_max_zero_empties_set(ledger):
+    """reference 'max 0 ops per ledger': the filter empties the set and
+    is idempotent."""
+    root = ledger.root_account
+    a = root.create(10**10)
+    ts = _mk_set(ledger, [_multi_pay(a, root, 1, 1000)])
+    header = ledger.header()
+    header.maxTxSetSize = 0
+    ts.surge_pricing_filter(header)
+    assert ts.size_ops() == 0
+    ts.surge_pricing_filter(header)
+    assert ts.size_ops() == 0
+
+
+def test_base_fee_applies_only_near_capacity(ledger):
+    """reference HerderTests 'txset base fee': from protocol 11, when the
+    set is within MAX_OPS_PER_TX of capacity every tx pays the LOWEST
+    per-op bid; under that, the protocol base fee applies."""
+    root = ledger.root_account
+    a = root.create(10**10)
+    base = a.next_seq()
+    frames = [_multi_pay(a, root, 1, 500 + 100 * n, seq=base + n)
+              for n in range(10)]
+    ts = _mk_set(ledger, frames)
+    header = ledger.header()
+    # far under capacity: None → protocol base fee
+    header.maxTxSetSize = 100000
+    assert ts.base_fee(header) is None
+    # within MAX_OPS_PER_TX of capacity: lowest ceil(bid/ops) in the set
+    header.maxTxSetSize = 10
+    assert ts.base_fee(header) == 500
+    # total_fees at the surge base fee: everyone pays min(bid, 500*ops)
+    assert ts.total_fees(header) == 500 * 10
