@@ -104,7 +104,9 @@ def add_buying_liabilities(header: LedgerHeader, entry: LedgerEntry,
     if entry.data.disc == LedgerEntryType.ACCOUNT:
         max_liab = INT64_MAX - dv.balance
     else:
-        if not trustline_authorized(dv):
+        # maintain-or-more: liabilities on existing offers stay
+        # adjustable (reference checkAuthorization in addBuyingLiabilities)
+        if not trustline_authorized_to_maintain(dv):
             return False
         max_liab = dv.limit - dv.balance
     new = buying + delta
@@ -128,7 +130,7 @@ def add_selling_liabilities(header: LedgerHeader, entry: LedgerEntry,
         if max_liab < 0:
             return False
     else:
-        if not trustline_authorized(dv):
+        if not trustline_authorized_to_maintain(dv):
             return False
         max_liab = dv.balance
     new = selling + delta
@@ -165,7 +167,9 @@ def max_amount_receive(header: LedgerHeader, entry: LedgerEntry) -> int:
         if header.ledgerVersion >= LIABILITIES_VERSION:
             out -= dv.balance + _raw_liabilities(dv)[0]
         return out
-    if not trustline_authorized(dv):
+    if not trustline_authorized_to_maintain(dv):
+        # maintain-or-more, like every capacity primitive (reference
+        # getMaxAmountReceive → checkAuthorization)
         return 0
     out = dv.limit - dv.balance
     if header.ledgerVersion >= LIABILITIES_VERSION:
@@ -201,7 +205,12 @@ def add_trust_balance(header: LedgerHeader, entry: LedgerEntry,
     tl = entry.data.value
     if delta == 0:
         return True
-    if not (tl.flags & TrustLineFlags.AUTHORIZED_FLAG):
+    # the balance PRIMITIVE accepts maintain-or-more so existing offers
+    # can execute (reference checkAuthorization,
+    # TransactionUtils.cpp:18-34); payments enforce FULL authorization
+    # at the op level. Pre-13 lines can only carry the AUTHORIZED bit,
+    # so this is version-safe.
+    if not (tl.flags & TrustLineFlags.AUTH_LEVELS_MASK):
         return False
     new = tl.balance + delta
     if new < 0 or new > tl.limit:
@@ -218,6 +227,12 @@ def add_trust_balance(header: LedgerHeader, entry: LedgerEntry,
 
 def trustline_authorized(tl: TrustLineEntry) -> bool:
     return bool(tl.flags & TrustLineFlags.AUTHORIZED_FLAG)
+
+
+def trustline_authorized_to_maintain(tl: TrustLineEntry) -> bool:
+    """Either auth level: enough to keep/release/execute existing
+    liabilities (reference isAuthorizedToMaintainLiabilities)."""
+    return bool(tl.flags & TrustLineFlags.AUTH_LEVELS_MASK)
 
 
 def change_subentries(header: LedgerHeader, entry: LedgerEntry,

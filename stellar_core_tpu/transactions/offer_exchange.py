@@ -30,6 +30,9 @@ from .account_helpers import (
     load_trustline, min_balance,
 )
 
+# either auth level lets EXISTING offers execute (CAP-0018)
+_AUTH_ANY = TrustLineFlags.AUTH_LEVELS_MASK
+
 
 def _ceil_div(a: int, b: int) -> int:
     return -(-a // b)
@@ -68,8 +71,9 @@ def _available_to_sell(ltx, account_id, asset: Asset) -> int:
     if account_id == asset.issuer:
         return INT64_MAX
     tl_e = ltx.load_without_record(LedgerKey.trustline(account_id, asset))
-    if tl_e is None or not (tl_e.data.value.flags &
-                            TrustLineFlags.AUTHORIZED_FLAG):
+    if tl_e is None or not (tl_e.data.value.flags & _AUTH_ANY):
+        # maintain-liabilities is enough to EXECUTE existing offers
+        # (reference canSellAtMost isAuthorizedToMaintainLiabilities)
         return 0
     avail = tl_e.data.value.balance
     if header.ledgerVersion >= LIABILITIES_VERSION:
@@ -91,8 +95,8 @@ def _available_to_receive(ltx, account_id, asset: Asset) -> int:
     if account_id == asset.issuer:
         return INT64_MAX
     tl_e = ltx.load_without_record(LedgerKey.trustline(account_id, asset))
-    if tl_e is None or not (tl_e.data.value.flags &
-                            TrustLineFlags.AUTHORIZED_FLAG):
+    if tl_e is None or not (tl_e.data.value.flags & _AUTH_ANY):
+        # (reference canBuyAtMost isAuthorizedToMaintainLiabilities)
         return 0
     tl = tl_e.data.value
     out = tl.limit - tl.balance

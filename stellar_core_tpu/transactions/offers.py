@@ -97,9 +97,13 @@ class _ManageOfferBase(OperationFrame):
                                      offer=_offer_deleted()))
 
     def _check_trust(self, ltx, src_id, selling: Asset,
-                     buying: Asset) -> Optional[int]:
+                     buying: Asset, header) -> Optional[int]:
+        """Posting/updating needs FULL authorization on both lines;
+        maintain-liabilities is not enough (reference checkOfferValid,
+        ManageOfferOpFrameBase.cpp:28-97; issuer-existence checks only
+        pre-13)."""
         if not selling.is_native and src_id != selling.issuer:
-            if ltx.load_without_record(
+            if header.ledgerVersion < 13 and ltx.load_without_record(
                     LedgerKey.account(selling.issuer)) is None:
                 return ManageOfferResultCode.SELL_NO_ISSUER
             tl = ltx.load_without_record(
@@ -109,7 +113,7 @@ class _ManageOfferBase(OperationFrame):
             if not (tl.data.value.flags & TrustLineFlags.AUTHORIZED_FLAG):
                 return ManageOfferResultCode.SELL_NOT_AUTHORIZED
         if not buying.is_native and src_id != buying.issuer:
-            if ltx.load_without_record(
+            if header.ledgerVersion < 13 and ltx.load_without_record(
                     LedgerKey.account(buying.issuer)) is None:
                 return ManageOfferResultCode.BUY_NO_ISSUER
             tl = ltx.load_without_record(
@@ -128,9 +132,12 @@ class _ManageOfferBase(OperationFrame):
         src_id = self.source_account_id()
         header = ltx.load_header()
 
-        err = self._check_trust(ltx, src_id, selling, buying)
-        if err is not None:
-            return self.set_inner(err)
+        if not self._is_delete():
+            # deletes skip trust checks entirely (reference
+            # checkOfferValid "don't bother loading trust lines")
+            err = self._check_trust(ltx, src_id, selling, buying, header)
+            if err is not None:
+                return self.set_inner(err)
 
         existing_flags = 0
         is_update = False

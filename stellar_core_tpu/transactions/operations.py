@@ -364,15 +364,29 @@ class AllowTrustOpFrame(OperationFrame):
     def threshold_level(self) -> int:
         return ThresholdLevel.LOW
 
+    @staticmethod
+    def _flag_valid(flag: int, ledger_version: int) -> bool:
+        """reference trustLineFlagIsValid (TransactionUtils.cpp): pre-13
+        only AUTHORIZED; from 13 also MAINTAIN_LIABILITIES, but never
+        both auth bits at once."""
+        if ledger_version < 13:
+            return (flag & ~TrustLineFlags.MASK_TRUSTLINE_FLAGS) == 0
+        both = TrustLineFlags.AUTH_LEVELS_MASK
+        return (flag & ~TrustLineFlags.MASK_TRUSTLINE_FLAGS_V13) == 0 \
+            and (flag & both) != both
+
     def do_check_valid(self, header) -> bool:
         b = self.op.body.value
         code = b.asset.value.rstrip(b"\x00")
         if not code:
             return self.set_inner(AllowTrustResultCode.MALFORMED)
+        if not self._flag_valid(b.authorize, header.ledgerVersion):
+            return self.set_inner(AllowTrustResultCode.MALFORMED)
         return self.set_inner(AllowTrustResultCode.SUCCESS)
 
     def do_apply(self, ltx) -> bool:
         b = self.op.body.value
+        header = ltx.load_header()
         issuer_id = self.source_account_id()
         if b.trustor == issuer_id:
             return self.set_inner(AllowTrustResultCode.SELF_NOT_ALLOWED)
@@ -380,8 +394,8 @@ class AllowTrustOpFrame(OperationFrame):
         acc = issuer.data.value
         if not is_auth_required(acc):
             return self.set_inner(AllowTrustResultCode.TRUST_NOT_REQUIRED)
-        if not b.authorize and not (
-                acc.flags & AccountFlags.AUTH_REVOCABLE_FLAG):
+        not_revocable = not (acc.flags & AccountFlags.AUTH_REVOCABLE_FLAG)
+        if not_revocable and b.authorize == 0:
             return self.set_inner(AllowTrustResultCode.CANT_REVOKE)
         code = b.asset.value
         asset = Asset.credit(code.rstrip(b"\x00").decode("ascii"), issuer_id)
@@ -389,11 +403,35 @@ class AllowTrustOpFrame(OperationFrame):
         if tle is None:
             return self.set_inner(AllowTrustResultCode.NO_TRUST_LINE)
         tl = tle.data.value
-        if b.authorize:
-            tl.flags |= TrustLineFlags.AUTHORIZED_FLAG
-        else:
-            tl.flags &= ~TrustLineFlags.AUTHORIZED_FLAG
+        # downgrading AUTHORIZED → MAINTAIN_LIABILITIES is also a
+        # (partial) revocation (reference AllowTrustOpFrame.cpp:99-110)
+        fully = bool(tl.flags & TrustLineFlags.AUTHORIZED_FLAG)
+        maintain_or_more = bool(
+            tl.flags & TrustLineFlags.AUTH_LEVELS_MASK)
+        if not_revocable and fully and (
+                b.authorize &
+                TrustLineFlags.AUTHORIZED_TO_MAINTAIN_LIABILITIES_FLAG):
+            return self.set_inner(AllowTrustResultCode.CANT_REVOKE)
+        # a FULL revoke (from >= maintain) pulls the trustor's offers in
+        # this asset and releases their liabilities (reference :115-140,
+        # protocol >= 10)
+        if header.ledgerVersion >= 10 and maintain_or_more and \
+                b.authorize == 0:
+            self._remove_offers(ltx, header, b.trustor, asset)
+        tl.flags = b.authorize
         return self.set_inner(AllowTrustResultCode.SUCCESS)
+
+    @staticmethod
+    def _remove_offers(ltx, header, trustor, asset: Asset) -> None:
+        from .offer_exchange import release_liabilities
+        for entry in ltx.load_offers_by_account(trustor):
+            oe = entry.data.value
+            if oe.selling != asset and oe.buying != asset:
+                continue
+            release_liabilities(ltx, oe)
+            acct = load_account(ltx, trustor)
+            change_subentries(header, acct, -1)
+            ltx.erase(LedgerKey.offer(trustor, oe.offerID))
 
 
 @register_op

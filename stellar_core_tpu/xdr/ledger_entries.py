@@ -143,7 +143,13 @@ class AccountEntry(XdrStruct):
 
 class TrustLineFlags:
     AUTHORIZED_FLAG = 1
+    # protocol 13 (CAP-0018): may keep existing offers/liabilities but
+    # not send/receive payments or post new offers
+    AUTHORIZED_TO_MAINTAIN_LIABILITIES_FLAG = 2
+    # either auth level — keeps/releases/executes EXISTING liabilities
+    AUTH_LEVELS_MASK = 1 | 2
     MASK_TRUSTLINE_FLAGS = 1
+    MASK_TRUSTLINE_FLAGS_V13 = 3
 
 
 class TrustLineEntry(XdrStruct):

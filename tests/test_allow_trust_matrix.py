@@ -1,0 +1,194 @@
+"""AllowTrust / authorized-to-maintain-liabilities matrix (CAP-0018).
+
+Role parity: reference `src/transactions/test/AllowTrustTests.cpp:18-300`
+("authorized to maintain liabilities" + "allow trust"): full revocation
+pulls the trustor's offers in that asset, the maintain level keeps them
+crossable while blocking payments and new/updated offers, the downgrade
+from AUTHORIZED needs AUTH_REVOCABLE, and the auth bits are mutually
+exclusive on the wire from protocol 13.
+"""
+
+import pytest
+
+import stellar_core_tpu.xdr as X
+from stellar_core_tpu.testing import TestLedger
+from stellar_core_tpu.transactions.offers import (
+    ManageOfferResultCode, PathPaymentResultCode,
+)
+from stellar_core_tpu.transactions.operations import (
+    AllowTrustResultCode, PaymentResultCode,
+)
+from stellar_core_tpu.xdr import LedgerKey, TrustLineFlags
+
+AUTH_REQUIRED = 0x1
+AUTH_REVOCABLE = 0x2
+MAINTAIN = TrustLineFlags.AUTHORIZED_TO_MAINTAIN_LIABILITIES_FLAG
+
+
+@pytest.fixture
+def ledger():
+    return TestLedger()
+
+
+def inner_code(frame, op_index=0):
+    return frame.result.op_results[op_index].value.value.disc
+
+
+def _issuer_world(ledger):
+    """Issuer with AUTH_REQUIRED|AUTH_REVOCABLE, alice holding 500 USD
+    (authorized), a USD/native order book counterparty."""
+    root = ledger.root_account
+    issuer = root.create(10**10)
+    assert ledger.apply_frame(issuer.tx([issuer.op_set_options(
+        set_flags=AUTH_REQUIRED | AUTH_REVOCABLE)]))
+    usd = X.Asset.credit("USD", issuer.account_id)
+    alice = root.create(10**10)
+    assert ledger.apply_frame(alice.tx([alice.op_change_trust(usd, 10**9)]))
+    assert ledger.apply_frame(issuer.tx([issuer.op_allow_trust(
+        alice.account_id, b"USD\x00", 1)]))
+    assert ledger.apply_frame(issuer.tx([issuer.op_payment(
+        alice.account_id, 500, usd)]))
+    return root, issuer, usd, alice
+
+
+def _offer(acct, selling, buying, amount, n=1, d=1, offer_id=0):
+    return acct.op_manage_sell_offer(selling, buying, amount, n, d,
+                                     offer_id=offer_id)
+
+
+def test_full_revoke_pulls_offers(ledger):
+    """reference 'denyTrust on selling asset': revoking to 0 deletes the
+    trustor's offers in the asset and releases the subentries."""
+    root, issuer, usd, alice = _issuer_world(ledger)
+    assert ledger.apply_frame(alice.tx([_offer(
+        alice, usd, X.Asset.native(), 100)]))
+    acc = ledger.root.get_entry(
+        LedgerKey.account(alice.account_id)).data.value
+    subs_before = acc.numSubEntries
+    assert ledger.apply_frame(issuer.tx([issuer.op_allow_trust(
+        alice.account_id, b"USD\x00", 0)]))
+    acc = ledger.root.get_entry(
+        LedgerKey.account(alice.account_id)).data.value
+    assert acc.numSubEntries == subs_before - 1   # offer subentry gone
+    tl = ledger.root.get_entry(
+        LedgerKey.trustline(alice.account_id, usd)).data.value
+    assert tl.flags == 0
+    from stellar_core_tpu.transactions.account_helpers import \
+        get_selling_liabilities
+    tle = ledger.root.get_entry(
+        LedgerKey.trustline(alice.account_id, usd))
+    assert get_selling_liabilities(ledger.header(), tle) == 0
+
+
+def test_maintain_keeps_offers_crossable(ledger):
+    """reference "don't pull orders until denyTrust": downgrading to
+    MAINTAIN keeps the offer on the book, and it still EXECUTES when
+    crossed."""
+    root, issuer, usd, alice = _issuer_world(ledger)
+    assert ledger.apply_frame(alice.tx([_offer(
+        alice, usd, X.Asset.native(), 100)]))
+    assert ledger.apply_frame(issuer.tx([issuer.op_allow_trust(
+        alice.account_id, b"USD\x00", MAINTAIN)]))
+    # the offer is still on the book after the downgrade
+    assert len(ledger.root._offers_by_account(alice.account_id)) == 1
+    # bob buys USD with native, crossing alice's maintained offer
+    bob = root.create(10**10)
+    assert ledger.apply_frame(bob.tx([bob.op_change_trust(usd, 10**9)]))
+    assert ledger.apply_frame(issuer.tx([issuer.op_allow_trust(
+        bob.account_id, b"USD\x00", 1)]))
+    f = bob.tx([_offer(bob, X.Asset.native(), usd, 40)])
+    assert ledger.apply_frame(f), f.result
+    assert ledger.trust_balance(bob.account_id, usd) == 40
+
+
+def test_maintain_blocks_new_and_updated_offers(ledger):
+    """reference "can't add offer" / "can't update offer": with only
+    MAINTAIN, posting or amending offers fails NOT_AUTHORIZED; deleting
+    is allowed."""
+    root, issuer, usd, alice = _issuer_world(ledger)
+    f0 = alice.tx([_offer(alice, usd, X.Asset.native(), 100)])
+    assert ledger.apply_frame(f0)
+    offer_id = f0.result.op_results[0].value.value.value.offer.value.offerID
+    assert ledger.apply_frame(issuer.tx([issuer.op_allow_trust(
+        alice.account_id, b"USD\x00", MAINTAIN)]))
+    # new offer rejected
+    f = alice.tx([_offer(alice, usd, X.Asset.native(), 10)])
+    assert not ledger.apply_frame(f)
+    assert inner_code(f) == ManageOfferResultCode.SELL_NOT_AUTHORIZED
+    # update rejected
+    f = alice.tx([_offer(alice, usd, X.Asset.native(), 120,
+                         offer_id=offer_id)])
+    assert not ledger.apply_frame(f)
+    assert inner_code(f) == ManageOfferResultCode.SELL_NOT_AUTHORIZED
+    # delete allowed
+    f = alice.tx([_offer(alice, usd, X.Asset.native(), 0,
+                         offer_id=offer_id)])
+    assert ledger.apply_frame(f), f.result
+
+
+def test_maintain_blocks_payments(ledger):
+    """MAINTAIN cannot receive or send the asset (payments need FULL
+    authorization)."""
+    root, issuer, usd, alice = _issuer_world(ledger)
+    bob = root.create(10**10)
+    assert ledger.apply_frame(bob.tx([bob.op_change_trust(usd, 10**9)]))
+    assert ledger.apply_frame(issuer.tx([issuer.op_allow_trust(
+        bob.account_id, b"USD\x00", MAINTAIN)]))
+    # alice (authorized) pays bob (maintain) → NOT_AUTHORIZED
+    f = alice.tx([alice.op_payment(bob.account_id, 5, usd)])
+    assert not ledger.apply_frame(f)
+    assert inner_code(f) == PaymentResultCode.NOT_AUTHORIZED
+    # downgrade alice to maintain: she can't SEND either
+    assert ledger.apply_frame(issuer.tx([issuer.op_allow_trust(
+        alice.account_id, b"USD\x00", MAINTAIN)]))
+    f = alice.tx([alice.op_payment(issuer.account_id, 5, usd)])
+    assert not ledger.apply_frame(f)
+    assert inner_code(f) in (PaymentResultCode.SRC_NOT_AUTHORIZED,
+                             PaymentResultCode.NOT_AUTHORIZED)
+
+
+def test_downgrade_needs_revocable(ledger):
+    """reference: AUTHORIZED → MAINTAIN is a partial revocation and
+    needs AUTH_REVOCABLE; a full revoke needs it too."""
+    root = ledger.root_account
+    issuer = root.create(10**10)
+    assert ledger.apply_frame(issuer.tx([issuer.op_set_options(
+        set_flags=AUTH_REQUIRED)]))        # NOT revocable
+    usd = X.Asset.credit("USD", issuer.account_id)
+    alice = root.create(10**10)
+    assert ledger.apply_frame(alice.tx([alice.op_change_trust(usd, 10**9)]))
+    assert ledger.apply_frame(issuer.tx([issuer.op_allow_trust(
+        alice.account_id, b"USD\x00", 1)]))
+    for level in (0, MAINTAIN):
+        f = issuer.tx([issuer.op_allow_trust(
+            alice.account_id, b"USD\x00", level)])
+        assert not ledger.apply_frame(f)
+        assert inner_code(f) == AllowTrustResultCode.CANT_REVOKE
+
+
+def test_both_auth_bits_malformed_v13(ledger):
+    """reference 'AUTHORIZED_FLAG and AUTHORIZED_TO_MAINTAIN_LIABILITIES
+    can't be set at the same time'."""
+    root, issuer, usd, alice = _issuer_world(ledger)
+    f = issuer.tx([issuer.op_allow_trust(
+        alice.account_id, b"USD\x00", 1 | MAINTAIN)])
+    assert not ledger.apply_frame(f)
+    assert inner_code(f) == AllowTrustResultCode.MALFORMED
+
+
+def test_maintain_malformed_before_v13():
+    """reference 'allowMaintainLiabilities only works from version 12/13'
+    — on this stack the wire gate is trustLineFlagIsValid's protocol-13
+    boundary."""
+    ledger = TestLedger(ledger_version=12)
+    root = ledger.root_account
+    issuer = root.create(10**10)
+    assert ledger.apply_frame(issuer.tx([issuer.op_set_options(
+        set_flags=AUTH_REQUIRED | AUTH_REVOCABLE)]))
+    usd = X.Asset.credit("USD", issuer.account_id)
+    alice = root.create(10**10)
+    assert ledger.apply_frame(alice.tx([alice.op_change_trust(usd, 10**9)]))
+    f = issuer.tx([issuer.op_allow_trust(
+        alice.account_id, b"USD\x00", MAINTAIN)])
+    assert not ledger.apply_frame(f)
+    assert inner_code(f) == AllowTrustResultCode.MALFORMED
