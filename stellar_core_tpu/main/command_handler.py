@@ -254,11 +254,89 @@ class CommandHandler:
         return {"status": "ok"}
 
     # -- test-only -----------------------------------------------------------
-    def cmd_generateload(self, params) -> dict:
-        """reference CommandHandler.cpp:103 (test-only)."""
+    def _require_test_mode(self):
+        """Gate shared by every test-only endpoint."""
         if not self.app.config.ARTIFICIALLY_GENERATE_LOAD_FOR_TESTING:
             return {"error":
                     "set ARTIFICIALLY_GENERATE_LOAD_FOR_TESTING to use"}
+        return None
+
+    @staticmethod
+    def _named_test_key(name: str):
+        """reference txtest::getAccount (TxTests.cpp:379): the name
+        stretched with '.' to a 32-byte seed; "root" is the network
+        root key."""
+        from ..crypto.keys import SecretKey
+        seed = name.encode()
+        seed += b"." * (32 - len(seed)) if len(seed) < 32 else b""
+        return SecretKey.from_seed(seed[:32])
+
+    def _test_key_for(self, name: str):
+        if name == "root":
+            return self.app.network_root_key()
+        return self._named_test_key(name)
+
+    def cmd_testacc(self, params) -> dict:
+        """reference CommandHandler::testAcc (test-only,
+        CommandHandler.cpp:103-105): balance/seqnum of a name-derived
+        test account."""
+        gated = self._require_test_mode()
+        if gated is not None:
+            return gated
+        name = params.get("name")
+        if not name:
+            return {"status": "error",
+                    "detail": "Bad HTTP GET: try testacc?name=bob"}
+        from ..crypto import strkey
+        from ..xdr import LedgerKey
+        key = self._test_key_for(name)
+        e = self.app.ledger_manager.ltx_root().get_entry(
+            LedgerKey.account(key.public_key))
+        if e is None:
+            return {"status": "error", "detail": "account does not exist"}
+        ae = e.data.value
+        return {"name": name,
+                "id": strkey.encode_public_key(ae.accountID.key_bytes),
+                "balance": ae.balance, "seqnum": ae.seqNum}
+
+    def cmd_testtx(self, params) -> dict:
+        """reference CommandHandler::testTx (test-only): submit a payment
+        (or create-account with create=true) between name-derived test
+        accounts."""
+        gated = self._require_test_mode()
+        if gated is not None:
+            return gated
+        frm, to = params.get("from"), params.get("to")
+        amount = params.get("amount")
+        if not (frm and to and amount):
+            return {"status": "error",
+                    "detail": "try testtx?from=root&to=bob&amount=N"
+                              "[&create=true]"}
+        from ..crypto import strkey
+        from ..testing import AppLedgerAdapter, TestAccount
+        ad = AppLedgerAdapter(self.app)
+        from_acct = TestAccount(ad, self._test_key_for(frm))
+        to_key = self._test_key_for(to)
+        amt = int(amount)
+        if params.get("create") == "true":
+            op = from_acct.op_create_account(to_key.public_key, amt)
+        else:
+            op = from_acct.op_payment(to_key.public_key, amt)
+        frame = from_acct.tx([op])
+        status = self.app.submit_transaction(frame)
+        return {"from_name": frm, "to_name": to,
+                "from_id": strkey.encode_public_key(
+                    from_acct.account_id.key_bytes),
+                "to_id": strkey.encode_public_key(
+                    to_key.public_key.key_bytes),
+                "amount": amt, "create": params.get("create") == "true",
+                "status": int(status)}
+
+    def cmd_generateload(self, params) -> dict:
+        """reference CommandHandler.cpp:103 (test-only)."""
+        gated = self._require_test_mode()
+        if gated is not None:
+            return gated
         from ..simulation.load_generator import LoadGenerator
         if not hasattr(self.app, "_load_generator"):
             self.app._load_generator = LoadGenerator(self.app)

@@ -275,3 +275,28 @@ def test_generateload_endpoint():
 def test_generateload_requires_testing_flag(app):
     st, out = cmd(app, "generateload", accounts=1, txs=1)
     assert "error" in out
+
+
+def test_testacc_and_testtx_endpoints(app):
+    """reference CommandHandler.cpp:103-105 test-only endpoints: testtx
+    creates/pays name-derived accounts, testacc reads them back."""
+    app.config.ARTIFICIALLY_GENERATE_LOAD_FOR_TESTING = True
+    st, out = cmd(app, "testtx", **{"from": "root", "to": "bob",
+                                    "amount": "100000000",
+                                    "create": "true"})
+    assert st == 200 and out["status"] == 0, out
+    app.manual_close()
+    st, acc = app.command_handler.handle_command("testacc", {"name": "bob"})
+    assert st == 200 and acc["balance"] == 100000000, acc
+    assert acc["id"].startswith("G")
+    # bob pays root
+    st, out = cmd(app, "testtx", **{"from": "bob", "to": "root",
+                                    "amount": "5000"})
+    assert st == 200 and out["status"] == 0, out
+    app.manual_close()
+    st, acc2 = app.command_handler.handle_command("testacc", {"name": "bob"})
+    assert st == 200 and acc2["balance"] < 100000000 - 5000 + 1, acc2
+    # gated off without the flag
+    app.config.ARTIFICIALLY_GENERATE_LOAD_FOR_TESTING = False
+    st, out = app.command_handler.handle_command("testacc", {"name": "bob"})
+    assert "error" in out

@@ -1,0 +1,205 @@
+"""The invariants themselves, tested as oracles (reference
+`src/invariant/test/*Tests.cpp`): each invariant must FIRE on a crafted
+corruption and stay silent on the equivalent legal delta — and a
+corrupted operation must abort a real ledger close loudly.
+"""
+
+import pytest
+
+import stellar_core_tpu.xdr as X
+from stellar_core_tpu.invariant.invariants import (
+    AccountSubEntriesCountIsValid, ConservationOfLumens,
+    InvariantDoesNotHold, InvariantManager, LedgerEntryIsValid,
+    LiabilitiesMatchOffers, SequentialLedgers,
+)
+from stellar_core_tpu.testing import genesis_header
+from stellar_core_tpu.transactions.account_helpers import make_account_entry
+from stellar_core_tpu.xdr import LedgerEntryType
+
+
+def _acct(i, balance=10**9, seq=0, subs=0, signers=()):
+    from stellar_core_tpu.crypto.keys import SecretKey
+    sk = SecretKey.from_seed(bytes([i]) * 32)
+    e = make_account_entry(sk.public_key, balance, seq)
+    e.data.value.numSubEntries = subs
+    e.data.value.signers = list(signers)
+    return e
+
+
+def _hdrs(seq=2):
+    prev = genesis_header()
+    prev.ledgerSeq = seq - 1
+    cur = genesis_header()
+    cur.ledgerSeq = seq
+    return prev, cur
+
+
+def _key(entry):
+    return b"k" + entry.data.value.accountID.key_bytes[:8] \
+        if entry.data.disc == LedgerEntryType.ACCOUNT else b"k?"
+
+
+# --------------------------------------------------------- LedgerEntryIsValid
+
+@pytest.mark.parametrize("mutate,msg", [
+    (lambda e: setattr(e.data.value, "balance", -1), "negative"),
+    (lambda e: setattr(e.data.value, "seqNum", -5), "negative"),
+    (lambda e: setattr(e, "lastModifiedLedgerSeq", 999), "future"),
+])
+def test_ledger_entry_is_valid_fires(mutate, msg):
+    inv = LedgerEntryIsValid()
+    prev, cur = _hdrs()
+    bad = _acct(1)
+    mutate(bad)
+    err = inv.check_on_close([(b"k", None, bad)], prev, cur)
+    assert err is not None and msg in err
+
+
+def test_ledger_entry_seqnum_decrease_fires():
+    inv = LedgerEntryIsValid()
+    prev, cur = _hdrs()
+    before = _acct(1, seq=100)
+    after = _acct(1, seq=99)
+    err = inv.check_on_close([(b"k", before, after)], prev, cur)
+    assert err is not None and "decreased" in err
+
+
+def test_ledger_entry_unsorted_signers_fire():
+    inv = LedgerEntryIsValid()
+    prev, cur = _hdrs()
+    s_hi = X.Signer(key=X.SignerKey.ed25519(b"\xff" * 32), weight=1)
+    s_lo = X.Signer(key=X.SignerKey.ed25519(b"\x01" * 32), weight=1)
+    bad = _acct(1, subs=2, signers=[s_hi, s_lo])
+    err = inv.check_on_close([(b"k", None, bad)], prev, cur)
+    assert err is not None and "sorted" in err
+    ok = _acct(1, subs=2, signers=[s_lo, s_hi])
+    assert inv.check_on_close([(b"k", None, ok)], prev, cur) is None
+
+
+# ------------------------------------------------------ ConservationOfLumens
+
+def test_conservation_fires_on_minted_balance():
+    inv = ConservationOfLumens()
+    prev, cur = _hdrs()
+    before = _acct(1, balance=100)
+    after = _acct(1, balance=150)       # +50 from nowhere
+    err = inv.check_on_close([(b"k", before, after)], prev, cur)
+    assert err is not None and "not conserved" in err
+    # legal shape: the account paid 50 into the fee pool
+    after2 = _acct(1, balance=50)
+    cur2 = genesis_header()
+    cur2.ledgerSeq = cur.ledgerSeq
+    cur2.feePool = prev.feePool + 50
+    assert inv.check_on_close([(b"k", before, after2)], prev, cur2) is None
+
+
+# ----------------------------------------- AccountSubEntriesCountIsValid
+
+def test_subentry_count_fires_on_undeclared_trustline():
+    inv = AccountSubEntriesCountIsValid()
+    prev, cur = _hdrs()
+    owner = _acct(2)                       # numSubEntries stays 0
+    usd = X.Asset.credit("USD", _acct(3).data.value.accountID)
+    tl = X.LedgerEntry(
+        lastModifiedLedgerSeq=2,
+        data=X.LedgerEntryData(
+            LedgerEntryType.TRUSTLINE,
+            X.TrustLineEntry(
+                accountID=owner.data.value.accountID, asset=usd,
+                balance=0, limit=100, flags=1,
+                ext=X.TrustLineEntryExt(0, None))),
+        ext=X._Ext.v0())
+    delta = [(b"a", owner, owner), (b"t", None, tl)]
+    err = inv.check_on_close(delta, prev, cur)
+    assert err is not None and "mismatch" in err
+    # declared properly → silent
+    owner2 = _acct(2, subs=1)
+    assert inv.check_on_close(
+        [(b"a", owner, owner2), (b"t", None, tl)], prev, cur) is None
+
+
+def test_merge_with_subentries_fires():
+    inv = AccountSubEntriesCountIsValid()
+    prev, cur = _hdrs()
+    doomed = _acct(4, subs=3)
+    err = inv.check_on_close([(b"a", doomed, None)], prev, cur)
+    assert err is not None and "removed with subentries" in err
+
+
+# ------------------------------------------------------- SequentialLedgers
+
+def test_sequential_ledgers_fires_on_gap():
+    inv = SequentialLedgers()
+    prev, _ = _hdrs(2)
+    _, cur = _hdrs(4)
+    assert inv.check_on_close([], prev, cur) is not None
+    _, cur2 = _hdrs(2)
+    assert inv.check_on_close([], prev, cur2) is None
+
+
+# --------------------------------------------------- LiabilitiesMatchOffers
+
+def test_liabilities_without_offer_fires():
+    inv = LiabilitiesMatchOffers()
+    prev, cur = _hdrs()
+    cur.ledgerVersion = 13
+    before = _acct(5)
+    after = _acct(5)
+    after.data.value.ext = X.AccountEntryExt(
+        1, X.AccountEntryExtensionV1(
+            liabilities=X.Liabilities(buying=0, selling=77),
+            ext=X._Ext.v0()))
+    err = inv.check_on_operation(None, [(b"a", before, after)], prev, cur)
+    assert err is not None
+
+
+# ---------------------------------------------------------- manager + close
+
+def test_manager_enable_patterns_and_raise():
+    m = InvariantManager()
+    m.enable(".*")
+    assert "ConservationOfLumens" in m.enabled_names()
+    prev, cur = _hdrs()
+    before = _acct(1, balance=100)
+    after = _acct(1, balance=175)
+    with pytest.raises(InvariantDoesNotHold):
+        m.check_on_ledger_close([(b"k", before, after)], prev, cur)
+
+
+def test_corrupted_op_aborts_real_close():
+    """End to end: an op whose apply mints lumens makes the ledger close
+    abort loudly (reference: InvariantDoesNotHold crashes the node, a
+    divergence never silently commits)."""
+    from stellar_core_tpu.main.application import Application
+    from stellar_core_tpu.main.config import Config
+    from stellar_core_tpu.testing import AppLedgerAdapter
+    from stellar_core_tpu.transactions.operations import (
+        PaymentOpFrame, PaymentResultCode,
+    )
+    from stellar_core_tpu.util.timer import ClockMode, VirtualClock
+
+    cfg = Config.test_config(0)
+    app = Application(VirtualClock(ClockMode.VIRTUAL_TIME), cfg)
+    app.start()
+    ad = AppLedgerAdapter(app)
+    root = ad.root_account()
+    a = root.create(10**9)
+
+    real_apply = PaymentOpFrame.do_apply
+
+    def minting_apply(self, ltx):
+        body = self.op.body.value
+        from stellar_core_tpu.transactions.account_helpers import (
+            add_balance, load_account,
+        )
+        dest = load_account(ltx, body.destination.account_id)
+        add_balance(ltx.load_header(), dest, body.amount)  # no debit!
+        return self.set_inner(PaymentResultCode.SUCCESS)
+
+    PaymentOpFrame.do_apply = minting_apply
+    try:
+        app.submit_transaction(a.tx([a.op_payment(root.account_id, 123)]))
+        with pytest.raises(InvariantDoesNotHold):
+            app.manual_close()
+    finally:
+        PaymentOpFrame.do_apply = real_apply
