@@ -24,8 +24,8 @@ from ..scp.scp import SCP
 from ..util.log import get_logger
 from ..util.timer import VirtualTimer
 from ..xdr import (
-    EnvelopeType, SCPEnvelope, SCPQuorumSet, StellarValue, StellarValueExt,
-    Uint32, Packer,
+    EnvelopeType, LedgerCloseValueSignature, SCPEnvelope, SCPQuorumSet,
+    StellarValue, StellarValueExt, Uint32, Uint64, Packer,
 )
 from ..ledger.ledger_manager import LedgerCloseData
 from .pending_envelopes import PendingEnvelopes, statement_qset_hash
@@ -82,12 +82,27 @@ class HerderSCPDriver(SCPDriver):
             sv = StellarValue.from_xdr(value)
         except Exception:
             return ValidationLevel.INVALID
+        if sv.ext.disc == StellarValueExt.STELLAR_VALUE_SIGNED:
+            # signed values are nomination-only, and the embedded
+            # signature must verify (reference validateValueHelper:203)
+            if not nomination or \
+                    not self.herder.verify_stellar_value_signature(sv):
+                return ValidationLevel.INVALID
         if not self._check_close_time(sv, slot_index):
             return ValidationLevel.INVALID
         lm = self.herder.app.ledger_manager
         if slot_index != lm.lcl_header.ledgerSeq + 1:
             # not the slot we can fully validate against
             return ValidationLevel.MAYBE_VALID
+        lclh = lm.lcl_header
+        if (not nomination or lclh.ledgerVersion < 11) and \
+                sv.ext.disc != 0:
+            # ballot protocol (and pre-11 entirely) only supports BASIC
+            return ValidationLevel.INVALID
+        if nomination and lclh.ledgerVersion >= 11 and \
+                sv.ext.disc != StellarValueExt.STELLAR_VALUE_SIGNED:
+            # v11+ requires SIGNED for nomination (reference :327-334)
+            return ValidationLevel.INVALID
         txset = self.herder.pending.get_tx_set(sv.txSetHash)
         if txset is None:
             return ValidationLevel.MAYBE_VALID
@@ -259,6 +274,33 @@ class Herder:
         hook = getattr(self.app, "out_of_sync_recovery", None)
         if hook is not None:
             hook()
+
+    # -- signed close values (v11+) ------------------------------------------
+    def _stellar_value_sign_bytes(self, sv: StellarValue) -> bytes:
+        """networkID ‖ ENVELOPE_TYPE_SCPVALUE ‖ txSetHash ‖ closeTime
+        (reference signStellarValue/verifyStellarValueSignature,
+        HerderImpl.cpp:1498-1516). The signature deliberately excludes
+        upgrades so extractValidValue can strip them."""
+        p = Packer()
+        p.put(self.app.config.network_id)
+        Uint32.pack(p, EnvelopeType.ENVELOPE_TYPE_SCPVALUE)
+        p.put(sv.txSetHash)
+        Uint64.pack(p, sv.closeTime)
+        return p.bytes()
+
+    def sign_stellar_value(self, sv: StellarValue) -> None:
+        sk = self.app.config.NODE_SEED
+        sv.ext = StellarValueExt(
+            StellarValueExt.STELLAR_VALUE_SIGNED,
+            LedgerCloseValueSignature(
+                nodeID=sk.public_key,
+                signature=sk.sign(self._stellar_value_sign_bytes(sv))))
+
+    def verify_stellar_value_signature(self, sv: StellarValue) -> bool:
+        from ..crypto.keys import PubKeyUtils
+        lcs = sv.ext.value
+        return PubKeyUtils.verify_sig(
+            lcs.nodeID, lcs.signature, self._stellar_value_sign_bytes(sv))
 
     def current_slot(self) -> int:
         return self.app.ledger_manager.last_closed_ledger_num() + 1
@@ -439,6 +481,11 @@ class Herder:
         value = StellarValue(txSetHash=h, closeTime=close_time,
                              upgrades=upgrades,
                              ext=StellarValueExt(0, None))
+        if lcl.ledgerVersion >= 11:
+            # v11+ nominates SIGNED values (reference signStellarValue,
+            # HerderImpl.cpp:828,1508: sig over networkID ‖
+            # ENVELOPE_TYPE_SCPVALUE ‖ txSetHash ‖ closeTime)
+            self.sign_stellar_value(value)
         prev = lcl.scpValue.to_xdr()
         self._nominate_started[slot] = self.app.clock.now()
         m = self._metrics()

@@ -227,3 +227,53 @@ def test_combine_candidates_prefers_size_then_fees():
     combined = drv.combine_candidates(slot, [val(stale, ct), val(low, ct)])
     got = StellarValue.from_xdr(combined)
     assert got.txSetHash == low.get_contents_hash()
+
+
+def test_signed_stellar_values_rules():
+    """v11+ nomination values must be SIGNED and verify; ballot values
+    must be BASIC (reference validateValueHelper:203-334,
+    signStellarValue/verifyStellarValueSignature)."""
+    from stellar_core_tpu.main.application import Application
+    from stellar_core_tpu.main.config import Config
+    from stellar_core_tpu.scp.driver import ValidationLevel
+    from stellar_core_tpu.util.timer import ClockMode, VirtualClock
+    from stellar_core_tpu.xdr import StellarValue, StellarValueExt
+
+    cfg = Config.test_config(0)
+    app = Application(VirtualClock(ClockMode.VIRTUAL_TIME), cfg)
+    app.start()
+    app.manual_close()
+    drv = app.herder.scp_driver
+    lm = app.ledger_manager
+    slot = lm.lcl_header.ledgerSeq + 1
+    ct = max(lm.lcl_header.scpValue.closeTime + 1,
+             int(app.clock.system_now()))
+
+    def make(signed, tamper=False):
+        sv = StellarValue(txSetHash=b"\x22" * 32, closeTime=ct,
+                          upgrades=[], ext=StellarValueExt(0, None))
+        if signed:
+            app.herder.sign_stellar_value(sv)
+            if tamper:
+                sig = bytearray(sv.ext.value.signature)
+                sig[0] ^= 1
+                sv.ext.value.signature = bytes(sig)
+        return sv.to_xdr()
+
+    # nomination at v13: BASIC rejected, SIGNED accepted (as MAYBE/FULL
+    # depending on txset availability — unknown txset → MAYBE_VALID)
+    assert drv.validate_value(slot, make(False), True) == \
+        ValidationLevel.INVALID
+    assert drv.validate_value(slot, make(True), True) == \
+        ValidationLevel.MAYBE_VALID
+    # a tampered signature is rejected outright
+    assert drv.validate_value(slot, make(True, tamper=True), True) == \
+        ValidationLevel.INVALID
+    # ballot protocol never accepts SIGNED
+    assert drv.validate_value(slot, make(True), False) == \
+        ValidationLevel.INVALID
+    # live consensus still externalizes end to end with signed nomination
+    from stellar_core_tpu.testing import AppLedgerAdapter
+    ad = AppLedgerAdapter(app)
+    root = ad.root_account()
+    assert ad.apply_frame(root.tx([root.op_payment(root.account_id, 1)]))

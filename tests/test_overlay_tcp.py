@@ -374,3 +374,58 @@ def test_tcp_transport_reset_midwrite_no_deadlock():
     finally:
         reactor.stop()
         srv.close()
+
+
+def test_quick_restart_rejoins_consensus(tmp_path):
+    """reference HerderTests.cpp:1617 'quick restart': a node stopped and
+    restarted from its database rejoins the live net over real sockets —
+    SCP state restores, peers re-authenticate, and consensus resumes
+    with byte-identical hashes."""
+    from stellar_core_tpu.xdr import SCPQuorumSet
+
+    ports = [BASE_PORT + 60, BASE_PORT + 61]
+    cfgs = []
+    for i in (0, 1):
+        c = _cfg(i, ports, i)
+        c.DATABASE = "sqlite3://%s" % (tmp_path / ("node%d.db" % i))
+        cfgs.append(c)
+    ids = [c.NODE_SEED.public_key for c in cfgs]
+    q = SCPQuorumSet(threshold=2, validators=ids, innerSets=[])
+    apps = []
+    for c in cfgs:
+        c.QUORUM_SET = q
+        app = Application(VirtualClock(ClockMode.REAL_TIME), c)
+        app.start()
+        apps.append(app)
+    try:
+        assert _crank_all(apps, 60, lambda: all(
+            a.ledger_manager.last_closed_ledger_num() >= 2 for a in apps))
+        # stop node 1 (2-of-2 quorum: consensus halts while it's gone)
+        victim_cfg = cfgs[1]
+        apps[1].stop()
+        stopped_at = apps[1].ledger_manager.last_closed_ledger_num()
+        apps.pop()
+        time.sleep(0.5)
+
+        # restart from the same database
+        reborn = Application(VirtualClock(ClockMode.REAL_TIME), victim_cfg)
+        reborn.start()
+        apps.append(reborn)
+        assert reborn.ledger_manager.last_closed_ledger_num() >= stopped_at
+
+        # the pair re-authenticates and resumes closing ledgers
+        assert _crank_all(apps, 40, lambda: all(
+            a.overlay_manager.get_authenticated_peers_count() >= 1
+            for a in apps)), "restarted node never re-authenticated"
+        target = max(a.ledger_manager.last_closed_ledger_num()
+                     for a in apps) + 2
+        assert _crank_all(apps, 90, lambda: all(
+            a.ledger_manager.last_closed_ledger_num() >= target
+            for a in apps)), "consensus did not resume after restart"
+        h = min(a.ledger_manager.last_closed_ledger_num() for a in apps)
+        hashes = {a.database.execute(
+            "SELECT ledgerhash FROM ledgerheaders WHERE ledgerseq = ?",
+            (h,)).fetchone()[0] for a in apps}
+        assert len(hashes) == 1, "nodes diverged after quick restart"
+    finally:
+        _shutdown(apps)
