@@ -68,8 +68,12 @@ def test_native_mod_l_against_python_ints():
         k = int.from_bytes(
             hashlib.sha512(sigs[i][:32] + pubs[i] + msgs[i]).digest(),
             "little") % E.L
-        want = np.array([(k >> (4 * j)) & 15 for j in range(64)], np.int32)
-        assert (nat["k_nibs"][i] == want).all(), i
+        # prepare_batch emits SIGNED radix-16 digits in [−8, 8); the
+        # recode must preserve the value exactly
+        digs = nat["k_nibs"][i]
+        assert (digs >= -8).all() and (digs < 8).all(), i
+        got = sum(int(digs[j]) << (4 * j) for j in range(64))
+        assert got == k, i
 
 
 def test_native_prep_feeds_kernel_correctly():
