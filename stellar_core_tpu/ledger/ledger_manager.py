@@ -28,7 +28,7 @@ from ..util.log import get_logger
 from ..xdr import (
     LedgerHeader, LedgerUpgrade, LedgerUpgradeType, StellarValue,
     StellarValueExt, TransactionResultPair, TransactionResultSet,
-    TransactionHistoryEntry, TransactionSet, _Ext,
+    TransactionHistoryEntry, TransactionSet, UpgradeEntryMeta, _Ext,
 )
 
 log = get_logger("Ledger")
@@ -246,6 +246,7 @@ class LedgerManager:
         header.txSetResultHash = sha256(rs.to_xdr())
 
         # upgrades (after txs; reference LedgerManagerImpl.cpp:617-669)
+        applied_upgrades = []
         for raw in lcd.value.upgrades:
             try:
                 up = LedgerUpgrade.from_xdr(raw)
@@ -253,6 +254,7 @@ class LedgerManager:
                 log.warning("ignoring malformed upgrade")
                 continue
             self._apply_upgrade(header, up)
+            applied_upgrades.append(up)
 
         # bucket-list hash over the close's delta (content-addressed chain;
         # stands in the header exactly where the reference's
@@ -290,11 +292,54 @@ class LedgerManager:
         self._store_header(self.root.get_header())
         self._store_txs(lcd, frames, result_pairs)
         self._store_local_has()
+        self._emit_close_meta(lcd, frames, result_pairs, applied_upgrades)
         hm = getattr(self.app, "history_manager", None)
         if hm is not None:
             hm.maybe_queue_checkpoint(self)
         log.debug("closed ledger %d (%d txs) hash %s", lcd.ledger_seq,
                   len(frames), self.lcl_hash.hex()[:8])
+
+    def _emit_close_meta(self, lcd: LedgerCloseData, frames,
+                         result_pairs, applied_upgrades) -> None:
+        """Stream the full close meta to the operator's configured
+        fd/file (reference LedgerManagerImpl.cpp:590,673-678 builds
+        LedgerCloseMeta alongside the apply loop and emits it once the
+        close commits). txProcessing is in APPLY order; each entry
+        carries the tx's result, its fee-processing changes, and the full
+        apply meta — a downstream consumer can reconstruct every balance
+        from the stream alone."""
+        stream = getattr(self.app, "close_meta_stream", None)
+        if stream is None:
+            return
+        from ..xdr import (
+            LedgerCloseMeta, LedgerCloseMetaV0, LedgerHeaderHistoryEntry,
+            TransactionResultMeta,
+        )
+        meta = LedgerCloseMetaV0(
+            ledgerHeader=LedgerHeaderHistoryEntry(
+                hash=self.lcl_hash, header=self.root.get_header(),
+                ext=_Ext.v0()),
+            txSet=lcd.tx_set.to_wire(),
+            txProcessing=[
+                TransactionResultMeta(result=rp, feeProcessing=f.fee_meta,
+                                      txApplyProcessing=f.tx_meta())
+                for f, rp in zip(frames, result_pairs)],
+            upgradesProcessing=[
+                # our upgrades only rewrite header fields, never ledger
+                # entries, so each entry's change list is empty
+                UpgradeEntryMeta(upgrade=up, changes=[])
+                for up in applied_upgrades],
+            scpInfo=[])
+        try:
+            stream.write_one(LedgerCloseMeta.v0(meta))
+        except OSError as e:
+            # a dead consumer pipe must not halt consensus; close and
+            # drop the stream, keep closing ledgers (operator sees the
+            # log)
+            log.error("close-meta stream failed at ledger %d: %s — "
+                      "disabling stream", lcd.ledger_seq, e)
+            stream.close()
+            self.app.close_meta_stream = None
 
     def _bucket_manager(self):
         return getattr(self.app, "bucket_manager", None)

@@ -63,6 +63,15 @@ class Application:
         for pattern in config.INVARIANT_CHECKS:
             self.invariant_manager.enable(pattern)
 
+        # downstream close-meta stream (reference METADATA_OUTPUT_STREAM,
+        # LedgerManagerImpl.cpp:590,673-678): opened before the first
+        # close so no record is ever skipped
+        self.close_meta_stream = None
+        if config.METADATA_OUTPUT_STREAM:
+            from ..ledger.close_meta_stream import CloseMetaStream
+            self.close_meta_stream = CloseMetaStream(
+                config.METADATA_OUTPUT_STREAM)
+
         self.bucket_manager = None   # wired in enable_buckets()
         self.history_manager = None  # wired by history layer
         self.catchup_manager = None
@@ -148,6 +157,8 @@ class Application:
         if self.overlay_manager is not None:
             self.overlay_manager.shutdown()
         self.process_manager.shutdown()
+        if self.close_meta_stream is not None:
+            self.close_meta_stream.close()
 
     # -- operations ----------------------------------------------------------
     def manual_close(self) -> None:

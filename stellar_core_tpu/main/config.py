@@ -103,6 +103,11 @@ class Config:
         self.AUTOMATIC_MAINTENANCE_PERIOD = 359.0
         self.AUTOMATIC_MAINTENANCE_COUNT = 50000
 
+        # downstream-consumer integration: stream one XDR LedgerCloseMeta
+        # record per close to this path or "fd:N" (reference
+        # Config.h:264 METADATA_OUTPUT_STREAM); "" disables
+        self.METADATA_OUTPUT_STREAM = ""
+
     # -- derived ------------------------------------------------------------
     @property
     def network_id(self) -> bytes:
@@ -139,7 +144,7 @@ class Config:
             "CATCHUP_COMPLETE", "CATCHUP_RECENT",
             "PEER_TIMEOUT", "PEER_STRAGGLER_TIMEOUT",
             "MAX_BATCH_WRITE_COUNT", "MAX_BATCH_WRITE_BYTES",
-            "PEER_SEND_QUEUE_LIMIT_BYTES",
+            "PEER_SEND_QUEUE_LIMIT_BYTES", "METADATA_OUTPUT_STREAM",
         ]
         for k in simple_keys:
             if k in data:

@@ -45,11 +45,13 @@ from .transaction import (
 )
 from .ledger import (
     BucketEntry, BucketEntryType, BucketMetadata,
+    LedgerCloseMeta, LedgerCloseMetaV0,
     LedgerCloseValueSignature, LedgerEntryChange, LedgerEntryChangeType,
     LedgerEntryChanges, LedgerHeader, LedgerHeaderHistoryEntry, LedgerUpgrade,
     LedgerUpgradeType, OperationMeta, StellarValue, StellarValueExt,
     TransactionHistoryEntry, TransactionHistoryResultEntry, TransactionMeta,
-    TransactionMetaV1, TransactionSet,
+    TransactionMetaV1, TransactionResultMeta, TransactionSet,
+    UpgradeEntryMeta,
 )
 from .scp import (
     LedgerSCPMessages, SCPBallot, SCPEnvelope, SCPHistoryEntry,

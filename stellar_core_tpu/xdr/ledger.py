@@ -135,6 +135,46 @@ class TransactionMeta(XdrUnion):
     xdr_arms = {1: ("v1", TransactionMetaV1)}
 
 
+# --- Ledger close meta (reference src/xdr/Stellar-ledger.x:282-320) --------
+# The full per-close record streamed to downstream consumers (Horizon-style
+# ingestion) via METADATA_OUTPUT_STREAM.
+
+class TransactionResultMeta(XdrStruct):
+    xdr_fields = [
+        ("result", TransactionResultPair),
+        ("feeProcessing", LedgerEntryChanges),
+        ("txApplyProcessing", TransactionMeta),
+    ]
+
+
+class UpgradeEntryMeta(XdrStruct):
+    xdr_fields = [
+        ("upgrade", LedgerUpgrade),
+        ("changes", LedgerEntryChanges),
+    ]
+
+
+class LedgerCloseMetaV0(XdrStruct):
+    from .scp import SCPHistoryEntry as _SHE
+    xdr_fields = [
+        ("ledgerHeader", LedgerHeaderHistoryEntry),
+        ("txSet", TransactionSet),
+        # in apply order, one entry per tx: result + fee-processing
+        # changes + full apply meta
+        ("txProcessing", VarArray(TransactionResultMeta)),
+        ("upgradesProcessing", VarArray(UpgradeEntryMeta)),
+        ("scpInfo", VarArray(_SHE)),
+    ]
+
+
+class LedgerCloseMeta(XdrUnion):
+    xdr_arms = {0: ("v0", LedgerCloseMetaV0)}
+
+    @classmethod
+    def v0(cls, value) -> "LedgerCloseMeta":
+        return cls(0, value)
+
+
 # --- Bucket entries (reference src/xdr/Stellar-ledger.x:148-182) -----------
 
 class BucketEntryType:

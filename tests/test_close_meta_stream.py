@@ -1,0 +1,230 @@
+"""METADATA_OUTPUT_STREAM: one XDR LedgerCloseMeta record per close.
+
+Mirrors the reference's LedgerCloseMetaStreamTests
+(/root/reference/src/ledger/test/LedgerCloseMetaStreamTests.cpp): stream
+to a file and to an inherited fd, meta contents track the closes
+(header-hash chain, tx processing, upgrades), a downstream consumer can
+reconstruct ledger state from the stream ALONE, torn tails are
+tolerated, and a dead consumer never halts consensus.
+"""
+
+from __future__ import annotations
+
+import os
+
+import pytest
+
+from stellar_core_tpu.herder.upgrades import UpgradeParameters
+from stellar_core_tpu.ledger.close_meta_stream import (
+    read_close_meta_stream,
+)
+from stellar_core_tpu.main.application import Application
+from stellar_core_tpu.main.config import Config
+from stellar_core_tpu.testing import AppLedgerAdapter
+from stellar_core_tpu.util.timer import ClockMode, VirtualClock
+from stellar_core_tpu.xdr import (
+    LedgerEntryChangeType, LedgerEntryType, LedgerUpgradeType,
+    TransactionResultCode,
+)
+
+
+def _make_app(stream_target: str, n: int = 0) -> Application:
+    cfg = Config.test_config(n)
+    cfg.METADATA_OUTPUT_STREAM = stream_target
+    app = Application(VirtualClock(ClockMode.VIRTUAL_TIME), cfg)
+    app.start()
+    return app
+
+
+def _close_some_ledgers(app, n_payments: int = 3):
+    """Returns (accounts, their expected final balances)."""
+    adapter = AppLedgerAdapter(app)
+    root = adapter.root_account()
+    alice = root.create(10**9)          # one close per create()
+    bob = root.create(2 * 10**9)
+    for i in range(n_payments):
+        f = alice.tx([alice.op_payment(bob.account_id, 1000 * (i + 1))])
+        app.submit_transaction(f)
+        app.manual_close()
+    return adapter, [alice, bob]
+
+
+def test_stream_to_file_tracks_closes(tmp_path):
+    path = str(tmp_path / "meta.xdr")
+    app = _make_app(path)
+    adapter, _ = _close_some_ledgers(app)
+    lcl = app.ledger_manager.last_closed_ledger_num()
+    records, err = read_close_meta_stream(path)
+    assert err is None
+    # genesis (ledger 1) is not a close; every close 2..lcl streams once
+    assert [r.value.ledgerHeader.header.ledgerSeq for r in records] == \
+        list(range(2, lcl + 1))
+    # the header-hash chain links record to record, and the last record's
+    # hash is the node's own LCL hash
+    for prev, cur in zip(records, records[1:]):
+        assert cur.value.ledgerHeader.header.previousLedgerHash == \
+            prev.value.ledgerHeader.hash
+    assert records[-1].value.ledgerHeader.hash == app.ledger_manager.lcl_hash
+    # tx-bearing closes carry txProcessing entries with successful results
+    n_txs = sum(len(r.value.txProcessing) for r in records)
+    assert n_txs == 5   # 2 creates + 3 payments
+    for r in records:
+        for trm in r.value.txProcessing:
+            assert trm.result.result.code == TransactionResultCode.txSUCCESS
+            assert len(trm.feeProcessing) >= 1   # fee debit is always meta
+            assert len(trm.txApplyProcessing.value.operations) >= 1
+
+
+def test_stream_to_inherited_fd():
+    r_fd, w_fd = os.pipe()
+    # widen the pipe so the writer can't block in this single-threaded
+    # test (64KB default is plenty for a handful of closes, but be safe)
+    try:
+        import fcntl
+        fcntl.fcntl(w_fd, 1031, 1 << 20)  # F_SETPIPE_SZ
+    except (ImportError, OSError):
+        pass
+    app = _make_app("fd:%d" % w_fd)
+    _close_some_ledgers(app, n_payments=1)
+    app.stop()
+    os.close(w_fd)   # "fd:" streams are operator-owned; close our end
+    records, err = read_close_meta_stream(r_fd)
+    os.close(r_fd)
+    assert err is None
+    assert len(records) == 3   # 2 creates + 1 payment close
+    assert all(r.disc == 0 for r in records)
+
+
+def _replay_entries_from_stream(records) -> dict:
+    """The downstream-consumer oracle: fold every LedgerEntryChange in
+    stream order into a key→entry map. CREATED/UPDATED/STATE carry the
+    entry (STATE is the pre-image, so only applied when the key is
+    unknown); REMOVED deletes."""
+    state: dict = {}
+
+    from stellar_core_tpu.xdr import ledger_entry_key
+
+    def fold(changes):
+        for ch in changes:
+            t = ch.disc
+            if t in (LedgerEntryChangeType.LEDGER_ENTRY_CREATED,
+                     LedgerEntryChangeType.LEDGER_ENTRY_UPDATED):
+                e = ch.value
+                state[ledger_entry_key(e).to_xdr()] = e
+            elif t == LedgerEntryChangeType.LEDGER_ENTRY_REMOVED:
+                state.pop(ch.value.to_xdr(), None)
+
+    for r in records:
+        v0 = r.value
+        for trm in v0.txProcessing:
+            fold(trm.feeProcessing)
+            tm = trm.txApplyProcessing.value
+            fold(tm.txChanges)
+            for op_meta in tm.operations:
+                fold(op_meta.changes)
+        for um in v0.upgradesProcessing:
+            fold(um.changes)
+    return state
+
+
+def test_downstream_replays_balances_from_stream_alone(tmp_path):
+    """The reference's acceptance bar: a consumer process that sees ONLY
+    the stream ends up with the same account balances as the node."""
+    path = str(tmp_path / "meta.xdr")
+    app = _make_app(path)
+    adapter, accounts = _close_some_ledgers(app)
+    records, err = read_close_meta_stream(path)
+    assert err is None
+    replayed = _replay_entries_from_stream(records)
+    # every account the stream touched must match the node's ledger state
+    # bit-for-bit (balance, seqnum, thresholds — the whole entry)
+    from stellar_core_tpu.xdr import LedgerKey
+    n_accounts = 0
+    for key_xdr, entry in replayed.items():
+        key = LedgerKey.from_xdr(key_xdr)
+        if key.disc != LedgerEntryType.ACCOUNT:
+            continue
+        n_accounts += 1
+        node_entry = app.ledger_manager.ltx_root().get_entry(key)
+        assert node_entry is not None
+        assert node_entry.to_xdr() == entry.to_xdr()
+    # root + alice + bob all appeared in meta
+    assert n_accounts == 3
+    # and the replayed balances are the DSL-visible ones
+    for acc in accounts:
+        key = LedgerKey.account(acc.account_id)
+        assert replayed[key.to_xdr()].data.value.balance == acc.balance()
+
+
+def test_upgrades_recorded_in_stream(tmp_path):
+    path = str(tmp_path / "meta.xdr")
+    app = _make_app(path)
+    p = UpgradeParameters()
+    p.upgrade_time = 0
+    p.base_fee = 321
+    app.herder.upgrades.set_parameters(p)
+    adapter = AppLedgerAdapter(app)
+    root = adapter.root_account()
+    root.create(10**8)   # one close; the armed upgrade rides it
+    assert adapter.header().baseFee == 321
+    records, err = read_close_meta_stream(path)
+    assert err is None
+    ups = [um for r in records for um in r.value.upgradesProcessing]
+    assert any(
+        um.upgrade.disc == LedgerUpgradeType.LEDGER_UPGRADE_BASE_FEE
+        and um.upgrade.value == 321 for um in ups)
+    # the record carrying the upgrade commits the POST-upgrade header
+    rec = next(r for r in records if r.value.upgradesProcessing)
+    assert rec.value.ledgerHeader.header.baseFee == 321
+
+
+def test_torn_tail_is_tolerated(tmp_path):
+    path = str(tmp_path / "meta.xdr")
+    app = _make_app(path)
+    _close_some_ledgers(app, n_payments=1)
+    records, err = read_close_meta_stream(path)
+    assert err is None and len(records) == 3
+    # crash mid-write: chop the last record in half
+    size = os.path.getsize(path)
+    with open(path, "rb") as f:
+        data = f.read()
+    with open(path, "wb") as f:
+        f.write(data[:size - 40])
+    records2, err2 = read_close_meta_stream(path)
+    assert len(records2) == 2
+    assert err2 is not None and "torn" in err2
+
+
+def test_dead_pipe_disables_stream_not_consensus():
+    r_fd, w_fd = os.pipe()
+    os.close(r_fd)   # consumer is gone before the first close
+    import signal
+    old = signal.signal(signal.SIGPIPE, signal.SIG_IGN)
+    try:
+        app = _make_app("fd:%d" % w_fd)
+        adapter = AppLedgerAdapter(app)
+        root = adapter.root_account()
+        alice = root.create(10**8)          # EPIPE on first emit
+        assert app.close_meta_stream is None   # stream dropped…
+        before = app.ledger_manager.last_closed_ledger_num()
+        app.submit_transaction(
+            alice.tx([alice.op_payment(root.account_id, 5)]))
+        app.manual_close()                  # …but closes keep happening
+        assert app.ledger_manager.last_closed_ledger_num() == before + 1
+    finally:
+        signal.signal(signal.SIGPIPE, old)
+        try:
+            os.close(w_fd)
+        except OSError:
+            pass
+
+
+def test_config_knob_roundtrip(tmp_path):
+    cfg = Config.from_toml(
+        'NETWORK_PASSPHRASE = "t"\n'
+        'NODE_SEED = "%s"\n'
+        'METADATA_OUTPUT_STREAM = "fd:7"\n'
+        'UNSAFE_QUORUM = true\nFAILURE_SAFETY = 0\n'
+        % Config.test_config(3).NODE_SEED.strkey_seed(),
+        is_path=False)
+    assert cfg.METADATA_OUTPUT_STREAM == "fd:7"
