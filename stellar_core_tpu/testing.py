@@ -224,6 +224,25 @@ class TestAccount:
             ManageSellOfferOp(selling=selling, buying=buying, amount=amount,
                               price=Price(n=n, d=d), offerID=offer_id)))
 
+    def op_manage_buy_offer(self, selling: Asset, buying: Asset,
+                            buy_amount: int, n: int, d: int,
+                            offer_id: int = 0) -> Operation:
+        from .xdr import ManageBuyOfferOp
+        return self.op(OperationBody(
+            OperationType.MANAGE_BUY_OFFER,
+            ManageBuyOfferOp(selling=selling, buying=buying,
+                             buyAmount=buy_amount, price=Price(n=n, d=d),
+                             offerID=offer_id)))
+
+    def op_create_passive_sell_offer(self, selling: Asset, buying: Asset,
+                                     amount: int, n: int, d: int
+                                     ) -> Operation:
+        from .xdr import CreatePassiveSellOfferOp
+        return self.op(OperationBody(
+            OperationType.CREATE_PASSIVE_SELL_OFFER,
+            CreatePassiveSellOfferOp(selling=selling, buying=buying,
+                                     amount=amount, price=Price(n=n, d=d))))
+
     def op_set_options(self, inflation_dest=None, clear_flags=None,
                        set_flags=None, master_weight=None, low=None,
                        med=None, high=None, home_domain=None,

@@ -71,6 +71,9 @@ class _ManageOfferBase(OperationFrame):
     ManageOfferOpFrameBase)."""
 
     passive = False
+    # True for ManageBuyOffer: the crossing/residual caps are expressed
+    # on the buying (wheat) side instead of the sell amount
+    is_buy = False
 
     # subclass accessors -----------------------------------------------------
     def _params(self) -> Tuple[Asset, Asset, int, Price, int]:
@@ -81,6 +84,14 @@ class _ManageOfferBase(OperationFrame):
     def _is_delete(self) -> bool:
         selling, buying, amount, price, offer_id = self._params()
         return amount == 0 and offer_id != 0
+
+    def _wheat_receive_cap(self) -> int:
+        """Cap on units of `buying` acquired while crossing AND promised
+        by the residual. INT64_MAX for sell offers (the sell amount caps
+        the other side); ManageBuyOffer overrides with buyAmount
+        (reference applyOperationSpecificLimits,
+        ManageBuyOfferOpFrame.cpp:69-76)."""
+        return INT64_MAX
 
     def do_check_valid(self, header) -> bool:
         selling, buying, amount, price, offer_id = self._params()
@@ -177,9 +188,16 @@ class _ManageOfferBase(OperationFrame):
         if max_sell_funds <= 0 and amount > 0:
             return self.set_inner(ManageOfferResultCode.UNDERFUNDED)
 
-        max_sell = min(amount, max_sell_funds)
+        # a buy offer's caps live on the wheat (buying) side — the sell
+        # side is limited by funds only (reference
+        # applyOperationSpecificLimits: sell offers clamp sheep, buy
+        # offers clamp wheat)
+        wheat_cap = self._wheat_receive_cap()
+        max_sell = max_sell_funds if self.is_buy \
+            else min(amount, max_sell_funds)
         code, bought, sold, claims = cross_offers(
-            ltx, src_id, selling, buying, max_buy=recv_cap,
+            ltx, src_id, selling, buying,
+            max_buy=min(recv_cap, wheat_cap),
             max_sell=max_sell, price_limit=(price.n, price.d),
             passive_taker=self.passive)
         if code == CrossResult.CROSSED_SELF:
@@ -189,11 +207,14 @@ class _ManageOfferBase(OperationFrame):
         assert _credit(ltx, src_id, buying, bought)
 
         # residual amount clamped to post-trade capacity (reference
-        # adjustOffer idempotence)
+        # adjustOffer idempotence). For a buy offer, the residual
+        # promises the REMAINING buy amount
+        sheep_resid = INT64_MAX if self.is_buy else (amount - sold)
         remaining = adjust_offer(
             price.n, price.d,
-            min(amount - sold, _available_to_sell(ltx, src_id, selling)),
-            _available_to_receive(ltx, src_id, buying))
+            min(sheep_resid, _available_to_sell(ltx, src_id, selling)),
+            min(_available_to_receive(ltx, src_id, buying),
+                wheat_cap - bought))
 
         if remaining > 0:
             if is_update:
@@ -248,6 +269,18 @@ class CreatePassiveSellOfferOpFrame(_ManageOfferBase):
 @register_op
 class ManageBuyOfferOpFrame(_ManageOfferBase):
     op_type = OperationType.MANAGE_BUY_OFFER
+    is_buy = True
+
+    def _wheat_receive_cap(self) -> int:
+        b = self.op.body.value
+        return b.buyAmount if b.buyAmount > 0 else INT64_MAX
+
+    def _is_delete(self) -> bool:
+        # delete is buyAmount == 0 — NOT the converted sell amount,
+        # which floors to 0 for small buyAmount at sub-unit prices
+        # (reference isDeleteOffer, ManageBuyOfferOpFrame.cpp:46-49)
+        b = self.op.body.value
+        return b.buyAmount == 0 and b.offerID != 0
 
     def _params(self):
         b = self.op.body.value
@@ -279,6 +312,10 @@ class _PathPaymentBase(OperationFrame):
     def _dest_credit_code(self, ltx, dest_id, asset: Asset,
                           amount: int) -> Optional[int]:
         if asset.is_native:
+            # int64 balance headroom (reference canBuyAtMost on native:
+            # crediting past INT64_MAX is LINE_FULL, not a crash)
+            if _available_to_receive(ltx, dest_id, asset) < amount:
+                return PathPaymentResultCode.LINE_FULL
             return None
         if dest_id == asset.issuer:
             return None

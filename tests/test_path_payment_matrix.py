@@ -1,0 +1,568 @@
+"""Path-payment matrix, section-for-section against the reference's
+PathPaymentTests.cpp (/root/reference/src/transactions/test/
+PathPaymentTests.cpp:66-4444) beyond the headline vectors in
+test_path_payment_vectors.py: per-position (first/middle/last exchange)
+book failures, self-cross and destination-cross placement, whole-offer
+consumption, offer-owner limit/trust edge cases, cycles, rounding, and
+liability interactions.
+
+Intended divergences from the reference, by design of this engine:
+- All tests run at protocol 13 (v10+ exchange semantics); pre-v10
+  variants live in test_protocol_matrix.py.
+- CAP-0018 revocation pulls offers, so "bogus offer from revoked auth"
+  cannot arise at v13; the unfunded-offer GC path is exercised via
+  fee-eaten native backing instead.
+"""
+
+import pytest
+
+from stellar_core_tpu.crypto.keys import SecretKey
+from stellar_core_tpu.testing import TestAccount, TestLedger
+from stellar_core_tpu.transactions.offers import PathPaymentResultCode
+from stellar_core_tpu.xdr import (
+    Asset, OperationBody, OperationType, PathPaymentStrictReceiveOp,
+    PathPaymentStrictSendOp, TransactionResultCode,
+)
+
+XLM = Asset.native()
+INT64_MAX = 2**63 - 1
+
+
+@pytest.fixture
+def ledger():
+    return TestLedger()
+
+
+@pytest.fixture
+def root(ledger):
+    from stellar_core_tpu.testing import root_secret_key
+    return TestAccount(ledger, root_secret_key())
+
+
+def inner_code(frame):
+    return frame.result.op_results[0].value.value.disc
+
+
+def success_of(frame):
+    return frame.result.op_results[0].value.value.value
+
+
+def recv_op(src, dst, send_asset, send_max, dest_asset, dest_amount,
+            path=()):
+    return src.op(OperationBody(
+        OperationType.PATH_PAYMENT_STRICT_RECEIVE,
+        PathPaymentStrictReceiveOp(
+            sendAsset=send_asset, sendMax=send_max, destination=dst.muxed,
+            destAsset=dest_asset, destAmount=dest_amount,
+            path=list(path))))
+
+
+def send_op(src, dst, send_asset, send_amount, dest_asset, dest_min,
+            path=()):
+    return src.op(OperationBody(
+        OperationType.PATH_PAYMENT_STRICT_SEND,
+        PathPaymentStrictSendOp(
+            sendAsset=send_asset, sendAmount=send_amount,
+            destination=dst.muxed, destAsset=dest_asset,
+            destMin=dest_min, path=list(path))))
+
+
+def three_hop_market(root, skip_book=None, self_offer_for=None,
+                     price=(2, 1)):
+    """XLM → A1 → A2 → A3 with one mm offer per hop at `price` (sheep
+    per wheat = price[0]/price[1], i.e. paying `price` of the previous
+    asset per unit). skip_book ∈ {0,1,2} leaves that hop bookless.
+    Returns (issuer, mm, [a1, a2, a3], chain) where chain[i] is hop i's
+    (selling, buying) pair."""
+    issuer = root.create(10**10)
+    mm = root.create(10**10)
+    assets = []
+    for i in range(3):
+        a = Asset.credit("AS%d" % i, issuer.account_id)
+        assert mm.change_trust(a, 10**14)
+        assert issuer.pay(mm, 10**8, a)
+        assets.append(a)
+    hops = [(XLM, assets[0]), (assets[0], assets[1]),
+            (assets[1], assets[2])]
+    for i, (have, want) in enumerate(hops):
+        if skip_book == i:
+            continue
+        assert mm.ledger.apply_frame(mm.tx([mm.op_manage_sell_offer(
+            want, have, 10**6, price[0], price[1])]))
+    return issuer, mm, assets, hops
+
+
+def payer_and_dest(root, ledger, dest_asset, dest_limit=10**12):
+    a = root.create(10**10)
+    b = root.create(10**10)
+    assert b.change_trust(dest_asset, dest_limit)
+    return a, b
+
+
+# ===================================================== validity cross-product
+
+def test_invalid_currency_in_each_slot(ledger, root):
+    """Reference 'send/destination/path currency invalid': an asset with
+    a malformed code fails MALFORMED regardless of position."""
+    a = root.create(10**9)
+    b = root.create(10**9)
+    bad = Asset.credit("USD", a.account_id)
+    bad.value.assetCode = b"\x00\x00\x00\x00"   # empty code is invalid
+    good = Asset.credit("OK", a.account_id)
+    for op in (recv_op(a, b, bad, 100, XLM, 10),
+               recv_op(a, b, XLM, 100, bad, 10),
+               recv_op(a, b, XLM, 100, XLM, 10, path=[bad]),
+               send_op(a, b, bad, 100, XLM, 10),
+               send_op(a, b, XLM, 100, bad, 10),
+               send_op(a, b, XLM, 100, good, 10, path=[bad])):
+        f = a.tx([op])
+        assert not ledger.apply_frame(f)
+        assert inner_code(f) == PathPaymentResultCode.MALFORMED
+
+
+def test_dest_amount_too_big_for_native(ledger, root):
+    """Crediting past INT64_MAX native fails LINE_FULL (reference 'dest
+    amount too big for XLM' → line full on the receive side)."""
+    a = root.create(10**10)
+    b = root.create(10**10)
+    f = a.tx([recv_op(a, b, XLM, INT64_MAX, XLM, INT64_MAX)])
+    assert not ledger.apply_frame(f)
+    assert inner_code(f) == PathPaymentResultCode.LINE_FULL
+
+
+def test_dest_amount_overflows_trust_line(ledger, root):
+    """Reference 'destination line overflow': balance + amount overflows
+    int64 even though the limit is INT64_MAX."""
+    issuer = root.create(10**10)
+    usd = Asset.credit("USD", issuer.account_id)
+    a, b = payer_and_dest(root, ledger, usd, dest_limit=INT64_MAX)
+    assert a.change_trust(usd, INT64_MAX)
+    assert issuer.pay(b, INT64_MAX - 50, usd)
+    assert issuer.pay(a, 1000, usd)
+    f = a.tx([recv_op(a, b, usd, 1000, usd, 100)])
+    assert not ledger.apply_frame(f)
+    assert inner_code(f) == PathPaymentResultCode.LINE_FULL
+
+
+def test_underfunded_asset_counts_selling_liabilities(ledger, root):
+    """Reference 'not enough funds' with liabilities: balance committed
+    to a resting offer is not spendable by a path payment."""
+    issuer = root.create(10**10)
+    usd = Asset.credit("USD", issuer.account_id)
+    a, b = payer_and_dest(root, ledger, usd)
+    assert a.change_trust(usd, 10**12)
+    assert issuer.pay(a, 1000, usd)
+    # 950 of the 1000 is encumbered selling USD
+    assert ledger.apply_frame(
+        a.tx([a.op_manage_sell_offer(usd, XLM, 950, 1, 1)]))
+    f = a.tx([recv_op(a, b, usd, 1000, usd, 100)])
+    assert not ledger.apply_frame(f)
+    assert inner_code(f) == PathPaymentResultCode.UNDERFUNDED
+    # 50 is still spendable
+    f = a.tx([recv_op(a, b, usd, 1000, usd, 50)])
+    assert ledger.apply_frame(f), f.result
+
+
+# ============================================== issuer / destination corners
+
+def test_destination_is_issuer_receives_without_trustline(ledger, root):
+    """Reference 'destination is issuer': paying an asset to its own
+    issuer burns it — no trustline needed on the destination."""
+    issuer = root.create(10**10)
+    usd = Asset.credit("USD", issuer.account_id)
+    a = root.create(10**10)
+    assert a.change_trust(usd, 10**12)
+    assert issuer.pay(a, 1000, usd)
+    f = a.tx([recv_op(a, issuer, usd, 500, usd, 500)])
+    assert ledger.apply_frame(f), f.result
+    assert ledger.trust_balance(a.account_id, usd) == 500
+
+
+def test_source_is_issuer_mints_without_trustline(ledger, root):
+    issuer = root.create(10**10)
+    usd = Asset.credit("USD", issuer.account_id)
+    b = root.create(10**10)
+    assert b.change_trust(usd, 10**12)
+    f = issuer.tx([recv_op(issuer, b, usd, 700, usd, 700)])
+    assert ledger.apply_frame(f), f.result
+    assert ledger.trust_balance(b.account_id, usd) == 700
+
+
+def test_issuer_missing_for_path_asset(ledger, root):
+    """Reference 'issuer missing': a mid-path asset whose issuer account
+    no longer exists. The books are empty for it, so the walk fails at
+    that hop with TOO_FEW_OFFERS (our engine checks issuers only at the
+    debit/credit endpoints — an intended divergence; the reference
+    pre-validates all path issuers and reports NO_ISSUER)."""
+    issuer = root.create(10**10)
+    usd = Asset.credit("USD", issuer.account_id)
+    ghost = SecretKey.pseudo_random_for_testing()
+    phantom = Asset.credit("PHA", ghost.public_key)
+    a, b = payer_and_dest(root, ledger, usd)
+    f = a.tx([recv_op(a, b, XLM, 10**6, usd, 100, path=[phantom])])
+    assert not ledger.apply_frame(f)
+    assert inner_code(f) in (PathPaymentResultCode.NO_ISSUER,
+                             PathPaymentResultCode.TOO_FEW_OFFERS)
+
+
+# ================================== book exhaustion per exchange position
+
+@pytest.mark.parametrize("missing_hop", [0, 1, 2])
+def test_not_enough_offers_per_position(ledger, root, missing_hop):
+    """Reference 'not enough offers for first/middle/last exchange'."""
+    issuer, mm, assets, hops = three_hop_market(root,
+                                                skip_book=missing_hop)
+    a, b = payer_and_dest(root, ledger, assets[2])
+    f = a.tx([recv_op(a, b, XLM, 10**7, assets[2], 100,
+                      path=[assets[0], assets[1]])])
+    assert not ledger.apply_frame(f)
+    assert inner_code(f) == PathPaymentResultCode.TOO_FEW_OFFERS
+
+
+@pytest.mark.parametrize("hop", [0, 1, 2])
+def test_crosses_own_offer_per_position(ledger, root, hop):
+    """Reference 'crosses own offer for first/middle/last exchange':
+    the payer's own resting offer in any hop's book fails the op."""
+    issuer, mm, assets, hops = three_hop_market(root, skip_book=hop)
+    a, b = payer_and_dest(root, ledger, assets[2])
+    have, want = hops[hop]
+    # arm the payer's own offer as the ONLY offer on hop's book
+    if not want.is_native:
+        assert a.change_trust(want, 10**14)
+        assert issuer.pay(a, 10**7, want)
+    if not have.is_native:
+        assert a.change_trust(have, 10**14)
+    assert ledger.apply_frame(
+        a.tx([a.op_manage_sell_offer(want, have, 10**5, 2, 1)]))
+    f = a.tx([recv_op(a, b, XLM, 10**7, assets[2], 100,
+                      path=[assets[0], assets[1]])])
+    assert not ledger.apply_frame(f)
+    assert inner_code(f) == PathPaymentResultCode.OFFER_CROSS_SELF
+
+
+@pytest.mark.parametrize("hop", [0, 1, 2])
+def test_own_offer_not_crossed_when_better_available(ledger, root, hop):
+    """Reference 'does not cross own offer if better is available': the
+    payer's WORSE offer rests behind the mm's better one and survives."""
+    issuer, mm, assets, hops = three_hop_market(root)
+    a, b = payer_and_dest(root, ledger, assets[2])
+    have, want = hops[hop]
+    if not want.is_native:
+        assert a.change_trust(want, 10**14)
+        assert issuer.pay(a, 10**7, want)
+    if not have.is_native:
+        assert a.change_trust(have, 10**14)
+    # payer's price 5 vs the mm's 2: never reached for this small fill
+    assert ledger.apply_frame(
+        a.tx([a.op_manage_sell_offer(want, have, 10**5, 5, 1)]))
+    f = a.tx([recv_op(a, b, XLM, 10**7, assets[2], 100,
+                      path=[assets[0], assets[1]])])
+    assert ledger.apply_frame(f), f.result
+    assert ledger.trust_balance(b.account_id, assets[2]) == 100
+
+
+@pytest.mark.parametrize("hop", [0, 1, 2])
+def test_crosses_destination_offer_per_position(ledger, root, hop):
+    """Reference 'crosses destination offer': the DESTINATION's resting
+    offers are fair game — only the source's are protected."""
+    issuer, mm, assets, hops = three_hop_market(root, skip_book=hop)
+    a, b = payer_and_dest(root, ledger, assets[2])
+    have, want = hops[hop]
+    if not want.is_native:
+        assert b.change_trust(want, 10**14)
+        assert issuer.pay(b, 10**7, want)
+    if not have.is_native:
+        assert b.change_trust(have, 10**14)
+    assert ledger.apply_frame(
+        b.tx([b.op_manage_sell_offer(want, have, 10**6, 2, 1)]))
+    before = ledger.trust_balance(b.account_id, assets[2]) \
+        if hop == 2 else 0
+    f = a.tx([recv_op(a, b, XLM, 10**7, assets[2], 100,
+                      path=[assets[0], assets[1]])])
+    assert ledger.apply_frame(f), f.result
+    succ = success_of(f)
+    assert any(c.sellerID == b.account_id for c in succ.offers)
+    assert succ.last.amount == 100
+    # b's dest-asset balance: +100 received, minus anything b itself
+    # sold out of its crossed offer (only when its offer sells assets[2])
+    sold_by_b = sum(c.amountSold for c in succ.offers
+                    if c.sellerID == b.account_id
+                    and c.assetSold.to_xdr() == assets[2].to_xdr())
+    assert ledger.trust_balance(b.account_id, assets[2]) == \
+        before + 100 - sold_by_b
+
+
+# =========================================== whole-offer / limit / GC edges
+
+@pytest.mark.parametrize("hop", [0, 1, 2])
+def test_uses_whole_best_offer_then_next(ledger, root, hop):
+    """Reference 'uses whole best offer for …': the best offer is fully
+    consumed (deleted) and the remainder comes from the next one."""
+    issuer, mm, assets, hops = three_hop_market(root, skip_book=hop)
+    mm2 = root.create(10**10)
+    for asset in assets:
+        assert mm2.change_trust(asset, 10**14)
+        assert issuer.pay(mm2, 10**8, asset)
+    have, want = hops[hop]
+    # best: 60 units at 2; next: plenty at 3 — a 100-unit hop spans both
+    assert ledger.apply_frame(
+        mm2.tx([mm2.op_manage_sell_offer(want, have, 60, 2, 1)]))
+    assert ledger.apply_frame(
+        mm2.tx([mm2.op_manage_sell_offer(want, have, 10**6, 3, 1)]))
+    a, b = payer_and_dest(root, ledger, assets[2])
+    f = a.tx([recv_op(a, b, XLM, 10**7, assets[2], 100,
+                      path=[assets[0], assets[1]])])
+    assert ledger.apply_frame(f), f.result
+    succ = success_of(f)
+    claims_this_hop = [c for c in succ.offers
+                       if c.assetSold.to_xdr() == want.to_xdr()]
+    # the backward walk needs 100 units at the LAST hop, ×2 per mm-priced
+    # hop upstream of it (mm sells at 2 wheat-per-sheep... sheep=2·wheat)
+    need = 100 * 2 ** (2 - hop)
+    assert [c.amountSold for c in claims_this_hop] == [60, need - 60]
+
+
+def test_limit_cannot_shrink_below_offer_liabilities(ledger, root):
+    """Reference 'reaches limit for offer' — at v10+ the scenario cannot
+    arise: lowering the buying line's limit below the liabilities a
+    resting offer encumbers is rejected with CHANGE_TRUST_INVALID_LIMIT
+    (reference PathPaymentTests.cpp:1780-1783 for_versions_from(10)),
+    so resting offers are always fully receivable."""
+    from stellar_core_tpu.transactions.operations import (
+        ChangeTrustResultCode,
+    )
+    issuer = root.create(10**10)
+    usd = Asset.credit("USD", issuer.account_id)
+    eur = Asset.credit("EUR", issuer.account_id)
+    mm = root.create(10**10)
+    for asset in (usd, eur):
+        assert mm.change_trust(asset, 200)
+    assert issuer.pay(mm, 100, usd)
+    # the offer encumbers 80 EUR of buying liabilities
+    assert ledger.apply_frame(
+        mm.tx([mm.op_manage_sell_offer(usd, eur, 80, 1, 1)]))
+    f = mm.tx([mm.op_change_trust(eur, 5)])
+    assert not ledger.apply_frame(f)
+    assert inner_code(f) == ChangeTrustResultCode.INVALID_LIMIT
+    # at-or-above the liabilities the change is fine
+    assert ledger.apply_frame(mm.tx([mm.op_change_trust(eur, 80)]))
+
+
+def test_one_unit_left_in_buying_line(ledger, root):
+    """Reference 'path payment 1 left in trust line for buying asset for
+    offer': headroom of exactly 1 still crosses 1 unit."""
+    issuer = root.create(10**10)
+    usd = Asset.credit("USD", issuer.account_id)
+    eur = Asset.credit("EUR", issuer.account_id)
+    mm = root.create(10**10)
+    for asset in (usd, eur):
+        assert mm.change_trust(asset, 10**14)
+    assert issuer.pay(mm, 10**8, usd)
+    assert ledger.apply_frame(mm.tx([mm.op_change_trust(eur, 100)]))
+    assert issuer.pay(mm, 99, eur)       # headroom exactly 1
+    # a bigger posting would be LINE_FULL at v10+ (liabilities must fit);
+    # amount 1 is the largest backable offer
+    f_big = mm.tx([mm.op_manage_sell_offer(usd, eur, 10**6, 1, 1)])
+    assert not ledger.apply_frame(f_big)
+    assert ledger.apply_frame(
+        mm.tx([mm.op_manage_sell_offer(usd, eur, 1, 1, 1)]))
+    a = root.create(10**10)
+    b = root.create(10**10)
+    for acct in (a, b):
+        assert acct.change_trust(usd, 10**12)
+        assert acct.change_trust(eur, 10**12)
+    assert issuer.pay(a, 10**6, eur)
+    f = a.tx([recv_op(a, b, eur, 10**6, usd, 1)])
+    assert ledger.apply_frame(f), f.result
+    assert ledger.trust_balance(mm.account_id, eur) == 100
+
+
+def test_fees_cannot_eat_offer_backing(ledger, root):
+    """The v10+ analog of the reference 'bogus offer' sections: fees can
+    no longer dig into a resting offer's native backing — a tx whose fee
+    would do so fails txINSUFFICIENT_BALANCE at checkValid, so offers on
+    the books are always genuinely funded (the reference's bogus-offer
+    walks are for_versions_to(9); the cross-time GC in
+    offer_exchange.cross_offers stays as defense in depth)."""
+    issuer = root.create(10**10)
+    usd = Asset.credit("USD", issuer.account_id)
+    seller = root.create(25_000_000)
+    assert seller.change_trust(usd, 10**12)
+    # sells every spendable stroop: balance minus the reserve for
+    # (2 base + trustline + the offer's own subentry) minus this tx's fee
+    avail = seller.balance() - 4 * 5_000_000 - 100
+    assert ledger.apply_frame(
+        seller.tx([seller.op_manage_sell_offer(XLM, usd, avail, 1, 1)]))
+    from stellar_core_tpu.xdr import BumpSequenceOp
+    bump = seller.op(OperationBody(
+        OperationType.BUMP_SEQUENCE, BumpSequenceOp(bumpTo=0)))
+    f = seller.tx([bump])
+    assert not ledger.apply_frame(f)
+    assert f.result.code == TransactionResultCode.txINSUFFICIENT_BALANCE
+    # the offer's full posted amount remains crossable
+    a = root.create(2 * 10**10)
+    b = root.create(10**10)
+    assert a.change_trust(usd, 10**12)
+    assert issuer.pay(a, 10**8, usd)
+    fp = a.tx([recv_op(a, b, usd, 10**8, XLM, avail)])
+    assert ledger.apply_frame(fp), fp.result
+    succ = success_of(fp)
+    assert sum(c.amountSold for c in succ.offers) == avail
+
+
+# ======================================================= self / cycles / mix
+
+def test_to_self_native_is_noop_but_charges_fee(ledger, root):
+    a = root.create(10**9)
+    before = a.balance()
+    f = a.tx([recv_op(a, a, XLM, 100, XLM, 100)])
+    assert ledger.apply_frame(f), f.result
+    assert a.balance() == before - f.fee_bid
+
+
+def test_to_self_same_asset_respects_limit(ledger, root):
+    """Reference 'path payment to self asset (+ over the limit)': a
+    same-asset self payment succeeds with no balance change, but the
+    receive headroom is STILL enforced — paying more than limit−balance
+    to yourself is LINE_FULL (PathPaymentTests.cpp:1248-1275)."""
+    issuer = root.create(10**10)
+    usd = Asset.credit("USD", issuer.account_id)
+    a = root.create(10**10)
+    assert a.change_trust(usd, 20)
+    assert issuer.pay(a, 19, usd)      # headroom exactly 1
+    f = a.tx([recv_op(a, a, usd, 2, usd, 2)])
+    assert not ledger.apply_frame(f)
+    assert inner_code(f) == PathPaymentResultCode.LINE_FULL
+    assert ledger.trust_balance(a.account_id, usd) == 19
+    f = a.tx([recv_op(a, a, usd, 1, usd, 1)])
+    assert ledger.apply_frame(f), f.result
+    assert ledger.trust_balance(a.account_id, usd) == 19
+
+
+def test_cycle_through_books_returns_to_native(ledger, root):
+    """Reference 'path payment with cycle': XLM → USD → XLM walks two
+    real books and nets the round-trip spread."""
+    issuer = root.create(10**10)
+    usd = Asset.credit("USD", issuer.account_id)
+    mm = root.create(2 * 10**10)
+    assert mm.change_trust(usd, 10**14)
+    assert issuer.pay(mm, 10**8, usd)
+    # sell USD at 2 XLM; sell XLM at 1 USD each (mm profits the spread)
+    assert ledger.apply_frame(
+        mm.tx([mm.op_manage_sell_offer(usd, XLM, 10**6, 2, 1)]))
+    assert ledger.apply_frame(
+        mm.tx([mm.op_manage_sell_offer(XLM, usd, 10**7, 1, 1)]))
+    a = root.create(10**10)
+    b = root.create(10**10)
+    f = a.tx([recv_op(a, b, XLM, 10**6, XLM, 100, path=[usd])])
+    assert ledger.apply_frame(f), f.result
+    succ = success_of(f)
+    # 100 XLM bought with 100 USD; 100 USD bought with 200 XLM
+    assert sorted(c.amountSold for c in succ.offers) == [100, 100]
+    total_spent = [c for c in succ.offers
+                   if c.assetSold.to_xdr() == usd.to_xdr()][0].amountBought
+    assert total_spent == 200
+
+
+def test_rounding_favors_resting_offer(ledger, root):
+    """Reference 'path payment rounding': at price 3/2 the sheep side
+    rounds UP so the offer owner is never underpaid."""
+    issuer = root.create(10**10)
+    usd = Asset.credit("USD", issuer.account_id)
+    mm = root.create(10**10)
+    assert mm.change_trust(usd, 10**14)
+    assert issuer.pay(mm, 10**8, usd)
+    assert ledger.apply_frame(
+        mm.tx([mm.op_manage_sell_offer(usd, XLM, 10**6, 3, 2)]))
+    a = root.create(10**10)
+    b = root.create(10**10)
+    assert b.change_trust(usd, 10**12)
+    f = a.tx([recv_op(a, b, XLM, 10**6, usd, 101)])   # 101*3/2 = 151.5
+    assert ledger.apply_frame(f), f.result
+    succ = success_of(f)
+    assert succ.offers[0].amountBought == 152          # rounded UP
+    assert succ.offers[0].amountSold == 101
+
+
+def test_strict_send_rounding_remainder_within_one(ledger, root):
+    """Strict send at an awkward price: the delivered amount is the
+    floor'd conversion and the spent amount is exactly sendAmount."""
+    issuer = root.create(10**10)
+    usd = Asset.credit("USD", issuer.account_id)
+    mm = root.create(10**10)
+    assert mm.change_trust(usd, 10**14)
+    assert issuer.pay(mm, 10**8, usd)
+    # price 7 XLM per 3 USD… wheat=USD, sheep=XLM, n/d = 7/3
+    assert ledger.apply_frame(
+        mm.tx([mm.op_manage_sell_offer(usd, XLM, 10**6, 7, 3)]))
+    a = root.create(10**10)
+    b = root.create(10**10)
+    assert b.change_trust(usd, 10**12)
+    # 100 XLM cannot fully convert at 7/3 (floor→42 wheat costs only 98
+    # sheep, leaving a 2-stroop residue) — the reference's checkTransfer
+    # requires maxSend == amountSend, so this is TOO_FEW_OFFERS
+    f = a.tx([send_op(a, b, XLM, 100, usd, 1)])
+    assert not ledger.apply_frame(f)
+    assert inner_code(f) == PathPaymentResultCode.TOO_FEW_OFFERS
+    # an exactly-convertible amount (98 = ceil(42·7/3)) goes through
+    f = a.tx([send_op(a, b, XLM, 98, usd, 1)])
+    assert ledger.apply_frame(f), f.result
+    succ = success_of(f)
+    assert succ.last.amount == 42
+    assert succ.offers[0].amountBought == 98
+
+
+def test_posting_offer_encumbers_selling_liabilities(ledger, root):
+    """Reference 'liabilities' section: a resting offer's backing is
+    unavailable to ANY spend until the offer dies."""
+    issuer = root.create(10**10)
+    usd = Asset.credit("USD", issuer.account_id)
+    a = root.create(10**10)
+    b = root.create(10**10)
+    assert a.change_trust(usd, 10**12)
+    assert b.change_trust(usd, 10**12)
+    assert issuer.pay(a, 1000, usd)
+    assert ledger.apply_frame(
+        a.tx([a.op_manage_sell_offer(usd, XLM, 1000, 1, 1)]))
+    assert not a.pay(b, 1, usd)          # fully encumbered
+    # delete the offer → spendable again
+    offer_id = None
+    from stellar_core_tpu.xdr import LedgerKey
+    # find the offer id from the op result of a fresh re-post attempt
+    # (id pool is monotonically increasing; the posted one was id 1)
+    assert ledger.apply_frame(
+        a.tx([a.op_manage_sell_offer(usd, XLM, 0, 1, 1, offer_id=1)]))
+    assert a.pay(b, 1, usd)
+
+
+def test_takes_all_offers_multiple_per_exchange(ledger, root):
+    """Reference 'takes all offers, multiple offers per exchange': an
+    exact sweep of every offer on both hops leaves both books empty.
+    Sizing: hop1 asks 100@2 + 50@3 = 350 AS0; hop0 supplies exactly
+    300@2 + 50@3 = 350 AS0 for 750 XLM."""
+    issuer = root.create(10**10)
+    as0 = Asset.credit("AS0", issuer.account_id)
+    as1 = Asset.credit("AS1", issuer.account_id)
+    mm1, mm2 = root.create(10**10), root.create(10**10)
+    for mm in (mm1, mm2):
+        for asset in (as0, as1):
+            assert mm.change_trust(asset, 10**14)
+            assert issuer.pay(mm, 10**8, asset)
+    book = [(mm1, as0, XLM, 300, 2), (mm2, as0, XLM, 50, 3),
+            (mm1, as1, as0, 100, 2), (mm2, as1, as0, 50, 3)]
+    for owner, sell, buy, amt, n in book:
+        assert ledger.apply_frame(
+            owner.tx([owner.op_manage_sell_offer(sell, buy, amt, n, 1)]))
+    a, b = payer_and_dest(root, ledger, as1)
+    f = a.tx([recv_op(a, b, XLM, 10**9, as1, 150, path=[as0])])
+    assert ledger.apply_frame(f), f.result
+    assert ledger.trust_balance(b.account_id, as1) == 150
+    succ = success_of(f)
+    assert len(succ.offers) == 4     # two offers per hop, all consumed
+    xlm_spent = sum(c.amountBought for c in succ.offers
+                    if c.assetBought.is_native)
+    assert xlm_spent == 300 * 2 + 50 * 3
+    # the books are now empty: the same payment again finds no offers
+    f = a.tx([recv_op(a, b, XLM, 10**9, as1, 1, path=[as0])])
+    assert not ledger.apply_frame(f)
+    assert inner_code(f) == PathPaymentResultCode.TOO_FEW_OFFERS
