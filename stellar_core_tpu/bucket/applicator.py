@@ -31,27 +31,29 @@ class BucketApplicator:
         entries applied this step."""
         if not self:
             return 0
-        ltx = LedgerTxn(self._root)
         n = 0
-        while self._pos < len(self._entries) and n < self._chunk:
-            e = self._entries[self._pos]
-            self._pos += 1
-            t = e.disc
-            if t in (BucketEntryType.LIVEENTRY, BucketEntryType.INITENTRY):
-                key = ledger_entry_key(e.value)
-                cur = ltx.load(key)
-                if cur is not None:
-                    cur.lastModifiedLedgerSeq = \
-                        e.value.lastModifiedLedgerSeq
-                    cur.data = e.value.data
-                    cur.ext = e.value.ext
-                else:
-                    ltx.create(e.value)
-            elif t == BucketEntryType.DEADENTRY:
-                if ltx.load(e.value) is not None:
-                    ltx.erase(e.value)
-            n += 1
-        ltx.commit()
+        # `with` rolls back on error: an abandoned-but-registered child
+        # would otherwise block every future LedgerTxn over this root
+        with LedgerTxn(self._root) as ltx:
+            while self._pos < len(self._entries) and n < self._chunk:
+                e = self._entries[self._pos]
+                self._pos += 1
+                t = e.disc
+                if t in (BucketEntryType.LIVEENTRY,
+                         BucketEntryType.INITENTRY):
+                    key = ledger_entry_key(e.value)
+                    cur = ltx.load(key)
+                    if cur is not None:
+                        cur.lastModifiedLedgerSeq = \
+                            e.value.lastModifiedLedgerSeq
+                        cur.data = e.value.data
+                        cur.ext = e.value.ext
+                    else:
+                        ltx.create(e.value)
+                elif t == BucketEntryType.DEADENTRY:
+                    if ltx.load(e.value) is not None:
+                        ltx.erase(e.value)
+                n += 1
         return n
 
 
@@ -64,33 +66,33 @@ def apply_buckets(root, buckets: Iterable[Bucket]) -> int:
     seen = set()
     # Newest-first with a seen-key shield: the first bucket to mention a key
     # decides its final state; older buckets' entries for that key are noise.
-    ltx = LedgerTxn(root)
-    for b in buckets:
-        for e in b.payload_entries():
-            t = e.disc
-            if t == BucketEntryType.METAENTRY:
-                continue
-            if t in (BucketEntryType.LIVEENTRY, BucketEntryType.INITENTRY):
-                key = ledger_entry_key(e.value)
-                kx = key.to_xdr()
-                if kx in seen:
+    with LedgerTxn(root) as ltx:
+        for b in buckets:
+            for e in b.payload_entries():
+                t = e.disc
+                if t == BucketEntryType.METAENTRY:
                     continue
-                seen.add(kx)
-                cur = ltx.load(key)
-                if cur is not None:
-                    cur.lastModifiedLedgerSeq = \
-                        e.value.lastModifiedLedgerSeq
-                    cur.data = e.value.data
-                    cur.ext = e.value.ext
-                else:
-                    ltx.create(e.value)
-            elif t == BucketEntryType.DEADENTRY:
-                kx = e.value.to_xdr()
-                if kx in seen:
-                    continue
-                seen.add(kx)
-                if ltx.load(e.value) is not None:
-                    ltx.erase(e.value)
-            total += 1
-    ltx.commit()
+                if t in (BucketEntryType.LIVEENTRY,
+                         BucketEntryType.INITENTRY):
+                    key = ledger_entry_key(e.value)
+                    kx = key.to_xdr()
+                    if kx in seen:
+                        continue
+                    seen.add(kx)
+                    cur = ltx.load(key)
+                    if cur is not None:
+                        cur.lastModifiedLedgerSeq = \
+                            e.value.lastModifiedLedgerSeq
+                        cur.data = e.value.data
+                        cur.ext = e.value.ext
+                    else:
+                        ltx.create(e.value)
+                elif t == BucketEntryType.DEADENTRY:
+                    kx = e.value.to_xdr()
+                    if kx in seen:
+                        continue
+                    seen.add(kx)
+                    if ltx.load(e.value) is not None:
+                        ltx.erase(e.value)
+                total += 1
     return total

@@ -75,6 +75,19 @@ def price_less(a_offer: OfferEntry, b_offer: OfferEntry) -> bool:
 
 
 class AbstractLedgerTxnParent:
+    # Exactly one child may be open under any parent — roots included
+    # (reference LedgerTxn.cpp addChild: both LedgerTxn and LedgerTxnRoot
+    # throw if a child is already open).
+    _child: Optional["LedgerTxn"] = None
+
+    def _register_child(self, child: "LedgerTxn") -> None:
+        assert self._child is None, "parent already has an open child"
+        self._child = child
+
+    def _clear_child(self, child: "LedgerTxn") -> None:
+        if self._child is child:
+            self._child = None
+
     def get_entry(self, key: LedgerKey) -> Optional[LedgerEntry]:
         raise NotImplementedError
 
@@ -104,8 +117,8 @@ class LedgerTxn(AbstractLedgerTxnParent):
         self._open = True
         self._child: Optional["LedgerTxn"] = None
         if isinstance(parent, LedgerTxn):
-            assert parent._child is None, "parent already has an open child"
-            parent._child = self
+            assert parent._open, "parent is sealed"
+        parent._register_child(self)
 
     # -- header -------------------------------------------------------------
     def load_header(self) -> LedgerHeader:
@@ -158,6 +171,18 @@ class LedgerTxn(AbstractLedgerTxnParent):
         self._changes[kb] = mine
         return mine
 
+    def create_or_update_without_loading(self, entry: LedgerEntry) -> None:
+        """Upsert with no existence check and no returned handle
+        (reference createOrUpdateWithoutLoading, LedgerTxn.h: bulk-apply
+        path). Still records the pre-image so deltas stay exact."""
+        self._assert_open()
+        key = ledger_entry_key(entry)
+        kb = _kb(key)
+        if kb not in self._previous:
+            base = self._parent.get_entry(key)
+            self._previous[kb] = base.to_xdr() if base is not None else None
+        self._changes[kb] = _copy_entry(entry)
+
     def erase(self, key: LedgerKey) -> None:
         self._assert_open()
         kb = _kb(key)
@@ -165,6 +190,16 @@ class LedgerTxn(AbstractLedgerTxnParent):
         assert existing is not None, "erasing missing entry"
         if kb not in self._previous:
             self._previous[kb] = existing.to_xdr()
+        self._changes[kb] = None
+
+    def erase_without_loading(self, key: LedgerKey) -> None:
+        """Delete with no existence check (reference eraseWithoutLoading):
+        erasing an absent key is a no-op record of absence, not an error."""
+        self._assert_open()
+        kb = _kb(key)
+        if kb not in self._previous:
+            base = self._parent.get_entry(key)
+            self._previous[kb] = base.to_xdr() if base is not None else None
         self._changes[kb] = None
 
     # -- order book ---------------------------------------------------------
@@ -214,24 +249,93 @@ class LedgerTxn(AbstractLedgerTxnParent):
                 out.pop(kb, None)
         return out
 
-    def load_offers_by_account(self, account_id) -> List[LedgerEntry]:
+    def load_offers_by_account(self, account_id,
+                               asset: Optional[Asset] = None
+                               ) -> List[LedgerEntry]:
+        """Load (for update) the account's offers; with `asset`, only
+        offers buying or selling it (reference
+        loadOffersByAccountAndAsset, LedgerTxn.h)."""
         self._assert_open()
         res = []
-        for kb in list(self._offers_by_account(account_id)):
+        for kb, view in list(self._offers_by_account(account_id).items()):
+            if asset is not None:
+                o = view.data.value
+                # filter on the view BEFORE load(): non-matching offers
+                # must not be copied or recorded in the delta
+                if o.selling != asset and o.buying != asset:
+                    continue
             e = self.load(LedgerKey.from_xdr(kb))
             if e is not None:
                 res.append(e)
         return res
 
+    def _all_offers(self) -> Dict[bytes, LedgerEntry]:
+        out = self._parent._all_offers()
+        for kb, e in self._changes.items():
+            if LedgerKey.from_xdr(kb).disc != LedgerEntryType.OFFER:
+                continue
+            if e is None:
+                out.pop(kb, None)
+            else:
+                out[kb] = e
+        return out
+
+    def load_all_offers(self) -> List[LedgerEntry]:
+        """Load (for update) every offer in the ledger (reference
+        loadAllOffers, LedgerTxn.h — liability-upgrade path)."""
+        self._assert_open()
+        res = []
+        for kb in list(self._all_offers()):
+            e = self.load(LedgerKey.from_xdr(kb))
+            if e is not None:
+                res.append(e)
+        return res
+
+    def query_inflation_winners(self, max_winners: int,
+                                min_votes: int) -> List[Tuple[bytes, int]]:
+        """[(accountID key_bytes, votes)] for inflation destinations with
+        at least `min_votes` of balance-weighted votes, sorted votes
+        descending (ties: account key descending), capped at
+        `max_winners` (reference queryInflationWinners, LedgerTxn.cpp —
+        including uncommitted changes in this txn chain, which the SQL
+        root alone cannot see)."""
+        self._assert_open()
+        # innermost change wins: collect ancestor overlays root-first
+        chain: List["LedgerTxn"] = []
+        node: AbstractLedgerTxnParent = self
+        while isinstance(node, LedgerTxn):
+            chain.append(node)
+            node = node._parent
+        merged: Dict[bytes, Optional[LedgerEntry]] = dict(
+            node._all_accounts())
+        for txn in reversed(chain):
+            for kb, e in txn._changes.items():
+                if LedgerKey.from_xdr(kb).disc == LedgerEntryType.ACCOUNT:
+                    merged[kb] = e
+        votes: Dict[bytes, int] = {}
+        for e in merged.values():
+            if e is None:
+                continue
+            acc = e.data.value
+            if acc.inflationDest is not None:
+                k = acc.inflationDest.key_bytes
+                votes[k] = votes.get(k, 0) + acc.balance
+        winners = sorted(
+            ((k, v) for k, v in votes.items() if v >= min_votes),
+            key=lambda kv: (-kv[1], tuple(
+                -c for c in strkey.encode_public_key(kv[0]).encode())))
+        return winners[:max_winners]
+
     # -- lifecycle ----------------------------------------------------------
     def commit(self) -> None:
         self._assert_open()
-        self._open = False
-        # serialize entries at the commit boundary so later mutations of the
-        # (now dead) child objects can't alias parent state
+        # seal only after commit_child succeeds: a transient failure there
+        # (e.g. sqlite "database is locked" at the root) must leave this
+        # txn open and registered so the caller can roll back — otherwise
+        # the parent's child slot is bricked for every future txn
         self._parent.commit_child(self._changes, self._header)
-        if isinstance(self._parent, LedgerTxn):
-            self._parent._child = None
+        self._open = False
+        self._parent._clear_child(self)
 
     def rollback(self) -> None:
         assert self._open
@@ -239,8 +343,7 @@ class LedgerTxn(AbstractLedgerTxnParent):
             self._child.rollback()
         self._open = False
         self._changes.clear()
-        if isinstance(self._parent, LedgerTxn):
-            self._parent._child = None
+        self._parent._clear_child(self)
 
     def commit_child(self, changes: Dict[bytes, Optional[LedgerEntry]],
                      header: LedgerHeader) -> None:
@@ -326,6 +429,20 @@ class InMemoryLedgerTxnRoot(AbstractLedgerTxnParent):
             e = LedgerEntry.from_xdr(eb)
             if e.data.value.sellerID.to_xdr() == acc:
                 out[kb] = e
+        return out
+
+    def _all_offers(self):
+        out: Dict[bytes, LedgerEntry] = {}
+        for kb, eb in self._entries.items():
+            if LedgerKey.from_xdr(kb).disc == LedgerEntryType.OFFER:
+                out[kb] = LedgerEntry.from_xdr(eb)
+        return out
+
+    def _all_accounts(self):
+        out: Dict[bytes, LedgerEntry] = {}
+        for kb, eb in self._entries.items():
+            if LedgerKey.from_xdr(kb).disc == LedgerEntryType.ACCOUNT:
+                out[kb] = LedgerEntry.from_xdr(eb)
         return out
 
     def commit_child(self, changes, header) -> None:
@@ -424,6 +541,40 @@ class LedgerTxnRoot(AbstractLedgerTxnParent):
             e = LedgerEntry.from_xdr(blob)
             out[_kb(ledger_entry_key(e))] = e
         return out
+
+    def _all_offers(self):
+        out: Dict[bytes, LedgerEntry] = {}
+        for (blob,) in self._db.execute(
+                "SELECT entry FROM offers").fetchall():
+            e = LedgerEntry.from_xdr(blob)
+            out[_kb(ledger_entry_key(e))] = e
+        return out
+
+    def _all_accounts(self):
+        out: Dict[bytes, LedgerEntry] = {}
+        for (blob,) in self._db.execute(
+                "SELECT entry FROM accounts").fetchall():
+            e = LedgerEntry.from_xdr(blob)
+            out[_kb(ledger_entry_key(e))] = e
+        return out
+
+    def prefetch(self, keys) -> int:
+        """Bulk-warm the entry cache for `keys`; returns how many were
+        actually cached (reference LedgerTxnRoot::prefetch,
+        LedgerTxn.cpp — stops when the cache is half full so prefetch
+        can't evict the working set)."""
+        budget = self._cache._max // 2
+        n = 0
+        for key in keys:
+            if len(self._cache) >= budget:
+                break
+            kb = _kb(key)
+            if self._cache.maybe_get(kb) is not None:
+                continue
+            blob = self._select_blob(key)
+            self._cache.put(kb, blob if blob is not None else b"")
+            n += 1
+        return n
 
     def clear_entries(self) -> None:
         """Drop all ledger entries + cache (bucket-apply catchup resets

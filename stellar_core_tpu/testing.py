@@ -97,25 +97,26 @@ class TestLedger:
         """check → charge fee/seq → apply, mirroring ledger close for a
         single tx."""
         self.advance_ledger()
-        ltx = LedgerTxn(self.root)
-        ok = frame.check_valid(ltx, 0, self.verifier)
-        if not ok:
-            ltx.rollback()
-            return False
-        frame.process_fee_seq_num(ltx, None)
-        applied = frame.apply(ltx, self.verifier)
-        ltx.commit()  # fees/seq consumed even on failed apply
+        # `with` rolls back on an exception mid-apply (common in failing
+        # tests) so the root's child slot isn't left registered
+        with LedgerTxn(self.root) as ltx:
+            ok = frame.check_valid(ltx, 0, self.verifier)
+            if not ok:
+                ltx.rollback()
+                return False
+            frame.process_fee_seq_num(ltx, None)
+            applied = frame.apply(ltx, self.verifier)
+            ltx.commit()  # fees/seq consumed even on failed apply
         return applied
 
     def close_with(self, frames: List[TransactionFrame]) -> List[bool]:
         """Apply a batch like a ledger close: all fees/seqs first, then all
         ops (reference LedgerManagerImpl::closeLedger ordering)."""
         self.advance_ledger()
-        ltx = LedgerTxn(self.root)
-        for f in frames:
-            f.process_fee_seq_num(ltx, None)
-        results = [f.apply(ltx, self.verifier) for f in frames]
-        ltx.commit()
+        with LedgerTxn(self.root) as ltx:
+            for f in frames:
+                f.process_fee_seq_num(ltx, None)
+            results = [f.apply(ltx, self.verifier) for f in frames]
         return results
 
 

@@ -424,10 +424,8 @@ class AllowTrustOpFrame(OperationFrame):
     @staticmethod
     def _remove_offers(ltx, header, trustor, asset: Asset) -> None:
         from .offer_exchange import release_liabilities
-        for entry in ltx.load_offers_by_account(trustor):
+        for entry in ltx.load_offers_by_account(trustor, asset):
             oe = entry.data.value
-            if oe.selling != asset and oe.buying != asset:
-                continue
             release_liabilities(ltx, oe)
             acct = load_account(ltx, trustor)
             change_subentries(header, acct, -1)
@@ -505,32 +503,23 @@ class InflationOpFrame(OperationFrame):
         if close_time < next_time:
             return self.set_inner(InflationResultCode.NOT_TIME)
         # classic mechanism (reference InflationOpFrame::doApply): tally
-        # inflationDest votes weighted by balance; winners over 0.05%
-        votes: dict[bytes, int] = {}
+        # inflationDest votes weighted by balance; winners over 0.05%.
+        # The query runs on the LedgerTxn so votes see uncommitted changes
+        # in the open txn chain (fees charged this close, earlier ops in
+        # this tx) — reference queryInflationWinners merges child deltas.
         total = header.totalCoins
-        for e in self._all_accounts(ltx):
-            acc = e.data.value
-            if acc.inflationDest is not None:
-                k = acc.inflationDest.to_xdr()
-                votes[k] = votes.get(k, 0) + acc.balance
         min_votes = total * self.INFLATION_WIN_MIN_PERCENT // 10**12
-        # reference winner order: votes descending, strkey descending on
-        # ties (LedgerTxn.cpp queryInflationWinners sort), capped at
-        # INFLATION_NUM_WINNERS
-        from ..crypto import strkey as _sk
-        winners = sorted(
-            ((k, v) for k, v in votes.items() if v >= min_votes),
-            key=lambda kv: (-kv[1], tuple(
-                -c for c in _sk.encode_public_key(
-                    AccountID.from_xdr(kv[0]).key_bytes).encode())))
-        winners = winners[:self.INFLATION_NUM_WINNERS]
+        winners = [
+            (AccountID.ed25519(kb), v)
+            for kb, v in ltx.query_inflation_winners(
+                self.INFLATION_NUM_WINNERS, min_votes)]
         inflation_amount = total * self.INFLATION_RATE_TRILLIONTHS // 10**12
         amount_to_dole = inflation_amount + header.feePool
         header.feePool = 0
         header.inflationSeq += 1
         left = amount_to_dole
         payouts = []
-        for k, v in winners:
+        for dest_id, v in winners:
             # each winner's share is its fraction of ALL coins, not of
             # the winning votes (reference bigDivide(amountToDole,
             # w.votes, totalVotes) with totalVotes = lh.totalCoins) —
@@ -538,7 +527,6 @@ class InflationOpFrame(OperationFrame):
             share = amount_to_dole * v // total
             if share == 0:
                 continue
-            dest_id = AccountID.from_xdr(k)
             dest = load_account(ltx, dest_id)
             if dest is None:
                 continue  # missing winner: nothing doled
@@ -562,15 +550,6 @@ class InflationOpFrame(OperationFrame):
         if header.ledgerVersion > 7:
             header.totalCoins += inflation_amount
         return self.set_inner(InflationResultCode.SUCCESS, payouts)
-
-    def _all_accounts(self, ltx):
-        # walk to the root for a full account scan
-        node = ltx
-        while hasattr(node, "_parent"):
-            node = node._parent
-        for e in node.all_entries():
-            if e.data.disc == LedgerEntryType.ACCOUNT:
-                yield e
 
 
 @register_op

@@ -1,0 +1,1022 @@
+"""LedgerTxn semantics matrix — section-for-section port of the reference
+suite `src/ledger/test/LedgerTxnTests.cpp` (3,126 LoC) onto this repo's
+mutability model (`ledger/ledgertxn.py`).
+
+Mapping notes (cases the Python model makes meaningless are listed here
+rather than silently dropped):
+
+| reference TEST_CASE                  | here                                |
+|--------------------------------------|-------------------------------------|
+| addChild (:94)                       | TestAddChild                        |
+| commit into LedgerTxn (:128)         | TestCommitIntoParent                |
+| rollback into LedgerTxn (:199)       | TestRollbackIntoParent              |
+| round trip (:270)                    | TestRoundTrip                       |
+| rollback/commit deactivate (:421)    | TestClosedTxnRejectsUse — C++
+|                                      | "deactivation" invalidates live
+|                                      | references; the Python analog is
+|                                      | that every API asserts on a closed
+|                                      | txn (the returned objects stay
+|                                      | alive but orphaned by design)       |
+| create (:474)                        | TestCreate                          |
+| createOrUpdateWithoutLoading (:532)  | TestCreateOrUpdateWithoutLoading    |
+| erase (:603)                         | TestErase                           |
+| eraseWithoutLoading (:662)           | TestEraseWithoutLoading             |
+| queryInflationWinners (:846)         | TestQueryInflationWinners           |
+| loadHeader (:1128)                   | TestLoadHeader — "fails if header
+|                                      | already loaded" is C++ double-
+|                                      | activation; load_header here is
+|                                      | idempotent (same object), so that
+|                                      | section is meaningless              |
+| load (:1170)                         | TestLoad                            |
+| loadWithoutRecord (:1227)            | TestLoadWithoutRecord               |
+| loadAllOffers (:1422)                | TestLoadAllOffers                   |
+| loadBestOffer (:1674)                | TestLoadBestOffer — "fails with
+|                                      | active entries" is the C++ single-
+|                                      | owner discipline; no Python analog  |
+| loadOffersByAccountAndAsset (:1933)  | TestLoadOffersByAccountAndAsset     |
+| unsealHeader (:2050)                 | skipped: seal/unseal is a C++ two-
+|                                      | phase close artifact; commit here
+|                                      | seals atomically                    |
+| move assignment (:2086)              | skipped: C++ move semantics         |
+| LedgerTxnRoot prefetch (:2178)       | TestPrefetch                        |
+| perf benchmarks (:2224-2816, [!hide])| skipped: hidden benches, not tests  |
+| in memory order book (:2817)         | TestOrderBookView — this repo
+|                                      | derives book views on the fly from
+|                                      | overlays instead of maintaining a
+|                                      | MultiOrderBook index; the observable
+|                                      | contract (parent updates on commit,
+|                                      | not on rollback) is what's tested   |
+"""
+
+import pytest
+
+import stellar_core_tpu.xdr as X
+from stellar_core_tpu.crypto import strkey
+from stellar_core_tpu.database.database import Database
+from stellar_core_tpu.ledger.ledgertxn import (
+    InMemoryLedgerTxnRoot, LedgerTxn, LedgerTxnRoot,
+)
+from stellar_core_tpu.transactions.account_helpers import make_account_entry
+
+NATIVE = X.Asset.native()
+
+
+def acc(i: int) -> X.PublicKey:
+    return X.PublicKey.ed25519(bytes([i] * 32))
+
+
+def cred(i: int, code="USD") -> X.Asset:
+    return X.Asset.credit(code, acc(i))
+
+
+def make_header(seq=1, version=13) -> X.LedgerHeader:
+    return X.LedgerHeader(
+        ledgerVersion=version, previousLedgerHash=b"\x00" * 32,
+        scpValue=X.StellarValue(txSetHash=b"\x00" * 32, closeTime=0,
+                                upgrades=[],
+                                ext=X.StellarValueExt(0, None)),
+        txSetResultHash=b"\x00" * 32, bucketListHash=b"\x00" * 32,
+        ledgerSeq=seq, totalCoins=10**17, feePool=0, inflationSeq=0,
+        idPool=0, baseFee=100, baseReserve=5 * 10**6, maxTxSetSize=100,
+        skipList=[b"\x00" * 32] * 4, ext=X._Ext.v0())
+
+
+def make_offer(seller, offer_id, selling=NATIVE, buying=None, amount=100,
+               n=1, d=1):
+    if buying is None:
+        buying = cred(99)
+    o = X.OfferEntry(sellerID=seller, offerID=offer_id, selling=selling,
+                     buying=buying, amount=amount,
+                     price=X.Price(n=n, d=d), flags=0, ext=X._Ext.v0())
+    return X.LedgerEntry(lastModifiedLedgerSeq=1,
+                         data=X.LedgerEntryData(X.LedgerEntryType.OFFER, o),
+                         ext=X._Ext.v0())
+
+
+def make_data(owner, name: str, value: bytes = b"v"):
+    de = X.DataEntry(accountID=owner, dataName=name, dataValue=value,
+                     ext=X._Ext.v0())
+    return X.LedgerEntry(lastModifiedLedgerSeq=1,
+                         data=X.LedgerEntryData(X.LedgerEntryType.DATA, de),
+                         ext=X._Ext.v0())
+
+
+def key_of(entry) -> X.LedgerKey:
+    return X.ledger_entry_key(entry)
+
+
+@pytest.fixture(params=["memory", "sql"])
+def root(request):
+    if request.param == "memory":
+        return InMemoryLedgerTxnRoot(make_header())
+    return LedgerTxnRoot(Database(":memory:"), make_header())
+
+
+# --- addChild (ref LedgerTxnTests.cpp:94-126) ------------------------------
+
+class TestAddChild:
+    def test_fails_if_parent_has_child(self, root):
+        parent = LedgerTxn(root)
+        LedgerTxn(parent)
+        with pytest.raises(AssertionError):
+            LedgerTxn(parent)
+
+    def test_fails_if_parent_sealed_by_commit(self, root):
+        parent = LedgerTxn(root)
+        parent.commit()
+        with pytest.raises(AssertionError):
+            LedgerTxn(parent)
+
+    def test_fails_if_parent_sealed_by_rollback(self, root):
+        parent = LedgerTxn(root)
+        parent.rollback()
+        with pytest.raises(AssertionError):
+            LedgerTxn(parent)
+
+    def test_root_fails_if_it_has_child(self, root):
+        ltx = LedgerTxn(root)
+        with pytest.raises(AssertionError):
+            LedgerTxn(root)
+        ltx.rollback()
+        LedgerTxn(root).rollback()   # fine once the first child is gone
+
+
+# --- commit into LedgerTxn (ref :128-198) ----------------------------------
+
+class TestCommitIntoParent:
+    def test_created_in_child(self, root):
+        parent = LedgerTxn(root)
+        child = LedgerTxn(parent)
+        e = make_account_entry(acc(1), 1000, 5)
+        child.create(e)
+        child.commit()
+        got = parent.load(key_of(e))
+        assert got is not None and got.data.value.balance == 1000
+
+    def test_loaded_in_child(self, root):
+        parent = LedgerTxn(root)
+        e = make_account_entry(acc(1), 1000, 5)
+        parent.create(e)
+        child = LedgerTxn(parent)
+        assert child.load(key_of(e)).data.value.balance == 1000
+        child.commit()
+        assert parent.load(key_of(e)).data.value.balance == 1000
+
+    def test_modified_in_child(self, root):
+        parent = LedgerTxn(root)
+        e = make_account_entry(acc(1), 1000, 5)
+        parent.create(e)
+        child = LedgerTxn(parent)
+        child.load(key_of(e)).data.value.balance = 777
+        child.commit()
+        assert parent.load(key_of(e)).data.value.balance == 777
+
+    def test_erased_in_child(self, root):
+        parent = LedgerTxn(root)
+        e = make_account_entry(acc(1), 1000, 5)
+        parent.create(e)
+        child = LedgerTxn(parent)
+        child.erase(key_of(e))
+        child.commit()
+        assert parent.load(key_of(e)) is None
+
+
+# --- rollback into LedgerTxn (ref :199-269) --------------------------------
+
+class TestRollbackIntoParent:
+    def test_created_in_child(self, root):
+        parent = LedgerTxn(root)
+        child = LedgerTxn(parent)
+        e = make_account_entry(acc(1), 1000, 5)
+        child.create(e)
+        child.rollback()
+        assert parent.load(key_of(e)) is None
+
+    def test_loaded_in_child(self, root):
+        parent = LedgerTxn(root)
+        e = make_account_entry(acc(1), 1000, 5)
+        parent.create(e)
+        child = LedgerTxn(parent)
+        child.load(key_of(e))
+        child.rollback()
+        assert parent.load(key_of(e)).data.value.balance == 1000
+
+    def test_modified_in_child(self, root):
+        parent = LedgerTxn(root)
+        e = make_account_entry(acc(1), 1000, 5)
+        parent.create(e)
+        child = LedgerTxn(parent)
+        child.load(key_of(e)).data.value.balance = 777
+        child.rollback()
+        assert parent.load(key_of(e)).data.value.balance == 1000
+
+    def test_erased_in_child(self, root):
+        parent = LedgerTxn(root)
+        e = make_account_entry(acc(1), 1000, 5)
+        parent.create(e)
+        child = LedgerTxn(parent)
+        child.erase(key_of(e))
+        child.rollback()
+        assert parent.load(key_of(e)) is not None
+
+
+# --- round trip (ref :270-420) ---------------------------------------------
+
+def _random_entries(rng, n):
+    """A mixed bag of accounts / offers / data entries with distinct keys."""
+    out = []
+    for i in range(n):
+        kind = rng.randrange(3)
+        if kind == 0:
+            out.append(make_account_entry(acc(i + 1),
+                                          rng.randrange(1, 10**9), i))
+        elif kind == 1:
+            out.append(make_offer(acc(200), 1000 + i,
+                                  amount=rng.randrange(1, 10**6),
+                                  n=rng.randrange(1, 50),
+                                  d=rng.randrange(1, 50)))
+        else:
+            out.append(make_data(acc(201), "name-%d" % i,
+                                 bytes([rng.randrange(256)]) * 4))
+    return out
+
+
+def _apply_mutations(rng, ltx, entries):
+    """Update a third, erase a third, keep a third; returns the expected
+    surviving {key_xdr: entry_xdr} map."""
+    expected = {}
+    for i, e in enumerate(entries):
+        k = key_of(e)
+        if i % 3 == 0:
+            loaded = ltx.load(k)
+            if loaded.data.disc == X.LedgerEntryType.ACCOUNT:
+                loaded.data.value.balance += 17
+            elif loaded.data.disc == X.LedgerEntryType.OFFER:
+                loaded.data.value.amount += 17
+            else:
+                loaded.data.value.dataValue = b"mut!"
+            expected[k.to_xdr()] = loaded.to_xdr()
+        elif i % 3 == 1:
+            ltx.erase(k)
+        else:
+            expected[k.to_xdr()] = e.to_xdr()
+    return expected
+
+
+class TestRoundTrip:
+    def test_round_trip_to_ledgertxn(self, root):
+        import random
+        rng = random.Random(42)
+        parent = LedgerTxn(root)
+        entries = _random_entries(rng, 30)
+        for e in entries:
+            parent.create(e)
+        child = LedgerTxn(parent)
+        expected = _apply_mutations(rng, child, entries)
+        child.commit()
+        for e in entries:
+            k = key_of(e)
+            got = parent.load(k)
+            want = expected.get(k.to_xdr())
+            if want is None:
+                assert got is None
+            else:
+                assert got.to_xdr() == want
+
+    @pytest.mark.parametrize("cache_size", [4096, 1],
+                             ids=["normal-cache", "no-cache"])
+    def test_round_trip_to_sql_root(self, cache_size):
+        import random
+        rng = random.Random(7)
+        from stellar_core_tpu.util.cache import RandomEvictionCache
+        root = LedgerTxnRoot(Database(":memory:"), make_header())
+        root._cache = RandomEvictionCache(cache_size)
+        ltx = LedgerTxn(root)
+        entries = _random_entries(rng, 30)
+        for e in entries:
+            ltx.create(e)
+        ltx.commit()
+        ltx2 = LedgerTxn(root)
+        expected = _apply_mutations(rng, ltx2, entries)
+        ltx2.commit()
+        for e in entries:
+            k = key_of(e)
+            got = root.get_entry(k)
+            want = expected.get(k.to_xdr())
+            if want is None:
+                assert got is None
+            else:
+                assert got.to_xdr() == want
+
+
+# --- rollback and commit deactivate (ref :421-473) -------------------------
+
+class TestClosedTxnRejectsUse:
+    @pytest.mark.parametrize("closer", ["commit", "rollback"])
+    def test_all_apis_assert_after_close(self, root, closer):
+        ltx = LedgerTxn(root)
+        e = make_account_entry(acc(1), 1000, 5)
+        ltx.create(e)
+        getattr(ltx, closer)()
+        k = key_of(e)
+        for call in (lambda: ltx.load(k), lambda: ltx.load_header(),
+                     lambda: ltx.create(make_account_entry(acc(2), 1, 1)),
+                     lambda: ltx.erase(k),
+                     lambda: ltx.load_without_record(k),
+                     lambda: ltx.best_offer(NATIVE, cred(99)),
+                     lambda: ltx.load_all_offers(),
+                     lambda: ltx.load_offers_by_account(acc(1)),
+                     lambda: ltx.create_or_update_without_loading(e),
+                     lambda: ltx.erase_without_loading(k),
+                     lambda: ltx.query_inflation_winners(1, 0),
+                     lambda: ltx.commit()):
+            with pytest.raises(AssertionError):
+                call()
+
+    def test_parent_usable_after_child_closes(self, root):
+        parent = LedgerTxn(root)
+        child = LedgerTxn(parent)
+        with pytest.raises(AssertionError):   # blocked while child open
+            parent.load_header()
+        child.commit()
+        parent.load_header()
+        child2 = LedgerTxn(parent)
+        child2.rollback()
+        parent.load_header()
+        parent.commit()
+
+
+# --- create (ref :474-531) --------------------------------------------------
+
+class TestCreate:
+    def test_fails_with_children(self, root):
+        parent = LedgerTxn(root)
+        LedgerTxn(parent)
+        with pytest.raises(AssertionError):
+            parent.create(make_account_entry(acc(1), 1, 1))
+
+    def test_fails_if_sealed(self, root):
+        ltx = LedgerTxn(root)
+        ltx.commit()
+        with pytest.raises(AssertionError):
+            ltx.create(make_account_entry(acc(1), 1, 1))
+
+    def test_when_key_does_not_exist(self, root):
+        ltx = LedgerTxn(root)
+        e = make_account_entry(acc(1), 1000, 5)
+        got = ltx.create(e)
+        assert got.data.value.balance == 1000
+        assert ltx.load(key_of(e)) is got
+
+    def test_when_key_exists_in_self(self, root):
+        ltx = LedgerTxn(root)
+        e = make_account_entry(acc(1), 1000, 5)
+        ltx.create(e)
+        with pytest.raises(AssertionError):
+            ltx.create(e)
+
+    def test_when_key_exists_in_parent(self, root):
+        parent = LedgerTxn(root)
+        e = make_account_entry(acc(1), 1000, 5)
+        parent.create(e)
+        child = LedgerTxn(parent)
+        with pytest.raises(AssertionError):
+            child.create(e)
+
+    def test_when_key_exists_in_grandparent_erased_in_parent(self, root):
+        grand = LedgerTxn(root)
+        e = make_account_entry(acc(1), 1000, 5)
+        grand.create(e)
+        parent = LedgerTxn(grand)
+        parent.erase(key_of(e))
+        child = LedgerTxn(parent)
+        child.create(make_account_entry(acc(1), 2000, 6))  # must succeed
+        child.commit()
+        parent.commit()
+        assert grand.load(key_of(e)).data.value.balance == 2000
+
+
+# --- createOrUpdateWithoutLoading (ref :532-602) ----------------------------
+
+class TestCreateOrUpdateWithoutLoading:
+    def test_fails_with_children_or_sealed(self, root):
+        parent = LedgerTxn(root)
+        LedgerTxn(parent)
+        with pytest.raises(AssertionError):
+            parent.create_or_update_without_loading(
+                make_account_entry(acc(1), 1, 1))
+
+    def test_when_key_does_not_exist(self, root):
+        ltx = LedgerTxn(root)
+        e = make_account_entry(acc(1), 1000, 5)
+        ltx.create_or_update_without_loading(e)
+        assert ltx.load(key_of(e)).data.value.balance == 1000
+
+    def test_when_key_exists_in_self_overwrites(self, root):
+        ltx = LedgerTxn(root)
+        ltx.create(make_account_entry(acc(1), 1000, 5))
+        ltx.create_or_update_without_loading(
+            make_account_entry(acc(1), 2000, 5))
+        assert ltx.load(X.LedgerKey.account(acc(1))).data.value.balance \
+            == 2000
+
+    def test_when_key_exists_in_parent_overwrites(self, root):
+        parent = LedgerTxn(root)
+        parent.create(make_account_entry(acc(1), 1000, 5))
+        child = LedgerTxn(parent)
+        child.create_or_update_without_loading(
+            make_account_entry(acc(1), 2000, 5))
+        child.commit()
+        assert parent.load(X.LedgerKey.account(acc(1))).data.value.balance \
+            == 2000
+
+    def test_when_key_exists_in_grandparent_erased_in_parent(self, root):
+        grand = LedgerTxn(root)
+        grand.create(make_account_entry(acc(1), 1000, 5))
+        parent = LedgerTxn(grand)
+        parent.erase(X.LedgerKey.account(acc(1)))
+        child = LedgerTxn(parent)
+        child.create_or_update_without_loading(
+            make_account_entry(acc(1), 3000, 5))
+        child.commit()
+        parent.commit()
+        assert grand.load(X.LedgerKey.account(acc(1))).data.value.balance \
+            == 3000
+
+    def test_delta_records_preimage(self, root):
+        parent = LedgerTxn(root)
+        parent.create(make_account_entry(acc(1), 1000, 5))
+        child = LedgerTxn(parent)
+        child.create_or_update_without_loading(
+            make_account_entry(acc(1), 2000, 5))
+        delta = child.get_delta()
+        assert len(delta) == 1
+        _, prev, cur = delta[0]
+        assert prev.data.value.balance == 1000
+        assert cur.data.value.balance == 2000
+
+
+# --- erase (ref :603-661) ---------------------------------------------------
+
+class TestErase:
+    def test_fails_with_children_or_sealed(self, root):
+        parent = LedgerTxn(root)
+        e = make_account_entry(acc(1), 1000, 5)
+        parent.create(e)
+        LedgerTxn(parent)
+        with pytest.raises(AssertionError):
+            parent.erase(key_of(e))
+
+    def test_when_key_does_not_exist(self, root):
+        ltx = LedgerTxn(root)
+        with pytest.raises(AssertionError):
+            ltx.erase(X.LedgerKey.account(acc(1)))
+
+    def test_when_key_exists_in_parent(self, root):
+        parent = LedgerTxn(root)
+        e = make_account_entry(acc(1), 1000, 5)
+        parent.create(e)
+        child = LedgerTxn(parent)
+        child.erase(key_of(e))
+        assert child.load(key_of(e)) is None
+        child.commit()
+        assert parent.load(key_of(e)) is None
+
+    def test_when_key_exists_in_grandparent_erased_in_parent(self, root):
+        grand = LedgerTxn(root)
+        e = make_account_entry(acc(1), 1000, 5)
+        grand.create(e)
+        parent = LedgerTxn(grand)
+        parent.erase(key_of(e))
+        child = LedgerTxn(parent)
+        with pytest.raises(AssertionError):   # already erased → missing
+            child.erase(key_of(e))
+
+
+# --- eraseWithoutLoading (ref :662-726) -------------------------------------
+
+class TestEraseWithoutLoading:
+    def test_when_key_does_not_exist_no_error(self, root):
+        ltx = LedgerTxn(root)
+        ltx.erase_without_loading(X.LedgerKey.account(acc(1)))
+        assert ltx.load(X.LedgerKey.account(acc(1))) is None
+        ltx.commit()   # commits cleanly
+
+    def test_when_key_exists_in_parent(self, root):
+        parent = LedgerTxn(root)
+        e = make_account_entry(acc(1), 1000, 5)
+        parent.create(e)
+        child = LedgerTxn(parent)
+        child.erase_without_loading(key_of(e))
+        child.commit()
+        assert parent.load(key_of(e)) is None
+
+    def test_when_key_exists_in_grandparent_erased_in_parent(self, root):
+        grand = LedgerTxn(root)
+        e = make_account_entry(acc(1), 1000, 5)
+        grand.create(e)
+        parent = LedgerTxn(grand)
+        parent.erase(key_of(e))
+        child = LedgerTxn(parent)
+        child.erase_without_loading(key_of(e))   # no-op, no error
+        child.commit()
+        parent.commit()
+        assert grand.load(key_of(e)) is None
+
+
+# --- queryInflationWinners (ref :846-1127) ----------------------------------
+
+def _voter(i, balance, dest):
+    e = make_account_entry(acc(i), balance, i)
+    e.data.value.inflationDest = dest
+    return e
+
+
+class TestQueryInflationWinners:
+    """Vote tallies must merge uncommitted child changes over parent
+    state (reference queryInflationWinners; regression for the round-5
+    bug where votes were read from the committed root only)."""
+
+    MIN = 10**9
+
+    def test_fails_with_children_or_sealed(self, root):
+        parent = LedgerTxn(root)
+        LedgerTxn(parent)
+        with pytest.raises(AssertionError):
+            parent.query_inflation_winners(1, self.MIN)
+
+    def test_no_voters(self, root):
+        ltx = LedgerTxn(root)
+        ltx.create(make_account_entry(acc(1), 10**12, 1))  # no dest set
+        assert ltx.query_inflation_winners(2, self.MIN) == []
+
+    def test_one_voter_below_minimum(self, root):
+        ltx = LedgerTxn(root)
+        ltx.create(_voter(1, self.MIN - 1, acc(7)))
+        assert ltx.query_inflation_winners(2, self.MIN) == []
+
+    def test_one_voter_above_minimum(self, root):
+        ltx = LedgerTxn(root)
+        ltx.create(_voter(1, self.MIN + 5, acc(7)))
+        assert ltx.query_inflation_winners(2, self.MIN) == \
+            [(acc(7).key_bytes, self.MIN + 5)]
+
+    def test_two_voters_same_dest_votes_sum(self, root):
+        ltx = LedgerTxn(root)
+        ltx.create(_voter(1, self.MIN - 1, acc(7)))
+        ltx.create(_voter(2, 1, acc(7)))          # sum crosses the minimum
+        assert ltx.query_inflation_winners(2, self.MIN) == \
+            [(acc(7).key_bytes, self.MIN)]
+
+    def test_two_voters_different_dests_max_one_winner(self, root):
+        ltx = LedgerTxn(root)
+        ltx.create(_voter(1, self.MIN + 10, acc(7)))
+        ltx.create(_voter(2, self.MIN + 20, acc(8)))
+        assert ltx.query_inflation_winners(1, self.MIN) == \
+            [(acc(8).key_bytes, self.MIN + 20)]
+
+    def test_two_voters_different_dests_max_two_winners(self, root):
+        ltx = LedgerTxn(root)
+        ltx.create(_voter(1, self.MIN + 10, acc(7)))
+        ltx.create(_voter(2, self.MIN + 20, acc(8)))
+        assert ltx.query_inflation_winners(2, self.MIN) == \
+            [(acc(8).key_bytes, self.MIN + 20),
+             (acc(7).key_bytes, self.MIN + 10)]
+
+    def test_vote_tie_breaks_by_strkey_descending(self, root):
+        ltx = LedgerTxn(root)
+        ltx.create(_voter(1, self.MIN, acc(7)))
+        ltx.create(_voter(2, self.MIN, acc(8)))
+        winners = ltx.query_inflation_winners(2, self.MIN)
+        keys = [strkey.encode_public_key(k) for k, _ in winners]
+        assert keys == sorted(keys, reverse=True)
+
+    def test_voter_in_parent_modified_balance_above_to_below(self, root):
+        parent = LedgerTxn(root)
+        parent.create(_voter(1, self.MIN + 5, acc(7)))
+        parent.commit()
+        ltx = LedgerTxn(root)
+        ltx.load(X.LedgerKey.account(acc(1))).data.value.balance = \
+            self.MIN - 1
+        assert ltx.query_inflation_winners(2, self.MIN) == []
+
+    def test_voter_in_parent_modified_balance_below_to_above(self, root):
+        parent = LedgerTxn(root)
+        parent.create(_voter(1, self.MIN - 1, acc(7)))
+        parent.commit()
+        ltx = LedgerTxn(root)
+        ltx.load(X.LedgerKey.account(acc(1))).data.value.balance = \
+            self.MIN + 3
+        assert ltx.query_inflation_winners(2, self.MIN) == \
+            [(acc(7).key_bytes, self.MIN + 3)]
+
+    def test_voter_in_parent_modified_dest(self, root):
+        parent = LedgerTxn(root)
+        parent.create(_voter(1, self.MIN + 5, acc(7)))
+        parent.commit()
+        ltx = LedgerTxn(root)
+        ltx.load(X.LedgerKey.account(acc(1))).data.value.inflationDest = \
+            acc(9)
+        assert ltx.query_inflation_winners(2, self.MIN) == \
+            [(acc(9).key_bytes, self.MIN + 5)]
+
+    def test_voter_erased_in_child_loses_votes(self, root):
+        parent = LedgerTxn(root)
+        parent.create(_voter(1, self.MIN + 5, acc(7)))
+        parent.commit()
+        ltx = LedgerTxn(root)
+        ltx.erase(X.LedgerKey.account(acc(1)))
+        assert ltx.query_inflation_winners(2, self.MIN) == []
+
+    def test_votes_merge_across_parent_and_child(self, root):
+        parent = LedgerTxn(root)
+        parent.create(_voter(1, self.MIN - 1, acc(7)))
+        child = LedgerTxn(parent)
+        child.create(_voter(2, 1, acc(7)))
+        assert child.query_inflation_winners(2, self.MIN) == \
+            [(acc(7).key_bytes, self.MIN)]
+
+    def test_grandchild_overrides_parent_and_root(self, root):
+        grand = LedgerTxn(root)
+        grand.create(_voter(1, self.MIN + 100, acc(7)))
+        parent = LedgerTxn(grand)
+        parent.load(X.LedgerKey.account(acc(1))).data.value.balance = \
+            self.MIN + 50
+        child = LedgerTxn(parent)
+        child.load(X.LedgerKey.account(acc(1))).data.value.balance = \
+            self.MIN + 20
+        assert child.query_inflation_winners(2, self.MIN) == \
+            [(acc(7).key_bytes, self.MIN + 20)]
+
+
+# --- loadHeader (ref :1128-1169) --------------------------------------------
+
+class TestLoadHeader:
+    def test_fails_with_children_or_sealed(self, root):
+        parent = LedgerTxn(root)
+        LedgerTxn(parent)
+        with pytest.raises(AssertionError):
+            parent.load_header()
+
+    def test_check_after_update(self, root):
+        parent = LedgerTxn(root)
+        child = LedgerTxn(parent)
+        h = child.load_header()
+        h.feePool = 12345
+        h.idPool = 99
+        child.commit()
+        got = parent.load_header()
+        assert got.feePool == 12345 and got.idPool == 99
+
+    def test_rollback_discards_header_changes(self, root):
+        parent = LedgerTxn(root)
+        child = LedgerTxn(parent)
+        child.load_header().feePool = 12345
+        child.rollback()
+        assert parent.load_header().feePool == 0
+
+
+# --- load (ref :1170-1226) --------------------------------------------------
+
+class TestLoad:
+    def test_fails_with_children_or_sealed(self, root):
+        parent = LedgerTxn(root)
+        e = make_account_entry(acc(1), 1000, 5)
+        parent.create(e)
+        LedgerTxn(parent)
+        with pytest.raises(AssertionError):
+            parent.load(key_of(e))
+
+    def test_when_key_does_not_exist(self, root):
+        ltx = LedgerTxn(root)
+        assert ltx.load(X.LedgerKey.account(acc(1))) is None
+
+    def test_when_key_exists_in_parent(self, root):
+        parent = LedgerTxn(root)
+        e = make_account_entry(acc(1), 1000, 5)
+        parent.create(e)
+        child = LedgerTxn(parent)
+        assert child.load(key_of(e)).data.value.balance == 1000
+
+    def test_when_key_exists_in_grandparent_erased_in_parent(self, root):
+        grand = LedgerTxn(root)
+        e = make_account_entry(acc(1), 1000, 5)
+        grand.create(e)
+        parent = LedgerTxn(grand)
+        parent.erase(key_of(e))
+        child = LedgerTxn(parent)
+        assert child.load(key_of(e)) is None
+
+    def test_load_is_stable_within_txn(self, root):
+        ltx = LedgerTxn(root)
+        e = make_account_entry(acc(1), 1000, 5)
+        ltx.create(e)
+        assert ltx.load(key_of(e)) is ltx.load(key_of(e))
+
+
+# --- loadWithoutRecord (ref :1227-1290) -------------------------------------
+
+class TestLoadWithoutRecord:
+    def test_when_key_does_not_exist(self, root):
+        ltx = LedgerTxn(root)
+        assert ltx.load_without_record(X.LedgerKey.account(acc(1))) is None
+
+    def test_when_key_exists_in_parent(self, root):
+        parent = LedgerTxn(root)
+        e = make_account_entry(acc(1), 1000, 5)
+        parent.create(e)
+        child = LedgerTxn(parent)
+        assert child.load_without_record(key_of(e)).data.value.balance \
+            == 1000
+
+    def test_when_key_erased_in_parent(self, root):
+        grand = LedgerTxn(root)
+        e = make_account_entry(acc(1), 1000, 5)
+        grand.create(e)
+        parent = LedgerTxn(grand)
+        parent.erase(key_of(e))
+        child = LedgerTxn(parent)
+        assert child.load_without_record(key_of(e)) is None
+
+    def test_no_delta_recorded_and_mutation_isolated(self, root):
+        parent = LedgerTxn(root)
+        e = make_account_entry(acc(1), 1000, 5)
+        parent.create(e)
+        child = LedgerTxn(parent)
+        peek = child.load_without_record(key_of(e))
+        peek.data.value.balance = 1   # mutating the copy must not leak
+        assert child.get_delta() == []
+        child.commit()
+        assert parent.load(key_of(e)).data.value.balance == 1000
+
+
+# --- loadAllOffers (ref :1422-1545) -----------------------------------------
+
+class TestLoadAllOffers:
+    def test_fails_with_children_or_sealed(self, root):
+        parent = LedgerTxn(root)
+        LedgerTxn(parent)
+        with pytest.raises(AssertionError):
+            parent.load_all_offers()
+
+    def test_empty_parent_no_offers(self, root):
+        assert LedgerTxn(root).load_all_offers() == []
+
+    @pytest.mark.parametrize("same_account", [True, False])
+    def test_empty_parent_two_offers(self, root, same_account):
+        ltx = LedgerTxn(root)
+        ltx.create(make_offer(acc(1), 1))
+        ltx.create(make_offer(acc(1) if same_account else acc(2), 2))
+        ids = sorted(o.data.value.offerID for o in ltx.load_all_offers())
+        assert ids == [1, 2]
+
+    def test_one_offer_in_parent_erased_in_child(self, root):
+        parent = LedgerTxn(root)
+        o = make_offer(acc(1), 1)
+        parent.create(o)
+        child = LedgerTxn(parent)
+        child.erase(key_of(o))
+        assert child.load_all_offers() == []
+
+    def test_one_offer_in_parent_modified_in_child(self, root):
+        parent = LedgerTxn(root)
+        o = make_offer(acc(1), 1, amount=100)
+        parent.create(o)
+        child = LedgerTxn(parent)
+        child.load(key_of(o)).data.value.amount = 42
+        got = child.load_all_offers()
+        assert len(got) == 1 and got[0].data.value.amount == 42
+
+    def test_other_offer_in_child(self, root):
+        parent = LedgerTxn(root)
+        parent.create(make_offer(acc(1), 1))
+        child = LedgerTxn(parent)
+        child.create(make_offer(acc(2), 2))
+        ids = sorted(o.data.value.offerID for o in child.load_all_offers())
+        assert ids == [1, 2]
+
+    def test_two_offers_in_parent(self, root):
+        parent = LedgerTxn(root)
+        parent.create(make_offer(acc(1), 1))
+        parent.create(make_offer(acc(2), 2))
+        child = LedgerTxn(parent)
+        ids = sorted(o.data.value.offerID for o in child.load_all_offers())
+        assert ids == [1, 2]
+
+
+# --- loadBestOffer (ref :1674-1932) -----------------------------------------
+
+class TestLoadBestOffer:
+    def test_fails_with_children_or_sealed(self, root):
+        parent = LedgerTxn(root)
+        LedgerTxn(parent)
+        with pytest.raises(AssertionError):
+            parent.best_offer(NATIVE, cred(99))
+
+    def test_empty_parent_no_offers(self, root):
+        assert LedgerTxn(root).best_offer(NATIVE, cred(99)) is None
+
+    def test_two_offers_same_assets_same_price(self, root):
+        ltx = LedgerTxn(root)
+        ltx.create(make_offer(acc(1), 2, n=3, d=2))
+        ltx.create(make_offer(acc(2), 1, n=3, d=2))
+        # tie → lowest offerID wins
+        assert ltx.best_offer(NATIVE, cred(99)).data.value.offerID == 1
+
+    def test_two_offers_same_assets_different_price(self, root):
+        ltx = LedgerTxn(root)
+        ltx.create(make_offer(acc(1), 1, n=3, d=2))
+        ltx.create(make_offer(acc(2), 2, n=1, d=2))
+        assert ltx.best_offer(NATIVE, cred(99)).data.value.offerID == 2
+
+    def test_two_offers_different_assets(self, root):
+        ltx = LedgerTxn(root)
+        ltx.create(make_offer(acc(1), 1, selling=NATIVE, buying=cred(98)))
+        ltx.create(make_offer(acc(2), 2, selling=NATIVE, buying=cred(99)))
+        assert ltx.best_offer(NATIVE, cred(98)).data.value.offerID == 1
+        assert ltx.best_offer(NATIVE, cred(99)).data.value.offerID == 2
+        assert ltx.best_offer(cred(98), NATIVE) is None
+
+    def test_one_offer_in_parent_erased_in_child(self, root):
+        parent = LedgerTxn(root)
+        o = make_offer(acc(1), 1)
+        parent.create(o)
+        child = LedgerTxn(parent)
+        child.erase(key_of(o))
+        assert child.best_offer(NATIVE, cred(99)) is None
+
+    def test_one_offer_in_parent_modified_assets_in_child(self, root):
+        parent = LedgerTxn(root)
+        o = make_offer(acc(1), 1, selling=NATIVE, buying=cred(99))
+        parent.create(o)
+        child = LedgerTxn(parent)
+        child.load(key_of(o)).data.value.buying = cred(98)
+        assert child.best_offer(NATIVE, cred(99)) is None
+        assert child.best_offer(NATIVE, cred(98)) is not None
+
+    def test_one_offer_in_parent_modified_price_in_child(self, root):
+        parent = LedgerTxn(root)
+        parent.create(make_offer(acc(1), 1, n=1, d=1))
+        parent.create(make_offer(acc(2), 2, n=2, d=1))
+        child = LedgerTxn(parent)
+        child.load(X.LedgerKey.offer(acc(2), 2)).data.value.price = \
+            X.Price(n=1, d=2)
+        assert child.best_offer(NATIVE, cred(99)).data.value.offerID == 2
+
+    def test_worse_offer_added_in_child(self, root):
+        parent = LedgerTxn(root)
+        parent.create(make_offer(acc(1), 1, n=1, d=1))
+        child = LedgerTxn(parent)
+        child.create(make_offer(acc(2), 2, n=2, d=1))
+        assert child.best_offer(NATIVE, cred(99)).data.value.offerID == 1
+
+    def test_exclude_set_skips_best(self, root):
+        ltx = LedgerTxn(root)
+        ltx.create(make_offer(acc(1), 1, n=1, d=1))
+        ltx.create(make_offer(acc(2), 2, n=2, d=1))
+        assert ltx.best_offer(NATIVE, cred(99),
+                              exclude={1}).data.value.offerID == 2
+
+
+# --- loadOffersByAccountAndAsset (ref :1933-2049) ---------------------------
+
+class TestLoadOffersByAccountAndAsset:
+    def test_empty_parent(self, root):
+        ltx = LedgerTxn(root)
+        assert ltx.load_offers_by_account(acc(1), NATIVE) == []
+
+    def test_filters_by_account_and_asset(self, root):
+        ltx = LedgerTxn(root)
+        ltx.create(make_offer(acc(1), 1, selling=NATIVE, buying=cred(99)))
+        ltx.create(make_offer(acc(1), 2, selling=cred(98), buying=cred(97)))
+        ltx.create(make_offer(acc(2), 3, selling=NATIVE, buying=cred(99)))
+        got = ltx.load_offers_by_account(acc(1), cred(99))
+        assert [o.data.value.offerID for o in got] == [1]
+        # asset matches either side
+        got = ltx.load_offers_by_account(acc(1), cred(98))
+        assert [o.data.value.offerID for o in got] == [2]
+
+    def test_one_offer_in_parent_erased_in_child(self, root):
+        parent = LedgerTxn(root)
+        o = make_offer(acc(1), 1)
+        parent.create(o)
+        child = LedgerTxn(parent)
+        child.erase(key_of(o))
+        assert child.load_offers_by_account(acc(1), NATIVE) == []
+
+    def test_modified_assets_in_child(self, root):
+        parent = LedgerTxn(root)
+        o = make_offer(acc(1), 1, selling=NATIVE, buying=cred(99))
+        parent.create(o)
+        child = LedgerTxn(parent)
+        child.load(key_of(o)).data.value.selling = cred(98)
+        assert child.load_offers_by_account(acc(1), NATIVE) == []
+        got = child.load_offers_by_account(acc(1), cred(98))
+        assert [x.data.value.offerID for x in got] == [1]
+
+    def test_two_offers_in_parent(self, root):
+        parent = LedgerTxn(root)
+        parent.create(make_offer(acc(1), 1))
+        parent.create(make_offer(acc(1), 2))
+        child = LedgerTxn(parent)
+        got = child.load_offers_by_account(acc(1), NATIVE)
+        assert sorted(x.data.value.offerID for x in got) == [1, 2]
+
+
+# --- LedgerTxnRoot prefetch (ref :2178-2223) --------------------------------
+
+class TestPrefetch:
+    def _seeded_root(self, n=64):
+        root = LedgerTxnRoot(Database(":memory:"), make_header())
+        ltx = LedgerTxn(root)
+        keys = []
+        for i in range(1, n + 1):
+            e = make_account_entry(acc(i), 1000 + i, i)
+            ltx.create(e)
+            keys.append(key_of(e))
+        ltx.commit()
+        return root, keys
+
+    def test_prefetch_normally(self):
+        root, keys = self._seeded_root()
+        root._cache.clear()
+        n = root.prefetch(keys)
+        assert n == len(keys)
+        # entries now served from cache (poison the table to prove it)
+        root._db.execute("DELETE FROM accounts")
+        assert root.get_entry(keys[0]).data.value.balance == 1001
+
+    def test_stops_as_cache_fills_up(self):
+        root, keys = self._seeded_root()
+        root._cache.clear()
+        root._cache._max = 40   # budget = 20
+        n = root.prefetch(keys)
+        assert n <= 20
+
+    def test_prefetch_skips_already_cached(self):
+        root, keys = self._seeded_root()
+        root._cache.clear()
+        root.get_entry(keys[0])
+        assert root.prefetch(keys[:1]) == 0
+
+
+# --- in memory order book (ref :2817-3126) ----------------------------------
+
+class TestOrderBookView:
+    def test_one_offer_erase_without_loading(self, root):
+        ltx = LedgerTxn(root)
+        o = make_offer(acc(1), 1)
+        ltx.create(o)
+        ltx.erase_without_loading(key_of(o))
+        assert ltx.best_offer(NATIVE, cred(99)) is None
+
+    def test_two_offers_erase_one_at_a_time(self, root):
+        ltx = LedgerTxn(root)
+        ltx.create(make_offer(acc(1), 1, n=1, d=1))
+        ltx.create(make_offer(acc(2), 2, n=2, d=1))
+        ltx.erase(X.LedgerKey.offer(acc(1), 1))
+        assert ltx.best_offer(NATIVE, cred(99)).data.value.offerID == 2
+        ltx.erase(X.LedgerKey.offer(acc(2), 2))
+        assert ltx.best_offer(NATIVE, cred(99)) is None
+
+    def test_four_offers_two_asset_pairs(self, root):
+        ltx = LedgerTxn(root)
+        ltx.create(make_offer(acc(1), 1, selling=NATIVE, buying=cred(99),
+                              n=2, d=1))
+        ltx.create(make_offer(acc(2), 2, selling=NATIVE, buying=cred(99),
+                              n=1, d=1))
+        ltx.create(make_offer(acc(3), 3, selling=cred(99), buying=NATIVE,
+                              n=3, d=1))
+        ltx.create(make_offer(acc(4), 4, selling=cred(99), buying=NATIVE,
+                              n=1, d=2))
+        assert ltx.best_offer(NATIVE, cred(99)).data.value.offerID == 2
+        assert ltx.best_offer(cred(99), NATIVE).data.value.offerID == 4
+
+    def test_create_or_update_without_loading_modifies_book(self, root):
+        ltx = LedgerTxn(root)
+        ltx.create(make_offer(acc(1), 1, n=2, d=1))
+        ltx.create_or_update_without_loading(make_offer(acc(1), 1, n=1, d=3))
+        best = ltx.best_offer(NATIVE, cred(99))
+        assert (best.data.value.price.n, best.data.value.price.d) == (1, 3)
+
+    def test_parent_book_updates_on_commit(self, root):
+        parent = LedgerTxn(root)
+        child = LedgerTxn(parent)
+        child.create(make_offer(acc(1), 1))
+        child.commit()
+        assert parent.best_offer(NATIVE, cred(99)) is not None
+
+    def test_parent_book_does_not_update_on_rollback(self, root):
+        parent = LedgerTxn(root)
+        child = LedgerTxn(parent)
+        child.create(make_offer(acc(1), 1))
+        child.rollback()
+        assert parent.best_offer(NATIVE, cred(99)) is None
+
+    def test_book_view_commits_through_to_root(self, root):
+        ltx = LedgerTxn(root)
+        ltx.create(make_offer(acc(1), 1))
+        ltx.commit()
+        ltx2 = LedgerTxn(root)
+        assert ltx2.best_offer(NATIVE, cred(99)) is not None
+        ltx2.rollback()
