@@ -170,7 +170,7 @@ def device_full_bench(partial_path: str, batch: int = 8192,
 
 
 def replay_bench(backend: str, n_checkpoints: int = 4,
-                 txs_per_ledger: int = 48, sigs_per_tx: int = 3) -> dict:
+                 txs_per_ledger: int = 100, sigs_per_tx: int = 20) -> dict:
     """Catchup-replay benchmark: the second north-star metric
     (BASELINE.md: >=5x pubnet replay vs libsodium CPU; reference
     methodology /root/reference/performance-eval/performance-eval.md:52-66).
@@ -193,6 +193,14 @@ def replay_bench(backend: str, n_checkpoints: int = 4,
     from stellar_core_tpu.work.basic_work import State
 
     freq = 8
+    # One bucket shape for the whole replay: the throughput leg already
+    # compiled (and the persistent cache holds) the 8192 kernel, so every
+    # checkpoint prewarm dispatches in that shape instead of cold-compiling
+    # a new one mid-replay (the r4->r5 0.026x pathology: BUCKETS=(1024,)
+    # was never AOT-compiled, and app.start()'s default warmup raced three
+    # other shapes onto the device during the timed window).
+    from stellar_core_tpu.crypto.batch_verifier import TpuSigVerifier
+    TpuSigVerifier.BUCKETS = (8192,)
     tmp = tempfile.mkdtemp(prefix="sct-replay-")
     try:
         archive_root = os.path.join(tmp, "archive")
@@ -203,6 +211,13 @@ def replay_bench(backend: str, n_checkpoints: int = 4,
             cfg.DATABASE = "sqlite3://:memory:"
             cfg.CHECKPOINT_FREQUENCY = freq
             cfg.SIG_VERIFY_BACKEND = be
+            # production perf config, identical for both legs: reference
+            # pubnet validators run with no invariants unless configured
+            # (Config.h INVARIANT_CHECKS default empty), and the genesis
+            # op capacity must admit the 20-op multisig-arming txs
+            # (maxTxSetSize counts OPS from protocol 11)
+            cfg.INVARIANT_CHECKS = []
+            cfg.TESTING_UPGRADE_MAX_TX_SET_SIZE = 10_000
             arch = HistoryArchive.local_dir("bench", archive_root)
             d = {"get": arch.get_tmpl, "mkdir": arch.mkdir_tmpl}
             if writable:
@@ -217,12 +232,19 @@ def replay_bench(backend: str, n_checkpoints: int = 4,
         pub = make_app(0, True, "cpu")
         adapter = AppLedgerAdapter(pub)
         root = adapter.root_account()
-        # each create() closes a ledger, so anchor the dense range AFTER
-        # account setup and aim for n_checkpoints more checkpoint files
-        senders = [root.create(10**10) for _ in range(txs_per_ledger)]
+        # one 100-op tx creates every sender in a single close (per-sender
+        # create() closes would advance closeTime past the 60s drift guard)
+        from stellar_core_tpu.crypto.keys import SecretKey
+        from stellar_core_tpu.testing import TestAccount
+        sender_sks = [SecretKey.from_seed(bytes([7, i & 0xFF] + [11] * 30))
+                      for i in range(txs_per_ledger)]
+        pub.submit_transaction(root.tx(
+            [root.op_create_account(sk.public_key, 10**10)
+             for sk in sender_sks]))
+        pub.manual_close()
+        senders = [TestAccount(adapter, sk) for sk in sender_sks]
         extra_signers = {}
         if sigs_per_tx > 1:
-            from stellar_core_tpu.crypto.keys import SecretKey
             for i, s in enumerate(senders):
                 ks = [SecretKey.from_seed(bytes([201 + j, i & 0xFF] + [7] * 30))
                       for j in range(sigs_per_tx - 1)]
@@ -286,11 +308,6 @@ def replay_bench(backend: str, n_checkpoints: int = 4,
         app.sig_verifier.verify_many = counted_verify_many
         app.clock.set_virtual_time(pub.clock.now() + 10.0)
         v = getattr(app, "sig_verifier", None)
-        inner = getattr(v, "inner", v)
-        if hasattr(inner, "BUCKETS"):
-            # one bucket shape: a checkpoint of this history is ~8 sigs,
-            # and each extra bucket costs a kernel compile at warmup
-            inner.BUCKETS = (1024,)
         if v is not None and hasattr(v, "warmup"):
             v.warmup(wait=True)           # compile off the clock
         work = app.catchup_manager.start_catchup(

@@ -46,11 +46,29 @@ class CatchupManager:
             if self._close_one(lcd) and self._drain_buffer() \
                     and not self._buffered:
                 lm.state = LedgerManagerState.LM_SYNCED_STATE
+            self._update_catchup_status()
             return
         self._buffered[lcd.ledger_seq] = lcd
         self._trim_buffer()
         if self._work is None or self._work.is_done():
             self.start_catchup()
+        self._update_catchup_status()
+
+    def _update_catchup_status(self) -> None:
+        """Rolled-up catchup progress line (reference CatchupManagerImpl::
+        logAndUpdateCatchupStatus:180-206)."""
+        from ..util.status_manager import StatusCategory
+        sm = getattr(self.app, "status_manager", None)
+        if sm is None:
+            return
+        if self.catchup_running() or self._buffered:
+            lcl = self.app.ledger_manager.last_closed_ledger_num()
+            sm.set_status_message(
+                StatusCategory.HISTORY_CATCHUP,
+                "Catching up from ledger %d: buffered %d externalized "
+                "ledgers" % (lcl, len(self._buffered)))
+        else:
+            sm.remove_status_message(StatusCategory.HISTORY_CATCHUP)
 
     def buffered_count(self) -> int:
         return len(self._buffered)
@@ -87,6 +105,7 @@ class CatchupManager:
             else:
                 self.catchups_failed += 1
                 log.warning("catchup failed; will retry on next gap")
+            self._update_catchup_status()
             if on_done is not None:
                 on_done(state)
 

@@ -93,19 +93,20 @@ class BatchSigVerifier:
         synchronous per-signature checks all hit. Already-cached triples
         are not re-dispatched."""
         out: List[Optional[bool]] = [None] * len(triples)
-        todo: List[Tuple[int, Triple]] = []
+        todo: List[Tuple[int, Triple, bytes]] = []   # (idx, triple, key)
         with _keys._cache_lock:
             for i, (k, s, m) in enumerate(triples):
-                hit = _keys._verify_cache.maybe_get(_keys._cache_key(k, s, m))
+                ck = _keys._cache_key(k, s, m)
+                hit = _keys._verify_cache.maybe_get(ck)
                 if hit is not None:
                     out[i] = hit
                 else:
-                    todo.append((i, (k, s, m)))
+                    todo.append((i, (k, s, m), ck))
         if todo:
-            results = self.verify_many([t for (_i, t) in todo])
+            results = self.verify_many([t for (_i, t, _ck) in todo])
             with _keys._cache_lock:
-                for ((i, (k, s, m)), ok) in zip(todo, results):
-                    _keys._verify_cache.put(_keys._cache_key(k, s, m), ok)
+                for ((i, _t, ck), ok) in zip(todo, results):
+                    _keys._verify_cache.put(ck, ok)
                     out[i] = ok
         return out  # type: ignore[return-value]
 

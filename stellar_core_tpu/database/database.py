@@ -15,7 +15,7 @@ from ..util.log import get_logger
 
 log = get_logger("Database")
 
-SCHEMA_VERSION = 2
+SCHEMA_VERSION = 3
 
 _SCHEMA = [
     """CREATE TABLE IF NOT EXISTS storestate (
@@ -59,6 +59,10 @@ _SCHEMA = [
         ledgerseq INTEGER PRIMARY KEY, state TEXT)""",
     """CREATE TABLE IF NOT EXISTS pubsub (
         resid TEXT PRIMARY KEY, lastread INTEGER)""",
+    """CREATE TABLE IF NOT EXISTS upgradehistory (
+        ledgerseq INTEGER NOT NULL, upgradeindex INTEGER NOT NULL,
+        upgrade BLOB NOT NULL, changes BLOB NOT NULL,
+        PRIMARY KEY (ledgerseq, upgradeindex))""",
 ]
 
 
@@ -85,8 +89,9 @@ class Database:
             if v > SCHEMA_VERSION:
                 raise RuntimeError("database schema %d newer than binary" % v)
             # migrations v -> SCHEMA_VERSION (reference Database::upgrade)
-            # v1 -> v2: the txfeehistory table — created above by the
-            # CREATE IF NOT EXISTS pass, so the step is just the bump
+            # v1 -> v2: txfeehistory; v2 -> v3: upgradehistory — both
+            # created above by the CREATE IF NOT EXISTS pass, so each
+            # step is just the bump
             self.set_state("databaseschema", str(SCHEMA_VERSION))
         self._conn.commit()
 

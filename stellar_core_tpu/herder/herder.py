@@ -24,8 +24,8 @@ from ..scp.scp import SCP
 from ..util.log import get_logger
 from ..util.timer import VirtualTimer
 from ..xdr import (
-    EnvelopeType, LedgerCloseValueSignature, SCPEnvelope, SCPQuorumSet,
-    StellarValue, StellarValueExt, Uint32, Uint64, Packer,
+    EnvelopeType, LedgerCloseValueSignature, LedgerUpgrade, SCPEnvelope,
+    SCPQuorumSet, StellarValue, StellarValueExt, Uint32, Uint64, Packer,
 )
 from ..ledger.ledger_manager import LedgerCloseData
 from .pending_envelopes import PendingEnvelopes, statement_qset_hash
@@ -114,10 +114,31 @@ class HerderSCPDriver(SCPDriver):
                 ltx_root, self.herder.verifier, trim=False)
             if not ok:
                 return ValidationLevel.INVALID
-            for raw in sv.upgrades:
-                if not Upgrades.is_valid_for_apply(raw, lm.lcl_header):
-                    return ValidationLevel.INVALID
+        if not self._upgrades_valid(sv, nomination):
+            return ValidationLevel.INVALID
         return ValidationLevel.FULLY_VALIDATED
+
+    def _upgrades_valid(self, sv: StellarValue, nomination: bool) -> bool:
+        """Reference HerderSCPDriver::validateValue:390-414: every upgrade
+        must be apply-valid (within OUR supported protocol), strictly
+        type-ordered, and — when nominating — match an armed local
+        parameter, so foreign upgrades are voted down and stripped by
+        extract_valid_value but still applied once externalized."""
+        lm = self.herder.app.ledger_manager
+        cfg = self.herder.app.config
+        last_type = None
+        for raw in sv.upgrades:
+            if not Upgrades.is_valid_for_apply(
+                    raw, lm.lcl_header, cfg.LEDGER_PROTOCOL_VERSION):
+                return False
+            if nomination and not self.herder.upgrades.is_valid_for_nomination(
+                    raw, lm.lcl_header, lm.lcl_header.scpValue.closeTime):
+                return False
+            t = LedgerUpgrade.from_xdr(raw).disc
+            if last_type is not None and last_type >= t:
+                return False
+            last_type = t
+        return True
 
     def extract_valid_value(self, slot_index: int,
                             value: bytes) -> Optional[bytes]:
@@ -126,9 +147,16 @@ class HerderSCPDriver(SCPDriver):
         except Exception:
             return None
         lm = self.herder.app.ledger_manager
-        # strip invalid upgrades and retry
-        upgrades = [u for u in sv.upgrades
-                    if Upgrades.is_valid_for_apply(u, lm.lcl_header)]
+        cfg = self.herder.app.config
+        # strip upgrades we would not nominate ourselves (reference
+        # extractValidValue:450 runs isValid in nomination mode: foreign
+        # or stale upgrades drop out, the rest of the value survives)
+        upgrades = [
+            u for u in sv.upgrades
+            if Upgrades.is_valid_for_apply(
+                u, lm.lcl_header, cfg.LEDGER_PROTOCOL_VERSION)
+            and self.herder.upgrades.is_valid_for_nomination(
+                u, lm.lcl_header, lm.lcl_header.scpValue.closeTime)]
         sv2 = StellarValue(txSetHash=sv.txSetHash, closeTime=sv.closeTime,
                            upgrades=upgrades, ext=sv.ext)
         v2 = sv2.to_xdr()
@@ -256,6 +284,23 @@ class Herder:
         self.app.ledger_manager.state = 1  # synced
         if not cfg.MANUAL_CLOSE:
             self._arm_trigger_timer()
+
+    def update_upgrades_status(self) -> None:
+        """Status line while upgrade parameters are armed (reference
+        HerderImpl upgrades status, :843-860)."""
+        from ..util.status_manager import StatusCategory
+        sm = getattr(self.app, "status_manager", None)
+        if sm is None:
+            return
+        p = self.upgrades.params
+        armed = {k: v for k, v in p.to_json().items()
+                 if k != "time" and v is not None}
+        if armed:
+            sm.set_status_message(
+                StatusCategory.REQUIRES_UPGRADES,
+                "Armed with network upgrades: %s" % armed)
+        else:
+            sm.remove_status_message(StatusCategory.REQUIRES_UPGRADES)
 
     def set_tracking(self, slot: int) -> None:
         self.state = HerderState.HERDER_TRACKING_STATE
@@ -526,6 +571,15 @@ class Herder:
         lm = self.app.ledger_manager
         lcd = LedgerCloseData(slot_index, txset, sv)
         lm.value_externalized(lcd)
+
+        # disarm upgrade parameters that just externalized or whose
+        # scheduled time expired (reference HerderImpl::valueExternalized →
+        # Upgrades::removeUpgrades; stale nodes must not keep pushing)
+        if self.upgrades.remove_applied_and_expired(
+                sv.upgrades, sv.closeTime):
+            log.info("upgrades: armed parameters now %s",
+                     self.upgrades.params.to_json())
+        self.update_upgrades_status()
 
         # tx queue maintenance
         self.tx_queue.remove_applied(list(txset.frames))

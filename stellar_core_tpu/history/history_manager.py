@@ -113,7 +113,24 @@ class HistoryManager:
             else:
                 self.failed_publishes += 1
                 break                # retry next time, keep order
+        self._update_publish_status()
         return n
+
+    def _update_publish_status(self) -> None:
+        """One rolled-up line about the publish backlog (reference
+        HistoryManagerImpl::logAndUpdatePublishStatus:104-122)."""
+        from ..util.status_manager import StatusCategory
+        sm = getattr(self.app, "status_manager", None)
+        if sm is None:
+            return
+        queue = self.publish_queue()
+        if queue:
+            sm.set_status_message(
+                StatusCategory.HISTORY_PUBLISH,
+                "Publishing %d queued checkpoints [%s]" % (
+                    len(queue), ", ".join(str(s) for s in queue[:8])))
+        else:
+            sm.remove_status_message(StatusCategory.HISTORY_PUBLISH)
 
     def _publish_one(self, checkpoint: int) -> bool:
         has = self._queued_has(checkpoint)

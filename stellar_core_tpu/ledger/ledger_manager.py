@@ -26,7 +26,7 @@ from ..ledger.ledgertxn import (
 from ..transactions.account_helpers import make_account_entry
 from ..util.log import get_logger
 from ..xdr import (
-    LedgerHeader, LedgerUpgrade, LedgerUpgradeType, StellarValue,
+    LedgerHeader, LedgerUpgrade, StellarValue,
     StellarValueExt, TransactionResultPair, TransactionResultSet,
     TransactionHistoryEntry, TransactionSet, UpgradeEntryMeta, _Ext,
 )
@@ -245,16 +245,45 @@ class LedgerManager:
         rs = TransactionResultSet(results=result_pairs)
         header.txSetResultHash = sha256(rs.to_xdr())
 
-        # upgrades (after txs; reference LedgerManagerImpl.cpp:617-669)
-        applied_upgrades = []
-        for raw in lcd.value.upgrades:
+        # invariants see the TX-phase delta under the pre-upgrade header:
+        # the reference hooks invariants per operation only, so upgrade
+        # rewrites (prepareLiabilities initializing liabilities / erasing
+        # offers) are exempt by design — they ESTABLISH the state the
+        # invariants check from then on
+        tx_phase_delta = ltx.get_delta()
+        tx_phase_header = _copy_header_fast(header)
+
+        # upgrades (after txs; reference LedgerManagerImpl.cpp:617-669):
+        # a malformed or invalid upgrade in an externalized value fails
+        # the whole close; valid upgrades each apply in a nested txn so
+        # their entry changes land in meta + upgradehistory, and an
+        # apply-time error skips that upgrade without aborting the close
+        from ..herder.upgrades import Upgrades, UpgradeValidity
+        applied_upgrades = []   # (LedgerUpgrade, LedgerEntryChanges rows)
+        max_version = getattr(getattr(self.app, "config", None),
+                              "LEDGER_PROTOCOL_VERSION", 2**32 - 1)
+        for i, raw in enumerate(lcd.value.upgrades):
+            validity = Upgrades.validity_for_apply(raw, header, max_version)
+            if validity == UpgradeValidity.XDR_INVALID:
+                raise RuntimeError("unknown upgrade at index %d" % i)
+            if validity == UpgradeValidity.INVALID:
+                raise RuntimeError("invalid upgrade at index %d" % i)
+            up = LedgerUpgrade.from_xdr(raw)
+            up_ltx = LedgerTxn(ltx)
             try:
-                up = LedgerUpgrade.from_xdr(raw)
-            except Exception:
-                log.warning("ignoring malformed upgrade")
+                Upgrades.apply_to(up_ltx, up)
+                changes = delta_to_changes(up_ltx.get_delta())
+                up_ltx.commit()
+            except RuntimeError as e:
+                if up_ltx._open:
+                    up_ltx.rollback()
+                log.error("exception during upgrade: %s", e)
                 continue
-            self._apply_upgrade(header, up)
-            applied_upgrades.append(up)
+            except BaseException:
+                if up_ltx._open:
+                    up_ltx.rollback()
+                raise
+            applied_upgrades.append((up, changes, i + 1))
 
         # bucket-list hash over the close's delta (content-addressed chain;
         # stands in the header exactly where the reference's
@@ -282,15 +311,21 @@ class LedgerManager:
                 h.add(cur.to_xdr() if cur is not None else b"\xff" * 4)
             header.bucketListHash = h.finish()
 
-        # invariants on the whole close
+        # invariants on the tx phase of the close (upgrade deltas exempt)
         inv = getattr(self.app, "invariant_manager", None)
         if inv is not None:
-            inv.check_on_ledger_close(delta, header_prev, header)
+            inv.check_on_ledger_close(tx_phase_delta, header_prev,
+                                      tx_phase_header)
 
         ltx.commit()
         self.lcl_hash = sha256(self.root.get_header().to_xdr())
         self._store_header(self.root.get_header())
         self._store_txs(lcd, frames, result_pairs)
+        # after the in-memory commit, like txhistory: a close that fails
+        # mid-upgrade must leave no pending history rows in the sqlite
+        # transaction (a catchup retry would hit the PRIMARY KEY)
+        for up, changes, index in applied_upgrades:
+            self._store_upgrade_history(lcd.ledger_seq, up, changes, index)
         self._store_local_has()
         self._emit_close_meta(lcd, frames, result_pairs, applied_upgrades)
         hm = getattr(self.app, "history_manager", None)
@@ -325,10 +360,8 @@ class LedgerManager:
                                       txApplyProcessing=f.tx_meta())
                 for f, rp in zip(frames, result_pairs)],
             upgradesProcessing=[
-                # our upgrades only rewrite header fields, never ledger
-                # entries, so each entry's change list is empty
-                UpgradeEntryMeta(upgrade=up, changes=[])
-                for up in applied_upgrades],
+                UpgradeEntryMeta(upgrade=up, changes=changes)
+                for (up, changes, _i) in applied_upgrades],
             scpInfo=[])
         try:
             stream.write_one(LedgerCloseMeta.v0(meta))
@@ -394,17 +427,20 @@ class LedgerManager:
                                         adopt=bm.adopt_bucket)
             log.warning("bucket-list restore failed: %s", e)
 
-    def _apply_upgrade(self, header: LedgerHeader,
-                       up: LedgerUpgrade) -> None:
-        t = up.disc
-        if t == LedgerUpgradeType.LEDGER_UPGRADE_VERSION:
-            header.ledgerVersion = up.value
-        elif t == LedgerUpgradeType.LEDGER_UPGRADE_BASE_FEE:
-            header.baseFee = up.value
-        elif t == LedgerUpgradeType.LEDGER_UPGRADE_MAX_TX_SET_SIZE:
-            header.maxTxSetSize = up.value
-        elif t == LedgerUpgradeType.LEDGER_UPGRADE_BASE_RESERVE:
-            header.baseReserve = up.value
+    def _store_upgrade_history(self, ledger_seq: int, up, changes,
+                               index: int) -> None:
+        """Reference Upgrades::storeUpgradeHistory — one row per applied
+        upgrade, 1-indexed like txhistory, carrying the upgrade and its
+        LedgerEntryChanges."""
+        db = getattr(self.app, "database", None)
+        if db is None:
+            return
+        from ..xdr import LedgerEntryChanges as _LEC
+        from ..xdr.codec import xdr_bytes as _xb
+        db.execute(
+            "INSERT OR REPLACE INTO upgradehistory (ledgerseq, "
+            "upgradeindex, upgrade, changes) VALUES (?,?,?,?)",
+            (ledger_seq, index, up.to_xdr(), _xb(_LEC, changes)))
 
     # -- persistence --------------------------------------------------------
     def _store_header(self, header: LedgerHeader) -> None:

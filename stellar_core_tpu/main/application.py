@@ -40,6 +40,8 @@ class Application:
         self.config = config
         self.state = AppState.APP_CREATED
         self.metrics = MetricsRegistry(now_fn=clock.now)
+        from ..util.status_manager import StatusManager
+        self.status_manager = StatusManager()
 
         # database (None in pure in-memory test mode)
         if config.DATABASE == "in-memory":
@@ -201,5 +203,8 @@ class Application:
             },
             "state": ("Synced!" if self.state == AppState.APP_SYNCED
                       else "Catching up"),
+            # per-subsystem rolled-up status lines (reference
+            # StatusManager → info "status" array)
+            "status": self.status_manager.to_list(),
             "quorum": self.herder.get_json_info(),
         }

@@ -144,9 +144,14 @@ class CommandHandler:
             return ups.params.to_json()
         if mode == "clear":
             ups.set_parameters(UpgradeParameters())
+            self.app.herder.update_upgrades_status()
             return {"status": "cleared"}
         if mode == "set":
             p = UpgradeParameters()
+            # default the schedule to "now": a 0 default would read as
+            # epoch and the 12h expiration (remove_applied_and_expired)
+            # would silently disarm at the very next close
+            p.upgrade_time = int(self.app.clock.now())
             if "upgradetime" in params:
                 p.upgrade_time = int(params["upgradetime"])
             if "protocolversion" in params:
@@ -158,6 +163,7 @@ class CommandHandler:
             if "maxtxsetsize" in params:
                 p.max_tx_set_size = int(params["maxtxsetsize"])
             ups.set_parameters(p)
+            self.app.herder.update_upgrades_status()
             return p.to_json()
         return {"error": "mode must be get|set|clear"}
 

@@ -22,9 +22,18 @@ from .xdr import (
 TESTING_NETWORK_ID = sha256(b"(sct) testing network")
 GENESIS_TOTAL_COINS = 10**17
 
+# Default protocol for TestLedger/genesis_header. The pytest harness's
+# --protocol-version option rewrites this (tests/conftest.py), re-running
+# every version-agnostic suite at another protocol — the reference's
+# `--all-versions` re-run (src/test/test.cpp:213-217). Tests pinning an
+# explicit ledger_version are unaffected.
+DEFAULT_LEDGER_VERSION = 13
+
 
 def genesis_header(base_fee=100, base_reserve=5_000_000,
-                   max_tx_set_size=100, ledger_version=13) -> LedgerHeader:
+                   max_tx_set_size=100, ledger_version=None) -> LedgerHeader:
+    if ledger_version is None:
+        ledger_version = DEFAULT_LEDGER_VERSION
     return LedgerHeader(
         ledgerVersion=ledger_version, previousLedgerHash=b"\x00" * 32,
         scpValue=StellarValue(txSetHash=b"\x00" * 32, closeTime=1,
@@ -49,7 +58,8 @@ class TestLedger:
     __test__ = False    # not a pytest collection target
 
     def __init__(self, network_id: bytes = TESTING_NETWORK_ID,
-                 verifier=None, ledger_version: int = 13) -> None:
+                 verifier=None,
+                 ledger_version: Optional[int] = None) -> None:
         self.network_id = network_id
         self.root = InMemoryLedgerTxnRoot(
             genesis_header(ledger_version=ledger_version))

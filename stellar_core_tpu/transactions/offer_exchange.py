@@ -148,8 +148,26 @@ def offer_liabilities(n: int, d: int, amount: int):
 
 def adjust_offer(n: int, d: int, max_sell: int, max_receive: int) -> int:
     """Largest posting amount backable by max_sell/max_receive (reference
-    adjustOffer, OfferExchange.cpp:903: idempotent on adjusted offers)."""
-    wheat, _sheep = exchange(max_sell, n, d, max_sell, max_receive)
+    adjustOffer, OfferExchange.cpp:903: exchangeV10 with unbounded taker,
+    NORMAL rounding — idempotent on adjusted offers). Models a buyer with
+    no limits crossing the offer, so sheep always stays: round toward the
+    taker, then zero the offer entirely if either side would eat more
+    than 1% price error (checkPriceErrorBound, OfferExchange.cpp:174) —
+    this is what deletes dust offers at the v10 upgrade."""
+    if max_sell <= 0 or max_receive <= 0:
+        return 0
+    wheat_value = min(max_sell * n, max_receive * d)
+    if n > d:  # wheat more valuable
+        wheat = wheat_value // n
+        sheep = (wheat * n) // d
+    else:
+        sheep = wheat_value // d
+        wheat = _ceil_div(sheep * d, n)
+    if wheat <= 0 or sheep <= 0:
+        return 0
+    # |100·n·wheat − 100·d·sheep| ≤ n·wheat  (≤1% relative price error)
+    if abs(100 * n * wheat - 100 * d * sheep) > n * wheat:
+        return 0
     return wheat
 
 
@@ -271,9 +289,10 @@ def cross_offers(ltx, taker_id, sell_asset: Asset, buy_asset: Asset,
         if o.amount <= 0 or wheat == wheat_cap and wheat < offer.amount:
             # fully taken, or residual is unfunded
             _erase_offer(ltx, key, owner)
-        else:
+        elif ltx.get_header().ledgerVersion >= 10:
             # clamp the residual to what the owner can still back, then
-            # re-encumber (reference performExchange newAmount + acquire)
+            # re-encumber (reference performExchange newAmount + acquire;
+            # v10+ only — the legacy engine keeps the raw remainder)
             o.amount = adjust_offer(
                 n, d, min(o.amount, _available_to_sell(ltx, owner,
                                                        buy_asset)),

@@ -207,14 +207,18 @@ class _ManageOfferBase(OperationFrame):
         assert _credit(ltx, src_id, buying, bought)
 
         # residual amount clamped to post-trade capacity (reference
-        # adjustOffer idempotence). For a buy offer, the residual
-        # promises the REMAINING buy amount
+        # adjustOffer idempotence, ManageOfferOpFrameBase.cpp:375-402:
+        # v10+ only — the legacy path posts the raw remainder). For a buy
+        # offer, the residual promises the REMAINING buy amount
         sheep_resid = INT64_MAX if self.is_buy else (amount - sold)
-        remaining = adjust_offer(
-            price.n, price.d,
-            min(sheep_resid, _available_to_sell(ltx, src_id, selling)),
-            min(_available_to_receive(ltx, src_id, buying),
-                wheat_cap - bought))
+        if header.ledgerVersion >= 10:
+            remaining = adjust_offer(
+                price.n, price.d,
+                min(sheep_resid, _available_to_sell(ltx, src_id, selling)),
+                min(_available_to_receive(ltx, src_id, buying),
+                    wheat_cap - bought))
+        else:
+            remaining = max_sell - sold
 
         if remaining > 0:
             if is_update:

@@ -495,10 +495,21 @@ class TransactionFrame:
             ok = True
             op_results = []
             op_metas = []
+            # pre-10 each op re-resolves its signature set against the
+            # CURRENT state at its own apply (reference OperationFrame::
+            # apply → checkSignature pre-10): an earlier op removing a
+            # signer or lowering a weight invalidates later ops. From 10
+            # the set resolved once in process_signatures above.
+            pre10 = ops_ltx.load_header().ledgerVersion < 10
             for f in self.op_frames:
                 op_ltx = LedgerTxn(ops_ltx)
                 try:
-                    if f.apply(op_ltx):
+                    if pre10 and not f.check_signature(op_ltx, checker):
+                        f.set_code(OperationResultCode.opBAD_AUTH)
+                        ok = False
+                        op_metas.append([])
+                        op_ltx.rollback()
+                    elif f.apply(op_ltx):
                         op_metas.append(delta_to_changes(op_ltx.get_delta()))
                         op_ltx.commit()
                     else:

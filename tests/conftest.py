@@ -49,6 +49,44 @@ if "--xla_force_host_platform_device_count" not in os.environ.get(
 import pytest  # noqa: E402
 
 
+def pytest_addoption(parser):
+    parser.addoption(
+        "--protocol-version", type=int, default=None, metavar="N",
+        help="Re-run the suite with TestLedger/app genesis at protocol N "
+             "(9..13) — the reference's --all-versions re-run "
+             "(src/test/test.cpp:213-217). Tests marked "
+             "min_version(M)/max_version(M) outside N's range are "
+             "skipped; tests pinning explicit versions are unaffected.")
+
+
+def pytest_configure(config):
+    config.addinivalue_line(
+        "markers", "min_version(n): behavior needs protocol >= n; skipped "
+        "when --protocol-version is lower")
+    config.addinivalue_line(
+        "markers", "max_version(n): behavior gone after protocol n; "
+        "skipped when --protocol-version is higher")
+    v = config.getoption("--protocol-version")
+    if v is not None:
+        from stellar_core_tpu import testing as _testing
+        from stellar_core_tpu.main.config import Config as _Config
+        _testing.DEFAULT_LEDGER_VERSION = v
+        _Config.LEDGER_PROTOCOL_VERSION = v
+
+
+def pytest_runtest_setup(item):
+    v = item.config.getoption("--protocol-version")
+    if v is None:
+        return
+    lo = item.get_closest_marker("min_version")
+    if lo is not None and v < lo.args[0]:
+        pytest.skip("needs protocol >= %d, running at %d" % (lo.args[0], v))
+    hi = item.get_closest_marker("max_version")
+    if hi is not None and v > hi.args[0]:
+        pytest.skip("behavior <= protocol %d, running at %d"
+                    % (hi.args[0], v))
+
+
 @pytest.fixture(autouse=True)
 def _reseed_rng():
     from stellar_core_tpu.util import rnd

@@ -180,12 +180,14 @@ def test_update_price_amount_and_assets(ledger, root, gateway):
     assert ledger.apply_frame(
         a.tx([a.op_manage_sell_offer(usd, XLM, 55, 7, 2, offer_id=oid)]))
     assert get_offer(ledger, a, oid).data.value.amount == 55
-    # update assets entirely (same id keeps living)
+    # update assets entirely (same id keeps living); 10 at 1/3 rounds to
+    # 9 — the largest amount with an integral counter-value (reference
+    # adjustOffer: floor(10/3)=3 sheep backs ceil(3·3)=9 wheat)
     assert ledger.apply_frame(
         a.tx([a.op_manage_sell_offer(eur, XLM, 10, 1, 3, offer_id=oid)]))
     o = get_offer(ledger, a, oid).data.value
     assert o.selling.to_xdr() == eur.to_xdr()
-    assert o.amount == 10
+    assert o.amount == 9
 
 
 def test_update_and_delete_nonexistent(ledger, root, gateway):

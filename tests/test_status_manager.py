@@ -1,0 +1,59 @@
+"""StatusManager: per-subsystem rolled-up status lines in `info`
+(reference src/util/test/StatusManagerTest.cpp + the HistoryManager/
+CatchupManager/Herder producer sites)."""
+
+import pytest
+
+from stellar_core_tpu.herder.upgrades import UpgradeParameters
+from stellar_core_tpu.main.application import Application
+from stellar_core_tpu.main.config import Config
+from stellar_core_tpu.util.status_manager import StatusCategory, StatusManager
+from stellar_core_tpu.util.timer import ClockMode, VirtualClock
+
+
+def test_set_get_remove():
+    sm = StatusManager()
+    assert len(sm) == 0
+    assert sm.get_status_message(StatusCategory.NTP) == ""
+    sm.set_status_message(StatusCategory.NTP, "clock skewed")
+    sm.set_status_message(StatusCategory.HISTORY_PUBLISH, "publishing 2")
+    assert len(sm) == 2
+    assert sm.get_status_message(StatusCategory.NTP) == "clock skewed"
+    sm.set_status_message(StatusCategory.NTP, "clock fine")  # overwrite
+    assert sm.get_status_message(StatusCategory.NTP) == "clock fine"
+    assert len(sm) == 2
+    sm.remove_status_message(StatusCategory.NTP)
+    assert sm.get_status_message(StatusCategory.NTP) == ""
+    sm.remove_status_message(StatusCategory.NTP)  # idempotent
+    assert len(sm) == 1
+    assert sm.to_list() == ["publishing 2"]
+
+
+def test_iteration_in_category_order():
+    sm = StatusManager()
+    sm.set_status_message(StatusCategory.REQUIRES_UPGRADES, "armed")
+    sm.set_status_message(StatusCategory.HISTORY_CATCHUP, "catching up")
+    assert sm.to_list() == ["catching up", "armed"]
+
+
+@pytest.fixture
+def app(tmp_path):
+    cfg = Config.test_config(0)
+    cfg.DATABASE = "sqlite3://:memory:"
+    a = Application(VirtualClock(ClockMode.VIRTUAL_TIME), cfg)
+    a.enable_buckets(str(tmp_path / "b"))
+    a.start()
+    return a
+
+
+def test_armed_upgrades_surface_in_info_and_clear(app):
+    assert app.get_info()["status"] == []
+    code, out = app.command_handler.handle_command(
+        "upgrades", {"mode": "set", "basefee": "777", "upgradetime": "0"})
+    assert code == 200
+    status = app.get_info()["status"]
+    assert len(status) == 1 and "fee" in status[0]
+    # the close applies + disarms the upgrade; status clears
+    app.manual_close()
+    assert app.get_info()["status"] == []
+    assert app.ledger_manager.lcl_header.baseFee == 777

@@ -1,0 +1,324 @@
+"""Envelope/signature matrix, section-for-section against the reference's
+TxEnvelopeTests.cpp (/root/reference/src/transactions/test/
+TxEnvelopeTests.cpp:43-1718) beyond the multisig/preauth coverage in
+test_multisig_merge_queue_matrix.py: the outer-envelope signature
+cross-product, common-transaction validity (fees, sequence, time bounds),
+multi-tx batching inside one close, and the change-signer-mid-transaction
+family (signature sets resolve against pre-tx state from protocol 10, so
+an earlier op removing a signer can't invalidate a later op)."""
+
+import pytest
+
+from stellar_core_tpu.crypto.keys import SecretKey
+from stellar_core_tpu.testing import TestAccount, TestLedger, root_secret_key
+from stellar_core_tpu.xdr import (
+    Asset, LedgerKey, OperationResultCode, TimeBounds, TransactionResultCode,
+)
+
+XLM = Asset.native()
+AMOUNT = 10**9
+
+
+@pytest.fixture
+def ledger():
+    return TestLedger()
+
+
+@pytest.fixture
+def root(ledger):
+    return TestAccount(ledger, root_secret_key())
+
+
+def op_code(frame, i=0):
+    """opINNER/opBAD_AUTH/... for operation i."""
+    return frame.result.op_results[i].disc
+
+
+def inner_disc(frame, i=0):
+    return frame.result.op_results[i].value.value.disc
+
+
+# ================================ outer envelope (60-165)
+
+def test_no_signature(ledger, root):
+    a = root.create(AMOUNT)
+    f = a.tx([a.op_payment(root.account_id, 1000)])
+    f.envelope.value.signatures.clear()
+    assert not ledger.apply_frame(f)
+    assert f.result.code == TransactionResultCode.txBAD_AUTH
+
+
+def test_bad_signature(ledger, root):
+    a = root.create(AMOUNT)
+    f = a.tx([a.op_payment(root.account_id, 1000)])
+    sig = f.envelope.value.signatures[0]
+    sig.signature = bytes([sig.signature[0] ^ 1]) + sig.signature[1:]
+    assert not ledger.apply_frame(f)
+    assert f.result.code == TransactionResultCode.txBAD_AUTH
+
+
+def test_bad_signature_wrong_hint(ledger, root):
+    a = root.create(AMOUNT)
+    f = a.tx([a.op_payment(root.account_id, 1000)])
+    f.envelope.value.signatures[0].hint = b"\x00\x00\x00\x00"
+    assert not ledger.apply_frame(f)
+    assert f.result.code == TransactionResultCode.txBAD_AUTH
+
+
+def test_too_many_signatures_signed_twice(ledger, root):
+    a = root.create(AMOUNT)
+    b = root.create(AMOUNT)
+    f = a.tx([a.op_payment(root.account_id, 1000)])
+    f.add_signature(b.sk)       # valid-but-unneeded second signer
+    assert not ledger.apply_frame(f)
+    assert f.result.code == TransactionResultCode.txBAD_AUTH_EXTRA
+
+
+def test_too_many_signatures_unused(ledger, root):
+    a = root.create(AMOUNT)
+    f = a.tx([a.op_payment(root.account_id, 1000)])
+    f.add_signature(SecretKey.pseudo_random_for_testing())  # stranger
+    assert not ledger.apply_frame(f)
+    assert f.result.code == TransactionResultCode.txBAD_AUTH_EXTRA
+
+
+def test_duplicate_signature_rejected(ledger, root):
+    """Reference 'do not allow duplicate signature' (:377): the same
+    valid signature twice is an unused extra."""
+    a = root.create(AMOUNT)
+    f = a.tx([a.op_payment(root.account_id, 1000)])
+    f.envelope.value.signatures.append(f.envelope.value.signatures[0])
+    assert not ledger.apply_frame(f)
+    assert f.result.code == TransactionResultCode.txBAD_AUTH_EXTRA
+
+
+# ============================ common transaction (1369-1501)
+
+def test_insufficient_fee(ledger, root):
+    a = root.create(AMOUNT)
+    f = a.tx([a.op_payment(root.account_id, 1000)], fee=99)
+    assert not ledger.apply_frame(f)
+    assert f.result.code == TransactionResultCode.txINSUFFICIENT_FEE
+
+
+def test_duplicate_payment_bad_seq(ledger, root):
+    a = root.create(AMOUNT)
+    f = a.tx([a.op_payment(root.account_id, 1000)])
+    assert ledger.apply_frame(f)
+    f2 = a.tx([a.op_payment(root.account_id, 1000)],
+              seq=ledger.seq_num(a.account_id))
+    assert not ledger.apply_frame(f2)
+    assert f2.result.code == TransactionResultCode.txBAD_SEQ
+
+
+def test_transaction_gap_bad_seq(ledger, root):
+    a = root.create(AMOUNT)
+    f = a.tx([a.op_payment(root.account_id, 1000)], seq=a.next_seq() + 1)
+    assert not ledger.apply_frame(f)
+    assert f.result.code == TransactionResultCode.txBAD_SEQ
+
+
+def test_time_bounds_too_early(ledger, root):
+    a = root.create(AMOUNT)
+    now = ledger.header().scpValue.closeTime
+    f = a.tx([a.op_payment(root.account_id, 1000)],
+             time_bounds=TimeBounds(minTime=now + 1000,
+                                    maxTime=now + 10000))
+    assert not ledger.apply_frame(f)
+    assert f.result.code == TransactionResultCode.txTOO_EARLY
+
+
+def test_time_bounds_on_time(ledger, root):
+    a = root.create(AMOUNT)
+    now = ledger.header().scpValue.closeTime
+    f = a.tx([a.op_payment(root.account_id, 1000)],
+             time_bounds=TimeBounds(minTime=max(0, now - 10),
+                                    maxTime=now + 10000))
+    assert ledger.apply_frame(f)
+
+
+def test_time_bounds_too_late(ledger, root):
+    a = root.create(AMOUNT)
+    now = ledger.header().scpValue.closeTime
+    f = a.tx([a.op_payment(root.account_id, 1000)],
+             time_bounds=TimeBounds(minTime=1, maxTime=max(1, now - 1)))
+    assert not ledger.apply_frame(f)
+    assert f.result.code == TransactionResultCode.txTOO_LATE
+
+
+# ================================= batching (1178-1368)
+
+def test_batch_single_tx_wrapped_by_different_account_missing_sig(
+        ledger, root):
+    """b submits a tx whose op source is a, signed only by b: the op
+    fails BAD_AUTH (reference :1203)."""
+    a = root.create(AMOUNT)
+    b = root.create(AMOUNT)
+    f = b.tx([TestAccount.op(
+        b.op_payment(root.account_id, 1000).body, source=a.account_id)])
+    assert not ledger.apply_frame(f)
+    assert op_code(f) == OperationResultCode.opBAD_AUTH
+
+
+def test_batch_single_tx_wrapped_by_different_account_success(ledger, root):
+    a = root.create(AMOUNT)
+    b = root.create(AMOUNT)
+    before = ledger.balance(a.account_id)
+    f = b.tx([TestAccount.op(
+        b.op_payment(root.account_id, 1000).body, source=a.account_id)],
+        extra_signers=[a.sk])
+    assert ledger.apply_frame(f)
+    assert ledger.balance(a.account_id) == before - 1000  # a paid, b fee'd
+
+
+def test_batch_one_invalid_tx_other_applies(ledger, root):
+    a = root.create(AMOUNT)
+    b = root.create(AMOUNT)
+    good = a.tx([a.op_payment(root.account_id, 1000)])
+    bad = b.tx([b.op_payment(root.account_id, 1000)], seq=b.next_seq() + 5)
+    results = ledger.close_with([good, bad])
+    assert results[0] and not results[1]
+    assert good.result.code == TransactionResultCode.txSUCCESS
+    assert bad.result.code == TransactionResultCode.txBAD_SEQ
+
+
+def test_batch_one_failed_tx_other_applies(ledger, root):
+    a = root.create(AMOUNT)
+    b = root.create(AMOUNT)
+    good = a.tx([a.op_payment(root.account_id, 1000)])
+    failing = b.tx([b.op_payment(root.account_id, 10 * AMOUNT)])  # broke
+    results = ledger.close_with([good, failing])
+    assert results[0] and not results[1]
+    assert failing.result.code == TransactionResultCode.txFAILED
+
+
+def test_batch_both_success(ledger, root):
+    a = root.create(AMOUNT)
+    b = root.create(AMOUNT)
+    r1 = a.tx([a.op_payment(root.account_id, 1000)])
+    r2 = b.tx([b.op_payment(root.account_id, 1000)])
+    assert ledger.close_with([r1, r2]) == [True, True]
+
+
+def test_batch_operation_using_default_signature(ledger, root):
+    """Op with explicit source == tx source needs no extra signature
+    (reference :1338)."""
+    a = root.create(AMOUNT)
+    f = a.tx([TestAccount.op(
+        a.op_payment(root.account_id, 1000).body, source=a.account_id)])
+    assert ledger.apply_frame(f)
+
+
+# ============== change signer and weights mid-transaction (1502-1718)
+
+def _two_op_tx(a, ops, extra=None):
+    return a.tx(ops, extra_signers=extra or [])
+
+
+def test_switch_into_regular_account_one_op(ledger, root):
+    """setOptions raising master weight AND zeroing the other signer in
+    ONE op: succeeds at every version (reference :1508)."""
+    a = root.create(AMOUNT)
+    b = root.create(AMOUNT)
+    from stellar_core_tpu.xdr import Signer, SignerKey
+    assert ledger.apply_frame(a.tx([a.op_set_options(
+        master_weight=1, low=2, med=2, high=2,
+        signer=Signer(key=SignerKey.ed25519(b.account_id.key_bytes),
+                      weight=1))]))
+    f = a.tx([a.op_set_options(master_weight=2),
+              a.op_add_signer(b.account_id.key_bytes, 0)],
+             extra_signers=[b.sk])
+    # one tx, ops split: still the one-signature-set semantics
+    assert ledger.apply_frame(f), f.result
+    assert f.result.code == TransactionResultCode.txSUCCESS
+
+
+def test_switch_into_regular_account_two_ops_v13(ledger, root):
+    """Removing the co-signer in op 1 does NOT invalidate op 2 at v10+:
+    the signature set resolved before apply (reference :1525 from-10
+    arm)."""
+    a = root.create(AMOUNT)
+    b = root.create(AMOUNT)
+    from stellar_core_tpu.xdr import Signer, SignerKey
+    assert ledger.apply_frame(a.tx([a.op_set_options(
+        master_weight=1, low=2, med=2, high=2,
+        signer=Signer(key=SignerKey.ed25519(b.account_id.key_bytes),
+                      weight=1))]))
+    f = a.tx([a.op_add_signer(b.account_id.key_bytes, 0),
+              a.op_set_options(master_weight=2)],
+             extra_signers=[b.sk])
+    assert ledger.apply_frame(f), f.result
+
+
+def test_change_thresholds_twice_v13(ledger, root):
+    a = root.create(AMOUNT)
+    f = a.tx([a.op_set_options(high=3), a.op_set_options(high=3)])
+    assert ledger.apply_frame(f), f.result
+
+
+def test_lower_master_weight_twice_v13(ledger, root):
+    a = root.create(AMOUNT)
+    assert ledger.apply_frame(a.tx([a.op_set_options(
+        master_weight=10, low=1, med=5, high=10)]))
+    f = a.tx([a.op_set_options(master_weight=9),
+              a.op_set_options(master_weight=8)])
+    assert ledger.apply_frame(f), f.result
+
+
+def test_remove_signer_then_do_something_v13(ledger, root):
+    a = root.create(AMOUNT)
+    b = root.create(AMOUNT)
+    from stellar_core_tpu.xdr import Signer, SignerKey
+    assert ledger.apply_frame(a.tx([a.op_set_options(
+        master_weight=1, low=2, med=2, high=2,
+        signer=Signer(key=SignerKey.ed25519(b.account_id.key_bytes),
+                      weight=1))]))
+    f = a.tx([a.op_add_signer(b.account_id.key_bytes, 0),
+              a.op_set_options(home_domain="stellar.org")],
+             extra_signers=[b.sk])
+    assert ledger.apply_frame(f), f.result
+    e = ledger.root.get_entry(LedgerKey.account(a.account_id))
+    assert e.data.value.homeDomain == "stellar.org"
+    assert len(e.data.value.signers) == 0
+
+
+def test_merge_signing_account_by_destination_v13(ledger, root):
+    """b's tx restores a's master key then merges a into b; the second
+    op still applies under the pre-tx signature set (reference :1558
+    from-10 arm)."""
+    from stellar_core_tpu.xdr import OperationBody, OperationType
+    a = root.create(AMOUNT)
+    b = root.create(AMOUNT)
+    assert ledger.apply_frame(a.tx([
+        a.op_add_signer(b.account_id.key_bytes, 1),
+        a.op_set_options(master_weight=0)]))
+    merge = TestAccount.op(OperationBody(
+        OperationType.ACCOUNT_MERGE, b.muxed), source=a.account_id)
+    restore = TestAccount.op(
+        a.op_set_options(master_weight=1).body, source=a.account_id)
+    restore.body.value.signer = None
+    f = b.tx([TestAccount.op(a.op_add_signer(
+        b.account_id.key_bytes, 0).body, source=a.account_id),
+        merge])
+    assert ledger.apply_frame(f), f.result
+    assert not ledger.account_exists(a.account_id)
+
+
+def test_pre_tx_signature_set_at_v9_reruns_per_op(ledger, root):
+    """The pre-10 arm: removing the co-signer in op 1 DOES invalidate
+    op 2 (reference :1525 versions {1..6,8,9} expect txFAILED/opBAD_AUTH)."""
+    led = TestLedger(ledger_version=9)
+    r = TestAccount(led, root_secret_key())
+    a = r.create(AMOUNT)
+    b = r.create(AMOUNT)
+    from stellar_core_tpu.xdr import Signer, SignerKey
+    assert led.apply_frame(a.tx([a.op_set_options(
+        master_weight=1, low=2, med=2, high=2,
+        signer=Signer(key=SignerKey.ed25519(b.account_id.key_bytes),
+                      weight=1))]))
+    f = a.tx([a.op_add_signer(b.account_id.key_bytes, 0),
+              a.op_set_options(master_weight=2)],
+             extra_signers=[b.sk])
+    assert not led.apply_frame(f)
+    assert f.result.code == TransactionResultCode.txFAILED
+    assert op_code(f, 1) == OperationResultCode.opBAD_AUTH

@@ -1,0 +1,671 @@
+"""Upgrade matrix, section-for-section against the reference's
+UpgradesTests.cpp (/root/reference/src/herder/test/UpgradesTests.cpp:1-2058):
+createUpgradesFor listings, nomination/apply validity cross-products, the
+upgrade-to-v10 liabilities-initialization matrix (prepareLiabilities),
+base-reserve upgrades, invalid-upgrade close failures, upgradehistory
+persistence, and armed-parameter expiration.
+
+The v10 matrix scenarios run on a TestLedger born at protocol 9 and apply
+LEDGER_UPGRADE_VERSION(10) through Upgrades.apply_to — the same entry
+point ledger close uses — then assert the exact offer/liability outcomes
+the reference pins (offer prices 2/1 amount 1000 unless a section says
+otherwise, so each offer encumbers selling=1000 / buying=2000).
+"""
+
+import pytest
+
+from stellar_core_tpu.herder.upgrades import (
+    UPGRADE_EXPIRATION_SECONDS, UpgradeParameters, Upgrades, UpgradeValidity,
+)
+from stellar_core_tpu.ledger.ledgertxn import LedgerTxn
+from stellar_core_tpu.testing import TestAccount, TestLedger, root_secret_key
+from stellar_core_tpu.xdr import (
+    AccountFlags, Asset, LedgerKey, LedgerUpgrade,
+    LedgerUpgradeType as UT,
+)
+
+from test_ledgertxn import make_header
+
+INT64_MAX = 2**63 - 1
+RESERVE = 5_000_000
+FEE = 100
+XLM = Asset.native()
+
+
+def up(t, v) -> bytes:
+    return LedgerUpgrade(t, v).to_xdr()
+
+
+def min_bal(n: int) -> int:
+    return (2 + n) * RESERVE
+
+
+def execute_upgrade(ledger: TestLedger, t: int, v: int) -> None:
+    """Apply one upgrade the way ledger close does (nested txn over the
+    root; reference executeUpgrade helper)."""
+    with LedgerTxn(ledger.root) as ltx:
+        Upgrades.apply_to(ltx, LedgerUpgrade(t, v))
+
+
+def native_liab(ledger, acc):
+    """(buying, selling) native liabilities off the account entry."""
+    e = ledger.root.get_entry(LedgerKey.account(acc.account_id))
+    dv = e.data.value
+    if dv.ext.disc == 0:
+        return (0, 0)
+    li = dv.ext.value.liabilities
+    return (li.buying, li.selling)
+
+
+def asset_liab(ledger, acc, asset):
+    e = ledger.root.get_entry(
+        LedgerKey.trustline(acc.account_id, asset))
+    if e is None or e.data.value.ext.disc == 0:
+        return (0, 0)
+    li = e.data.value.ext.value.liabilities
+    return (li.buying, li.selling)
+
+
+def get_offer(ledger, acc, offer_id):
+    return ledger.root.get_entry(LedgerKey.offer(acc.account_id, offer_id))
+
+
+class V10Fixture:
+    """Protocol-9 ledger with issuer/cur1/cur2 (reference fixture at
+    UpgradesTests.cpp:580-605)."""
+
+    def __init__(self):
+        self.ledger = TestLedger(ledger_version=9)
+        self.root = TestAccount(self.ledger, root_secret_key())
+        self.issuer = self.root.create(min_bal(0) + 100 * FEE + 10**10)
+        self.cur1 = Asset.credit("CUR1", self.issuer.account_id)
+        self.cur2 = Asset.credit("CUR2", self.issuer.account_id)
+
+    def create_offer(self, acc, selling, buying, amount=1000, n=2, d=1):
+        f = acc.tx([acc.op_manage_sell_offer(selling, buying, amount, n, d)])
+        assert self.ledger.apply_frame(f), f.result
+        return f.result.op_results[0].value.value.value.offer.value.offerID
+
+    def upgrade_to_v10(self):
+        execute_upgrade(self.ledger, UT.LEDGER_UPGRADE_VERSION, 10)
+        assert self.ledger.header().ledgerVersion == 10
+
+
+@pytest.fixture
+def v10():
+    return V10Fixture()
+
+
+# ====================================== one account, one asset pair (646)
+
+def test_v10_valid_native(v10):
+    a1 = v10.root.create(min_bal(5) + 2000 + 5 * FEE)
+    a1.change_trust(v10.cur1, 6000)
+    v10.issuer.pay(a1, 2000, v10.cur1)
+    ids = [v10.create_offer(a1, XLM, v10.cur1),
+           v10.create_offer(a1, XLM, v10.cur1),
+           v10.create_offer(a1, v10.cur1, XLM),
+           v10.create_offer(a1, v10.cur1, XLM)]
+    v10.upgrade_to_v10()
+    assert all(get_offer(v10.ledger, a1, i) is not None for i in ids)
+    assert native_liab(v10.ledger, a1) == (4000, 2000)
+    assert asset_liab(v10.ledger, a1, v10.cur1) == (4000, 2000)
+
+
+def test_v10_invalid_selling_native(v10):
+    a1 = v10.root.create(min_bal(5) + 1000 + 5 * FEE)
+    a1.change_trust(v10.cur1, 6000)
+    v10.issuer.pay(a1, 2000, v10.cur1)
+    dead = [v10.create_offer(a1, XLM, v10.cur1),
+            v10.create_offer(a1, XLM, v10.cur1)]
+    kept = [v10.create_offer(a1, v10.cur1, XLM),
+            v10.create_offer(a1, v10.cur1, XLM)]
+    v10.upgrade_to_v10()
+    assert all(get_offer(v10.ledger, a1, i) is None for i in dead)
+    assert all(get_offer(v10.ledger, a1, i) is not None for i in kept)
+    assert native_liab(v10.ledger, a1) == (4000, 0)
+    assert asset_liab(v10.ledger, a1, v10.cur1) == (0, 2000)
+
+
+def test_v10_invalid_buying_native(v10):
+    a1 = v10.root.create(min_bal(5) + 2000 + 5 * FEE)
+    a1.change_trust(v10.cur1, INT64_MAX)
+    v10.issuer.pay(a1, INT64_MAX - 4000, v10.cur1)
+    kept = [v10.create_offer(a1, XLM, v10.cur1),
+            v10.create_offer(a1, XLM, v10.cur1)]
+    dead = [v10.create_offer(a1, v10.cur1, XLM, INT64_MAX // 4 - 2000),
+            v10.create_offer(a1, v10.cur1, XLM, INT64_MAX // 4 - 2000)]
+    v10.upgrade_to_v10()
+    assert all(get_offer(v10.ledger, a1, i) is None for i in dead)
+    assert all(get_offer(v10.ledger, a1, i) is not None for i in kept)
+    assert native_liab(v10.ledger, a1) == (0, 2000)
+    assert asset_liab(v10.ledger, a1, v10.cur1) == (4000, 0)
+
+
+def test_v10_valid_non_native(v10):
+    a1 = v10.root.create(min_bal(6) + 6 * FEE)
+    a1.change_trust(v10.cur1, 6000)
+    a1.change_trust(v10.cur2, 6000)
+    v10.issuer.pay(a1, 2000, v10.cur1)
+    v10.issuer.pay(a1, 2000, v10.cur2)
+    ids = [v10.create_offer(a1, v10.cur1, v10.cur2),
+           v10.create_offer(a1, v10.cur1, v10.cur2),
+           v10.create_offer(a1, v10.cur2, v10.cur1),
+           v10.create_offer(a1, v10.cur2, v10.cur1)]
+    v10.upgrade_to_v10()
+    assert all(get_offer(v10.ledger, a1, i) is not None for i in ids)
+    assert asset_liab(v10.ledger, a1, v10.cur1) == (4000, 2000)
+    assert asset_liab(v10.ledger, a1, v10.cur2) == (4000, 2000)
+
+
+def test_v10_invalid_non_native(v10):
+    a1 = v10.root.create(min_bal(6) + 6 * FEE)
+    a1.change_trust(v10.cur1, 6000)
+    a1.change_trust(v10.cur2, 6000)
+    v10.issuer.pay(a1, 1000, v10.cur1)
+    v10.issuer.pay(a1, 2000, v10.cur2)
+    dead = [v10.create_offer(a1, v10.cur1, v10.cur2),
+            v10.create_offer(a1, v10.cur1, v10.cur2)]
+    kept = [v10.create_offer(a1, v10.cur2, v10.cur1),
+            v10.create_offer(a1, v10.cur2, v10.cur1)]
+    v10.upgrade_to_v10()
+    assert all(get_offer(v10.ledger, a1, i) is None for i in dead)
+    assert all(get_offer(v10.ledger, a1, i) is not None for i in kept)
+    assert asset_liab(v10.ledger, a1, v10.cur1) == (4000, 0)
+    assert asset_liab(v10.ledger, a1, v10.cur2) == (0, 2000)
+
+
+def test_v10_valid_issued_by_account(v10):
+    a1 = v10.root.create(min_bal(4) + 4 * FEE)
+    ic1 = Asset.credit("CUR1", a1.account_id)
+    ic2 = Asset.credit("CUR2", a1.account_id)
+    ids = [v10.create_offer(a1, ic1, ic2), v10.create_offer(a1, ic1, ic2),
+           v10.create_offer(a1, ic2, ic1), v10.create_offer(a1, ic2, ic1)]
+    v10.upgrade_to_v10()
+    assert all(get_offer(v10.ledger, a1, i) is not None for i in ids)
+
+
+# ============================ one account, multiple asset pairs (775-845)
+
+def _twelve_offers(v10, acc, state="valid"):
+    """The createOffers 12-offer helper: 2 each of the 6 directed pairs.
+    Returns {label: [ids]} keyed native_cur1/cur1_native/..."""
+    out = {}
+    out["native_cur1"] = [v10.create_offer(acc, XLM, v10.cur1)
+                          for _ in range(2)]
+    out["cur1_native"] = [v10.create_offer(acc, v10.cur1, XLM)
+                          for _ in range(2)]
+    out["native_cur2"] = [v10.create_offer(acc, XLM, v10.cur2)
+                          for _ in range(2)]
+    out["cur2_native"] = [v10.create_offer(acc, v10.cur2, XLM)
+                          for _ in range(2)]
+    out["cur1_cur2"] = [v10.create_offer(acc, v10.cur1, v10.cur2)
+                        for _ in range(2)]
+    out["cur2_cur1"] = [v10.create_offer(acc, v10.cur2, v10.cur1)
+                        for _ in range(2)]
+    return out
+
+
+def _setup_multi(v10, extra_native, cur2_amount=4000):
+    a = v10.root.create(min_bal(14) + extra_native + 14 * FEE)
+    a.change_trust(v10.cur1, 12000)
+    a.change_trust(v10.cur2, 12000)
+    v10.issuer.pay(a, 4000, v10.cur1)
+    v10.issuer.pay(a, cur2_amount, v10.cur2)
+    return a
+
+
+def _check_offers(v10, acc, offers, dead_labels):
+    for label, ids in offers.items():
+        want_dead = label in dead_labels
+        for i in ids:
+            got = get_offer(v10.ledger, acc, i)
+            assert (got is None) == want_dead, (label, i)
+
+
+def test_v10_multi_pairs_all_valid(v10):
+    a1 = _setup_multi(v10, 4000)
+    offers = _twelve_offers(v10, a1)
+    v10.upgrade_to_v10()
+    _check_offers(v10, a1, offers, set())
+    assert native_liab(v10.ledger, a1) == (8000, 4000)
+    assert asset_liab(v10.ledger, a1, v10.cur1) == (8000, 4000)
+    assert asset_liab(v10.ledger, a1, v10.cur2) == (8000, 4000)
+
+
+def test_v10_multi_pairs_one_invalid_native(v10):
+    a1 = _setup_multi(v10, 2000)
+    offers = _twelve_offers(v10, a1)
+    v10.upgrade_to_v10()
+    _check_offers(v10, a1, offers, {"native_cur1", "native_cur2"})
+    assert native_liab(v10.ledger, a1) == (8000, 0)
+    assert asset_liab(v10.ledger, a1, v10.cur1) == (4000, 4000)
+    assert asset_liab(v10.ledger, a1, v10.cur2) == (4000, 4000)
+
+
+def test_v10_multi_pairs_one_invalid_non_native(v10):
+    a1 = _setup_multi(v10, 4000, cur2_amount=1000)
+    offers = _twelve_offers(v10, a1)
+    v10.upgrade_to_v10()
+    _check_offers(v10, a1, offers, {"cur2_native", "cur2_cur1"})
+    assert native_liab(v10.ledger, a1) == (4000, 4000)
+    assert asset_liab(v10.ledger, a1, v10.cur1) == (4000, 4000)
+    assert asset_liab(v10.ledger, a1, v10.cur2) == (8000, 0)
+
+
+# =============================== multiple accounts (865-970)
+
+def test_v10_multi_accounts_all_valid(v10):
+    a1 = _setup_multi(v10, 4000)
+    a2 = _setup_multi(v10, 4000)
+    o1 = _twelve_offers(v10, a1)
+    o2 = _twelve_offers(v10, a2)
+    v10.upgrade_to_v10()
+    _check_offers(v10, a1, o1, set())
+    _check_offers(v10, a2, o2, set())
+    for a in (a1, a2):
+        assert native_liab(v10.ledger, a) == (8000, 4000)
+        assert asset_liab(v10.ledger, a, v10.cur1) == (8000, 4000)
+        assert asset_liab(v10.ledger, a, v10.cur2) == (8000, 4000)
+
+
+def test_v10_multi_accounts_one_invalid_each(v10):
+    a1 = _setup_multi(v10, 2000)
+    a2 = _setup_multi(v10, 4000, cur2_amount=2000)
+    o1 = _twelve_offers(v10, a1)
+    o2 = _twelve_offers(v10, a2)
+    v10.upgrade_to_v10()
+    _check_offers(v10, a1, o1, {"native_cur1", "native_cur2"})
+    _check_offers(v10, a2, o2, {"cur2_native", "cur2_cur1"})
+    assert native_liab(v10.ledger, a1) == (8000, 0)
+    assert asset_liab(v10.ledger, a1, v10.cur1) == (4000, 4000)
+    assert asset_liab(v10.ledger, a1, v10.cur2) == (4000, 4000)
+    assert native_liab(v10.ledger, a2) == (4000, 4000)
+    assert asset_liab(v10.ledger, a2, v10.cur1) == (4000, 4000)
+    assert asset_liab(v10.ledger, a2, v10.cur2) == (8000, 0)
+
+
+# ============================== liabilities overflow (972-1046)
+
+def test_v10_overflow_all_invalid(v10):
+    a1 = v10.root.create(min_bal(6) + 6 * FEE)
+    a1.change_trust(v10.cur1, INT64_MAX)
+    a1.change_trust(v10.cur2, INT64_MAX)
+    v10.issuer.pay(a1, INT64_MAX // 3, v10.cur1)
+    v10.issuer.pay(a1, INT64_MAX // 3, v10.cur2)
+    big = INT64_MAX // 3
+    dead = [v10.create_offer(a1, v10.cur1, v10.cur2, big),
+            v10.create_offer(a1, v10.cur1, v10.cur2, big),
+            v10.create_offer(a1, v10.cur2, v10.cur1, big),
+            v10.create_offer(a1, v10.cur2, v10.cur1, big)]
+    v10.upgrade_to_v10()
+    assert all(get_offer(v10.ledger, a1, i) is None for i in dead)
+    assert asset_liab(v10.ledger, a1, v10.cur1) == (0, 0)
+    assert asset_liab(v10.ledger, a1, v10.cur2) == (0, 0)
+
+
+def test_v10_overflow_half_invalid(v10):
+    a1 = v10.root.create(min_bal(6) + 6 * FEE)
+    a1.change_trust(v10.cur1, INT64_MAX)
+    a1.change_trust(v10.cur2, INT64_MAX)
+    v10.issuer.pay(a1, INT64_MAX // 3, v10.cur1)
+    v10.issuer.pay(a1, INT64_MAX // 3, v10.cur2)
+    big = INT64_MAX // 3
+    dead = [v10.create_offer(a1, v10.cur1, v10.cur2, big),
+            v10.create_offer(a1, v10.cur1, v10.cur2, big)]
+    kept = v10.create_offer(a1, v10.cur2, v10.cur1, big)
+    v10.upgrade_to_v10()
+    assert all(get_offer(v10.ledger, a1, i) is None for i in dead)
+    assert get_offer(v10.ledger, a1, kept) is not None
+    assert asset_liab(v10.ledger, a1, v10.cur1) == (INT64_MAX // 3 * 2, 0)
+    assert asset_liab(v10.ledger, a1, v10.cur2) == (0, INT64_MAX // 3)
+
+
+def test_v10_overflow_issued_for_issued(v10):
+    a1 = v10.root.create(min_bal(4) + 4 * FEE)
+    ic1 = Asset.credit("CUR1", a1.account_id)
+    ic2 = Asset.credit("CUR2", a1.account_id)
+    big = INT64_MAX // 3
+    ids = [v10.create_offer(a1, ic1, ic2, big),
+           v10.create_offer(a1, ic1, ic2, big),
+           v10.create_offer(a1, ic2, ic1, big),
+           v10.create_offer(a1, ic2, ic1, big)]
+    v10.upgrade_to_v10()
+    assert all(get_offer(v10.ledger, a1, i) is not None for i in ids)
+
+
+# ================================= adjust offers (1047-1198)
+
+def test_v10_offers_below_threshold_deleted(v10):
+    a1 = v10.root.create(min_bal(6) + 6 * FEE)
+    a1.change_trust(v10.cur1, 1000)
+    a1.change_trust(v10.cur2, 1000)
+    v10.issuer.pay(a1, 500, v10.cur1)
+    v10.issuer.pay(a1, 500, v10.cur2)
+    dead = [v10.create_offer(a1, v10.cur1, v10.cur2, 27, 3, 2),
+            v10.create_offer(a1, v10.cur2, v10.cur1, 27, 3, 2)]
+    kept = [v10.create_offer(a1, v10.cur1, v10.cur2, 28, 3, 2),
+            v10.create_offer(a1, v10.cur2, v10.cur1, 28, 3, 2)]
+    v10.upgrade_to_v10()
+    assert all(get_offer(v10.ledger, a1, i) is None for i in dead)
+    assert all(get_offer(v10.ledger, a1, i) is not None for i in kept)
+    assert native_liab(v10.ledger, a1) == (0, 0)
+    assert asset_liab(v10.ledger, a1, v10.cur1) == (42, 28)
+    assert asset_liab(v10.ledger, a1, v10.cur2) == (42, 28)
+
+
+def test_v10_offers_needing_rounding_are_rounded(v10):
+    a1 = v10.root.create(min_bal(4) + 4 * FEE)
+    a1.change_trust(v10.cur1, 1000)
+    a1.change_trust(v10.cur2, 1000)
+    v10.issuer.pay(a1, 500, v10.cur1)
+    same = v10.create_offer(a1, v10.cur1, v10.cur2, 201, 2, 3)
+    adjusted = v10.create_offer(a1, v10.cur1, v10.cur2, 202, 2, 3)
+    v10.upgrade_to_v10()
+    assert get_offer(v10.ledger, a1, same).data.value.amount == 201
+    assert get_offer(v10.ledger, a1, adjusted).data.value.amount == 201
+    assert native_liab(v10.ledger, a1) == (0, 0)
+    assert asset_liab(v10.ledger, a1, v10.cur1) == (0, 402)
+    assert asset_liab(v10.ledger, a1, v10.cur2) == (268, 0)
+
+
+def test_v10_threshold_offers_still_contribute_remain(v10):
+    a1 = v10.root.create(min_bal(10) + 2000 + 12 * FEE)
+    a1.change_trust(v10.cur1, 5125)
+    a1.change_trust(v10.cur2, 5125)
+    v10.issuer.pay(a1, 2050, v10.cur1)
+    v10.issuer.pay(a1, 2050, v10.cur2)
+    # match the next test's balance trajectory (reference comment)
+    assert a1.pay(v10.root, 4 * RESERVE + 3 * FEE)
+    kept = [v10.create_offer(a1, v10.cur1, XLM, 1000, 3, 2),
+            v10.create_offer(a1, v10.cur1, XLM, 1000, 3, 2),
+            v10.create_offer(a1, XLM, v10.cur1, 1000, 3, 2),
+            v10.create_offer(a1, XLM, v10.cur1, 1000, 3, 2)]
+    v10.upgrade_to_v10()
+    assert all(get_offer(v10.ledger, a1, i) is not None for i in kept)
+    assert native_liab(v10.ledger, a1) == (3000, 2000)
+    assert asset_liab(v10.ledger, a1, v10.cur1) == (3000, 2000)
+    assert asset_liab(v10.ledger, a1, v10.cur2) == (0, 0)
+
+
+def test_v10_threshold_offers_still_contribute_delete(v10):
+    a1 = v10.root.create(min_bal(10) + 2000 + 12 * FEE)
+    a1.change_trust(v10.cur1, 5125)
+    a1.change_trust(v10.cur2, 5125)
+    v10.issuer.pay(a1, 2050, v10.cur1)
+    v10.issuer.pay(a1, 2050, v10.cur2)
+    dead = [v10.create_offer(a1, v10.cur1, v10.cur2, 27, 3, 2),
+            v10.create_offer(a1, v10.cur1, v10.cur2, 27, 3, 2),
+            v10.create_offer(a1, v10.cur1, XLM, 1000, 3, 2),
+            v10.create_offer(a1, v10.cur1, XLM, 1000, 3, 2),
+            v10.create_offer(a1, v10.cur2, v10.cur1, 27, 3, 2),
+            v10.create_offer(a1, v10.cur2, v10.cur1, 27, 3, 2),
+            v10.create_offer(a1, XLM, v10.cur1, 1000, 3, 2),
+            v10.create_offer(a1, XLM, v10.cur1, 1000, 3, 2)]
+    v10.upgrade_to_v10()
+    assert all(get_offer(v10.ledger, a1, i) is None for i in dead)
+    assert native_liab(v10.ledger, a1) == (0, 0)
+    assert asset_liab(v10.ledger, a1, v10.cur1) == (0, 0)
+    assert asset_liab(v10.ledger, a1, v10.cur2) == (0, 0)
+
+
+# ============================== unauthorized offers (1200-1332)
+
+def _auth_issuer(v10):
+    f = v10.issuer.tx([v10.issuer.op_set_options(
+        set_flags=AccountFlags.AUTH_REQUIRED_FLAG |
+        AccountFlags.AUTH_REVOCABLE_FLAG)])
+    assert v10.ledger.apply_frame(f)
+
+
+def _allow(v10, asset, trustor, authorize=1):
+    f = v10.issuer.tx([v10.issuer.op_allow_trust(
+        trustor.account_id, asset.value.assetCode, authorize)])
+    assert v10.ledger.apply_frame(f), f.result
+
+
+def test_v10_both_assets_authorized(v10):
+    _auth_issuer(v10)
+    a1 = v10.root.create(min_bal(6) + 6 * FEE)
+    a1.change_trust(v10.cur1, 6000)
+    a1.change_trust(v10.cur2, 6000)
+    _allow(v10, v10.cur1, a1)
+    _allow(v10, v10.cur2, a1)
+    v10.issuer.pay(a1, 2000, v10.cur1)
+    v10.issuer.pay(a1, 2000, v10.cur2)
+    ids = [v10.create_offer(a1, v10.cur1, v10.cur2),
+           v10.create_offer(a1, v10.cur1, v10.cur2),
+           v10.create_offer(a1, v10.cur2, v10.cur1),
+           v10.create_offer(a1, v10.cur2, v10.cur1)]
+    v10.upgrade_to_v10()
+    assert all(get_offer(v10.ledger, a1, i) is not None for i in ids)
+    assert asset_liab(v10.ledger, a1, v10.cur1) == (4000, 2000)
+    assert asset_liab(v10.ledger, a1, v10.cur2) == (4000, 2000)
+
+
+def test_v10_selling_asset_not_authorized(v10):
+    _auth_issuer(v10)
+    a1 = v10.root.create(min_bal(6) + 4000 + 6 * FEE)
+    a1.change_trust(v10.cur1, 6000)
+    a1.change_trust(v10.cur2, 6000)
+    _allow(v10, v10.cur1, a1)
+    _allow(v10, v10.cur2, a1)
+    v10.issuer.pay(a1, 2000, v10.cur1)
+    v10.issuer.pay(a1, 2000, v10.cur2)
+    dead = [v10.create_offer(a1, v10.cur1, XLM),
+            v10.create_offer(a1, v10.cur1, XLM)]
+    kept = [v10.create_offer(a1, v10.cur2, XLM),
+            v10.create_offer(a1, v10.cur2, XLM)]
+    _allow(v10, v10.cur1, a1, authorize=0)
+    v10.upgrade_to_v10()
+    assert all(get_offer(v10.ledger, a1, i) is None for i in dead)
+    assert all(get_offer(v10.ledger, a1, i) is not None for i in kept)
+    assert native_liab(v10.ledger, a1) == (4000, 0)
+    assert asset_liab(v10.ledger, a1, v10.cur1) == (0, 0)
+    assert asset_liab(v10.ledger, a1, v10.cur2) == (0, 2000)
+
+
+def test_v10_buying_asset_not_authorized(v10):
+    _auth_issuer(v10)
+    a1 = v10.root.create(min_bal(6) + 4000 + 6 * FEE)
+    a1.change_trust(v10.cur1, 6000)
+    a1.change_trust(v10.cur2, 6000)
+    _allow(v10, v10.cur1, a1)
+    _allow(v10, v10.cur2, a1)
+    v10.issuer.pay(a1, 2000, v10.cur1)
+    v10.issuer.pay(a1, 2000, v10.cur2)
+    dead = [v10.create_offer(a1, XLM, v10.cur1),
+            v10.create_offer(a1, XLM, v10.cur1)]
+    kept = [v10.create_offer(a1, XLM, v10.cur2),
+            v10.create_offer(a1, XLM, v10.cur2)]
+    _allow(v10, v10.cur1, a1, authorize=0)
+    v10.upgrade_to_v10()
+    assert all(get_offer(v10.ledger, a1, i) is None for i in dead)
+    assert all(get_offer(v10.ledger, a1, i) is not None for i in kept)
+    assert native_liab(v10.ledger, a1) == (0, 2000)
+    assert asset_liab(v10.ledger, a1, v10.cur1) == (0, 0)
+    assert asset_liab(v10.ledger, a1, v10.cur2) == (4000, 0)
+
+
+def test_v10_unauthorized_still_contribute_remain(v10):
+    _auth_issuer(v10)
+    a1 = v10.root.create(min_bal(10) + 2000 + 10 * FEE)
+    a1.change_trust(v10.cur1, 6000)
+    a1.change_trust(v10.cur2, 6000)
+    _allow(v10, v10.cur1, a1)
+    _allow(v10, v10.cur2, a1)
+    v10.issuer.pay(a1, 2000, v10.cur1)
+    v10.issuer.pay(a1, 2000, v10.cur2)
+    assert a1.pay(v10.root, 4 * RESERVE + 3 * FEE)
+    kept = [v10.create_offer(a1, v10.cur1, XLM),
+            v10.create_offer(a1, v10.cur1, XLM),
+            v10.create_offer(a1, XLM, v10.cur1),
+            v10.create_offer(a1, XLM, v10.cur1)]
+    _allow(v10, v10.cur2, a1, authorize=0)
+    v10.upgrade_to_v10()
+    assert all(get_offer(v10.ledger, a1, i) is not None for i in kept)
+    assert native_liab(v10.ledger, a1) == (4000, 2000)
+    assert asset_liab(v10.ledger, a1, v10.cur1) == (4000, 2000)
+    assert asset_liab(v10.ledger, a1, v10.cur2) == (0, 0)
+
+
+def test_v10_unauthorized_still_contribute_delete(v10):
+    _auth_issuer(v10)
+    a1 = v10.root.create(min_bal(10) + 2000 + 10 * FEE)
+    a1.change_trust(v10.cur1, 6000)
+    a1.change_trust(v10.cur2, 6000)
+    _allow(v10, v10.cur1, a1)
+    _allow(v10, v10.cur2, a1)
+    v10.issuer.pay(a1, 2000, v10.cur1)
+    v10.issuer.pay(a1, 2000, v10.cur2)
+    dead = [v10.create_offer(a1, v10.cur1, v10.cur2),
+            v10.create_offer(a1, v10.cur1, v10.cur2),
+            v10.create_offer(a1, v10.cur1, XLM),
+            v10.create_offer(a1, v10.cur1, XLM),
+            v10.create_offer(a1, v10.cur2, v10.cur1),
+            v10.create_offer(a1, v10.cur2, v10.cur1),
+            v10.create_offer(a1, XLM, v10.cur1),
+            v10.create_offer(a1, XLM, v10.cur1)]
+    _allow(v10, v10.cur2, a1, authorize=0)
+    v10.upgrade_to_v10()
+    assert all(get_offer(v10.ledger, a1, i) is None for i in dead)
+    assert native_liab(v10.ledger, a1) == (0, 0)
+    assert asset_liab(v10.ledger, a1, v10.cur1) == (0, 0)
+    assert asset_liab(v10.ledger, a1, v10.cur2) == (0, 0)
+
+
+# =============================== deleted trust lines (1334-1419)
+
+def _deleted_tl_fixture(v10):
+    a1 = v10.root.create(min_bal(4) + 6 * FEE)
+    a1.change_trust(v10.cur1, 6000)
+    a1.change_trust(v10.cur2, 6000)
+    v10.issuer.pay(a1, 2000, v10.cur1)
+    dead = [v10.create_offer(a1, v10.cur1, v10.cur2),
+            v10.create_offer(a1, v10.cur1, v10.cur2)]
+    return a1, dead
+
+
+def test_v10_deleted_selling_trust_line(v10):
+    a1, dead = _deleted_tl_fixture(v10)
+    assert a1.pay(v10.issuer, 2000, v10.cur1)
+    assert a1.change_trust(v10.cur1, 0)
+    v10.upgrade_to_v10()
+    assert all(get_offer(v10.ledger, a1, i) is None for i in dead)
+    assert asset_liab(v10.ledger, a1, v10.cur1) == (0, 0)
+    assert asset_liab(v10.ledger, a1, v10.cur2) == (0, 0)
+
+
+def test_v10_deleted_buying_trust_line(v10):
+    a1, dead = _deleted_tl_fixture(v10)
+    assert a1.change_trust(v10.cur2, 0)
+    v10.upgrade_to_v10()
+    assert all(get_offer(v10.ledger, a1, i) is None for i in dead)
+    assert asset_liab(v10.ledger, a1, v10.cur1) == (0, 0)
+    assert asset_liab(v10.ledger, a1, v10.cur2) == (0, 0)
+
+
+def test_v10_deleted_tl_still_contribute_remain(v10):
+    a1 = v10.root.create(min_bal(10) + 2000 + 12 * FEE)
+    a1.change_trust(v10.cur1, 6000)
+    a1.change_trust(v10.cur2, 6000)
+    v10.issuer.pay(a1, 2000, v10.cur1)
+    v10.issuer.pay(a1, 2000, v10.cur2)
+    assert a1.pay(v10.root, 4 * RESERVE + 3 * FEE)
+    kept = [v10.create_offer(a1, v10.cur1, XLM),
+            v10.create_offer(a1, v10.cur1, XLM),
+            v10.create_offer(a1, XLM, v10.cur1),
+            v10.create_offer(a1, XLM, v10.cur1)]
+    assert a1.pay(v10.issuer, 2000, v10.cur2)
+    assert a1.change_trust(v10.cur2, 0)
+    v10.upgrade_to_v10()
+    assert all(get_offer(v10.ledger, a1, i) is not None for i in kept)
+    assert native_liab(v10.ledger, a1) == (4000, 2000)
+    assert asset_liab(v10.ledger, a1, v10.cur1) == (4000, 2000)
+    assert asset_liab(v10.ledger, a1, v10.cur2) == (0, 0)
+
+
+def test_v10_deleted_tl_still_contribute_delete(v10):
+    a1 = v10.root.create(min_bal(10) + 2000 + 12 * FEE)
+    a1.change_trust(v10.cur1, 6000)
+    a1.change_trust(v10.cur2, 6000)
+    v10.issuer.pay(a1, 2000, v10.cur1)
+    v10.issuer.pay(a1, 2000, v10.cur2)
+    dead = [v10.create_offer(a1, v10.cur1, v10.cur2),
+            v10.create_offer(a1, v10.cur1, v10.cur2),
+            v10.create_offer(a1, v10.cur1, XLM),
+            v10.create_offer(a1, v10.cur1, XLM),
+            v10.create_offer(a1, v10.cur2, v10.cur1),
+            v10.create_offer(a1, v10.cur2, v10.cur1),
+            v10.create_offer(a1, XLM, v10.cur1),
+            v10.create_offer(a1, XLM, v10.cur1)]
+    assert a1.pay(v10.issuer, 2000, v10.cur2)
+    assert a1.change_trust(v10.cur2, 0)
+    v10.upgrade_to_v10()
+    assert all(get_offer(v10.ledger, a1, i) is None for i in dead)
+    assert native_liab(v10.ledger, a1) == (0, 0)
+    assert asset_liab(v10.ledger, a1, v10.cur1) == (0, 0)
+    assert asset_liab(v10.ledger, a1, v10.cur2) == (0, 0)
+
+
+# =============================== base reserve (1687-1896)
+
+def test_reserve_decrease_keeps_offers(v10):
+    """At >=10, halving the reserve runs no prepareLiabilities — offers
+    and liabilities stay (reference 'decrease reserve' from-10 arm, run
+    here with offers created at v10 so liabilities exist up front)."""
+    v10.upgrade_to_v10()
+    a1 = _setup_multi(v10, 4000)
+    offers = _twelve_offers(v10, a1)
+    execute_upgrade(v10.ledger, UT.LEDGER_UPGRADE_BASE_RESERVE, RESERVE // 2)
+    assert v10.ledger.header().baseReserve == RESERVE // 2
+    _check_offers(v10, a1, offers, set())
+    assert native_liab(v10.ledger, a1) == (8000, 4000)
+    assert asset_liab(v10.ledger, a1, v10.cur1) == (8000, 4000)
+    assert asset_liab(v10.ledger, a1, v10.cur2) == (8000, 4000)
+
+
+def test_reserve_increase_pre_v10_keeps_offers(v10):
+    a1 = v10.root.create(2 * min_bal(14) + 3999 + 14 * FEE)
+    a1.change_trust(v10.cur1, 12000)
+    a1.change_trust(v10.cur2, 12000)
+    v10.issuer.pay(a1, 4000, v10.cur1)
+    v10.issuer.pay(a1, 4000, v10.cur2)
+    offers = _twelve_offers(v10, a1)
+    execute_upgrade(v10.ledger, UT.LEDGER_UPGRADE_BASE_RESERVE, 2 * RESERVE)
+    _check_offers(v10, a1, offers, set())      # pre-10: header change only
+
+
+def _reserve_increase_v10(v10):
+    def mk(extra):
+        a = v10.root.create(2 * min_bal(14) + extra + 14 * FEE)
+        a.change_trust(v10.cur1, 12000)
+        a.change_trust(v10.cur2, 12000)
+        v10.issuer.pay(a, 4000, v10.cur1)
+        v10.issuer.pay(a, 4000, v10.cur2)
+        return a
+    a1, a2 = mk(3999), mk(4000)
+    o1 = _twelve_offers(v10, a1)
+    o2 = _twelve_offers(v10, a2)
+    execute_upgrade(v10.ledger, UT.LEDGER_UPGRADE_BASE_RESERVE, 2 * RESERVE)
+    _check_offers(v10, a1, o1, {"native_cur1", "native_cur2"})
+    _check_offers(v10, a2, o2, set())
+    assert native_liab(v10.ledger, a1) == (8000, 0)
+    assert asset_liab(v10.ledger, a1, v10.cur1) == (4000, 4000)
+    assert asset_liab(v10.ledger, a1, v10.cur2) == (4000, 4000)
+    assert native_liab(v10.ledger, a2) == (8000, 4000)
+    assert asset_liab(v10.ledger, a2, v10.cur1) == (8000, 4000)
+    assert asset_liab(v10.ledger, a2, v10.cur2) == (8000, 4000)
+
+
+def test_reserve_increase_v10_erases_underwater_native_sellers(v10):
+    v10.upgrade_to_v10()
+    _reserve_increase_v10(v10)
+
+
+def test_reserve_increase_v13_with_maintain_liabilities(v10):
+    """Same outcome at v13 when cur1 is maintain-liabilities-authorized
+    (reference increaseReserveFromV10(true) arm)."""
+    v10.upgrade_to_v10()
+    execute_upgrade(v10.ledger, UT.LEDGER_UPGRADE_VERSION, 13)
+    _reserve_increase_v10(v10)

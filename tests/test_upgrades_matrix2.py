@@ -1,0 +1,329 @@
+"""Upgrade matrix part 2 (reference UpgradesTests.cpp:240-540, 1986-2058):
+createUpgradesFor listings at/before/without the scheduled time, the
+nomination/apply validity cross-product, LedgerManager applying armed
+upgrades through real closes, invalid upgrades failing the close,
+upgradehistory persistence + close-meta changes, and armed-parameter
+expiration/disarm-on-externalize."""
+
+import pytest
+
+from stellar_core_tpu.herder.upgrades import (
+    UPGRADE_EXPIRATION_SECONDS, UpgradeParameters, Upgrades, UpgradeValidity,
+)
+from stellar_core_tpu.main.application import Application
+from stellar_core_tpu.main.config import Config
+from stellar_core_tpu.testing import AppLedgerAdapter
+from stellar_core_tpu.util.timer import ClockMode, VirtualClock
+from stellar_core_tpu.xdr import LedgerUpgrade, LedgerUpgradeType as UT
+
+from test_ledgertxn import make_header
+
+
+def up(t, v) -> bytes:
+    return LedgerUpgrade(t, v).to_xdr()
+
+
+def armed_params(time=0):
+    """The reference testListUpgrades/testValidateUpgrades arming: version
+    10, fee 100, maxtx 50, reserve 100000000."""
+    p = UpgradeParameters()
+    p.upgrade_time = time
+    p.protocol_version = 10
+    p.base_fee = 100
+    p.max_tx_set_size = 50
+    p.base_reserve = 100_000_000
+    return p
+
+
+def armed_header():
+    h = make_header()
+    h.ledgerVersion = 10
+    h.baseFee = 100
+    h.maxTxSetSize = 50
+    h.baseReserve = 100_000_000
+    h.scpValue.closeTime = 1000
+    return h
+
+
+# ===================== list upgrades (240-320, 491-520)
+
+@pytest.mark.parametrize("time,should_list", [(0, True), (1001, False)])
+def test_list_upgrades_per_type(time, should_list):
+    u = Upgrades(armed_params(time))
+    cases = [
+        ("ledgerVersion", 9, UT.LEDGER_UPGRADE_VERSION, 10),
+        ("baseFee", 50, UT.LEDGER_UPGRADE_BASE_FEE, 100),
+        ("maxTxSetSize", 25, UT.LEDGER_UPGRADE_MAX_TX_SET_SIZE, 50),
+        ("baseReserve", 50_000_000, UT.LEDGER_UPGRADE_BASE_RESERVE,
+         100_000_000),
+    ]
+    for field, lowered, ut, target in cases:
+        h = armed_header()
+        setattr(h, field, lowered)
+        got = u.create_upgrades_for(h, close_time=h.scpValue.closeTime)
+        assert got == ([up(ut, target)] if should_list else []), field
+
+
+@pytest.mark.parametrize("time,should_list", [(0, True), (1001, False)])
+def test_list_upgrades_all_needed(time, should_list):
+    u = Upgrades(armed_params(time))
+    h = armed_header()
+    h.ledgerVersion = 9
+    h.baseFee = 50
+    h.maxTxSetSize = 25
+    h.baseReserve = 50_000_000
+    got = u.create_upgrades_for(h, close_time=h.scpValue.closeTime)
+    want = [up(UT.LEDGER_UPGRADE_VERSION, 10),
+            up(UT.LEDGER_UPGRADE_BASE_FEE, 100),
+            up(UT.LEDGER_UPGRADE_MAX_TX_SET_SIZE, 50),
+            up(UT.LEDGER_UPGRADE_BASE_RESERVE, 100_000_000)]
+    assert got == (want if should_list else [])
+
+
+def test_list_upgrades_nothing_when_at_targets():
+    u = Upgrades(armed_params(0))
+    h = armed_header()
+    assert u.create_upgrades_for(h, close_time=h.scpValue.closeTime) == []
+
+
+# ===================== validate upgrades (324-491)
+
+def base_lh():
+    h = make_header()
+    h.ledgerVersion = 8
+    h.scpValue.closeTime = 1000
+    return h
+
+
+@pytest.mark.parametrize("can_be_valid", [True, False])
+def test_validate_invalid_upgrade_data(can_be_valid):
+    u = Upgrades(armed_params(0 if can_be_valid else 1001))
+    h = base_lh()
+    assert not Upgrades.is_valid_for_apply(b"", h, 10)
+    assert not u.is_valid_for_nomination(b"", h, h.scpValue.closeTime)
+    assert Upgrades.validity_for_apply(b"\x99", h, 10) == \
+        UpgradeValidity.XDR_INVALID
+
+
+@pytest.mark.parametrize("can_be_valid", [True, False])
+def test_validate_version(can_be_valid):
+    """Armed for 10, max supported 10, header at 8 (reference 'version'
+    section): 10 nominates iff the time has come; 9 is apply-valid but
+    never nominated (not armed); 7 is a rollback; 11 is unsupported."""
+    u = Upgrades(armed_params(0 if can_be_valid else 1001))
+    h = base_lh()
+    ct = h.scpValue.closeTime
+
+    def ok(v, nomination):
+        if not Upgrades.is_valid_for_apply(up(UT.LEDGER_UPGRADE_VERSION, v),
+                                           h, 10):
+            return False
+        if nomination and not u.is_valid_for_nomination(
+                up(UT.LEDGER_UPGRADE_VERSION, v), h, ct):
+            return False
+        return True
+
+    assert ok(10, nomination=True) == can_be_valid
+    assert ok(10, nomination=False)
+    assert not ok(9, nomination=True)      # queued is 10, not 9
+    assert ok(9, nomination=False)
+    assert not ok(7, nomination=True)      # 7 < 8: rollback
+    assert not ok(7, nomination=False)
+    assert not ok(11, nomination=True)     # > max supported
+    assert not ok(11, nomination=False)
+
+
+@pytest.mark.parametrize("can_be_valid", [True, False])
+@pytest.mark.parametrize("ut,armed,off_by_one_low,off_by_one_high,zero_ok", [
+    (UT.LEDGER_UPGRADE_BASE_FEE, 100, 99, 101, False),
+    (UT.LEDGER_UPGRADE_MAX_TX_SET_SIZE, 50, 49, 51, True),
+    (UT.LEDGER_UPGRADE_BASE_RESERVE, 100_000_000, 99_999_999, 100_000_001,
+     False),
+])
+def test_validate_value_types(can_be_valid, ut, armed, off_by_one_low,
+                              off_by_one_high, zero_ok):
+    u = Upgrades(armed_params(0 if can_be_valid else 1001))
+    h = base_lh()
+    ct = h.scpValue.closeTime
+
+    def ok(v, nomination):
+        if not Upgrades.is_valid_for_apply(up(ut, v), h, 10):
+            return False
+        if nomination and not u.is_valid_for_nomination(up(ut, v), h, ct):
+            return False
+        return True
+
+    assert ok(armed, nomination=True) == can_be_valid
+    assert not ok(off_by_one_low, nomination=True)
+    assert not ok(off_by_one_high, nomination=True)
+    assert ok(armed, nomination=False)
+    assert ok(off_by_one_low, nomination=False)
+    assert ok(off_by_one_high, nomination=False)
+    # zero is structurally invalid for fee/reserve, allowed for tx count
+    assert Upgrades.is_valid_for_apply(up(ut, 0), h, 10) == zero_ok
+
+
+def test_validate_tx_count_zero_nomination():
+    """A node armed for maxtxsize 0 nominates the 0 upgrade (reference
+    cfg0TxSize arm)."""
+    p = armed_params(0)
+    p.max_tx_set_size = 0
+    u = Upgrades(p)
+    h = base_lh()
+    assert u.is_valid_for_nomination(
+        up(UT.LEDGER_UPGRADE_MAX_TX_SET_SIZE, 0), h, h.scpValue.closeTime)
+
+
+# ===================== ledger manager applies upgrades (521-580)
+
+@pytest.fixture
+def app(tmp_path):
+    cfg = Config.test_config(0)
+    cfg.DATABASE = "sqlite3://:memory:"
+    a = Application(VirtualClock(ClockMode.VIRTUAL_TIME), cfg)
+    a.enable_buckets(str(tmp_path / "b"))
+    a.start()
+    return a
+
+
+def test_ledger_manager_applies_each_upgrade_type(app):
+    p = UpgradeParameters()
+    p.upgrade_time = 0
+    p.protocol_version = 13    # genesis is already 13: nothing to nominate
+    p.base_fee = 1000
+    p.max_tx_set_size = 1300
+    p.base_reserve = 1000
+    app.herder.upgrades.set_parameters(p)
+    adapter = AppLedgerAdapter(app)
+    app.manual_close()
+    h = adapter.header()
+    assert h.ledgerVersion == 13
+    assert h.baseFee == 1000
+    assert h.maxTxSetSize == 1300
+    assert h.baseReserve == 1000
+    # externalized parameters disarm (reference removeUpgrades); the
+    # version target never nominated — the header was already there —
+    # so it stays armed
+    q = app.herder.upgrades.params
+    assert q.protocol_version == 13
+    assert q.base_fee is None
+    assert q.max_tx_set_size is None and q.base_reserve is None
+
+
+def test_upgrade_history_rows_written(app):
+    p = UpgradeParameters()
+    p.upgrade_time = 0
+    p.base_fee = 777
+    app.herder.upgrades.set_parameters(p)
+    app.manual_close()
+    seq = app.ledger_manager.last_closed_ledger_num()
+    rows = app.database.execute(
+        "SELECT ledgerseq, upgradeindex, upgrade FROM upgradehistory"
+    ).fetchall()
+    assert len(rows) == 1
+    assert rows[0][0] == seq
+    assert rows[0][1] == 1                     # 1-indexed like txhistory
+    got = LedgerUpgrade.from_xdr(bytes(rows[0][2]))
+    assert (got.disc, got.value) == (UT.LEDGER_UPGRADE_BASE_FEE, 777)
+
+
+# ===================== upgrade invalid during ledger close (1986-2005)
+
+def _close_with_upgrades(app, upgrades):
+    from stellar_core_tpu.herder.txset import TxSetFrame
+    from stellar_core_tpu.ledger.ledger_manager import LedgerCloseData
+    from stellar_core_tpu.xdr import StellarValue, StellarValueExt
+    lm = app.ledger_manager
+    ts = TxSetFrame(app.config.network_id, lm.lcl_hash, [])
+    sv = StellarValue(
+        txSetHash=ts.get_contents_hash(),
+        closeTime=lm.lcl_header.scpValue.closeTime + 1,
+        upgrades=upgrades, ext=StellarValueExt(0, None))
+    lm.close_ledger(LedgerCloseData(
+        lm.last_closed_ledger_num() + 1, ts, sv))
+
+
+def test_upgrade_invalid_during_ledger_close(app):
+    max_v = app.config.LEDGER_PROTOCOL_VERSION
+    for bad in (up(UT.LEDGER_UPGRADE_VERSION, max_v + 1),     # unsupported
+                up(UT.LEDGER_UPGRADE_VERSION,
+                   app.ledger_manager.lcl_header.ledgerVersion - 1),
+                up(UT.LEDGER_UPGRADE_BASE_FEE, 0),
+                up(UT.LEDGER_UPGRADE_BASE_RESERVE, 0),
+                b"\x00\x00\x00\x63\x00\x00\x00\x07"):         # unknown type
+        before = app.ledger_manager.last_closed_ledger_num()
+        with pytest.raises(RuntimeError):
+            _close_with_upgrades(app, [bad])
+        assert app.ledger_manager.last_closed_ledger_num() == before
+
+
+def test_valid_upgrade_through_direct_close(app):
+    _close_with_upgrades(app, [up(UT.LEDGER_UPGRADE_BASE_FEE, 321)])
+    assert app.ledger_manager.lcl_header.baseFee == 321
+
+
+# ===================== expiration logic (2007-2058)
+
+def test_remove_expired_upgrades():
+    u = Upgrades(armed_params(time=1_000_000))
+    updated = u.remove_applied_and_expired(
+        [], 1_000_000 + UPGRADE_EXPIRATION_SECONDS)
+    assert updated
+    p = u.params
+    assert p.protocol_version is None and p.base_fee is None
+    assert p.max_tx_set_size is None and p.base_reserve is None
+
+
+def test_upgrades_not_yet_expired():
+    u = Upgrades(armed_params(time=1_000_000))
+    updated = u.remove_applied_and_expired(
+        [], 1_000_000 + UPGRADE_EXPIRATION_SECONDS - 1)
+    assert not updated
+    p = u.params
+    assert p.protocol_version == 10 and p.base_fee == 100
+    assert p.max_tx_set_size == 50 and p.base_reserve == 100_000_000
+
+
+# ===================== simulate upgrades (1896-1986)
+
+def _simulate_upgrade_vote(n_armed):
+    """Arm a base-fee upgrade on n_armed of 3 nodes and run consensus
+    (reference 'simulate upgrades' voting distributions): nodes that
+    didn't arm it vote the value down and extract_valid_value strips it,
+    so the network only upgrades when the armed set can win nomination
+    for every close — but once externalized EVERY node applies it."""
+    from stellar_core_tpu.simulation import topologies
+    sim = topologies.core(3, 2)
+    for i, node in enumerate(sim.nodes.values()):
+        if i < n_armed:
+            p = UpgradeParameters()
+            p.upgrade_time = 0
+            p.base_fee = 4321
+            node.app.herder.upgrades.set_parameters(p)
+    sim.start_all_nodes()
+    ok = sim.crank_until(lambda: sim.have_all_externalized(4), 40000)
+    assert ok
+    return [n.app.ledger_manager.lcl_header.baseFee
+            for n in sim.nodes.values()]
+
+
+@pytest.mark.slow
+def test_simulate_upgrades_0_of_3_no_upgrade():
+    assert all(f != 4321 for f in _simulate_upgrade_vote(0))
+
+
+@pytest.mark.slow
+def test_simulate_upgrades_3_of_3_upgrade():
+    assert all(f == 4321 for f in _simulate_upgrade_vote(3))
+
+
+def test_externalized_upgrades_disarm_matching_params_only():
+    u = Upgrades(armed_params(time=1_000_000))
+    # non-matching value: stays armed; matching: disarms
+    assert not u.remove_applied_and_expired(
+        [up(UT.LEDGER_UPGRADE_BASE_FEE, 99)], 1_000_000)
+    assert u.params.base_fee == 100
+    assert u.remove_applied_and_expired(
+        [up(UT.LEDGER_UPGRADE_BASE_FEE, 100)], 1_000_000)
+    assert u.params.base_fee is None
+    assert u.params.protocol_version == 10     # untouched
