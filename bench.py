@@ -160,9 +160,14 @@ def device_full_bench(partial_path: str, batch: int = 8192,
     flush("warm_compile")
 
     # stage 3: replay, tpu backend (cpu leg runs in a scrubbed child so
-    # the ratio's denominator never touches the relay)
+    # the ratio's denominator never touches the relay). The stage flushes
+    # at each internal phase (publish, each replay attempt) so the
+    # orchestrator's stall watchdog sees progress — a mid-stage kill of a
+    # live JAX client is what wedges the relay (r5 postmortem: the
+    # publish+warmup+2-replay stage overran the old single-flush window).
     try:
-        results["replay_tpu"] = replay_bench("tpu")
+        results["replay_tpu"] = replay_bench(
+            "tpu", progress=lambda ph: flush("replay_tpu:" + ph))
     except Exception as e:   # noqa: BLE001 - recorded, not swallowed
         results["replay_tpu_error"] = repr(e)[:400]
     flush("replay_tpu")
@@ -170,7 +175,8 @@ def device_full_bench(partial_path: str, batch: int = 8192,
 
 
 def replay_bench(backend: str, n_checkpoints: int = 4,
-                 txs_per_ledger: int = 100, sigs_per_tx: int = 20) -> dict:
+                 txs_per_ledger: int = 100, sigs_per_tx: int = 20,
+                 progress=None) -> dict:
     """Catchup-replay benchmark: the second north-star metric
     (BASELINE.md: >=5x pubnet replay vs libsodium CPU; reference
     methodology /root/reference/performance-eval/performance-eval.md:52-66).
@@ -193,12 +199,15 @@ def replay_bench(backend: str, n_checkpoints: int = 4,
     from stellar_core_tpu.work.basic_work import State
 
     freq = 8
-    # One bucket shape for the whole replay: the throughput leg already
-    # compiled (and the persistent cache holds) the 8192 kernel, so every
-    # checkpoint prewarm dispatches in that shape instead of cold-compiling
-    # a new one mid-replay (the r4->r5 0.026x pathology: BUCKETS=(1024,)
-    # was never AOT-compiled, and app.start()'s default warmup raced three
-    # other shapes onto the device during the timed window).
+    # One bucket shape for the whole replay, AOT-compiled off the clock in
+    # app.start()'s warmup + the explicit warmup(wait=True) below (the
+    # r4->r5 0.026x pathology: BUCKETS=(1024,) was never AOT-compiled, and
+    # the default warmup raced three other shapes onto the device during
+    # the timed window). 8192 is the shape the throughput leg compiles in
+    # stage 1 — in-memory hit in the same process, persistent-cache hit in
+    # a fresh one. (A 16384 experiment measured NO gain — the drain is not
+    # RTT-bound at this scale — and its one-off cold compile overran the
+    # stall watchdog, which kills the child and wedges the relay.)
     from stellar_core_tpu.crypto.batch_verifier import TpuSigVerifier
     TpuSigVerifier.BUCKETS = (8192,)
     tmp = tempfile.mkdtemp(prefix="sct-replay-")
@@ -280,58 +289,74 @@ def replay_bench(backend: str, n_checkpoints: int = 4,
         lcl = pub.ledger_manager.last_closed_ledger_num()
         tip = ((lcl + 1) // freq) * freq - 1
         dense_past_tip = max(0, lcl - tip)
+        if progress is not None:
+            progress("publish")
 
-        # --- replay it with the target backend ----------------------------
-        with _keys._cache_lock:
-            _keys._verify_cache.clear()   # publish filled the result cache
-        app = make_app(1, False, backend)
-        # account time spent inside the verifier's batch drain: the
-        # crypto-subsystem speedup (whole-checkpoint batch path) reported
-        # alongside the end-to-end ratio
-        crypto = {"s": 0.0, "sigs": 0}
-        _orig_pw = app.sig_verifier.prewarm_many
-        _orig_vm = app.sig_verifier.verify_many
+        # --- replay it with the target backend. Best-of-`repeats` over
+        # the SAME published history: ambient relay latency varies run to
+        # run by several hundred ms per drain, so a single replay is a
+        # noisy sample; each attempt gets a fresh node + cleared caches.
+        def one_replay() -> dict:
+            with _keys._cache_lock:
+                _keys._verify_cache.clear()  # earlier runs filled it
+            app = make_app(1, False, backend)
+            # account time spent inside the verifier's batch drain: the
+            # crypto-subsystem speedup (whole-checkpoint batch path)
+            # reported alongside the end-to-end ratio
+            crypto = {"s": 0.0, "sigs": 0}
+            _orig_pw = app.sig_verifier.prewarm_many
+            _orig_vm = app.sig_verifier.verify_many
 
-        def timed_prewarm(triples):
-            t = time.perf_counter()
-            out = _orig_pw(triples)
-            crypto["s"] += time.perf_counter() - t
-            return out
+            def timed_prewarm(triples):
+                t = time.perf_counter()
+                out = _orig_pw(triples)
+                crypto["s"] += time.perf_counter() - t
+                return out
 
-        def counted_verify_many(triples):
-            # only triples that MISSED the cache reach verify_many — this
-            # is the actual device/CPU crypto work
-            crypto["sigs"] += len(triples)
-            return _orig_vm(triples)
+            def counted_verify_many(triples):
+                # only cache MISSES reach verify_many — this is the
+                # actual device/CPU crypto work
+                crypto["sigs"] += len(triples)
+                return _orig_vm(triples)
 
-        app.sig_verifier.prewarm_many = timed_prewarm
-        app.sig_verifier.verify_many = counted_verify_many
-        app.clock.set_virtual_time(pub.clock.now() + 10.0)
-        v = getattr(app, "sig_verifier", None)
-        if v is not None and hasattr(v, "warmup"):
-            v.warmup(wait=True)           # compile off the clock
-        work = app.catchup_manager.start_catchup(
-            CatchupConfiguration.complete())
-        t0 = time.perf_counter()
-        for _ in range(10**7):
-            if work.is_done():
-                break
-            app.crank(False)
-        wall = time.perf_counter() - t0
-        assert work.state == State.SUCCESS, "catchup replay failed"
-        got = app.ledger_manager.last_closed_ledger_num()
-        assert got == tip, (got, tip)
-        n_ledgers = got - 1   # replayed from genesis
-        # only dense closes inside the replayed range count toward rate
-        n_txs = (dense - dense_past_tip) * txs_per_ledger
-        return {"backend": backend, "ledgers": n_ledgers,
-                "dense_ledgers": dense, "wall_s": round(wall, 3),
-                "ledgers_per_sec": round(n_ledgers / wall, 2),
-                "txs_per_sec": round(n_txs / wall, 1),
-                "txs_per_ledger": txs_per_ledger,
-                "sigs_per_tx": sigs_per_tx,
-                "crypto_s": round(crypto["s"], 3),
-                "crypto_sigs": crypto["sigs"]}
+            app.sig_verifier.prewarm_many = timed_prewarm
+            app.sig_verifier.verify_many = counted_verify_many
+            app.clock.set_virtual_time(pub.clock.now() + 10.0)
+            v = getattr(app, "sig_verifier", None)
+            if v is not None and hasattr(v, "warmup"):
+                v.warmup(wait=True)       # compile off the clock
+            work = app.catchup_manager.start_catchup(
+                CatchupConfiguration.complete())
+            t0 = time.perf_counter()
+            for _ in range(10**7):
+                if work.is_done():
+                    break
+                app.crank(False)
+            wall = time.perf_counter() - t0
+            assert work.state == State.SUCCESS, "catchup replay failed"
+            got = app.ledger_manager.last_closed_ledger_num()
+            assert got == tip, (got, tip)
+            n_ledgers = got - 1   # replayed from genesis
+            # only dense closes inside the replayed range count
+            n_txs = (dense - dense_past_tip) * txs_per_ledger
+            return {"backend": backend, "ledgers": n_ledgers,
+                    "dense_ledgers": dense, "wall_s": round(wall, 3),
+                    "ledgers_per_sec": round(n_ledgers / wall, 2),
+                    "txs_per_sec": round(n_txs / wall, 1),
+                    "txs_per_ledger": txs_per_ledger,
+                    "sigs_per_tx": sigs_per_tx,
+                    "crypto_s": round(crypto["s"], 3),
+                    "crypto_sigs": crypto["sigs"]}
+
+        repeats = int(os.environ.get("BENCH_REPLAY_REPEATS", "2"))
+        best = None
+        for k in range(max(1, repeats)):
+            r = one_replay()
+            if progress is not None:
+                progress("replay%d" % (k + 1))
+            if best is None or r["wall_s"] < best["wall_s"]:
+                best = r
+        return best
     finally:
         shutil.rmtree(tmp, ignore_errors=True)
 
@@ -438,7 +463,9 @@ def main() -> None:
     device_present, info = probe_device(30.0)
     if not device_present:
         errors["device_probe"] = info
-        reprobe_budget = float(os.environ.get("BENCH_REPROBE_S", "180"))
+        # a wedge can clear after many minutes; the headline artifact is
+        # worth waiting for (r5: a stall-kill wedge cleared in ~20 min)
+        reprobe_budget = float(os.environ.get("BENCH_REPROBE_S", "1500"))
         reprobe_dl = time.time() + reprobe_budget
         n_reprobes = 0
         while not device_present and time.time() < reprobe_dl:

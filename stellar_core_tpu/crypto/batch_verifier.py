@@ -281,8 +281,7 @@ class TpuSigVerifier(BatchSigVerifier):
                 jnp.asarray(padded["ay"]), jnp.asarray(padded["a_sign"]),
                 jnp.asarray(padded["ry"]), jnp.asarray(padded["r_sign"]),
                 jnp.asarray(padded["s_nibs"]), jnp.asarray(padded["k_nibs"])))
-            ok = ok[:n] & prep["pre_ok"]
-            out.extend(bool(x) for x in ok)
+            out.extend((ok[:n] & prep["pre_ok"]).tolist())
             self.batches_dispatched += 1
             self.sigs_verified += n
             i += n
