@@ -340,12 +340,22 @@ class BucketList:
                        max_protocol_version: int) -> None:
         """Re-kick merges whose inputs we still hold after a restart
         (reference BucketList::restartMerges, BucketList.cpp:588-640).
-        With shadows removed (protocol >= 12) the next state for level i+1
-        is recomputable from level i's snap."""
+        Only valid with shadows removed (protocol >= 12), where the next
+        state for level i+1 is recomputable from level i's snap alone; a
+        clear next over a pre-12 nonempty snap means the serialized merge
+        state was lost — restarting it shadowless would fork the bucket
+        hash chain, so it is an error (reference :625-648)."""
+        from .bucket import FIRST_PROTOCOL_SHADOWS_REMOVED
         for i in range(1, K_NUM_LEVELS):
             lev = self.levels[i]
             if lev.next.is_clear():
                 snap = self.levels[i - 1].snap
-                if not snap.is_empty():
-                    lev.prepare(self._executor, curr_ledger,
-                                max_protocol_version, snap, [], self._adopt)
+                if snap.is_empty():
+                    continue
+                if snap.get_version() < FIRST_PROTOCOL_SHADOWS_REMOVED:
+                    raise RuntimeError(
+                        "invalid state: level %d has clear future bucket "
+                        "but pre-%d snap" % (i,
+                                             FIRST_PROTOCOL_SHADOWS_REMOVED))
+                lev.prepare(self._executor, curr_ledger,
+                            max_protocol_version, snap, [], self._adopt)

@@ -120,11 +120,16 @@ class BucketManager:
         return dropped
 
     # -- state restore (catchup / restart) -----------------------------------
-    def assume_state(self, level_hashes: Sequence[Dict[str, bytes]],
+    def assume_state(self, level_hashes: Sequence[Dict[str, object]],
                      curr_ledger: int, max_protocol_version: int) -> None:
         """Adopt a full set of level hashes (from a HistoryArchiveState)
-        as the current bucket list, then restart merges (reference
-        BucketManagerImpl::assumeState)."""
+        as the current bucket list, then resume merges (reference
+        BucketManagerImpl::assumeState). Each level dict carries curr/
+        snap plus the serialized next merge: "next_output" (resolved) or
+        "next_curr"/"next_snap"/"next_shadows" (in flight) — the latter
+        is the only way to resume a shadowed pre-12 merge exactly;
+        restarting it shadowless forks the bucket hash chain."""
+        from .bucket_list import FutureBucket, keep_dead_entries
         assert len(level_hashes) == K_NUM_LEVELS
         # resolve every bucket BEFORE mutating any level: a missing file
         # must not leave the list half-adopted
@@ -134,12 +139,38 @@ class BucketManager:
             snap = self.get_bucket_by_hash(lh["snap"])
             if curr is None or snap is None:
                 raise KeyError("missing bucket for level %d" % i)
-            resolved.append((curr, snap))
-        for i, (curr, snap) in enumerate(resolved):
+            nxt = None
+            if lh.get("next_output"):
+                out = self.get_bucket_by_hash(lh["next_output"])
+                if out is None:
+                    raise KeyError("missing next output for level %d" % i)
+                nxt = ("output", out)
+            elif lh.get("next_curr"):
+                mc = self.get_bucket_by_hash(lh["next_curr"])
+                ms = self.get_bucket_by_hash(lh["next_snap"])
+                sh = [self.get_bucket_by_hash(h)
+                      for h in lh.get("next_shadows", [])]
+                if mc is None or ms is None or any(s is None for s in sh):
+                    raise KeyError("missing next inputs for level %d" % i)
+                nxt = ("inputs", (mc, ms, sh))
+            resolved.append((curr, snap, nxt))
+        for i, (curr, snap, nxt) in enumerate(resolved):
             lev = self.bucket_list.get_level(i)
             lev.curr = curr
             lev.snap = snap
             lev.next.clear()
+            if nxt is None:
+                continue
+            kind, payload = nxt
+            if kind == "output":
+                lev.next = FutureBucket.resolved(payload)
+            else:
+                mc, ms, sh = payload
+                lev.next = FutureBucket.start(
+                    self._executor, mc, ms, sh,
+                    keep_dead=keep_dead_entries(i),
+                    max_protocol_version=max_protocol_version,
+                    adopt=self.adopt_bucket)
         self.bucket_list.restart_merges(curr_ledger, max_protocol_version)
 
     def shutdown(self) -> None:

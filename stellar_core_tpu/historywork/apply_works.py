@@ -21,7 +21,7 @@ from typing import Dict, List, Optional, Tuple
 
 from ..crypto.hashing import sha256
 from ..history.archive import HistoryArchive, category_path
-from ..history.archive_state import HistoryArchiveState
+from ..history.archive_state import HistoryArchiveState, has_level_dicts
 from ..history.checkpoints import checkpoints_in_range, first_in_checkpoint
 from ..util.log import get_logger
 from ..util.xdrstream import XDRInputFileStream
@@ -100,11 +100,7 @@ class ApplyBucketsWork(BasicWork):
                  header.ledgerSeq)
 
         if bm is not None:
-            level_hashes = [
-                {"curr": bytes.fromhex(lv.curr),
-                 "snap": bytes.fromhex(lv.snap)}
-                for lv in self.has.levels]
-            bm.assume_state(level_hashes, header.ledgerSeq,
+            bm.assume_state(has_level_dicts(self.has), header.ledgerSeq,
                             header.ledgerVersion)
 
         lm.set_last_closed_ledger(header, self.header_entry.hash)

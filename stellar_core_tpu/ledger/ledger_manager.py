@@ -401,14 +401,14 @@ class LedgerManager:
         s = ps.get_state(ps.kHistoryArchiveState)
         if not s:
             return
-        from ..history.archive_state import HistoryArchiveState
+        from ..history.archive_state import (
+            HistoryArchiveState, has_level_dicts,
+        )
         try:
             has = HistoryArchiveState.from_json(s)
             header = self.lcl_header
-            bm.assume_state(
-                [{"curr": bytes.fromhex(lv.curr),
-                  "snap": bytes.fromhex(lv.snap)} for lv in has.levels],
-                header.ledgerSeq, header.ledgerVersion)
+            bm.assume_state(has_level_dicts(has),
+                            header.ledgerSeq, header.ledgerVersion)
             # the adopted list must hash to what the LCL header committed
             # to — a stale HAS (e.g. written before a bucket-apply catchup
             # fast-forwarded the LCL) silently forks the chain otherwise

@@ -88,6 +88,12 @@ class OperationFrame:
         # apply without this process having run checkValid
         if not self.is_version_supported(ltx.get_header().ledgerVersion):
             return self.set_code(OperationResultCode.opNOT_SUPPORTED)
+        # the op source must exist AT APPLY (reference OperationFrame::
+        # checkValid forApply arm, v8+): an earlier op in the same tx may
+        # have merged it away — that fails THIS op, not the process
+        if ltx.load_without_record(
+                LedgerKey.account(self.source_account_id())) is None:
+            return self.set_code(OperationResultCode.opNO_ACCOUNT)
         return self.do_apply(ltx)
 
     # subclass hooks

@@ -56,6 +56,7 @@ def _offer(acct, selling, buying, amount, n=1, d=1, offer_id=0):
                                      offer_id=offer_id)
 
 
+@pytest.mark.min_version(10)
 def test_full_revoke_pulls_offers(ledger):
     """reference 'denyTrust on selling asset': revoking to 0 deletes the
     trustor's offers in the asset and releases the subentries."""
@@ -80,6 +81,7 @@ def test_full_revoke_pulls_offers(ledger):
     assert get_selling_liabilities(ledger.header(), tle) == 0
 
 
+@pytest.mark.min_version(13)
 def test_maintain_keeps_offers_crossable(ledger):
     """reference "don't pull orders until denyTrust": downgrading to
     MAINTAIN keeps the offer on the book, and it still EXECUTES when
@@ -101,6 +103,7 @@ def test_maintain_keeps_offers_crossable(ledger):
     assert ledger.trust_balance(bob.account_id, usd) == 40
 
 
+@pytest.mark.min_version(13)
 def test_maintain_blocks_new_and_updated_offers(ledger):
     """reference "can't add offer" / "can't update offer": with only
     MAINTAIN, posting or amending offers fails NOT_AUTHORIZED; deleting
@@ -126,6 +129,7 @@ def test_maintain_blocks_new_and_updated_offers(ledger):
     assert ledger.apply_frame(f), f.result
 
 
+@pytest.mark.min_version(13)
 def test_maintain_blocks_payments(ledger):
     """MAINTAIN cannot receive or send the asset (payments need FULL
     authorization)."""
@@ -147,6 +151,7 @@ def test_maintain_blocks_payments(ledger):
                              PaymentResultCode.NOT_AUTHORIZED)
 
 
+@pytest.mark.min_version(13)
 def test_downgrade_needs_revocable(ledger):
     """reference: AUTHORIZED → MAINTAIN is a partial revocation and
     needs AUTH_REVOCABLE; a full revoke needs it too."""

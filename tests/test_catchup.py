@@ -70,8 +70,10 @@ def test_catchup_range_recent():
 
 # ---------------------------------------------------------------- fixtures
 
-def make_app(tmp_path, n, archive_root, writable=True):
+def make_app(tmp_path, n, archive_root, writable=True, protocol=None):
     cfg = Config.test_config(n)
+    if protocol is not None:
+        cfg.LEDGER_PROTOCOL_VERSION = protocol
     cfg.DATABASE = "sqlite3://:memory:"
     cfg.CHECKPOINT_FREQUENCY = FREQ
     arch = HistoryArchive.local_dir("test", str(archive_root))
@@ -375,3 +377,31 @@ def test_replay_history_containing_fee_bump(publisher):
         (lm_b.last_closed_ledger_num(),)).fetchone()[0]
     assert AppLedgerAdapter(app_b).balance(payer.account_id) == \
         ad.balance(payer.account_id)
+
+
+def test_bucket_apply_resumes_pre12_shadowed_merges(tmp_path):
+    """r5 regression: a bucket-apply catchup at protocol < 12 must resume
+    the publisher's in-flight SHADOWED merges exactly — the HAS now
+    serializes each level's next merge (output hash, or input+shadow
+    hashes while in flight), and assume_state reconstructs it. Before the
+    fix, restart_merges re-kicked pre-12 merges shadowless, the replayer's
+    bucketListHash forked on its first own close, and the buffered drain
+    rejected every later ledger ("txset based on wrong ledger")."""
+    archive_root = tmp_path / "archive"
+    os.makedirs(archive_root, exist_ok=True)
+    app_a = make_app(tmp_path, 0, archive_root, protocol=9)
+    close_ledgers_with_traffic(app_a, 2 * FREQ + 3)
+    app_a.crank_until(lambda: app_a.history_manager.publish_queue() == [],
+                      max_cranks=5000)
+    assert app_a.ledger_manager.lcl_header.ledgerVersion == 9
+
+    app_b = make_app(tmp_path, 3, archive_root, writable=False, protocol=9)
+    top = app_a.ledger_manager.last_closed_ledger_num()
+    tip = 2 * FREQ - 1
+    for seq in range(tip + 1, top + 1):
+        app_b.ledger_manager.value_externalized(make_lcd_from_db(app_a, seq))
+    app_b.crank_until(
+        lambda: not app_b.catchup_manager.catchup_running(),
+        max_cranks=200000)
+    assert app_b.ledger_manager.last_closed_ledger_num() == top
+    assert app_b.ledger_manager.lcl_hash == app_a.ledger_manager.lcl_hash

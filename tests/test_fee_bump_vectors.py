@@ -118,6 +118,7 @@ def test_inner_invalid_reports_inner_pair(ledger, root):
     assert pair.result.code == TransactionResultCode.txBAD_SEQ
 
 
+@pytest.mark.min_version(13)
 def test_inner_op_failure_fee_still_charged_to_sponsor(ledger, root):
     """Inner operation fails at apply: the sponsor pays the fee, the
     inner source pays nothing, and the result carries the inner pair

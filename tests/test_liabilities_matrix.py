@@ -1,0 +1,337 @@
+"""Liability-primitive matrix, section-for-section against the reference's
+LiabilitiesTests.cpp (/root/reference/src/ledger/test/LiabilitiesTests.cpp
+:18-1261): the add{Selling,Buying}Liabilities bounds for accounts and
+trustlines, balance/subentry changes against liabilities, and the
+available-balance/limit getters. These primitives underlie every offer,
+payment, and upgrade path — their boundary behavior is consensus-critical.
+
+All cases run at protocol 13 headers (liabilities active); the <10
+behavior (liabilities ignored) is pinned at the end.
+"""
+
+import pytest
+
+from stellar_core_tpu.crypto.keys import SecretKey
+from stellar_core_tpu.testing import genesis_header
+from stellar_core_tpu.transactions.account_helpers import (
+    INT64_MAX, account_available_balance, add_balance,
+    add_buying_liabilities, add_selling_liabilities, add_trust_balance,
+    change_subentries, make_account_entry, max_amount_receive, min_balance,
+    trustline_available_balance,
+)
+from stellar_core_tpu.xdr import (
+    Asset, LedgerEntry, LedgerEntryData, LedgerEntryType, TrustLineEntry,
+    TrustLineEntryExt, TrustLineFlags, _Ext,
+)
+
+RESERVE = 5_000_000
+UINT32_MAX = 2**32 - 1
+
+
+def header(version=13):
+    return genesis_header(ledger_version=version)
+
+
+def account(balance, subentries=0, selling=0, buying=0, init_ext=True):
+    sk = SecretKey.from_seed(b"\x42" * 32)
+    e = make_account_entry(sk.public_key, balance, 1)
+    e.data.value.numSubEntries = subentries
+    if init_ext or selling or buying:
+        from stellar_core_tpu.transactions.account_helpers import (
+            _prepare_liabilities,
+        )
+        li = _prepare_liabilities(e.data.value)
+        li.selling = selling
+        li.buying = buying
+    return e
+
+
+def trustline(balance, limit, selling=0, buying=0, flags=None,
+              init_ext=True):
+    sk = SecretKey.from_seed(b"\x43" * 32)
+    issuer = SecretKey.from_seed(b"\x44" * 32)
+    tl = TrustLineEntry(
+        accountID=sk.public_key,
+        asset=Asset.credit("USD", issuer.public_key),
+        balance=balance, limit=limit,
+        flags=(TrustLineFlags.AUTHORIZED_FLAG if flags is None else flags),
+        ext=TrustLineEntryExt.v0())
+    e = LedgerEntry(lastModifiedLedgerSeq=1,
+                    data=LedgerEntryData(LedgerEntryType.TRUSTLINE, tl),
+                    ext=_Ext.v0())
+    if init_ext or selling or buying:
+        from stellar_core_tpu.transactions.account_helpers import (
+            _prepare_liabilities,
+        )
+        li = _prepare_liabilities(tl)
+        li.selling = selling
+        li.buying = buying
+    return e
+
+
+def liab(e):
+    dv = e.data.value
+    if dv.ext.disc == 0:
+        return (0, 0)
+    li = dv.ext.value.liabilities
+    return (li.buying, li.selling)
+
+
+def mb(n):
+    return min_balance(header(), n)
+
+
+# ============== add account selling liabilities (:25-218)
+
+@pytest.mark.parametrize("subs,balance,init,delta,ok", [
+    # below reserve: unchanged ok, increase fails
+    (0, mb(0) - 1, 0, 0, True),
+    (0, mb(0) - 1, 0, 1, False),
+    # cannot go negative
+    (0, mb(0), 0, 0, True),
+    (0, mb(0), 0, -1, False),
+    (0, mb(0) + 1, 0, -1, False),
+    (0, mb(0) + 1, 1, -1, True),
+    (0, mb(0) + 1, 1, -2, False),
+    (0, mb(0) + 2, 1, -1, True),
+    (0, mb(0) + 2, 1, -2, False),
+    # cannot exceed balance minus reserve
+    (0, mb(0), 0, 1, False),
+    (0, mb(0) + 1, 0, 1, True),
+    (0, mb(0) + 1, 0, 2, False),
+    (0, mb(0) + 1, 1, 0, True),
+    (0, mb(0) + 1, 1, 1, False),
+    (0, mb(0) + 2, 1, 1, True),
+    (0, mb(0) + 2, 1, 2, False),
+    # limiting values
+    (0, INT64_MAX, 0, INT64_MAX - mb(0), True),
+    (0, INT64_MAX, 0, INT64_MAX - mb(0) + 1, False),
+])
+def test_account_selling_liabilities(subs, balance, init, delta, ok):
+    e = account(balance, subs, selling=init)
+    before = e.to_xdr()
+    res = add_selling_liabilities(header(), e, delta)
+    assert res == ok
+    assert e.data.value.balance == balance          # balance untouched
+    if ok:
+        assert liab(e) == (0, init + delta)
+    else:
+        assert e.to_xdr() == before                  # failure mutates nothing
+
+
+def test_account_selling_uninitialized_ext():
+    h = header()
+    # failure leaves the extension uninitialized
+    e = account(mb(0), init_ext=False)
+    assert not add_selling_liabilities(h, e, 1)
+    assert e.data.value.ext.disc == 0
+    # delta 0 succeeds without initializing
+    e = account(mb(0), init_ext=False)
+    assert add_selling_liabilities(h, e, 0)
+    assert e.data.value.ext.disc == 0
+    # nonzero success initializes v1
+    e = account(mb(0) + 1, init_ext=False)
+    assert add_selling_liabilities(h, e, 1)
+    assert e.data.value.ext.disc == 1
+    assert liab(e) == (0, 1)
+
+
+# ============== add account buying liabilities (:219-437)
+
+@pytest.mark.parametrize("subs,balance,init,delta,ok", [
+    # buying has NO reserve constraint: below-reserve increase is fine
+    (0, mb(0) - 1, 1, 1, True),
+    # cannot go negative
+    (0, mb(0), 0, 0, True),
+    (0, mb(0), 0, -1, False),
+    (0, mb(0), 1, -1, True),
+    (0, mb(0), 1, -2, False),
+    # cannot exceed INT64_MAX - balance
+    (0, INT64_MAX, 0, 1, False),
+    (0, INT64_MAX - 1, 0, 1, True),
+    (0, INT64_MAX - 1, 0, 2, False),
+    (0, INT64_MAX - 1, 1, 0, True),
+    (0, INT64_MAX - 1, 1, 1, False),
+    (UINT32_MAX, INT64_MAX // 2 + 1, 0, INT64_MAX // 2 + 1, False),
+    (UINT32_MAX, INT64_MAX // 2, 0, INT64_MAX // 2 + 1, True),
+    (UINT32_MAX, INT64_MAX // 2, 0, INT64_MAX // 2 + 2, False),
+])
+def test_account_buying_liabilities(subs, balance, init, delta, ok):
+    e = account(balance, subs, buying=init)
+    before = e.to_xdr()
+    res = add_buying_liabilities(header(), e, delta)
+    assert res == ok
+    assert e.data.value.balance == balance
+    if ok:
+        assert liab(e) == (init + delta, 0)
+    else:
+        assert e.to_xdr() == before
+
+
+# ============== add trustline selling liabilities (:438-579)
+
+@pytest.mark.parametrize("balance,limit,init,delta,ok", [
+    # cannot go negative
+    (0, 10, 0, -1, False),
+    (1, 10, 1, -1, True),
+    (1, 10, 1, -2, False),
+    # cannot exceed balance
+    (0, 10, 0, 1, False),
+    (1, 10, 0, 1, True),
+    (1, 10, 0, 2, False),
+    (2, 10, 1, 1, True),
+    (2, 10, 1, 2, False),
+    # limiting values
+    (INT64_MAX, INT64_MAX, 0, INT64_MAX, True),
+    (INT64_MAX - 1, INT64_MAX, 0, INT64_MAX, False),
+])
+def test_trustline_selling_liabilities(balance, limit, init, delta, ok):
+    e = trustline(balance, limit, selling=init)
+    before = e.to_xdr()
+    res = add_selling_liabilities(header(), e, delta)
+    assert res == ok
+    assert e.data.value.balance == balance
+    if ok:
+        assert liab(e) == (0, init + delta)
+    else:
+        assert e.to_xdr() == before
+
+
+def test_trustline_selling_requires_authorization():
+    e = trustline(5, 10, flags=0)
+    assert not add_selling_liabilities(header(), e, 1)
+    # maintain-liabilities level is enough (CAP-0018)
+    e = trustline(
+        5, 10, flags=TrustLineFlags.AUTHORIZED_TO_MAINTAIN_LIABILITIES_FLAG)
+    assert add_selling_liabilities(header(), e, 1)
+
+
+# ============== add trustline buying liabilities (:580-722)
+
+@pytest.mark.parametrize("balance,limit,init,delta,ok", [
+    (0, 10, 0, -1, False),
+    (0, 10, 1, -1, True),
+    (0, 10, 1, -2, False),
+    # cannot exceed limit - balance
+    (0, 10, 0, 10, True),
+    (0, 10, 0, 11, False),
+    (5, 10, 0, 5, True),
+    (5, 10, 0, 6, False),
+    (5, 10, 4, 1, True),
+    (5, 10, 4, 2, False),
+    # limiting values
+    (0, INT64_MAX, 0, INT64_MAX, True),
+    (1, INT64_MAX, 0, INT64_MAX, False),
+])
+def test_trustline_buying_liabilities(balance, limit, init, delta, ok):
+    e = trustline(balance, limit, buying=init)
+    before = e.to_xdr()
+    res = add_buying_liabilities(header(), e, delta)
+    assert res == ok
+    if ok:
+        assert liab(e) == (init + delta, 0)
+    else:
+        assert e.to_xdr() == before
+
+
+# ============== balance with liabilities (:722-992)
+
+@pytest.mark.parametrize("subs,balance,selling,buying,delta,ok", [
+    # decrease respects reserve + selling liabilities
+    (0, mb(0) + 1, 0, 0, -1, True),
+    (0, mb(0) + 1, 0, 0, -2, False),
+    (0, mb(0) + 2, 1, 0, -1, True),
+    (0, mb(0) + 2, 1, 0, -2, False),
+    # increase respects INT64_MAX - buying
+    (0, INT64_MAX - 1, 0, 0, 1, True),
+    (0, INT64_MAX - 1, 0, 1, 1, False),
+    (0, INT64_MAX - 2, 0, 1, 1, True),
+    # zero delta always fine
+    (0, mb(0), 0, 0, 0, True),
+])
+def test_account_add_balance_with_liabilities(subs, balance, selling,
+                                              buying, delta, ok):
+    e = account(balance, subs, selling=selling, buying=buying)
+    res = add_balance(header(), e, delta)
+    assert res == ok
+    assert e.data.value.balance == (balance + delta if ok else balance)
+
+
+@pytest.mark.parametrize("subs,balance,selling,delta,ok", [
+    # adding a subentry needs reserve for the NEW count plus selling
+    (0, mb(1), 0, 1, True),
+    (0, mb(1) - 1, 0, 1, False),
+    (0, mb(1) + 1, 1, 1, True),
+    (0, mb(1), 1, 1, False),
+    # removing always fine (never below zero)
+    (1, mb(0), 0, -1, True),
+    (0, mb(0), 0, -1, False),
+])
+def test_account_change_subentries(subs, balance, selling, delta, ok):
+    e = account(balance, subs, selling=selling)
+    res = change_subentries(header(), e, delta)
+    assert res == ok
+    assert e.data.value.numSubEntries == (subs + delta if ok else subs)
+
+
+@pytest.mark.parametrize("balance,limit,selling,buying,delta,ok", [
+    # decrease cannot dip below selling liabilities
+    (2, 10, 1, 0, -1, True),
+    (2, 10, 1, 0, -2, False),
+    # increase cannot exceed limit - buying
+    (5, 10, 0, 4, 1, True),
+    (5, 10, 0, 5, 1, False),
+    (9, 10, 0, 0, 1, True),
+    (10, 10, 0, 0, 1, False),
+])
+def test_trustline_add_balance_with_liabilities(balance, limit, selling,
+                                                buying, delta, ok):
+    e = trustline(balance, limit, selling=selling, buying=buying)
+    res = add_trust_balance(header(), e, delta)
+    assert res == ok
+    assert e.data.value.balance == (balance + delta if ok else balance)
+
+
+# ============== available balance and limit (:994-1261)
+
+def test_account_available_balance():
+    h = header()
+    assert account_available_balance(
+        h, account(mb(0)).data.value) == 0
+    assert account_available_balance(
+        h, account(mb(0) + 5).data.value) == 5
+    assert account_available_balance(
+        h, account(mb(0) + 5, selling=3).data.value) == 2
+    assert account_available_balance(
+        h, account(mb(2), 2).data.value) == 0
+
+
+def test_account_available_limit():
+    h = header()
+    e = account(100, buying=7)
+    assert max_amount_receive(h, e) == INT64_MAX - 100 - 7
+    e = account(INT64_MAX)
+    assert max_amount_receive(h, e) == 0
+
+
+def test_trustline_available_balance_and_limit():
+    h = header()
+    tl = trustline(10, 100, selling=4)
+    assert trustline_available_balance(h, tl.data.value) == 6
+    tl = trustline(10, 100, buying=7)
+    assert max_amount_receive(h, tl) == 100 - 10 - 7
+    # unauthorized line can receive nothing
+    tl = trustline(10, 100, flags=0)
+    assert max_amount_receive(h, tl) == 0
+
+
+# ============== pre-10 behavior: liabilities ignored
+
+def test_pre10_liabilities_ignored():
+    h = header(version=9)
+    # getters report zero regardless of the extension
+    e = account(mb(0) + 10, selling=7, buying=5)
+    assert account_available_balance(h, e.data.value) == 10
+    # balance moves ignore liabilities below protocol 10
+    assert add_balance(h, e, -10)
+    assert e.data.value.balance == mb(0)

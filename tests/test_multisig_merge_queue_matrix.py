@@ -68,6 +68,7 @@ def test_bad_thresholds_out_of_range(ledger, root):
         assert inner_code(f) == SetOptionsResultCode.THRESHOLD_OUT_OF_RANGE
 
 
+@pytest.mark.min_version(10)
 def test_signer_weight_above_255_bad_signer(ledger, root):
     """reference SetOptionsTests.cpp 'invalid signer weight' (v10+)."""
     a = root.create(10**9)
@@ -258,6 +259,7 @@ def test_preauth_tx_applies_unsigned_and_is_consumed(ledger, root):
     assert not ledger.apply_frame(future2)
 
 
+@pytest.mark.min_version(10)
 def test_preauth_consumed_even_when_tx_fails(ledger, root):
     """v13: the pre-auth signer is consumed when the tx reaches signature
     processing and FAILS in its ops (reference processSignatures →

@@ -12,6 +12,9 @@ sweeps live in test_protocol_matrix.py.
 
 import pytest
 
+# the whole matrix runs at protocol-13 semantics (module docstring)
+pytestmark = pytest.mark.min_version(13)
+
 from stellar_core_tpu.crypto.keys import SecretKey
 from stellar_core_tpu.testing import TestAccount, TestLedger
 from stellar_core_tpu.transactions.offers import ManageOfferResultCode

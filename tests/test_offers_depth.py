@@ -166,6 +166,7 @@ def test_herder_rejects_bad_close_times():
         ValidationLevel.INVALID
 
 
+@pytest.mark.min_version(11)
 def test_combine_candidates_prefers_size_then_fees():
     """reference HerderSCPDriver::combineCandidates + compareTxSets: the
     winning txset has the most capacity units, then (v11+) the highest
@@ -229,6 +230,7 @@ def test_combine_candidates_prefers_size_then_fees():
     assert got.txSetHash == low.get_contents_hash()
 
 
+@pytest.mark.min_version(11)
 def test_signed_stellar_values_rules():
     """v11+ nomination values must be SIGNED and verify; ballot values
     must be BASIC (reference validateValueHelper:203-334,

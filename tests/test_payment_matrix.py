@@ -1,0 +1,182 @@
+"""Payment/merge interleaving matrix, section-for-section against the
+reference's PaymentTests.cpp (/root/reference/src/transactions/test/
+PaymentTests.cpp:105-1490, modern protocol arms) beyond the basics in
+test_transactions.py: multi-op transactions where an account merges away
+mid-tx and later ops reference it — the account-lifecycle edge cases
+where atomic-rollback semantics decide the chain."""
+
+import pytest
+
+from stellar_core_tpu.testing import TestAccount, TestLedger, root_secret_key
+from stellar_core_tpu.transactions.operations import PaymentResultCode
+from stellar_core_tpu.xdr import (
+    LedgerKey, OperationBody, OperationResultCode, OperationType,
+    TransactionResultCode,
+)
+
+FEE = 100
+RESERVE = 5_000_000
+MIN0 = 2 * RESERVE
+
+
+@pytest.fixture
+def ledger():
+    return TestLedger()
+
+
+@pytest.fixture
+def root(ledger):
+    return TestAccount(ledger, root_secret_key())
+
+
+def merge_op(src: TestAccount, dest: TestAccount):
+    return src.op(OperationBody(OperationType.ACCOUNT_MERGE, dest.muxed),
+                  source=src.account_id)
+
+
+def op_code(frame, i):
+    return frame.result.op_results[i].disc
+
+
+def inner(frame, i):
+    return frame.result.op_results[i].value.value
+
+
+def test_a_pays_b_then_a_merges_into_b(ledger, root):
+    a = root.create(MIN0 + 10**7)
+    b = root.create(MIN0 + 10**6)
+    a_bal, b_bal = a.balance(), b.balance()
+    f = a.tx([a.op_payment(b.account_id, 200), merge_op(a, b)])
+    assert ledger.apply_frame(f), f.result
+    assert not ledger.account_exists(a.account_id)
+    assert ledger.account_exists(b.account_id)
+    assert ledger.balance(b.account_id) == a_bal + b_bal - f.fee_bid
+
+
+def test_a_pays_b_then_b_merges_into_a(ledger, root):
+    a = root.create(MIN0 + 10**7)
+    b = root.create(MIN0 + 10**6)
+    a_bal, b_bal = a.balance(), b.balance()
+    f = a.tx([a.op_payment(b.account_id, 200), merge_op(b, a)],
+             extra_signers=[b.sk])
+    assert ledger.apply_frame(f), f.result
+    assert ledger.account_exists(a.account_id)
+    assert not ledger.account_exists(b.account_id)
+    assert ledger.balance(a.account_id) == a_bal + b_bal - f.fee_bid
+
+
+def test_merge_then_send_fails_atomically(ledger, root):
+    """Post-8 arm: the payment after the merge sees no source account,
+    the tx FAILS, and every op (including the merge) rolls back."""
+    a = root.create(MIN0 + 10**7)
+    b = root.create(MIN0)
+    a_bal, b_bal = a.balance(), b.balance()
+    f = a.tx([merge_op(a, b), a.op_payment(b.account_id, 200)])
+    assert not ledger.apply_frame(f)
+    assert f.result.code == TransactionResultCode.txFAILED
+    assert ledger.account_exists(a.account_id)
+    assert ledger.account_exists(b.account_id)
+    assert ledger.balance(b.account_id) == b_bal
+    assert ledger.balance(a.account_id) == a_bal - f.fee_bid
+    assert op_code(f, 1) == OperationResultCode.opNO_ACCOUNT
+
+
+def test_payment_no_destination(ledger, root):
+    from stellar_core_tpu.crypto.keys import SecretKey
+    ghost = SecretKey.pseudo_random_for_testing()
+    before = root.balance()
+    f = root.tx([root.op_payment(ghost.public_key, MIN0)])
+    assert not ledger.apply_frame(f)
+    assert inner(f, 0).disc == PaymentResultCode.NO_DESTINATION
+    assert root.balance() == before - FEE
+
+
+def test_rescue_account_below_reserve(ledger, root):
+    b = root.create(MIN0 + 1000)
+    # raise the reserve out from under b (direct header edit, like the
+    # reference's LedgerTxn header mutation)
+    from stellar_core_tpu.ledger.ledgertxn import LedgerTxn
+    add_reserve = 100_000
+    with LedgerTxn(ledger.root) as ltx:
+        ltx.load_header().baseReserve += add_reserve
+    f = b.tx([b.op_payment(root.account_id, 1)])
+    assert not ledger.apply_frame(f)
+    assert f.result.code == TransactionResultCode.txINSUFFICIENT_BALANCE
+    # top up past the new reserve: payments work again
+    assert root.pay(b, 2 * add_reserve + 2 * FEE)
+    assert b.pay(root, 1)
+
+
+def test_two_payments_first_breaking_second(ledger, root):
+    """v9+ arm: the second tx fails at APPLY with UNDERFUNDED (it was
+    valid when admitted; the first payment broke it)."""
+    pay = 10**6
+    b = root.create(pay + 5 + MIN0 + 2 * FEE)
+    root_bal = root.balance()
+    t1 = b.tx([b.op_payment(root.account_id, pay)])
+    t2 = b.tx([b.op_payment(root.account_id, 6)], seq=b.next_seq() + 1)
+    ok = ledger.close_with([t1, t2])
+    assert ok == [True, False]
+    assert t2.result.code == TransactionResultCode.txFAILED
+    assert inner(t2, 0).disc == PaymentResultCode.UNDERFUNDED
+    assert b.balance() == MIN0 + 5
+    assert ledger.balance(root.account_id) == root_bal + pay
+
+
+def test_create_merge_pay_self_two_accounts(ledger, root):
+    """Post-8 arm (:438-473): create a new account, merge into it, then
+    pay SELF — the third op references the merged-away source, so the
+    whole tx fails and rolls back; only fee+seq survive."""
+    amount = 300_000_000_000_000
+    create_amount = 500_000_000
+    src = root.create(amount)
+    from stellar_core_tpu.crypto.keys import SecretKey
+    new_sk = SecretKey.pseudo_random_for_testing()
+    new_acc = TestAccount(ledger, new_sk)
+    seq_before = ledger.seq_num(src.account_id)
+    f = src.tx([src.op_create_account(new_sk.public_key, create_amount),
+                merge_op(src, new_acc),
+                src.op_payment(src.account_id, 200_000_000)])
+    assert not ledger.apply_frame(f)
+    assert f.result.code == TransactionResultCode.txFAILED
+    assert ledger.account_exists(src.account_id)
+    assert not ledger.account_exists(new_sk.public_key)
+    assert src.balance() == amount - f.fee_bid
+    assert ledger.seq_num(src.account_id) == seq_before + 1
+    # per-op results: create ok, merge ok (with the source balance it
+    # moved), pay opNO_ACCOUNT
+    assert op_code(f, 0) == OperationResultCode.opINNER
+    assert inner(f, 0).disc == 0
+    assert op_code(f, 1) == OperationResultCode.opINNER
+    assert inner(f, 1).disc == 0
+    assert inner(f, 1).value == amount - create_amount - f.fee_bid
+    assert op_code(f, 2) == OperationResultCode.opNO_ACCOUNT
+
+
+def test_pay_self_merge_pay_self_merge(ledger, root):
+    """:1050 family (post-10 arm): self-payment is a no-op; after the op
+    source merges away, the second self-payment fails the tx."""
+    a = root.create(MIN0 + 10**7)
+    b = root.create(MIN0 + 10**6)
+    a_bal = a.balance()
+    f = a.tx([a.op_payment(a.account_id, 100),
+              merge_op(a, b),
+              a.op_payment(a.account_id, 100)])
+    assert not ledger.apply_frame(f)
+    assert f.result.code == TransactionResultCode.txFAILED
+    assert ledger.account_exists(a.account_id)
+    assert a.balance() == a_bal - f.fee_bid
+    assert op_code(f, 2) == OperationResultCode.opNO_ACCOUNT
+
+
+def test_merge_source_then_recreate_in_same_close(ledger, root):
+    """:963 family — create + path of merges across two txs in ONE close:
+    tx1 merges a into b, tx2 (from b) recreates a; both apply."""
+    a = root.create(MIN0 + 10**7)
+    b = root.create(MIN0 + 10**7)
+    a_id = a.account_id
+    t1 = a.tx([merge_op(a, b)])
+    t2 = b.tx([b.op_create_account(a_id, MIN0)])
+    assert ledger.close_with([t1, t2]) == [True, True]
+    assert ledger.account_exists(a_id)
+    assert ledger.balance(a_id) == MIN0

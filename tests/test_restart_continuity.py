@@ -5,6 +5,8 @@ loadLastKnownLedger + Herder::restoreState)."""
 
 import sqlite3
 
+import pytest
+
 from stellar_core_tpu.crypto import strkey
 from stellar_core_tpu.crypto.hashing import sha256
 from stellar_core_tpu.crypto.keys import SecretKey
@@ -206,6 +208,7 @@ def test_inflation_not_supported_from_protocol_12():
 
 # ------------------------------------------------- transaction meta rows
 
+@pytest.mark.min_version(10)
 def test_txmeta_and_feehistory_rows(tmp_path):
     """Closes persist TransactionMeta (per-op LedgerEntryChanges) and the
     fee-processing changes (reference txhistory.txmeta + txfeehistory)."""

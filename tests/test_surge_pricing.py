@@ -24,6 +24,7 @@ def _mk_set(ledger, frames):
     return TxSetFrame(ledger.network_id, b"\x00" * 32, frames)
 
 
+@pytest.mark.min_version(11)
 def test_surge_basic_single_account(ledger):
     """reference surgeTest 'basic single account' (protocol current):
     the kept txs form a seq-ordered PREFIX of the account's chain and the
@@ -115,6 +116,7 @@ def test_surge_max_zero_empties_set(ledger):
     assert ts.size_ops() == 0
 
 
+@pytest.mark.min_version(11)
 def test_base_fee_applies_only_near_capacity(ledger):
     """reference HerderTests 'txset base fee': from protocol 11, when the
     set is within MAX_OPS_PER_TX of capacity every tx pays the LOWEST

@@ -607,6 +607,7 @@ def test_muxed_destination_and_memo_types(ledger, root):
         assert ledger.balance(b.account_id) == bal_b + 111
 
 
+@pytest.mark.min_version(10)
 def test_seq_consumed_at_apply_not_fee_time(ledger, root):
     """v10+ semantics: sequence numbers are consumed during APPLY, not when
     taking fees (reference processFeeSeqNum:530-538 consumes only <= v9;

@@ -171,6 +171,7 @@ def test_batch_single_tx_wrapped_by_different_account_success(ledger, root):
     assert ledger.balance(a.account_id) == before - 1000  # a paid, b fee'd
 
 
+@pytest.mark.min_version(10)
 def test_batch_one_invalid_tx_other_applies(ledger, root):
     a = root.create(AMOUNT)
     b = root.create(AMOUNT)
@@ -233,6 +234,7 @@ def test_switch_into_regular_account_one_op(ledger, root):
     assert f.result.code == TransactionResultCode.txSUCCESS
 
 
+@pytest.mark.min_version(10)
 def test_switch_into_regular_account_two_ops_v13(ledger, root):
     """Removing the co-signer in op 1 does NOT invalidate op 2 at v10+:
     the signature set resolved before apply (reference :1525 from-10
@@ -250,12 +252,14 @@ def test_switch_into_regular_account_two_ops_v13(ledger, root):
     assert ledger.apply_frame(f), f.result
 
 
+@pytest.mark.min_version(10)
 def test_change_thresholds_twice_v13(ledger, root):
     a = root.create(AMOUNT)
     f = a.tx([a.op_set_options(high=3), a.op_set_options(high=3)])
     assert ledger.apply_frame(f), f.result
 
 
+@pytest.mark.min_version(10)
 def test_lower_master_weight_twice_v13(ledger, root):
     a = root.create(AMOUNT)
     assert ledger.apply_frame(a.tx([a.op_set_options(
@@ -265,6 +269,7 @@ def test_lower_master_weight_twice_v13(ledger, root):
     assert ledger.apply_frame(f), f.result
 
 
+@pytest.mark.min_version(10)
 def test_remove_signer_then_do_something_v13(ledger, root):
     a = root.create(AMOUNT)
     b = root.create(AMOUNT)
@@ -282,6 +287,7 @@ def test_remove_signer_then_do_something_v13(ledger, root):
     assert len(e.data.value.signers) == 0
 
 
+@pytest.mark.min_version(10)
 def test_merge_signing_account_by_destination_v13(ledger, root):
     """b's tx restores a's master key then merges a into b; the second
     op still applies under the pre-tx signature set (reference :1558

@@ -79,6 +79,7 @@ def app(tmp_path):
     return a
 
 
+@pytest.mark.min_version(13)
 def test_armed_upgrades_apply_through_consensus(app):
     """Arm fee+version upgrades on a standalone node: the next closes
     nominate and APPLY them — header changes and future txs pay the new

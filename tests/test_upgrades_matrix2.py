@@ -186,6 +186,7 @@ def app(tmp_path):
     return a
 
 
+@pytest.mark.min_version(13)
 def test_ledger_manager_applies_each_upgrade_type(app):
     p = UpgradeParameters()
     p.upgrade_time = 0
