@@ -352,10 +352,17 @@ class BucketList:
                 snap = self.levels[i - 1].snap
                 if snap.is_empty():
                     continue
-                if snap.get_version() < FIRST_PROTOCOL_SHADOWS_REMOVED:
+                version = snap.get_version()
+                if version < FIRST_PROTOCOL_SHADOWS_REMOVED:
                     raise RuntimeError(
                         "invalid state: level %d has clear future bucket "
                         "but pre-%d snap" % (i,
                                              FIRST_PROTOCOL_SHADOWS_REMOVED))
-                lev.prepare(self._executor, curr_ledger,
-                            max_protocol_version, snap, [], self._adopt)
+                # round the ledger down to when the merge was STARTED and
+                # merge at the snap's own version — prepare()'s
+                # pending-snapshot branch keys off the merge-start ledger,
+                # and a mid-window restart ledger could flip its curr-vs-
+                # empty decision (reference restartMerges:650-654)
+                merge_start = mask(curr_ledger, level_half(i - 1))
+                lev.prepare(self._executor, merge_start,
+                            version, snap, [], self._adopt)

@@ -317,23 +317,23 @@ class ChangeTrustOpFrame(OperationFrame):
         key = LedgerKey.trustline(src_id, b.line)
         existing = ltx.load(key)
         if existing is not None:
+            # reference order (ChangeTrustOpFrame.cpp:66-93): the limit
+            # floor first — balance + buying liabilities (v10+; the
+            # helper reports 0 below 10) — THEN delete-without-issuer is
+            # legal, and only a non-delete edit needs a live issuer
             from .account_helpers import get_buying_liabilities
             tl = existing.data.value
+            if b.limit < tl.balance + get_buying_liabilities(header,
+                                                             existing):
+                return self.set_inner(ChangeTrustResultCode.INVALID_LIMIT)
             if b.limit == 0:
-                # cannot delete a trustline that open offers encumber
-                if tl.balance != 0 or \
-                        get_buying_liabilities(header, existing) != 0:
-                    return self.set_inner(
-                        ChangeTrustResultCode.INVALID_LIMIT)
                 ltx.erase(key)
                 src = load_account(ltx, src_id)
                 change_subentries(header, src, -1)
                 return self.set_inner(ChangeTrustResultCode.SUCCESS)
-            # new limit must cover balance + buying liabilities (reference
-            # ChangeTrustOpFrame::doApply protocol >= 10)
-            if b.limit < tl.balance + get_buying_liabilities(header,
-                                                             existing):
-                return self.set_inner(ChangeTrustResultCode.INVALID_LIMIT)
+            if ltx.load_without_record(
+                    LedgerKey.account(b.line.issuer)) is None:
+                return self.set_inner(ChangeTrustResultCode.NO_ISSUER)
             tl.limit = b.limit
             return self.set_inner(ChangeTrustResultCode.SUCCESS)
         if b.limit == 0:
@@ -595,6 +595,11 @@ class BumpSequenceOpFrame(OperationFrame):
 
     def threshold_level(self) -> int:
         return ThresholdLevel.LOW
+
+    def is_version_supported(self, ledger_version: int) -> bool:
+        # introduced in protocol 10 (reference BumpSequenceOpFrame::
+        # isVersionSupported)
+        return ledger_version >= 10
 
     def do_check_valid(self, header) -> bool:
         if self.op.body.value.bumpTo < 0:

@@ -1,0 +1,227 @@
+"""Subentry-op × liability interactions, ported from the reference's
+ChangeTrustTests.cpp (:39-245), SetOptionsTests.cpp (:44-130),
+ManageDataTests.cpp (:122-160) and BumpSequenceTests.cpp (:38-78): ops
+that ADD a subentry must clear the reserve INCLUDING native selling
+liabilities (v10+), buying liabilities never count against the reserve,
+trustline limits can't shrink below encumbrance, and the self-trust /
+missing-issuer / bump-sequence edges."""
+
+import pytest
+
+from stellar_core_tpu.crypto.keys import SecretKey
+from stellar_core_tpu.testing import TestAccount, TestLedger, root_secret_key
+from stellar_core_tpu.transactions.operations import (
+    BumpSequenceResultCode, ChangeTrustResultCode, ManageDataResultCode,
+    SetOptionsResultCode,
+)
+from stellar_core_tpu.xdr import Asset, OperationResultCode
+
+XLM = Asset.native()
+FEE = 100
+RESERVE = 5_000_000
+INT64_MAX = 2**63 - 1
+
+
+def min_bal(n):
+    return (2 + n) * RESERVE
+
+
+@pytest.fixture
+def ledger():
+    return TestLedger()
+
+
+@pytest.fixture
+def root(ledger):
+    return TestAccount(ledger, root_secret_key())
+
+
+def inner(frame, i=0):
+    return frame.result.op_results[i].value.value
+
+
+def _with_native_liability(root, ledger, side):
+    """Account one stroop short of affording another subentry, with a
+    500-unit native offer on `side` ('selling' or 'buying')."""
+    acc = root.create(min_bal(2) + 2 * FEE + 500 - 1)
+    cur = Asset.credit("CUR1", acc.account_id)   # own asset: no trustline
+    if side == "selling":
+        f = acc.tx([acc.op_manage_sell_offer(XLM, cur, 500, 1, 1)])
+    else:
+        f = acc.tx([acc.op_manage_sell_offer(cur, XLM, 500, 1, 1)])
+    assert ledger.apply_frame(f), f.result
+    return acc
+
+
+def test_change_trust_with_native_selling_liabilities(ledger, root):
+    """v10+: the selling liability encumbers the reserve, so the new
+    trustline's subentry can't be afforded until topped up."""
+    acc = _with_native_liability(root, ledger, "selling")
+    idr = Asset.credit("IDR", root.account_id)
+    f = acc.tx([acc.op_change_trust(idr, 1000)])
+    assert not ledger.apply_frame(f)
+    assert inner(f).disc == ChangeTrustResultCode.LOW_RESERVE
+    assert root.pay(acc, FEE + 1)
+    assert acc.change_trust(idr, 1000)
+
+
+def test_change_trust_with_native_buying_liabilities(ledger, root):
+    acc = _with_native_liability(root, ledger, "buying")
+    idr = Asset.credit("IDR", root.account_id)
+    assert acc.change_trust(idr, 1000)   # buying never blocks the reserve
+
+
+def test_add_signer_with_native_selling_liabilities(ledger, root):
+    acc = _with_native_liability(root, ledger, "selling")
+    other = SecretKey.pseudo_random_for_testing()
+    f = acc.tx([acc.op_add_signer(other.public_key.key_bytes, 1)])
+    assert not ledger.apply_frame(f)
+    assert inner(f).disc == SetOptionsResultCode.LOW_RESERVE
+    assert root.pay(acc, FEE + 1)
+    assert ledger.apply_frame(
+        acc.tx([acc.op_add_signer(other.public_key.key_bytes, 1)]))
+
+
+def test_add_signer_with_native_buying_liabilities(ledger, root):
+    acc = _with_native_liability(root, ledger, "buying")
+    other = SecretKey.pseudo_random_for_testing()
+    assert ledger.apply_frame(
+        acc.tx([acc.op_add_signer(other.public_key.key_bytes, 1)]))
+
+
+def test_manage_data_with_native_selling_liabilities(ledger, root):
+    acc = _with_native_liability(root, ledger, "selling")
+    f = acc.tx([acc.op_manage_data("k", b"v")])
+    assert not ledger.apply_frame(f)
+    assert inner(f).disc == ManageDataResultCode.LOW_RESERVE
+    assert root.pay(acc, FEE + 1)
+    assert ledger.apply_frame(acc.tx([acc.op_manage_data("k", b"v")]))
+
+
+def test_manage_data_with_native_buying_liabilities(ledger, root):
+    acc = _with_native_liability(root, ledger, "buying")
+    assert ledger.apply_frame(acc.tx([acc.op_manage_data("k", b"v")]))
+
+
+def test_change_trust_cannot_reduce_limit_below_buying_liabilities(
+        ledger, root):
+    gateway = root.create(10**9)
+    idr = Asset.credit("IDR", gateway.account_id)
+    acc = root.create(min_bal(2) + 10 * FEE + 500)
+    assert acc.change_trust(idr, 1000)
+    assert ledger.apply_frame(
+        acc.tx([acc.op_manage_sell_offer(XLM, idr, 500, 1, 1)]))
+    assert acc.change_trust(idr, 500)          # exactly at the encumbrance
+    for bad in (499, 0):
+        f = acc.tx([acc.op_change_trust(idr, bad)])
+        assert not ledger.apply_frame(f), bad
+        assert inner(f).disc == ChangeTrustResultCode.INVALID_LIMIT
+
+
+def test_change_trust_self_not_allowed(ledger, root):
+    gateway = root.create(10**9)
+    idr = Asset.credit("IDR", gateway.account_id)
+    for limit in (INT64_MAX - 1, INT64_MAX, 50, 0):
+        f = gateway.tx([gateway.op_change_trust(idr, limit)])
+        assert not ledger.apply_frame(f), limit
+        assert inner(f).disc == ChangeTrustResultCode.SELF_NOT_ALLOWED
+
+
+def test_change_trust_native_malformed(ledger, root):
+    a = root.create(10**9)
+    f = a.tx([a.op_change_trust(XLM, 1000)])
+    assert not ledger.apply_frame(f)
+    assert inner(f).disc == ChangeTrustResultCode.MALFORMED
+
+
+def test_change_trust_issuer_does_not_exist(ledger, root):
+    ghost = SecretKey.pseudo_random_for_testing()
+    usd = Asset.credit("IDR", ghost.public_key)
+    f = root.tx([root.op_change_trust(usd, 100)])
+    assert not ledger.apply_frame(f)
+    assert inner(f).disc == ChangeTrustResultCode.NO_ISSUER
+
+
+def test_change_trust_delete_after_issuer_merged(ledger, root):
+    """Deleting a trustline never needs a live issuer (reference doApply:
+    the zero-limit branch skips the issuer load) — the subentry reserve
+    must not be strandable by an issuer merge."""
+    from stellar_core_tpu.xdr import LedgerKey, OperationBody, OperationType
+    gateway = root.create(10**9)
+    idr = Asset.credit("IDR", gateway.account_id)
+    a = root.create(10**9)
+    assert a.change_trust(idr, 100)
+    merge = TestAccount.op(
+        OperationBody(OperationType.ACCOUNT_MERGE, root.muxed),
+        source=gateway.account_id)
+    assert ledger.apply_frame(gateway.tx([merge]))
+    acct_key = LedgerKey.account(a.account_id)
+    subs_before = ledger.root.get_entry(acct_key).data.value.numSubEntries
+    assert a.change_trust(idr, 0)          # delete succeeds, no issuer
+    assert ledger.root.get_entry(acct_key).data.value.numSubEntries == \
+        subs_before - 1
+
+
+def test_change_trust_edit_after_issuer_merged(ledger, root):
+    from stellar_core_tpu.xdr import OperationBody, OperationType
+    gateway = root.create(10**9)
+    idr = Asset.credit("IDR", gateway.account_id)
+    assert root.change_trust(idr, 100)
+    merge = TestAccount.op(
+        OperationBody(OperationType.ACCOUNT_MERGE, root.muxed),
+        source=gateway.account_id)
+    assert ledger.apply_frame(gateway.tx([merge]))
+    assert not ledger.account_exists(gateway.account_id)
+    f = root.tx([root.op_change_trust(idr, 99)])
+    assert not ledger.apply_frame(f)
+    assert inner(f).disc == ChangeTrustResultCode.NO_ISSUER
+
+
+# =============================== bump sequence (v10+; repo floor 9)
+
+def _bump_op(a, to):
+    from stellar_core_tpu.xdr import BumpSequenceOp, OperationBody, \
+        OperationType
+    return a.op(OperationBody(OperationType.BUMP_SEQUENCE,
+                              BumpSequenceOp(bumpTo=to)))
+
+
+def test_bump_small_and_large(ledger, root):
+    a = root.create(10**9)
+    target = ledger.seq_num(a.account_id) + 3
+    assert ledger.apply_frame(a.tx([_bump_op(a, target)]))
+    assert ledger.seq_num(a.account_id) == target
+    assert ledger.apply_frame(a.tx([_bump_op(a, INT64_MAX)]))
+    assert ledger.seq_num(a.account_id) == INT64_MAX
+    # INT64_MAX reached: no further tx can have a valid sequence (seq+1
+    # would overflow; any offered seq fails BAD_SEQ)
+    from stellar_core_tpu.xdr import TransactionResultCode
+    f = a.tx([a.op_payment(root.account_id, 1)], seq=INT64_MAX)
+    assert not ledger.apply_frame(f)
+    assert f.result.code == TransactionResultCode.txBAD_SEQ
+
+
+def test_bump_backward_is_noop(ledger, root):
+    a = root.create(10**9)
+    old = ledger.seq_num(a.account_id)
+    assert ledger.apply_frame(a.tx([_bump_op(a, 1)]))
+    # the tx consumed its own seq; the backward bump changed nothing
+    assert ledger.seq_num(a.account_id) == old + 1
+
+
+def test_bump_bad_seq(ledger, root):
+    a = root.create(10**9)
+    for bad in (-1, -(2**63)):
+        f = a.tx([_bump_op(a, bad)])
+        assert not ledger.apply_frame(f), bad
+        assert inner(f).disc == BumpSequenceResultCode.BAD_SEQ
+
+
+def test_bump_not_supported_pre10(root):
+    led = TestLedger(ledger_version=9)
+    r = TestAccount(led, root_secret_key())
+    a = r.create(10**9)
+    f = a.tx([_bump_op(a, 99)])
+    assert not led.apply_frame(f)
+    assert f.result.op_results[0].disc == \
+        OperationResultCode.opNOT_SUPPORTED
