@@ -336,8 +336,7 @@ class BucketList:
         self.levels[0].commit()
         self.resolve_any_ready_futures()
 
-    def restart_merges(self, curr_ledger: int,
-                       max_protocol_version: int) -> None:
+    def restart_merges(self, curr_ledger: int) -> None:
         """Re-kick merges whose inputs we still hold after a restart
         (reference BucketList::restartMerges, BucketList.cpp:588-640).
         Only valid with shadows removed (protocol >= 12), where the next

@@ -171,7 +171,7 @@ class BucketManager:
                     keep_dead=keep_dead_entries(i),
                     max_protocol_version=max_protocol_version,
                     adopt=self.adopt_bucket)
-        self.bucket_list.restart_merges(curr_ledger, max_protocol_version)
+        self.bucket_list.restart_merges(curr_ledger)
 
     def shutdown(self) -> None:
         if self._executor is not None:

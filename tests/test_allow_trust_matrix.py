@@ -197,3 +197,65 @@ def test_maintain_malformed_before_v13():
         alice.account_id, b"USD\x00", MAINTAIN)])
     assert not ledger.apply_frame(f)
     assert inner_code(f) == AllowTrustResultCode.MALFORMED
+
+
+@pytest.mark.min_version(13)
+def test_auth_transitions_need_revocable(ledger):
+    """Reference 'auth transition tests' (:272-293): WITHOUT
+    AUTH_REVOCABLE, authorized -> maintain and maintain -> deny are both
+    revocations and fail CANT_REVOKE."""
+    root = ledger.root_account
+    issuer = root.create(10**10)
+    assert ledger.apply_frame(issuer.tx([issuer.op_set_options(
+        set_flags=AUTH_REQUIRED)]))          # required, NOT revocable
+    usd = X.Asset.credit("USD", issuer.account_id)
+    a3 = root.create(10**10)
+    assert ledger.apply_frame(a3.tx([a3.op_change_trust(usd, 10**9)]))
+
+    # authorized -> maintain blocked
+    assert ledger.apply_frame(issuer.tx([issuer.op_allow_trust(
+        a3.account_id, b"USD\x00", 1)]))
+    f = issuer.tx([issuer.op_allow_trust(a3.account_id, b"USD\x00", 2)])
+    assert not ledger.apply_frame(f)
+    assert inner_code(f) == AllowTrustResultCode.CANT_REVOKE
+
+    # reset on a fresh trustor: maintain -> deny blocked
+    a4 = root.create(10**10)
+    assert ledger.apply_frame(a4.tx([a4.op_change_trust(usd, 10**9)]))
+    assert ledger.apply_frame(issuer.tx([issuer.op_allow_trust(
+        a4.account_id, b"USD\x00", 2)]))     # granting maintain is fine
+    f = issuer.tx([issuer.op_allow_trust(a4.account_id, b"USD\x00", 0)])
+    assert not ledger.apply_frame(f)
+    assert inner_code(f) == AllowTrustResultCode.CANT_REVOKE
+
+
+def test_deny_without_trustline_nonrevocable_is_cant_revoke(ledger):
+    """Reference 'allow trust without trustline / do not set revocable
+    flag': the CANT_REVOKE check fires BEFORE the trustline lookup for
+    denyTrust; allowTrust reports NO_TRUST_LINE."""
+    root = ledger.root_account
+    issuer = root.create(10**10)
+    assert ledger.apply_frame(issuer.tx([issuer.op_set_options(
+        set_flags=AUTH_REQUIRED)]))
+    stranger = root.create(10**9)
+    f = issuer.tx([issuer.op_allow_trust(stranger.account_id,
+                                         b"USD\x00", 1)])
+    assert not ledger.apply_frame(f)
+    assert inner_code(f) == AllowTrustResultCode.NO_TRUST_LINE
+    f = issuer.tx([issuer.op_allow_trust(stranger.account_id,
+                                         b"USD\x00", 0)])
+    assert not ledger.apply_frame(f)
+    assert inner_code(f) == AllowTrustResultCode.CANT_REVOKE
+
+
+def test_deny_without_trustline_revocable_is_no_trust_line(ledger):
+    root = ledger.root_account
+    issuer = root.create(10**10)
+    assert ledger.apply_frame(issuer.tx([issuer.op_set_options(
+        set_flags=AUTH_REQUIRED | AUTH_REVOCABLE)]))
+    stranger = root.create(10**9)
+    for authorize in (1, 0):
+        f = issuer.tx([issuer.op_allow_trust(stranger.account_id,
+                                             b"USD\x00", authorize)])
+        assert not ledger.apply_frame(f), authorize
+        assert inner_code(f) == AllowTrustResultCode.NO_TRUST_LINE

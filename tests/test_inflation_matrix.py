@@ -1,0 +1,139 @@
+"""Inflation distribution scenarios, ported from the reference's
+InflationTests.cpp (:285-560 'inflation scenarios'): vote tallies across
+many accounts, the 0.05% winner threshold, share math against an
+in-test oracle, and feePool/totalCoins conservation. All at protocol 11
+(the last protocol with inflation; the 12+ retirement is pinned in
+test_restart_continuity)."""
+
+import pytest
+
+from stellar_core_tpu.testing import TestAccount, TestLedger, root_secret_key
+from stellar_core_tpu.transactions.operations import (
+    InflationOpFrame, InflationResultCode,
+)
+from stellar_core_tpu.xdr import OperationBody, OperationType
+
+RATE = InflationOpFrame.INFLATION_RATE_TRILLIONTHS
+WIN_MIN = InflationOpFrame.INFLATION_WIN_MIN_PERCENT
+
+
+def setup_net(vote_balances):
+    """Voters with given balances, each voting for its own dest account.
+    Returns (ledger, runner, [dest accounts], [voter accounts])."""
+    led = TestLedger()
+    led.header().ledgerVersion = 11
+    root = TestAccount(led, root_secret_key())
+    led.header().scpValue.closeTime = \
+        InflationOpFrame.INFLATION_FREQUENCY + 1
+    voters, dests = [], []
+    for bal in vote_balances:
+        v = root.create(bal)
+        d = root.create(10**9)
+        assert led.apply_frame(v.tx([v.op_set_options(
+            inflation_dest=d.account_id)]))
+        voters.append(v)
+        dests.append(d)
+    runner = root.create(10**9)
+    return led, runner, dests, voters
+
+
+def run_inflation(led, acct):
+    f = acct.tx([acct.op(OperationBody(OperationType.INFLATION, None))])
+    ok = led.apply_frame(f)
+    return ok, f
+
+
+def oracle(led, winner_votes):
+    """Expected (per-winner payouts, minted) — the reference payout rule:
+    share = floor(amountToDole * votes / totalCoins), amountToDole =
+    minted + feePool. The pool already includes the runner's own 100
+    stroop fee when the op applies (fees are charged first), and the
+    leftover stays pooled."""
+    total = led.header().totalCoins
+    minted = total * RATE // 10**12
+    dole = minted + led.header().feePool + 100
+    return [dole * v // total for v in winner_votes], minted
+
+
+def test_two_guys_over_threshold(ledger=None):
+    total0 = TestLedger().header().totalCoins
+    threshold = total0 * WIN_MIN // 10**12
+    # voter balances set BEFORE fees: two clear the threshold, one misses
+    led, runner, dests, voters = setup_net(
+        [threshold + 10**9, 2 * threshold, threshold // 2])
+    # votes = voter balances at run time (fees already subtracted)
+    votes = [led.balance(v.account_id) for v in voters]
+    assert votes[0] >= threshold and votes[1] >= threshold
+    assert votes[2] < threshold
+    before = [led.balance(d.account_id) for d in dests]
+    want, minted = oracle(led, votes[:2])
+    total_before = led.header().totalCoins
+    ok, f = run_inflation(led, runner)
+    assert ok, f.result
+    paid = [led.balance(d.account_id) - b for d, b in zip(dests, before)]
+    assert paid[:2] == want
+    assert paid[2] == 0
+    assert led.header().totalCoins == total_before + minted
+    payouts = f.result.op_results[0].value.value.value
+    assert sorted(p.amount for p in payouts) == sorted(want)
+
+
+def test_no_one_over_min():
+    total0 = TestLedger().header().totalCoins
+    threshold = total0 * WIN_MIN // 10**12
+    led, runner, dests, _ = setup_net([threshold // 3, threshold // 4])
+    before = [led.balance(d.account_id) for d in dests]
+    total_before = led.header().totalCoins
+    pool_before = led.header().feePool
+    ok, f = run_inflation(led, runner)
+    assert ok
+    assert f.result.op_results[0].value.value.value == []
+    assert [led.balance(d.account_id) for d in dests] == before
+    minted = led.header().totalCoins - total_before
+    assert minted == total_before * RATE // 10**12
+    # everything (old pool + mint) stays pooled, plus the runner's fee
+    assert led.header().feePool == pool_before + minted + 100
+
+
+def test_all_votes_to_one_destination():
+    total0 = TestLedger().header().totalCoins
+    threshold = total0 * WIN_MIN // 10**12
+    led = TestLedger()
+    led.header().ledgerVersion = 11
+    root = TestAccount(led, root_secret_key())
+    led.header().scpValue.closeTime = \
+        InflationOpFrame.INFLATION_FREQUENCY + 1
+    dest = root.create(10**9)
+    voters = [root.create(threshold) for _ in range(3)]
+    for v in voters:
+        assert led.apply_frame(v.tx([v.op_set_options(
+            inflation_dest=dest.account_id)]))
+    runner = root.create(10**9)
+    votes = sum(led.balance(v.account_id) for v in voters)
+    (want,), minted = oracle(led, [votes])
+    before = led.balance(dest.account_id)
+    ok, f = run_inflation(led, runner)
+    assert ok, f.result
+    assert led.balance(dest.account_id) - before == want
+    payouts = f.result.op_results[0].value.value.value
+    assert len(payouts) == 1 and payouts[0].amount == want
+
+
+def test_fifty_fifty_split():
+    total0 = TestLedger().header().totalCoins
+    bal = total0 // 100          # each holds 1% — far over threshold
+    led, runner, dests, voters = setup_net([bal, bal])
+    votes = [led.balance(v.account_id) for v in voters]
+    want, minted = oracle(led, votes)
+    before = [led.balance(d.account_id) for d in dests]
+    pool_before = led.header().feePool
+    total_before = led.header().totalCoins
+    ok, f = run_inflation(led, runner)
+    assert ok, f.result
+    paid = [led.balance(d.account_id) - b for d, b in zip(dests, before)]
+    assert paid == want
+    # conservation: leftover of the dole (incl. the runner's fee,
+    # swept into the pool before the op ran) stays pooled
+    dole = minted + pool_before + 100
+    assert led.header().feePool == dole - sum(want)
+    assert led.header().totalCoins == total_before + minted

@@ -217,7 +217,7 @@ def test_bump_bad_seq(ledger, root):
         assert inner(f).disc == BumpSequenceResultCode.BAD_SEQ
 
 
-def test_bump_not_supported_pre10(root):
+def test_bump_not_supported_pre10():
     led = TestLedger(ledger_version=9)
     r = TestAccount(led, root_secret_key())
     a = r.create(10**9)
