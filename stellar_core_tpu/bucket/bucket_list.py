@@ -16,6 +16,7 @@ batches are for signature verification only.
 from __future__ import annotations
 
 from concurrent.futures import Executor, Future
+from functools import lru_cache
 from typing import Callable, List, Optional, Sequence
 
 from ..crypto.hashing import SHA256
@@ -60,9 +61,13 @@ def keep_dead_entries(level: int) -> bool:
     return level < K_NUM_LEVELS - 1
 
 
+@lru_cache(maxsize=1 << 16)
 def size_of_curr(ledger: int, level: int) -> int:
     """Number of ledgers covered by curr at `level` as of `ledger`
-    (BucketList.cpp:245-283; validated by reference BucketListTests)."""
+    (BucketList.cpp:245-283; validated by reference BucketListTests).
+    Memoized: the recurrence branches into both (prev_relevant, level)
+    and every lower level, which is exponential uncached (the reference
+    caches the same way via BucketListDepth tables)."""
     assert ledger != 0 and level < K_NUM_LEVELS
     if level == 0:
         return 1 if ledger == 1 else 1 + ledger % 2
@@ -89,6 +94,7 @@ def size_of_curr(ledger: int, level: int) -> int:
     return ledger - blsize
 
 
+@lru_cache(maxsize=1 << 16)
 def size_of_snap(ledger: int, level: int) -> int:
     """(BucketList.cpp:286-310)."""
     assert ledger != 0 and level < K_NUM_LEVELS

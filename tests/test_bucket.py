@@ -273,3 +273,97 @@ def test_apply_buckets_restores_state():
             assert got is None, i
         else:
             assert got is not None, i
+
+
+# --- list-level structural behaviors (BucketListTests.cpp:175-470) ----------
+
+def _account_entry(i, balance):
+    from stellar_core_tpu.transactions.account_helpers import \
+        make_account_entry
+    from stellar_core_tpu.crypto.keys import SecretKey
+    sk = SecretKey.from_seed(bytes([i & 0xFF]) + b"\x51" * 31)
+    return make_account_entry(sk.public_key, balance, 1)
+
+
+def _contains_key(bucket, entry):
+    from stellar_core_tpu.xdr import BucketEntryType
+    from stellar_core_tpu.ledger.ledgertxn import ledger_entry_key
+    want = ledger_entry_key(entry).to_xdr()
+    for e in bucket.payload_entries():
+        if e.disc == BucketEntryType.DEADENTRY:
+            if e.value.to_xdr() == want:
+                return True
+        elif ledger_entry_key(e.value).to_xdr() == want:
+            return True
+    return False
+
+
+@pytest.mark.parametrize("version", [9, 13])
+def test_hot_entries_shadowing_stays_in_top_levels(version):
+    """BucketListTests 'shadowing pre/post proto 12': an entry rewritten
+    EVERY ledger always lives in levels 0-1. Pre-12 it NEVER deepens —
+    the level-0 copy continuously shadows it out of every lower merge;
+    from 12 (shadows removed) stale copies legitimately sink into levels
+    2..5 but can't reach deeper in this many ledgers (reference
+    :234-262)."""
+    from stellar_core_tpu.bucket.bucket_list import BucketList
+    bl = BucketList()
+    alice = _account_entry(1, 100)
+    bob = _account_entry(2, 100)
+    total = 400
+    deep_sunk = False
+    for i in range(1, total + 1):
+        alice.data.value.balance += 1
+        bob.data.value.balance += 1
+        bl.add_batch(i, version, [], [alice, bob], [])
+        if i % 100 == 0:
+            for j in (0, 1):
+                lev = bl.get_level(j)
+                assert _contains_key(lev.curr, alice) or \
+                    _contains_key(lev.snap, alice)
+                assert _contains_key(lev.curr, bob) or \
+                    _contains_key(lev.snap, bob)
+            for j in range(2, K_NUM_LEVELS):
+                lev = bl.get_level(j)
+                has = _contains_key(lev.curr, alice) or \
+                    _contains_key(lev.snap, alice)
+                if version < 12 or j > 5:
+                    assert not has, (version, i, j)
+                elif has:
+                    deep_sunk = True
+    if version >= 12:
+        # shadows removed: stale copies really did sink below level 1
+        assert deep_sunk
+
+
+@pytest.mark.parametrize("version", [9, 13])
+def test_single_entry_bubbling_up(version):
+    """BucketListTests 'single entry bubbling up': one entry added at
+    ledger 1 then never touched occupies EXACTLY ONE of curr/snap at the
+    level whose window covers ledger 1, and every other level is empty."""
+    from stellar_core_tpu.bucket.bucket_list import (
+        BucketList, oldest_ledger_in_curr, oldest_ledger_in_snap,
+        size_of_curr, size_of_snap,
+    )
+    bl = BucketList()
+    e = _account_entry(3, 777)
+    bl.add_batch(1, version, [], [e], [])
+    for i in range(2, 300):
+        bl.add_batch(i, version, [], [], [])
+        for j in range(K_NUM_LEVELS):
+            lev = bl.get_level(j)
+            # resolve in-flight merges so curr is observable
+            if lev.next.is_live():
+                lev.next.resolve()
+            n_curr = len(lev.curr.payload_entries())
+            n_snap = len(lev.snap.payload_entries())
+            covers = False
+            for size, oldest in (
+                    (size_of_curr(i, j), oldest_ledger_in_curr(i, j)),
+                    (size_of_snap(i, j), oldest_ledger_in_snap(i, j))):
+                if size and oldest <= 1 < oldest + size:
+                    covers = True
+            if covers:
+                assert n_curr + n_snap == 1, (version, i, j)
+            else:
+                assert n_curr == 0 and n_snap == 0, (version, i, j)

@@ -55,7 +55,7 @@ def oracle(led, winner_votes):
     return [dole * v // total for v in winner_votes], minted
 
 
-def test_two_guys_over_threshold(ledger=None):
+def test_two_guys_over_threshold():
     total0 = TestLedger().header().totalCoins
     threshold = total0 * WIN_MIN // 10**12
     # voter balances set BEFORE fees: two clear the threshold, one misses
