@@ -53,6 +53,13 @@ class Config:
         self.PREFERRED_PEERS: List[str] = []
         self.TARGET_PEER_CONNECTIONS = 8
         self.MAX_PENDING_CONNECTIONS = 500
+        # connection policy (reference Config.h PREFERRED_PEERS_ONLY /
+        # PREFERRED_PEER_KEYS): preferred peers — by address or by strkey
+        # node id — always win an authenticated slot (evicting a
+        # non-preferred victim at capacity), and strict mode rejects
+        # everyone else at authentication
+        self.PREFERRED_PEERS_ONLY = False
+        self.PREFERRED_PEER_KEYS: List[str] = []
         self.MAX_ADDITIONAL_PEER_CONNECTIONS = -1
         self.PEER_AUTHENTICATION_TIMEOUT = 2.0
         self.PEER_TIMEOUT = 30.0
@@ -136,6 +143,7 @@ class Config:
             "RUN_STANDALONE", "MANUAL_CLOSE", "FORCE_SCP", "DATABASE",
             "BUCKET_DIR_PATH", "TMP_DIR_PATH", "PEER_PORT", "HTTP_PORT",
             "PUBLIC_HTTP_PORT", "KNOWN_PEERS", "PREFERRED_PEERS",
+            "PREFERRED_PEERS_ONLY", "PREFERRED_PEER_KEYS",
             "TARGET_PEER_CONNECTIONS", "UNSAFE_QUORUM", "FAILURE_SAFETY",
             "EXPECTED_LEDGER_CLOSE_TIME", "MAX_SLOTS_TO_REMEMBER",
             "INVARIANT_CHECKS", "WORKER_THREADS",

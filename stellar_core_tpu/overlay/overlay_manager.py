@@ -141,6 +141,12 @@ class OverlayManager:
             exclude += [p.address for p in self.pending_peers if p.address]
             for rec in self.peer_manager.candidates_to_connect(
                     missing, exclude):
+                # strict mode would reject a non-preferred peer right
+                # after its handshake anyway — dialing it would redial
+                # every tick forever (the policy drop happens post-auth,
+                # outside the connect-failure backoff)
+                if cfg.PREFERRED_PEERS_ONLY and not rec.preferred:
+                    continue
                 self.connect_to(rec.host, rec.port)
         self.load_manager.maybe_shed_excess_load(self)
         self._arm_tick()
@@ -197,16 +203,70 @@ class OverlayManager:
             peer.connect_handshake()
         return peer
 
+    def _preferred_key_set(self) -> frozenset:
+        """PREFERRED_PEER_KEYS strkeys decoded once (invalid entries are
+        logged once and skipped)."""
+        cfg_keys = tuple(self.app.config.PREFERRED_PEER_KEYS)
+        if getattr(self, "_pref_keys_src", None) != cfg_keys:
+            from ..crypto import strkey
+            decoded = []
+            for s in cfg_keys:
+                try:
+                    decoded.append(strkey.decode_public_key(s))
+                except Exception:
+                    log.warning("ignoring invalid PREFERRED_PEER_KEYS "
+                                "entry %r", s)
+            self._pref_keys_src = cfg_keys
+            self._pref_keys = frozenset(decoded)
+        return self._pref_keys
+
+    def is_preferred(self, peer: Peer) -> bool:
+        """Preferred by configured address or by node key (reference
+        OverlayManagerImpl::isPreferred). Inbound peers match on their
+        LISTENING port from HELLO, not the ephemeral socket port."""
+        if peer.address is not None:
+            for port in (peer.address[1], peer.remote_listening_port):
+                rec = self.peer_manager._peers.get((peer.address[0], port))
+                if rec is not None and rec.preferred:
+                    return True
+        if peer.peer_id is not None and \
+                peer.peer_id.key_bytes in self._preferred_key_set():
+            return True
+        return False
+
     def accept_authenticated_peer(self, peer: Peer) -> bool:
         """Handshake finished: move pending → authenticated
         (reference moveToAuthenticated/acceptAuthenticatedPeer)."""
         # the transport + handshake worked: whatever happens next (ban,
-        # duplicate-connection tiebreak) must NOT count toward the
-        # connect-failure backoff
+        # duplicate-connection tiebreak, policy rejection) must NOT count
+        # toward the connect-failure backoff
         peer.ever_authenticated = True
         key = peer.peer_id.to_xdr()
         if self.ban_manager.is_banned(peer.peer_id):
             peer.drop("banned")
+            return False
+        # connection policy (reference acceptAuthenticatedPeer:178-215):
+        # preferred peers always win a slot — evicting a non-preferred
+        # victim at capacity — and strict mode rejects everyone else.
+        # Capacity matches the load manager's shedding limit: target
+        # plus the operator's additional inbound headroom.
+        cfg = self.app.config
+        max_auth = cfg.TARGET_PEER_CONNECTIONS + \
+            max(0, cfg.MAX_ADDITIONAL_PEER_CONNECTIONS)
+        if self.is_preferred(peer):
+            if len(self.authenticated_peers) >= max_auth and \
+                    self.authenticated_peers.get(key) is None:
+                for vk, victim in list(self.authenticated_peers.items()):
+                    if not self.is_preferred(victim):
+                        log.info("evicting non-preferred peer %s for "
+                                 "preferred %s", victim.id_str(),
+                                 peer.id_str())
+                        victim.drop("preferred peer selected instead")
+                        break
+        elif cfg.PREFERRED_PEERS_ONLY or \
+                (len(self.authenticated_peers) >= max_auth and
+                 self.authenticated_peers.get(key) is None):
+            peer.drop("peer rejected")
             return False
         existing = self.authenticated_peers.get(key)
         if existing is not None and existing is not peer:

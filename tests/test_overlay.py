@@ -185,3 +185,88 @@ def test_transaction_floods_and_applies_over_real_overlay():
             for n in sim.nodes.values())
 
     assert sim.crank_until(all_have_alice, 30000)
+
+
+# --- connection policy (reference OverlayTests.cpp:150-440) -----------------
+
+def _policy_pair(strict_on_b=True, prefer_a_key=False, target_b=8):
+    keys = [SecretKey.from_seed(sha256(b"pol" + bytes([i])))
+            for i in range(2)]
+    qset = X.SCPQuorumSet(threshold=1,
+                          validators=[k.public_key for k in keys],
+                          innerSets=[])
+    sim = Simulation(mode=Simulation.OVER_PEERS)
+
+    def tweak(c):
+        if strict_on_b:
+            c.PREFERRED_PEERS_ONLY = True
+        if prefer_a_key:
+            from stellar_core_tpu.crypto import strkey
+            c.PREFERRED_PEER_KEYS = [
+                strkey.encode_public_key(keys[0].public_key.key_bytes)]
+        c.TARGET_PEER_CONNECTIONS = target_b
+
+    a = sim.add_node(keys[0], qset, name="a")
+    b = sim.add_node(keys[1], qset, name="b", cfg_tweak=tweak)
+    return sim, a, b
+
+
+def test_strict_mode_rejects_non_preferred_peer():
+    """Reference 'reject non preferred peer': PREFERRED_PEERS_ONLY drops
+    everyone not preferred at authentication time, in both directions."""
+    sim, a, b = _policy_pair(strict_on_b=True)
+    sim.connect_peers("a", "b")
+    sim.crank_all_nodes(20)
+    assert a.app.overlay_manager.get_authenticated_peers_count() == 0
+    assert b.app.overlay_manager.get_authenticated_peers_count() == 0
+    sim2, a2, b2 = _policy_pair(strict_on_b=True)
+    sim2.connect_peers("b", "a")          # outbound from the strict node
+    sim2.crank_all_nodes(20)
+    assert b2.app.overlay_manager.get_authenticated_peers_count() == 0
+
+
+def test_strict_mode_accepts_preferred_peer_by_key():
+    """Reference 'accept preferred peer even when strict'."""
+    sim, a, b = _policy_pair(strict_on_b=True, prefer_a_key=True)
+    sim.connect_peers("a", "b")
+    sim.crank_all_nodes(20)
+    assert a.app.overlay_manager.get_authenticated_peers_count() == 1
+    assert b.app.overlay_manager.get_authenticated_peers_count() == 1
+
+
+def test_preferred_peer_evicts_at_capacity():
+    """Reference 'reject peers beyond max - preferred peer wins': with
+    one authenticated slot taken by a non-preferred peer, a preferred
+    arrival evicts it; a non-preferred arrival is rejected."""
+    keys = [SecretKey.from_seed(sha256(b"cap" + bytes([i])))
+            for i in range(3)]
+    qset = X.SCPQuorumSet(threshold=1,
+                          validators=[k.public_key for k in keys],
+                          innerSets=[])
+    sim = Simulation(mode=Simulation.OVER_PEERS)
+
+    def tweak(c):
+        from stellar_core_tpu.crypto import strkey
+        c.TARGET_PEER_CONNECTIONS = 1
+        c.PREFERRED_PEER_KEYS = [
+            strkey.encode_public_key(keys[2].public_key.key_bytes)]
+
+    hub = sim.add_node(keys[0], qset, name="hub", cfg_tweak=tweak)
+    sim.add_node(keys[1], qset, name="plain")
+    sim.add_node(keys[2], qset, name="vip")
+    sim.connect_peers("plain", "hub")
+    sim.crank_all_nodes(20)
+    om = hub.app.overlay_manager
+    assert om.get_authenticated_peers_count() == 1
+    # a preferred peer arrives at capacity: the non-preferred one goes
+    sim.connect_peers("vip", "hub")
+    sim.crank_all_nodes(20)
+    assert om.get_authenticated_peers_count() == 1
+    (only,) = om.authenticated_peers.values()
+    assert only.peer_id.key_bytes == keys[2].public_key.key_bytes
+    # another plain peer is rejected outright at capacity
+    sim.connect_peers("plain", "hub")
+    sim.crank_all_nodes(20)
+    assert om.get_authenticated_peers_count() == 1
+    (only,) = om.authenticated_peers.values()
+    assert only.peer_id.key_bytes == keys[2].public_key.key_bytes

@@ -429,3 +429,49 @@ def test_quick_restart_rejoins_consensus(tmp_path):
         assert len(hashes) == 1, "nodes diverged after quick restart"
     finally:
         _shutdown(apps)
+
+
+def test_inbound_preferred_peer_matches_listening_port():
+    """A strict hub with the peer's ADDRESS in PREFERRED_PEERS must
+    recognize an INBOUND dial as preferred via the listening port from
+    HELLO — the ephemeral socket port never matches the config entry
+    (reference isPreferred uses the resolved peer address)."""
+    apps = []
+    try:
+        # dialer first, so its listening port is known for the hub's cfg
+        dial_cfg = _cfg(0, [BASE_PORT + 40, BASE_PORT + 41], 0)
+        dial_cfg.KNOWN_PEERS = []
+        dialer = Application(VirtualClock(ClockMode.REAL_TIME), dial_cfg)
+        dialer.start()
+        apps.append(dialer)
+
+        hub_cfg = _cfg(1, [BASE_PORT + 40, BASE_PORT + 41], 1)
+        hub_cfg.KNOWN_PEERS = []
+        hub_cfg.PREFERRED_PEERS_ONLY = True
+        hub_cfg.PREFERRED_PEERS = [
+            "127.0.0.1:%d" % dialer.config.PEER_PORT]
+        hub = Application(VirtualClock(ClockMode.REAL_TIME), hub_cfg)
+        hub.start()
+        apps.append(hub)
+
+        dialer.overlay_manager.connect_to("127.0.0.1",
+                                          hub.config.PEER_PORT)
+        ok = _crank_all(
+            apps, 8, until=lambda:
+            hub.overlay_manager.get_authenticated_peers_count() == 1 and
+            dialer.overlay_manager.get_authenticated_peers_count() == 1)
+        assert ok, "preferred inbound dialer was not accepted"
+
+        # a stranger on a non-preferred address is rejected by strict mode
+        str_cfg = _cfg(0, [BASE_PORT + 42], 0)
+        str_cfg.KNOWN_PEERS = []
+        stranger = Application(VirtualClock(ClockMode.REAL_TIME), str_cfg)
+        stranger.start()
+        apps.append(stranger)
+        stranger.overlay_manager.connect_to("127.0.0.1",
+                                            hub.config.PEER_PORT)
+        _crank_all(apps, 3)
+        assert hub.overlay_manager.get_authenticated_peers_count() == 1
+        assert stranger.overlay_manager.get_authenticated_peers_count() == 0
+    finally:
+        _shutdown(apps)
