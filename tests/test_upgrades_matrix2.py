@@ -318,6 +318,29 @@ def test_simulate_upgrades_3_of_3_upgrade():
     assert all(f == 4321 for f in _simulate_upgrade_vote(3))
 
 
+@pytest.mark.slow
+def test_simulate_upgrades_2_of_3_vblocking_all_upgrade():
+    """Reference '2 of 3 vote (v-blocking) - 3 upgrade': the third node
+    votes the upgrade down, but once leader rotation hands nomination to
+    an armed node the 2-of-3 quorum ratifies it and EVERYONE applies.
+    Needs a longer horizon than the 0/3 and 3/3 cases — convergence
+    waits on the leader schedule."""
+    from stellar_core_tpu.simulation import topologies
+    from stellar_core_tpu.herder.upgrades import UpgradeParameters
+    sim = topologies.core(3, 2)
+    for i, node in enumerate(sim.nodes.values()):
+        if i < 2:
+            p = UpgradeParameters()
+            p.upgrade_time = 0
+            p.base_fee = 4321
+            node.app.herder.upgrades.set_parameters(p)
+    sim.start_all_nodes()
+    assert sim.crank_until(lambda: sim.have_all_externalized(12), 200000)
+    fees = [n.app.ledger_manager.lcl_header.baseFee
+            for n in sim.nodes.values()]
+    assert all(f == 4321 for f in fees), fees
+
+
 def test_externalized_upgrades_disarm_matching_params_only():
     u = Upgrades(armed_params(time=1_000_000))
     # non-matching value: stays armed; matching: disarms
