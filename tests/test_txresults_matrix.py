@@ -1,0 +1,103 @@
+"""Transaction-error precedence matrix, ported from the reference's
+TxResultsTests.cpp (:273-530 'transaction errors'): the same structural
+defect crossed with the envelope's signature state. Structural errors
+(missing op, time bounds, fee floor, missing source, bad seq) report
+regardless of signatures; the signature check outranks only the
+fee-balance check (unsigned+poor → txBAD_AUTH), and an unneeded extra
+signature is reported LAST (valid-but-extra + poor →
+txINSUFFICIENT_BALANCE, not txBAD_AUTH_EXTRA)."""
+
+import pytest
+
+from stellar_core_tpu.crypto.keys import SecretKey
+from stellar_core_tpu.testing import TestAccount, TestLedger, root_secret_key
+from stellar_core_tpu.xdr import TimeBounds, TransactionResultCode as TX
+
+FEE = 100
+RESERVE = 5_000_000
+
+
+@pytest.fixture
+def ledger():
+    return TestLedger()
+
+
+@pytest.fixture
+def root(ledger):
+    return TestAccount(ledger, root_secret_key())
+
+
+def _case(ledger, root, kind):
+    """Build a tx with exactly one structural defect; returns (frame,
+    expected signed-state code)."""
+    a = root.create(10**9)
+    now = ledger.header().scpValue.closeTime
+    if kind == "missing_operation":
+        return a.tx([]), TX.txMISSING_OPERATION
+    if kind == "too_early":
+        return a.tx([a.op_payment(root.account_id, 1)],
+                    time_bounds=TimeBounds(minTime=now + 100,
+                                           maxTime=0)), TX.txTOO_EARLY
+    if kind == "too_late":
+        return a.tx([a.op_payment(root.account_id, 1)],
+                    time_bounds=TimeBounds(minTime=1,
+                                           maxTime=max(1, now - 1))), \
+            TX.txTOO_LATE
+    if kind == "insufficient_fee":
+        return a.tx([a.op_payment(root.account_id, 1)], fee=FEE - 1), \
+            TX.txINSUFFICIENT_FEE
+    if kind == "no_account":
+        ghost = TestAccount(ledger, SecretKey.pseudo_random_for_testing())
+        return ghost.tx([ghost.op_payment(root.account_id, 1)], seq=1), \
+            TX.txNO_ACCOUNT
+    if kind == "bad_seq":
+        return a.tx([a.op_payment(root.account_id, 1)],
+                    seq=a.next_seq() + 1), TX.txBAD_SEQ
+    if kind == "insufficient_balance":
+        # exactly the reserve: the fee cannot come out of it
+        g = root.create(2 * RESERVE)
+        return g.tx([g.op_payment(root.account_id, 1)]), \
+            TX.txINSUFFICIENT_BALANCE
+    raise AssertionError(kind)
+
+
+KINDS = ["missing_operation", "too_early", "too_late", "insufficient_fee",
+         "no_account", "bad_seq", "insufficient_balance"]
+
+
+@pytest.mark.parametrize("kind", KINDS)
+def test_signed(ledger, root, kind):
+    f, want = _case(ledger, root, kind)
+    assert not ledger.apply_frame(f)
+    assert f.result.code == want, kind
+
+
+@pytest.mark.parametrize("kind", KINDS)
+def test_unsigned(ledger, root, kind):
+    """Unsigned: every structural code still reports; only the balance
+    case flips to txBAD_AUTH (signatures check before the fee balance)."""
+    f, want = _case(ledger, root, kind)
+    f.envelope.value.signatures.clear()
+    if kind == "insufficient_balance":
+        want = TX.txBAD_AUTH
+    assert not ledger.apply_frame(f)
+    assert f.result.code == want, kind
+
+
+@pytest.mark.parametrize("kind", KINDS)
+def test_extra_signature(ledger, root, kind):
+    """Valid signature plus a stranger's: the structural code (including
+    INSUFFICIENT_BALANCE) wins — txBAD_AUTH_EXTRA is only reported when
+    everything else is valid."""
+    f, want = _case(ledger, root, kind)
+    f.add_signature(SecretKey.pseudo_random_for_testing())
+    assert not ledger.apply_frame(f)
+    assert f.result.code == want, kind
+
+
+def test_extra_signature_alone_reports_last(ledger, root):
+    a = root.create(10**9)
+    f = a.tx([a.op_payment(root.account_id, 1)])
+    f.add_signature(SecretKey.pseudo_random_for_testing())
+    assert not ledger.apply_frame(f)
+    assert f.result.code == TX.txBAD_AUTH_EXTRA
