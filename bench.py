@@ -563,6 +563,15 @@ def main() -> None:
             if err:
                 errors["cpu_jax"] = err
     cache_path = os.path.join(_REPO, ".bench_device_cache.json")
+    if res is not None and res.get("platform") in ("tpu", "axon"):
+        # persist the kernel measurement NOW — the replay-cpu denominator
+        # leg can still fail/abort, and the fresh device numbers must
+        # survive it; the complete blob overwrites this at the end
+        try:
+            with open(cache_path, "w") as fh:
+                json.dump({"at_unix": int(t_start), **res}, fh)
+        except OSError:
+            pass
     if res is None and cpu_res is not None:
         # No device: report the framework's best CPU-mode rate — the
         # synchronous OpenSSL backend is the default CPU path and usually

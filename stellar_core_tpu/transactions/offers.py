@@ -275,6 +275,11 @@ class ManageBuyOfferOpFrame(_ManageOfferBase):
     op_type = OperationType.MANAGE_BUY_OFFER
     is_buy = True
 
+    def is_version_supported(self, ledger_version: int) -> bool:
+        # introduced in protocol 11 (reference
+        # ManageBuyOfferOpFrame::isVersionSupported)
+        return ledger_version >= 11
+
     def _wheat_receive_cap(self) -> int:
         b = self.op.body.value
         return b.buyAmount if b.buyAmount > 0 else INT64_MAX
@@ -422,6 +427,11 @@ class PathPaymentStrictReceiveOpFrame(_PathPaymentBase):
 @register_op
 class PathPaymentStrictSendOpFrame(_PathPaymentBase):
     op_type = OperationType.PATH_PAYMENT_STRICT_SEND
+
+    def is_version_supported(self, ledger_version: int) -> bool:
+        # introduced by CAP-0018's companion in protocol 12 (reference
+        # PathPaymentStrictSendOpFrame::isVersionSupported)
+        return ledger_version >= 12
 
     def do_check_valid(self, header) -> bool:
         b = self.op.body.value

@@ -225,3 +225,46 @@ def test_bump_not_supported_pre10():
     assert not led.apply_frame(f)
     assert f.result.op_results[0].disc == \
         OperationResultCode.opNOT_SUPPORTED
+
+
+def test_strict_send_and_buy_offer_version_floors():
+    """PATH_PAYMENT_STRICT_SEND needs protocol 12; MANAGE_BUY_OFFER
+    needs 11 (reference isVersionSupported overrides)."""
+    from stellar_core_tpu.xdr import (
+        ManageBuyOfferOp, OperationBody, OperationType,
+        PathPaymentStrictSendOp, Price,
+    )
+    for version, send_ok, buy_ok in ((10, False, False),
+                                     (11, False, True),
+                                     (12, True, True)):
+        led = TestLedger(ledger_version=version)
+        r = TestAccount(led, root_secret_key())
+        a = r.create(10**9)
+        b = r.create(10**9)
+        send = a.op(OperationBody(
+            OperationType.PATH_PAYMENT_STRICT_SEND,
+            PathPaymentStrictSendOp(
+                sendAsset=XLM, sendAmount=100, destination=b.muxed,
+                destAsset=XLM, destMin=1, path=[])))
+        f = a.tx([send])
+        got = led.apply_frame(f)
+        assert got == send_ok, (version, "send")
+        if not send_ok:
+            assert f.result.op_results[0].disc == \
+                OperationResultCode.opNOT_SUPPORTED
+        buy = b.op(OperationBody(
+            OperationType.MANAGE_BUY_OFFER,
+            ManageBuyOfferOp(selling=XLM,
+                             buying=Asset.credit("USD", a.account_id),
+                             buyAmount=0, price=Price(n=1, d=1),
+                             offerID=0)))
+        f2 = b.tx([buy])
+        got2 = led.apply_frame(f2)
+        if buy_ok:
+            # delete-of-nothing fails, but NOT with opNOT_SUPPORTED
+            assert f2.result.op_results[0].disc != \
+                OperationResultCode.opNOT_SUPPORTED, version
+        else:
+            assert not got2
+            assert f2.result.op_results[0].disc == \
+                OperationResultCode.opNOT_SUPPORTED, version

@@ -569,3 +569,142 @@ def test_takes_all_offers_multiple_per_exchange(ledger, root):
     f = a.tx([recv_op(a, b, XLM, 10**9, as1, 1, path=[as0])])
     assert not ledger.apply_frame(f)
     assert inner_code(f) == PathPaymentResultCode.TOO_FEW_OFFERS
+
+
+# ======================= strict-send matrix (PathPaymentStrictSendTests)
+
+def test_strict_send_amount_constraints(ledger, root):
+    """Reference 'send amount constraints' / 'destination minimum
+    constraints': non-positive sendAmount or destMin are MALFORMED."""
+    a = root.create(10**9)
+    b = root.create(10**9)
+    for send_amount, dest_min in ((0, 100), (-1, 100), (100, 0),
+                                  (100, -1)):
+        f = a.tx([send_op(a, b, XLM, send_amount, XLM, dest_min)])
+        assert not ledger.apply_frame(f), (send_amount, dest_min)
+        assert inner_code(f) == PathPaymentResultCode.MALFORMED
+
+
+def test_strict_send_source_no_trust_and_not_authorized(ledger, root):
+    issuer = root.create(10**10)
+    usd = Asset.credit("USD", issuer.account_id)
+    a = root.create(10**9)
+    b = root.create(10**9)
+    assert b.change_trust(usd, 10**9)
+    f = a.tx([send_op(a, b, usd, 100, usd, 1)])
+    assert not ledger.apply_frame(f)
+    assert inner_code(f) == PathPaymentResultCode.SRC_NO_TRUST
+    # authorized-required issuer; trustline exists but not authorized
+    from stellar_core_tpu.xdr import AccountFlags
+    assert ledger.apply_frame(issuer.tx([issuer.op_set_options(
+        set_flags=AccountFlags.AUTH_REQUIRED_FLAG)]))
+    assert a.change_trust(usd, 10**9)
+    f = a.tx([send_op(a, b, usd, 100, usd, 1)])
+    assert not ledger.apply_frame(f)
+    assert inner_code(f) == PathPaymentResultCode.SRC_NOT_AUTHORIZED
+
+
+def test_strict_send_destination_errors(ledger, root):
+    issuer = root.create(10**10)
+    usd = Asset.credit("USD", issuer.account_id)
+    a = root.create(10**9)
+    assert a.change_trust(usd, 10**9)
+    assert issuer.pay(a, 1000, usd)
+    ghost = TestAccount(ledger, SecretKey.pseudo_random_for_testing())
+    f = a.tx([send_op(a, ghost, usd, 100, usd, 1)])
+    assert not ledger.apply_frame(f)
+    assert inner_code(f) == PathPaymentResultCode.NO_DESTINATION
+    c = root.create(10**9)       # no trustline
+    f = a.tx([send_op(a, c, usd, 100, usd, 1)])
+    assert not ledger.apply_frame(f)
+    assert inner_code(f) == PathPaymentResultCode.NO_TRUST
+
+
+def test_strict_send_destination_line_full(ledger, root):
+    issuer = root.create(10**10)
+    usd = Asset.credit("USD", issuer.account_id)
+    a = root.create(10**9)
+    b = root.create(10**9)
+    assert a.change_trust(usd, 10**9)
+    assert issuer.pay(a, 1000, usd)
+    assert b.change_trust(usd, 100)
+    assert issuer.pay(b, 95, usd)          # 5 units of headroom
+    f = a.tx([send_op(a, b, usd, 6, usd, 1)])
+    assert not ledger.apply_frame(f)
+    assert inner_code(f) == PathPaymentResultCode.LINE_FULL
+    assert ledger.apply_frame(a.tx([send_op(a, b, usd, 5, usd, 1)]))
+
+
+def test_strict_send_too_few_offers_at_each_hop(ledger, root):
+    for skip in (0, 1, 2):
+        led = TestLedger()
+        from stellar_core_tpu.testing import root_secret_key
+        r = TestAccount(led, root_secret_key())
+        issuer, mm, assets, hops = three_hop_market(r, skip_book=skip)
+        a, b = payer_and_dest(r, led, assets[2])
+        f = a.tx([send_op(a, b, XLM, 1000, assets[2], 1,
+                          path=[assets[0], assets[1]])])
+        assert not led.apply_frame(f), skip
+        assert inner_code(f) == PathPaymentResultCode.TOO_FEW_OFFERS, skip
+
+
+def test_strict_send_under_destination_minimum(ledger, root):
+    """Reference 'under destination minimum with real path': the path
+    delivers, but less than destMin — UNDER_DESTMIN, nothing moves."""
+    issuer, mm, assets, hops = three_hop_market(root)
+    a, b = payer_and_dest(root, ledger, assets[2])
+    before = a.balance()
+    # each hop asks 2 of the previous asset per unit: 1000 XLM -> 125
+    f = a.tx([send_op(a, b, XLM, 1000, assets[2], 126,
+                      path=[assets[0], assets[1]])])
+    assert not ledger.apply_frame(f)
+    assert inner_code(f) == PathPaymentResultCode.UNDER_DESTMIN
+    assert a.balance() == before - 100     # only the fee
+    assert ledger.trust_balance(b.account_id, assets[2]) == 0
+
+
+def test_strict_send_three_hop_exact_delivery(ledger, root):
+    """1000 XLM through three 2:1 hops delivers exactly 125 and eats the
+    full send amount (strict-send: sendAmount fixed, delivery floors)."""
+    issuer, mm, assets, hops = three_hop_market(root)
+    a, b = payer_and_dest(root, ledger, assets[2])
+    before = a.balance()
+    f = a.tx([send_op(a, b, XLM, 1000, assets[2], 125,
+                      path=[assets[0], assets[1]])])
+    assert ledger.apply_frame(f), f.result
+    assert ledger.trust_balance(b.account_id, assets[2]) == 125
+    assert a.balance() == before - 1000 - 100
+    s = success_of(f)
+    assert s.last.amount == 125
+
+
+def test_strict_send_to_self_asset_is_real_exchange(ledger, root):
+    """Reference 'to self asset': strict-send to self still walks the
+    books (unlike the strict-receive native self-pay no-op)."""
+    issuer, mm, assets, hops = three_hop_market(root)
+    a = root.create(10**10)
+    assert a.change_trust(assets[0], 10**12)
+    before = a.balance()
+    f = a.tx([send_op(a, a, XLM, 1000, assets[0], 1)])
+    assert ledger.apply_frame(f), f.result
+    assert a.balance() == before - 1000 - 100
+    assert ledger.trust_balance(a.account_id, assets[0]) == 500
+
+
+def test_strict_send_crosses_own_offer_excluded(ledger, root):
+    """Reference 'crosses own offer': the sender's own resting offer is
+    skipped; with no other book the path fails rather than self-cross."""
+    issuer = root.create(10**10)
+    usd = Asset.credit("USD", issuer.account_id)
+    a = root.create(10**10)
+    b = root.create(10**10)
+    assert a.change_trust(usd, 10**12)
+    assert b.change_trust(usd, 10**12)
+    assert issuer.pay(a, 10**6, usd)
+    # a's own offer is the only one selling USD for XLM
+    assert ledger.apply_frame(a.tx([a.op_manage_sell_offer(
+        usd, XLM, 10**5, 1, 1)]))
+    f = a.tx([send_op(a, b, XLM, 1000, usd, 1)])
+    assert not ledger.apply_frame(f)
+    assert inner_code(f) in (PathPaymentResultCode.OFFER_CROSS_SELF,
+                             PathPaymentResultCode.TOO_FEW_OFFERS)
