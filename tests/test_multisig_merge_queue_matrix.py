@@ -393,6 +393,7 @@ def test_merge_with_data_subentry_blocked(ledger, root):
     assert ledger.apply_frame(a.tx([_merge_op(a, b)]))
 
 
+@pytest.mark.min_version(10)
 def test_merge_seqnum_too_far(ledger, root):
     """reference MergeTests.cpp 'merge too far' (v10+): a source whose
     seqnum belongs to a FUTURE ledger era cannot merge (replay guard)."""

@@ -72,6 +72,7 @@ def test_passive_offer_still_crosses_better_price(market):
     assert len(succ.offersClaimed) == 1      # strictly-better price crosses
 
 
+@pytest.mark.min_version(11)
 def test_buy_offer_acquires_exact_buy_amount(market):
     """ManageBuyOffer expresses the amount to BUY; crossing delivers
     exactly that much of the buying asset."""

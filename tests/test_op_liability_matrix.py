@@ -53,6 +53,7 @@ def _with_native_liability(root, ledger, side):
     return acc
 
 
+@pytest.mark.min_version(10)
 def test_change_trust_with_native_selling_liabilities(ledger, root):
     """v10+: the selling liability encumbers the reserve, so the new
     trustline's subentry can't be afforded until topped up."""
@@ -71,6 +72,7 @@ def test_change_trust_with_native_buying_liabilities(ledger, root):
     assert acc.change_trust(idr, 1000)   # buying never blocks the reserve
 
 
+@pytest.mark.min_version(10)
 def test_add_signer_with_native_selling_liabilities(ledger, root):
     acc = _with_native_liability(root, ledger, "selling")
     other = SecretKey.pseudo_random_for_testing()
@@ -89,6 +91,7 @@ def test_add_signer_with_native_buying_liabilities(ledger, root):
         acc.tx([acc.op_add_signer(other.public_key.key_bytes, 1)]))
 
 
+@pytest.mark.min_version(10)
 def test_manage_data_with_native_selling_liabilities(ledger, root):
     acc = _with_native_liability(root, ledger, "selling")
     f = acc.tx([acc.op_manage_data("k", b"v")])
@@ -103,6 +106,7 @@ def test_manage_data_with_native_buying_liabilities(ledger, root):
     assert ledger.apply_frame(acc.tx([acc.op_manage_data("k", b"v")]))
 
 
+@pytest.mark.min_version(10)
 def test_change_trust_cannot_reduce_limit_below_buying_liabilities(
         ledger, root):
     gateway = root.create(10**9)
@@ -186,6 +190,7 @@ def _bump_op(a, to):
                               BumpSequenceOp(bumpTo=to)))
 
 
+@pytest.mark.min_version(10)
 def test_bump_small_and_large(ledger, root):
     a = root.create(10**9)
     target = ledger.seq_num(a.account_id) + 3
@@ -201,6 +206,7 @@ def test_bump_small_and_large(ledger, root):
     assert f.result.code == TransactionResultCode.txBAD_SEQ
 
 
+@pytest.mark.min_version(10)
 def test_bump_backward_is_noop(ledger, root):
     a = root.create(10**9)
     old = ledger.seq_num(a.account_id)
@@ -209,6 +215,7 @@ def test_bump_backward_is_noop(ledger, root):
     assert ledger.seq_num(a.account_id) == old + 1
 
 
+@pytest.mark.min_version(10)
 def test_bump_bad_seq(ledger, root):
     a = root.create(10**9)
     for bad in (-1, -(2**63)):

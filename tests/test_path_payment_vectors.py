@@ -69,6 +69,7 @@ def market(root, n_assets=1):
 
 # ------------------------------------------------------- validity failures
 
+@pytest.mark.min_version(12)
 def test_malformed_amounts(ledger, root):
     a = root.create(10**9)
     b = root.create(10**9)
@@ -176,6 +177,7 @@ def test_no_issuer(ledger, root):
     assert inner_code(f) == PathPaymentResultCode.NO_ISSUER
 
 
+@pytest.mark.min_version(12)
 def test_underfunded_native(ledger, root):
     a = root.create(2 * 10**7)   # barely above reserve
     b = root.create(10**9)
@@ -197,6 +199,7 @@ def test_too_few_offers_empty_book(ledger, root):
     assert inner_code(f) == PathPaymentResultCode.TOO_FEW_OFFERS
 
 
+@pytest.mark.min_version(12)
 def test_over_sendmax_and_under_destmin(ledger, root):
     issuer, mm, (usd,) = market(root)
     a = root.create(10**9)
@@ -292,6 +295,7 @@ def test_same_asset_no_book_is_direct_transfer(ledger, root):
     assert ledger.trust_balance(a.account_id, usd) == 900
 
 
+@pytest.mark.min_version(12)
 def test_strict_send_sweeps_multiple_offers(ledger, root):
     issuer, mm, (usd,) = market(root)
     a = root.create(10**10)

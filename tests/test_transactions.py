@@ -224,6 +224,7 @@ def test_manage_data(ledger, root):
     assert inner_code(f) == ManageDataResultCode.NAME_NOT_FOUND
 
 
+@pytest.mark.min_version(10)
 def test_bump_sequence(ledger, root):
     from stellar_core_tpu.xdr import BumpSequenceOp
     a = root.create(10**9)
@@ -376,6 +377,7 @@ def test_path_payment_strict_receive(ledger, root):
     assert inner_code(f) == PathPaymentResultCode.OVER_SENDMAX
 
 
+@pytest.mark.min_version(12)
 def test_path_payment_strict_send(ledger, root):
     issuer = root.create(10**10)
     mm = root.create(10**10)
@@ -508,6 +510,7 @@ def test_allow_trust_result_codes(ledger, root):
     assert inner_code(f) == AllowTrustResultCode.CANT_REVOKE
 
 
+@pytest.mark.min_version(10)
 def test_manage_data_and_bump_seq_codes(ledger, root):
     from stellar_core_tpu.transactions.operations import (
         BumpSequenceResultCode,
