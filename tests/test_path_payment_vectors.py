@@ -69,16 +69,23 @@ def market(root, n_assets=1):
 
 # ------------------------------------------------------- validity failures
 
-@pytest.mark.min_version(12)
 def test_malformed_amounts(ledger, root):
     a = root.create(10**9)
     b = root.create(10**9)
     for op in (recv_op(a, b, XLM, 10, XLM, 0),
-               recv_op(a, b, XLM, 0, XLM, 10),
-               send_op(a, b, XLM, 0, XLM, 1)):
+               recv_op(a, b, XLM, 0, XLM, 10)):
         f = a.tx([op])
         assert not ledger.apply_frame(f)
         assert inner_code(f) == PathPaymentResultCode.MALFORMED
+
+
+@pytest.mark.min_version(12)
+def test_malformed_amounts_strict_send(ledger, root):
+    a = root.create(10**9)
+    b = root.create(10**9)
+    f = a.tx([send_op(a, b, XLM, 0, XLM, 1)])
+    assert not ledger.apply_frame(f)
+    assert inner_code(f) == PathPaymentResultCode.MALFORMED
 
 
 def test_path_too_long_rejected_at_wire(ledger, root):
@@ -199,8 +206,7 @@ def test_too_few_offers_empty_book(ledger, root):
     assert inner_code(f) == PathPaymentResultCode.TOO_FEW_OFFERS
 
 
-@pytest.mark.min_version(12)
-def test_over_sendmax_and_under_destmin(ledger, root):
+def test_over_sendmax(ledger, root):
     issuer, mm, (usd,) = market(root)
     a = root.create(10**9)
     b = root.create(10**9)
@@ -210,6 +216,16 @@ def test_over_sendmax_and_under_destmin(ledger, root):
     f = a.tx([recv_op(a, b, XLM, 199, usd, 100)])   # needs 200 XLM
     assert not ledger.apply_frame(f)
     assert inner_code(f) == PathPaymentResultCode.OVER_SENDMAX
+
+
+@pytest.mark.min_version(12)
+def test_under_destmin_strict_send(ledger, root):
+    issuer, mm, (usd,) = market(root)
+    a = root.create(10**9)
+    b = root.create(10**9)
+    assert b.change_trust(usd, 10**12)
+    assert ledger.apply_frame(
+        mm.tx([mm.op_manage_sell_offer(usd, XLM, 10**6, 2, 1)]))
     f = a.tx([send_op(a, b, XLM, 200, usd, 101)])   # yields 100 USD
     assert not ledger.apply_frame(f)
     assert inner_code(f) == PathPaymentResultCode.UNDER_DESTMIN

@@ -510,6 +510,13 @@ def test_allow_trust_result_codes(ledger, root):
     assert inner_code(f) == AllowTrustResultCode.CANT_REVOKE
 
 
+def test_manage_data_invalid_name(ledger, root):
+    a = root.create(10**9)
+    f = a.tx([a.op_manage_data("", b"v")])
+    assert not ledger.apply_frame(f)
+    assert inner_code(f) == ManageDataResultCode.INVALID_NAME
+
+
 @pytest.mark.min_version(10)
 def test_manage_data_and_bump_seq_codes(ledger, root):
     from stellar_core_tpu.transactions.operations import (
@@ -518,10 +525,6 @@ def test_manage_data_and_bump_seq_codes(ledger, root):
     from stellar_core_tpu.xdr import BumpSequenceOp
 
     a = root.create(10**9)
-    # invalid name (empty)
-    f = a.tx([a.op_manage_data("", b"v")])
-    assert not ledger.apply_frame(f)
-    assert inner_code(f) == ManageDataResultCode.INVALID_NAME
     # bump backwards is a success no-op; negative target is BAD_SEQ
     cur = ledger.seq_num(a.account_id)
     assert ledger.apply_frame(a.tx([a.op(OperationBody(
