@@ -667,10 +667,15 @@ class FeeBumpTransactionFrame:
     def _common_valid(self, checker: SignatureChecker, ltx,
                       applying: bool) -> int:
         """Outer-envelope checks shared by check_valid and apply
-        (reference FeeBumpTransactionFrame::commonValid): fee floors,
-        fee-source existence, LOW-threshold auth, all-signatures-used,
-        and (when not applying) the fee-source balance."""
+        (reference FeeBumpTransactionFrame::commonValid): protocol gate,
+        fee floors, fee-source existence, LOW-threshold auth,
+        all-signatures-used, and (when not applying) the fee-source
+        balance."""
         header = ltx.load_header()
+        if header.ledgerVersion < 13:
+            # fee bumps are CAP-0015, protocol 13 (reference commonValid
+            # → txNOT_SUPPORTED below)
+            return TransactionResultCode.txNOT_SUPPORTED
         if self.fee_bid < self.min_fee(header) or \
                 self.fee_bid < self.inner.fee_bid:
             return TransactionResultCode.txINSUFFICIENT_FEE

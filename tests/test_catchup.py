@@ -331,6 +331,7 @@ def test_prewarm_batches_checkpoint_sigs(publisher):
     assert raw_calls[0] == len(cv.distinct)
 
 
+@pytest.mark.min_version(13)
 def test_replay_history_containing_fee_bump(publisher):
     """A fee-bump envelope in published history replays byte-exactly
     (checkpoint prewarm collects outer fee-source + inner signatures)."""

@@ -4,6 +4,10 @@ processing, inner-failure reporting)."""
 
 import pytest
 
+# fee bumps are CAP-0015 (protocol 13): the whole module runs at
+# v13 semantics; the explicit not-supported test pins its versions
+pytestmark = pytest.mark.min_version(13)
+
 from stellar_core_tpu.crypto.keys import SecretKey
 from stellar_core_tpu.testing import TestAccount, TestLedger, root_secret_key
 from stellar_core_tpu.transactions.transaction_frame import (
@@ -267,3 +271,24 @@ def test_outer_auth_rechecked_at_apply(ledger, root):
     assert not ok
     assert f.result.code == TransactionResultCode.txBAD_AUTH
     assert sponsor.balance() == bal - f.fee_charged(ledger.header())
+
+
+def test_fee_bump_not_supported_below_v13():
+    """Reference FeeBumpTransactionTests 'not supported': the envelope
+    is structurally valid at v12 but commonValid gates it."""
+    from stellar_core_tpu.xdr import TransactionResultCode
+    led = TestLedger(ledger_version=12)
+    r = TestAccount(led, root_secret_key())
+    a = r.create(10**9)
+    sponsor = r.create(10**9)
+    inner = a.tx([a.op_payment(r.account_id, 100)])
+    fb = bump(led, sponsor, inner)
+    assert not led.apply_frame(fb)
+    assert fb.result.code == TransactionResultCode.txNOT_SUPPORTED
+    # v13: same envelope applies fine
+    led13 = TestLedger(ledger_version=13)
+    r13 = TestAccount(led13, root_secret_key())
+    a13 = r13.create(10**9)
+    sp13 = r13.create(10**9)
+    inner13 = a13.tx([a13.op_payment(r13.account_id, 100)])
+    assert led13.apply_frame(bump(led13, sp13, inner13)), "v13 fee bump"

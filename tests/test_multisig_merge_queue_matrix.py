@@ -629,6 +629,7 @@ def _bump(led, sponsor, inner_frame, fee=2000):
     return frame
 
 
+@pytest.mark.min_version(13)
 def test_fee_bump_same_source_ages_and_bans(env):
     """reference '1 fee bump, fee source same as source': a fee bump
     queues under the INNER source's chain, ages with it, and bans."""
@@ -644,6 +645,7 @@ def test_fee_bump_same_source_ages_and_bans(env):
     assert q.is_banned(fb.full_hash())
 
 
+@pytest.mark.min_version(13)
 def test_fee_bump_distinct_fee_source_chains_by_inner(env):
     """reference '1 fee bump, fee source distinct from source': the chain
     key is the inner source; the fee source only sponsors the bid."""
@@ -660,6 +662,7 @@ def test_fee_bump_distinct_fee_source_chains_by_inner(env):
     assert q.size_ops() == 4   # fee bump (2) + two plain txs
 
 
+@pytest.mark.min_version(13)
 def test_two_fee_bumps_same_sponsor_different_sources(env):
     """reference '2 fee bumps with same fee source but different source':
     both queue; the sponsor's balance covers both bids."""
@@ -672,6 +675,7 @@ def test_two_fee_bumps_same_sponsor_different_sources(env):
     assert q.size_ops() == 4   # two fee bumps, 2 ops each
 
 
+@pytest.mark.min_version(13)
 def test_fee_bump_ban_drops_inner_chain_tail(env):
     """reference 'ban first of two fee bumps with same fee source and
     source': banning the first drops the dependent second."""
@@ -688,6 +692,7 @@ def test_fee_bump_ban_drops_inner_chain_tail(env):
     assert q.try_add(fb2) == LATER
 
 
+@pytest.mark.min_version(13)
 def test_fee_bump_remove_applied_keeps_later(env):
     """reference 'remove first of two fee bumps': applying the first
     leaves the second chained correctly."""

@@ -400,6 +400,7 @@ def test_path_payment_strict_send(ledger, root):
     assert ledger.trust_balance(dst.account_id, usd) == 100
 
 
+@pytest.mark.min_version(13)
 def test_fee_bump(ledger, root):
     from stellar_core_tpu.transactions.transaction_frame import (
         FeeBumpTransactionFrame,

@@ -149,6 +149,7 @@ def test_to_txset_orders_chains(env):
     assert order == [a1.seq_num, a2.seq_num]
 
 
+@pytest.mark.min_version(13)
 def test_txset_fee_balance_keyed_by_fee_source():
     """A fee bump's fee counts against the SPONSOR's balance across the
     set (reference accountFeeMap by getFeeSourceID), and a sponsored tx
