@@ -23,6 +23,33 @@ log = get_logger("Bucket")
 
 ZERO_HASH = b"\x00" * 32
 
+# skip-list stride constants (reference BucketManager.h): every SKIP_1
+# ledgers the header's skipList[0] takes the close's bucket-list hash,
+# cascading the older values down at the larger strides
+SKIP_1 = 50
+SKIP_2 = 5000
+SKIP_3 = 50000
+SKIP_4 = 500000
+
+
+def calculate_skip_values(header) -> None:
+    """Advance the header's skipList in place (reference
+    BucketManagerImpl::calculateSkipValues, BucketManagerImpl.cpp:726-752).
+    Consensus-visible: every node must shift the same values at the same
+    sequence numbers or header hashes fork."""
+    if header.ledgerSeq % SKIP_1 != 0:
+        return
+    v = header.ledgerSeq - SKIP_1
+    if v > 0 and v % SKIP_2 == 0:
+        v = header.ledgerSeq - SKIP_2 - SKIP_1
+        if v > 0 and v % SKIP_3 == 0:
+            v = header.ledgerSeq - SKIP_3 - SKIP_2 - SKIP_1
+            if v > 0 and v % SKIP_4 == 0:
+                header.skipList[3] = header.skipList[2]
+            header.skipList[2] = header.skipList[1]
+        header.skipList[1] = header.skipList[0]
+    header.skipList[0] = header.bucketListHash
+
 
 class BucketManager:
     def __init__(self, bucket_dir: Optional[str] = None,
@@ -85,6 +112,12 @@ class BucketManager:
 
     def get_hash(self) -> bytes:
         return self.bucket_list.get_hash()
+
+    def snapshot_ledger(self, header) -> None:
+        """Stamp the closing header with the bucket-list hash and advance
+        its skipList (reference BucketManagerImpl::snapshotLedger)."""
+        header.bucketListHash = self.get_hash()
+        calculate_skip_values(header)
 
     def get_referenced_hashes(self) -> List[bytes]:
         refs: List[bytes] = []

@@ -91,17 +91,26 @@ class BatchSigVerifier:
         """Whole-ledger/checkpoint drain (SURVEY.md §2.2): verify a large
         batch in one dispatch and seed the result cache so subsequent
         synchronous per-signature checks all hit. Already-cached triples
-        are not re-dispatched."""
+        are not re-dispatched. Cache keys for the whole drain hash in one
+        native call (prep.c sct_cache_keys) when available."""
+        cks = None
+        if len(triples) >= 256:   # below this the fixed numpy/ctypes
+            # marshalling cost exceeds hashlib's per-triple overhead
+            # (the native apply engine calls here once per tx, ~20-ish
+            # triples; checkpoint drains come in by the thousand)
+            from ..native import cache_keys_native
+            cks = cache_keys_native(triples)
+        if cks is None:
+            cks = [_keys._cache_key(k, s, m) for (k, s, m) in triples]
         out: List[Optional[bool]] = [None] * len(triples)
         todo: List[Tuple[int, Triple, bytes]] = []   # (idx, triple, key)
         with _keys._cache_lock:
-            for i, (k, s, m) in enumerate(triples):
-                ck = _keys._cache_key(k, s, m)
+            for i, (t, ck) in enumerate(zip(triples, cks)):
                 hit = _keys._verify_cache.maybe_get(ck)
                 if hit is not None:
                     out[i] = hit
                 else:
-                    todo.append((i, (k, s, m), ck))
+                    todo.append((i, t, ck))
         if todo:
             results = self.verify_many([t for (_i, t, _ck) in todo])
             with _keys._cache_lock:
@@ -128,7 +137,7 @@ class CpuSigVerifier(BatchSigVerifier):
         pass
 
     def verify_many(self, triples: Sequence[Triple]) -> List[bool]:
-        return [_keys.raw_verify(k, s, m) for (k, s, m) in triples]
+        return _keys.raw_verify_batch(triples)
 
 
 class TpuSigVerifier(BatchSigVerifier):

@@ -8,15 +8,32 @@ from __future__ import annotations
 
 import os
 
-from cryptography.hazmat.primitives import serialization
-from cryptography.hazmat.primitives.asymmetric.x25519 import (
-    X25519PrivateKey, X25519PublicKey,
-)
+try:
+    from cryptography.hazmat.primitives import serialization
+    from cryptography.hazmat.primitives.asymmetric.x25519 import (
+        X25519PrivateKey, X25519PublicKey,
+    )
+except ImportError:  # hermetic container: crypto/fallback.py supplies
+    # the same X25519 + AEAD primitives (native C or pure Python)
+    serialization = X25519PrivateKey = X25519PublicKey = None
 
 from .hashing import hkdf_expand, hkdf_extract
 
 
+def _aead_cls():
+    try:
+        from cryptography.hazmat.primitives.ciphers.aead import (
+            ChaCha20Poly1305,
+        )
+        return ChaCha20Poly1305
+    except ImportError:
+        from .fallback import ChaCha20Poly1305
+        return ChaCha20Poly1305
+
+
 def curve25519_random_secret() -> bytes:
+    if X25519PrivateKey is None:
+        return os.urandom(32)
     sk = X25519PrivateKey.generate()
     return sk.private_bytes(serialization.Encoding.Raw,
                             serialization.PrivateFormat.Raw,
@@ -24,6 +41,9 @@ def curve25519_random_secret() -> bytes:
 
 
 def curve25519_derive_public(secret32: bytes) -> bytes:
+    if X25519PrivateKey is None:
+        from .fallback import x25519_public
+        return x25519_public(secret32)
     sk = X25519PrivateKey.from_private_bytes(secret32)
     return sk.public_key().public_bytes(serialization.Encoding.Raw,
                                         serialization.PublicFormat.Raw)
@@ -34,8 +54,13 @@ def curve25519_derive_shared(local_secret32: bytes, remote_public32: bytes,
     """ECDH then HKDF-extract over (shared ‖ publicA ‖ publicB) — the caller
     fixes the A/B ordering so both sides derive the same key
     (reference Curve25519.cpp:47-71)."""
-    sk = X25519PrivateKey.from_private_bytes(local_secret32)
-    shared = sk.exchange(X25519PublicKey.from_public_bytes(remote_public32))
+    if X25519PrivateKey is None:
+        from .fallback import x25519_shared
+        shared = x25519_shared(local_secret32, remote_public32)
+    else:
+        sk = X25519PrivateKey.from_private_bytes(local_secret32)
+        shared = sk.exchange(
+            X25519PublicKey.from_public_bytes(remote_public32))
     return hkdf_extract(shared + public_a + public_b)
 
 
@@ -48,7 +73,7 @@ def curve25519_seal(recipient_public32: bytes, plaintext: bytes) -> bytes:
     SurveyManager encrypted responses): ephemeral X25519 + ChaCha20-
     Poly1305, key = HKDF(ECDH ‖ epk ‖ recipient), nonce derived from the
     public halves. Output: epk(32) ‖ ciphertext."""
-    from cryptography.hazmat.primitives.ciphers.aead import ChaCha20Poly1305
+    ChaCha20Poly1305 = _aead_cls()
     from .hashing import sha256
     esk = curve25519_random_secret()
     epk = curve25519_derive_public(esk)
@@ -60,7 +85,7 @@ def curve25519_seal(recipient_public32: bytes, plaintext: bytes) -> bytes:
 
 def curve25519_unseal(secret32: bytes, blob: bytes) -> bytes:
     """Inverse of curve25519_seal; raises on tamper/garbage."""
-    from cryptography.hazmat.primitives.ciphers.aead import ChaCha20Poly1305
+    ChaCha20Poly1305 = _aead_cls()
     from .hashing import sha256
     epk, ct = blob[:32], blob[32:]
     pub = curve25519_derive_public(secret32)

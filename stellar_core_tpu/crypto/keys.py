@@ -18,9 +18,14 @@ import hashlib
 import threading
 from typing import Optional
 
-from cryptography.exceptions import InvalidSignature
-from cryptography.hazmat.primitives import serialization
-from cryptography.hazmat.primitives.asymmetric import ed25519 as _ed
+try:
+    from cryptography.exceptions import InvalidSignature
+    from cryptography.hazmat.primitives import serialization
+    from cryptography.hazmat.primitives.asymmetric import ed25519 as _ed
+except ImportError:  # hermetic container: self-contained fallback
+    # (native C ed25519c.c when a compiler exists, pure-Python RFC 8032
+    # otherwise — identical accept/reject semantics, see crypto/fallback)
+    InvalidSignature = serialization = _ed = None
 
 from ..util.cache import RandomEvictionCache
 from ..xdr import PublicKey, SignatureHint
@@ -55,15 +60,36 @@ def flush_verify_cache() -> None:
 
 
 def raw_verify(key32: bytes, sig: bytes, msg: bytes) -> bool:
-    """Uncached single ed25519 verify (OpenSSL)."""
+    """Uncached single ed25519 verify (OpenSSL, or the self-contained
+    fallback when `cryptography` is absent)."""
     if len(sig) != 64:
         return False
+    if _ed is None:
+        from . import fallback as _fb
+        return _fb.ed25519_verify(key32, sig, msg)
     try:
         pk = _ed.Ed25519PublicKey.from_public_bytes(key32)
         pk.verify(sig, msg)
         return True
     except (InvalidSignature, ValueError):
         return False
+
+
+def raw_verify_batch(triples) -> list:
+    """[(key32, sig, msg)] → [bool], one native call when the C library
+    is available (CpuSigVerifier's whole-batch drain path)."""
+    if _ed is None:
+        from ..native import ed25519_native
+        lib = ed25519_native()
+        if lib is not None:
+            out = [False] * len(triples)
+            good = [i for i, (k, s, _m) in enumerate(triples)
+                    if len(k) == 32 and len(s) == 64]
+            for i, ok in zip(good,
+                             lib.verify_batch([triples[i] for i in good])):
+                out[i] = ok
+            return out
+    return [raw_verify(k, s, m) for (k, s, m) in triples]
 
 
 class PubKeyUtils:
@@ -93,9 +119,14 @@ class SecretKey:
     def __init__(self, seed32: bytes) -> None:
         assert len(seed32) == 32
         self._seed = seed32
-        self._sk = _ed.Ed25519PrivateKey.from_private_bytes(seed32)
-        pub = self._sk.public_key().public_bytes(
-            serialization.Encoding.Raw, serialization.PublicFormat.Raw)
+        if _ed is not None:
+            self._sk = _ed.Ed25519PrivateKey.from_private_bytes(seed32)
+            pub = self._sk.public_key().public_bytes(
+                serialization.Encoding.Raw, serialization.PublicFormat.Raw)
+        else:
+            from . import fallback as _fb
+            self._sk = None
+            pub = _fb.ed25519_public(seed32)
         self._pub = PublicKey.ed25519(pub)
 
     # -- constructors -------------------------------------------------------
@@ -135,7 +166,10 @@ class SecretKey:
 
     # -- signing ------------------------------------------------------------
     def sign(self, msg: bytes) -> bytes:
-        return self._sk.sign(msg)
+        if self._sk is not None:
+            return self._sk.sign(msg)
+        from . import fallback as _fb
+        return _fb.ed25519_sign(self._seed, msg)
 
     def sign_decorated(self, msg: bytes):
         from ..xdr import DecoratedSignature

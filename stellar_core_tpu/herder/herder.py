@@ -274,6 +274,12 @@ class Herder:
             cfg.node_id(), lambda: self.app.config.QUORUM_SET)
         self._nominate_started: dict = {}
         self.last_quorum_intersection: Optional[dict] = None
+        # in-flight background intersection check (reference
+        # QuorumMapIntersectionState): the main loop owns these fields;
+        # the worker thread only reads `checker` via its own reference
+        self._qic_checker = None      # live QuorumIntersectionChecker
+        self._qic_thread = None
+        self.quorum_check_recalculating = False
 
     # -- state machine -------------------------------------------------------
     def bootstrap(self) -> None:
@@ -456,11 +462,19 @@ class Herder:
         (reference HerderImpl::checkAndMaybeReanalyzeQuorumMap); with
         critical=True also search for intersection-critical groups
         (reference getIntersectionCriticalGroups)."""
-        from .quorum_intersection import (
-            QuorumIntersectionChecker, intersection_critical_groups_strkey,
-        )
+        from .quorum_intersection import QuorumIntersectionChecker
         qmap = self.quorum_tracker.get_quorum()
         checker = QuorumIntersectionChecker(qmap)
+        out = self._run_intersection_check(checker, qmap, critical)
+        self.last_quorum_intersection = out
+        return out
+
+    @staticmethod
+    def _run_intersection_check(checker, qmap, critical: bool) -> dict:
+        """The computation itself — safe on any thread (touches only the
+        checker and the snapshotted qmap). Raises InterruptedError when
+        the main loop sets checker.interrupted."""
+        from .quorum_intersection import intersection_critical_groups_strkey
         ok = checker.network_enjoys_quorum_intersection()
         out = {
             "node_count": checker.n,
@@ -471,10 +485,57 @@ class Herder:
             out["last_good_split"] = [
                 [x.hex() for x in side] for side in checker.last_split]
         if critical:
+            # share the checker's interrupt flag with every throwaway
+            # checker the criticality scan builds, so a shutdown-time
+            # interrupt lands mid-scan too, not just mid-enumeration
             out["intersection_critical"] = \
-                intersection_critical_groups_strkey(qmap)
-        self.last_quorum_intersection = out
+                intersection_critical_groups_strkey(qmap, parent=checker)
         return out
+
+    def start_quorum_intersection_check(self, critical: bool = False) -> bool:
+        """Kick the intersection check onto a worker thread so a slow
+        enumeration never stalls ledger close (reference
+        checkAndMaybeReanalyzeQuorumMap posts the checker to a background
+        thread and keeps mRecalculating state). Returns False if a check
+        is already in flight. The result lands in
+        last_quorum_intersection via post_to_main on a later crank."""
+        import threading
+        from .quorum_intersection import QuorumIntersectionChecker
+        if self.quorum_check_recalculating:
+            return False
+        qmap = dict(self.quorum_tracker.get_quorum())
+        checker = QuorumIntersectionChecker(qmap)
+        self._qic_checker = checker
+        self.quorum_check_recalculating = True
+        clock = self.app.clock
+
+        def work() -> None:
+            try:
+                out = self._run_intersection_check(checker, qmap, critical)
+            except InterruptedError:
+                out = {"node_count": checker.n, "interrupted": True}
+            except Exception as e:   # never kill the process from a worker
+                out = {"node_count": checker.n, "error": str(e)}
+
+            def install() -> None:
+                self.last_quorum_intersection = out
+                self.quorum_check_recalculating = False
+                self._qic_checker = None
+            clock.post_to_main(install)
+
+        self._qic_thread = threading.Thread(
+            target=work, name="quorum-intersection", daemon=True)
+        self._qic_thread.start()
+        return True
+
+    def interrupt_quorum_intersection(self) -> None:
+        """Ask an in-flight background check to bail at its next branch
+        (reference HerderImpl.cpp:140-144: shutdown sets mInterruptFlag
+        to avoid a long pause joining worker threads). Safe to call with
+        no check running."""
+        checker = self._qic_checker
+        if checker is not None:
+            checker.interrupted = True
 
     def recv_tx_set(self, h: bytes, txset: TxSetFrame) -> bool:
         if txset.get_contents_hash() != h:
@@ -690,5 +751,6 @@ class Herder:
             "transitive": {
                 "node_count": len(self.quorum_tracker.get_quorum()),
                 "intersection": self.last_quorum_intersection,
+                "recalculating": self.quorum_check_recalculating,
             },
         }

@@ -23,10 +23,17 @@ log = get_logger("SCP")
 
 
 class QuorumIntersectionChecker:
-    def __init__(self, qmap: Dict[bytes, Optional[SCPQuorumSet]]) -> None:
+    def __init__(self, qmap: Dict[bytes, Optional[SCPQuorumSet]],
+                 parent: "QuorumIntersectionChecker" = None) -> None:
         """qmap: node id (raw 32B ed25519) -> its quorum set (None if
         unknown; unknown nodes can never be satisfied, matching the
-        reference's treatment of missing qsets)."""
+        reference's treatment of missing qsets).
+
+        `parent` shares its interrupt flag with this checker (the
+        criticality scan builds one throwaway checker per candidate
+        group; the reference threads one shared interrupt flag through
+        all of them — HerderImpl.cpp:140-144)."""
+        self._parent = parent
         self.ids: List[bytes] = sorted(qmap)
         self.index: Dict[bytes, int] = {v: i for i, v in enumerate(self.ids)}
         self.n = len(self.ids)
@@ -245,7 +252,8 @@ class QuorumIntersectionChecker:
     def _enumerate(self, committed: int, remaining: int) -> bool:
         """True iff no disjoint minq pair found in this branch (reference's
         recursive enumerate with early exits #1-3)."""
-        if self.interrupted:
+        if self.interrupted or \
+                (self._parent is not None and self._parent.interrupted):
             raise InterruptedError("quorum intersection check interrupted")
         if bin(committed).count("1") > self._maxsz:
             return True
@@ -370,7 +378,8 @@ def _criticality_candidates(qs: SCPQuorumSet, out: set, root: bool) -> None:
 
 
 def intersection_critical_groups(
-        qmap: Dict[bytes, Optional[SCPQuorumSet]]) -> List[set]:
+        qmap: Dict[bytes, Optional[SCPQuorumSet]],
+        parent: QuorumIntersectionChecker = None) -> List[set]:
     """Find "intersection-critical" node groups (reference
     QuorumIntersectionChecker::getIntersectionCriticalGroups): for each
     candidate group (leaf innerSets + singletons), install a "fickle"
@@ -407,16 +416,17 @@ def intersection_critical_groups(
         test_qmap = dict(qmap)
         for k in group:
             test_qmap[k] = fickle
-        checker = QuorumIntersectionChecker(test_qmap)
+        checker = QuorumIntersectionChecker(test_qmap, parent=parent)
         if not checker.network_enjoys_quorum_intersection():
             critical.append(set(group))
     return critical
 
 
 def intersection_critical_groups_strkey(
-        qmap: Dict[bytes, Optional[SCPQuorumSet]]) -> List[List[str]]:
+        qmap: Dict[bytes, Optional[SCPQuorumSet]],
+        parent: QuorumIntersectionChecker = None) -> List[List[str]]:
     """Criticality report in operator form (strkey lists) — shared by the
     HTTP checkquorum endpoint and the check-quorum CLI."""
     from ..crypto.strkey import encode_public_key
     return [sorted(encode_public_key(k) for k in group)
-            for group in intersection_critical_groups(qmap)]
+            for group in intersection_critical_groups(qmap, parent=parent)]

@@ -170,12 +170,28 @@ class ApplyCheckpointWork(BasicWork):
                     self._txsets[t.ledgerSeq] = t.txSet
         return True
 
+    def _prewarm_redundant(self) -> bool:
+        """The checkpoint prewarm exists to batch crypto into one device
+        dispatch AND to pre-resolve signer sets in Python. With the
+        native apply engine active it resolves signer sets in C and
+        feeds the verifier per tx, and on the synchronous CPU backend
+        batching buys nothing — the whole Python collection pass is then
+        pure overhead on the replay clock."""
+        verifier = getattr(self.app, "sig_verifier", None)
+        if getattr(verifier, "name", "") != "cpu":
+            return False
+        lm = self.app.ledger_manager
+        if not getattr(lm, "use_native_apply", True):
+            return False
+        from ..native import apply_engine
+        return apply_engine() is not None
+
     def _prewarm_frames(self, frames) -> None:
         """Collect candidate triples against CURRENT ledger state and
         drain them through the batch verifier (cached triples are skipped
         inside prewarm_many — a fully-covered call dispatches nothing)."""
         verifier = getattr(self.app, "sig_verifier", None)
-        if verifier is None or not frames:
+        if verifier is None or not frames or self._prewarm_redundant():
             return
         from ..ledger.ledgertxn import LedgerTxn
         ltx = LedgerTxn(self.app.ledger_manager.ltx_root())
@@ -197,6 +213,8 @@ class ApplyCheckpointWork(BasicWork):
                 continue
             fr = TxSetFrame.from_wire(net, ts)
             self._frames[seq] = fr       # reused at apply: parse once
+            for f in fr.frames:          # history wire is immutable:
+                f.freeze_signatures()    # skip per-serialize fp checks
             frames.extend(fr.frames)
         self._prewarm_frames(frames)
         log.debug("prewarmed checkpoint %08x (%d txs)",

@@ -26,12 +26,16 @@ from ..ledger.ledgertxn import (
 from ..transactions.account_helpers import make_account_entry
 from ..util.log import get_logger
 from ..xdr import (
-    LedgerHeader, LedgerUpgrade, StellarValue,
-    StellarValueExt, TransactionResultPair, TransactionResultSet,
-    TransactionHistoryEntry, TransactionSet, UpgradeEntryMeta, _Ext,
+    LedgerHeader, LedgerKey, LedgerUpgrade, StellarValue,
+    StellarValueExt, TransactionHistoryEntry, TransactionSet,
+    UpgradeEntryMeta, _Ext,
 )
 
 log = get_logger("Ledger")
+
+
+def _be_u32(n: int) -> bytes:
+    return n.to_bytes(4, "big")
 
 GENESIS_LEDGER_SEQ = 1
 
@@ -222,36 +226,54 @@ class LedgerManager:
         frames = lcd.tx_set.sort_for_apply()
         base_fee = lcd.tx_set.base_fee(header)
 
-        # phase 1: fees + seq nums for every tx, each in a nested txn so
-        # the per-tx fee-processing changes become txfeehistory meta
-        # (reference saves these LedgerEntryChanges per tx)
+        # fast path: the native engine runs BOTH phases in one C call and
+        # installs per-frame results/meta + the close-level delta; any
+        # ineligibility falls through to the Python phases with no state
+        # mutated (ledger/native_apply.py)
         from ..ledger.ledgertxn import delta_to_changes
-        for f in frames:
-            fee_ltx = LedgerTxn(ltx)
-            try:
-                f.process_fee_seq_num(fee_ltx, base_fee)
-                f.fee_meta = delta_to_changes(fee_ltx.get_delta())
-                fee_ltx.commit()
-            except BaseException:
-                if fee_ltx._open:
-                    fee_ltx.rollback()
-                raise
-        # phase 2: apply, collecting results (+ invariant checks per tx)
-        result_pairs: List[TransactionResultPair] = []
-        for f in frames:
-            f.apply(ltx, verifier)
-            result_pairs.append(f.result_pair())
-        # result hash in apply order
-        rs = TransactionResultSet(results=result_pairs)
-        header.txSetResultHash = sha256(rs.to_xdr())
+        from ..ledger.native_apply import native_apply_txset
+        if not native_apply_txset(self, ltx, frames, base_fee, verifier):
+            # phase 1: fees + seq nums for every tx, each in a nested txn
+            # so the per-tx fee-processing changes become txfeehistory
+            # meta (reference saves these LedgerEntryChanges per tx)
+            for f in frames:
+                fee_ltx = LedgerTxn(ltx)
+                try:
+                    f.process_fee_seq_num(fee_ltx, base_fee)
+                    f.fee_meta = delta_to_changes(fee_ltx.get_delta())
+                    fee_ltx.commit()
+                except BaseException:
+                    if fee_ltx._open:
+                        fee_ltx.rollback()
+                    raise
+            # phase 2: apply, collecting results (+ invariant checks)
+            for f in frames:
+                f.apply(ltx, verifier)
+        # result hash in apply order, assembled from wire bytes:
+        # TransactionResultSet XDR is count ‖ pairs, and each frame holds
+        # (or lazily serializes) its own pair bytes — on the native fast
+        # path no TransactionResult is ever parsed or re-serialized here
+        # (tests/test_native_apply.py pins this layout against the codec)
+        header.txSetResultHash = sha256(
+            _be_u32(len(frames)) +
+            b"".join(f.result_pair_xdr() for f in frames))
 
         # invariants see the TX-phase delta under the pre-upgrade header:
         # the reference hooks invariants per operation only, so upgrade
         # rewrites (prepareLiabilities initializing liabilities / erasing
         # offers) are exempt by design — they ESTABLISH the state the
-        # invariants check from then on
-        tx_phase_delta = ltx.get_delta()
-        tx_phase_header = _copy_header_fast(header)
+        # invariants check from then on. Snapshotting the delta costs a
+        # full parse+serialize pass over every changed entry, so it only
+        # happens when an invariant manager will actually read it.
+        # an InvariantManager with nothing enabled (the production
+        # default) must not cost the snapshot either — Application always
+        # constructs one
+        inv = getattr(self.app, "invariant_manager", None)
+        if inv is not None and not inv.enabled_names():
+            inv = None
+        tx_phase_delta = ltx.get_delta() if inv is not None else None
+        tx_phase_header = _copy_header_fast(header) if inv is not None \
+            else None
 
         # upgrades (after txs; reference LedgerManagerImpl.cpp:617-669):
         # a malformed or invalid upgrade in an externalized value fails
@@ -288,31 +310,38 @@ class LedgerManager:
         # bucket-list hash over the close's delta (content-addressed chain;
         # stands in the header exactly where the reference's
         # BucketList::getHash result goes)
-        delta = ltx.get_delta()
+        # need_prev=False: the init/live/dead split below only tests
+        # pre-image EXISTENCE, so native-injected deltas skip parsing
+        # every pre-image entry; raw_keys=True: only DEAD entries need a
+        # parsed LedgerKey (bucket dead keys), live/init keys would be
+        # parsed once per touched account per close just to be dropped
+        delta = ltx.get_delta(need_prev=False, raw_keys=True)
         bl = self._bucket_manager()
         if bl is not None:
             init_entries, live_entries, dead_keys = [], [], []
-            for key, prev, cur in delta:
+            for kb, prev, cur in delta:
                 if cur is None:
-                    dead_keys.append(key)
+                    dead_keys.append(LedgerKey.from_xdr(kb))
                 elif prev is None:
                     init_entries.append(cur)
                 else:
                     live_entries.append(cur)
             bl.add_batch(header.ledgerSeq, header.ledgerVersion,
                          init_entries, live_entries, dead_keys)
-            header.bucketListHash = bl.get_hash()
+            bl.snapshot_ledger(header)
         else:
             h = SHA256()
             h.add(header_prev.bucketListHash)
-            for key, prev, cur in sorted(delta,
-                                         key=lambda t: t[0].to_xdr()):
-                h.add(key.to_xdr())
+            for kb, prev, cur in sorted(delta, key=lambda t: t[0]):
+                h.add(kb)
                 h.add(cur.to_xdr() if cur is not None else b"\xff" * 4)
             header.bucketListHash = h.finish()
+            # skipList advances identically with or without a real bucket
+            # list — it hangs off whatever stands in bucketListHash
+            from ..bucket.bucket_manager import calculate_skip_values
+            calculate_skip_values(header)
 
         # invariants on the tx phase of the close (upgrade deltas exempt)
-        inv = getattr(self.app, "invariant_manager", None)
         if inv is not None:
             inv.check_on_ledger_close(tx_phase_delta, header_prev,
                                       tx_phase_header)
@@ -320,14 +349,14 @@ class LedgerManager:
         ltx.commit()
         self.lcl_hash = sha256(self.root.get_header().to_xdr())
         self._store_header(self.root.get_header())
-        self._store_txs(lcd, frames, result_pairs)
+        self._store_txs(lcd, frames)
         # after the in-memory commit, like txhistory: a close that fails
         # mid-upgrade must leave no pending history rows in the sqlite
         # transaction (a catchup retry would hit the PRIMARY KEY)
         for up, changes, index in applied_upgrades:
             self._store_upgrade_history(lcd.ledger_seq, up, changes, index)
         self._store_local_has()
-        self._emit_close_meta(lcd, frames, result_pairs, applied_upgrades)
+        self._emit_close_meta(lcd, frames, applied_upgrades)
         hm = getattr(self.app, "history_manager", None)
         if hm is not None:
             hm.maybe_queue_checkpoint(self)
@@ -335,7 +364,7 @@ class LedgerManager:
                   len(frames), self.lcl_hash.hex()[:8])
 
     def _emit_close_meta(self, lcd: LedgerCloseData, frames,
-                         result_pairs, applied_upgrades) -> None:
+                         applied_upgrades) -> None:
         """Stream the full close meta to the operator's configured
         fd/file (reference LedgerManagerImpl.cpp:590,673-678 builds
         LedgerCloseMeta alongside the apply loop and emits it once the
@@ -356,9 +385,10 @@ class LedgerManager:
                 ext=_Ext.v0()),
             txSet=lcd.tx_set.to_wire(),
             txProcessing=[
-                TransactionResultMeta(result=rp, feeProcessing=f.fee_meta,
+                TransactionResultMeta(result=f.result_pair(),
+                                      feeProcessing=f.fee_meta,
                                       txApplyProcessing=f.tx_meta())
-                for f, rp in zip(frames, result_pairs)],
+                for f in frames],
             upgradesProcessing=[
                 UpgradeEntryMeta(upgrade=up, changes=changes)
                 for (up, changes, _i) in applied_upgrades],
@@ -457,19 +487,16 @@ class LedgerManager:
              header.ledgerSeq, header.scpValue.closeTime, hb))
         db.commit()
 
-    def _store_txs(self, lcd: LedgerCloseData, frames,
-                   result_pairs) -> None:
+    def _store_txs(self, lcd: LedgerCloseData, frames) -> None:
         db = getattr(self.app, "database", None)
         if db is None:
             return
-        from ..xdr import LedgerEntryChanges as _LEC
-        from ..xdr.codec import xdr_bytes as _xb
         tx_rows, fee_rows = [], []
-        for i, (f, rp) in enumerate(zip(frames, result_pairs)):
+        for i, f in enumerate(frames):
             h = f.contents_hash().hex()
             tx_rows.append((h, lcd.ledger_seq, i, f.envelope_bytes(),
-                            rp.to_xdr(), f.tx_meta().to_xdr()))
-            fee_rows.append((h, lcd.ledger_seq, i, _xb(_LEC, f.fee_meta)))
+                            f.result_pair_xdr(), f.tx_meta_xdr()))
+            fee_rows.append((h, lcd.ledger_seq, i, f.fee_meta_xdr()))
         db.executemany(
             "INSERT OR REPLACE INTO txhistory (txid, ledgerseq, "
             "txindex, txbody, txresult, txmeta) VALUES (?,?,?,?,?,?)",

@@ -102,7 +102,11 @@ class AbstractLedgerTxnParent:
         raise NotImplementedError
 
     def commit_child(self, changes: Dict[bytes, Optional[LedgerEntry]],
-                     header: LedgerHeader) -> None:
+                     header: LedgerHeader,
+                     blobs: Optional[Dict[bytes, bytes]] = None) -> None:
+        """`blobs` optionally carries known-serialized forms of entries in
+        `changes` (native-injected deltas) so roots can skip
+        re-serializing them."""
         raise NotImplementedError
 
 
@@ -113,6 +117,18 @@ class LedgerTxn(AbstractLedgerTxnParent):
         self._parent = parent
         self._changes: Dict[bytes, Optional[LedgerEntry]] = {}
         self._previous: Dict[bytes, Optional[bytes]] = {}  # pre-images (xdr)
+        # parsed pre-image snapshots (same instant as _previous): get_delta
+        # reads these instead of re-parsing the blob — a structural copy at
+        # record time is ~4x cheaper than LedgerEntry.from_xdr at delta
+        # time, and the close path takes a delta per fee/op txn
+        self._prev_objs: Dict[bytes, LedgerEntry] = {}
+        # serialized forms of UNTOUCHED _changes values (native-injected
+        # deltas): valid only while the parsed object has never been
+        # handed to a mutator — every path that exposes a mutable entry
+        # pops the key. get_delta/commit reuse these instead of
+        # re-serializing, the close path's main self-cost after the
+        # native engine (replay profile)
+        self._cur_blobs: Dict[bytes, bytes] = {}
         self._header = _copy_header(parent.get_header())
         self._open = True
         self._child: Optional["LedgerTxn"] = None
@@ -136,7 +152,12 @@ class LedgerTxn(AbstractLedgerTxnParent):
     def get_entry(self, key: LedgerKey) -> Optional[LedgerEntry]:
         kb = _kb(key)
         if kb in self._changes:
-            return self._changes[kb]
+            cur = self._changes[kb]
+            if cur is not None:
+                # the caller holds an aliased reference from here on; a
+                # mutation through it must not leave a stale blob behind
+                self._cur_blobs.pop(kb, None)
+            return cur
         return self._parent.get_entry(key)
 
     def load(self, key: LedgerKey) -> Optional[LedgerEntry]:
@@ -146,12 +167,16 @@ class LedgerTxn(AbstractLedgerTxnParent):
         kb = _kb(key)
         if kb in self._changes:
             cur = self._changes[kb]
+            if cur is not None:
+                self._cur_blobs.pop(kb, None)   # handing out a mutable ref
             return cur
         base = self._parent.get_entry(key)
         if base is None:
             return None
         mine = _copy_entry(base)
-        self._previous.setdefault(kb, base.to_xdr())
+        if kb not in self._previous:
+            self._previous[kb] = base.to_xdr()
+            self._prev_objs[kb] = _copy_entry(base)
         self._changes[kb] = mine
         return mine
 
@@ -161,6 +186,22 @@ class LedgerTxn(AbstractLedgerTxnParent):
         e = self.get_entry(key)
         return _copy_entry(e) if e is not None else None
 
+    def inject_native_changes(self, changes) -> None:
+        """Install the native apply engine's close-level delta
+        (ledger/native_apply.py): `changes` is [(key_xdr, prev_xdr|None,
+        cur_xdr|None)] in first-touch order, exactly what this txn's
+        _previous/_changes would hold after the Python fee+apply phases.
+        Entries parse once per close here instead of once per tx there."""
+        self._assert_open()
+        assert not self._changes, "native delta injected over live changes"
+        for kb, prev_b, cur_b in changes:
+            self._previous[kb] = prev_b
+            if cur_b is None:
+                self._changes[kb] = None
+            else:
+                self._changes[kb] = LedgerEntry.from_xdr(cur_b)
+                self._cur_blobs[kb] = cur_b
+
     def create(self, entry: LedgerEntry) -> LedgerEntry:
         self._assert_open()
         key = ledger_entry_key(entry)
@@ -168,8 +209,20 @@ class LedgerTxn(AbstractLedgerTxnParent):
         assert self.get_entry(key) is None, "entry already exists"
         mine = _copy_entry(entry)
         self._previous.setdefault(kb, None)
+        self._cur_blobs.pop(kb, None)
         self._changes[kb] = mine
         return mine
+
+    def _record_previous(self, kb: bytes) -> None:
+        """Snapshot the parent-visible state of `kb` (blob + parsed)."""
+        if kb in self._previous:
+            return
+        base = self._parent.get_entry(LedgerKey.from_xdr(kb))
+        if base is None:
+            self._previous[kb] = None
+        else:
+            self._previous[kb] = base.to_xdr()
+            self._prev_objs[kb] = _copy_entry(base)
 
     def create_or_update_without_loading(self, entry: LedgerEntry) -> None:
         """Upsert with no existence check and no returned handle
@@ -178,9 +231,8 @@ class LedgerTxn(AbstractLedgerTxnParent):
         self._assert_open()
         key = ledger_entry_key(entry)
         kb = _kb(key)
-        if kb not in self._previous:
-            base = self._parent.get_entry(key)
-            self._previous[kb] = base.to_xdr() if base is not None else None
+        self._record_previous(kb)
+        self._cur_blobs.pop(kb, None)
         self._changes[kb] = _copy_entry(entry)
 
     def erase(self, key: LedgerKey) -> None:
@@ -189,7 +241,11 @@ class LedgerTxn(AbstractLedgerTxnParent):
         existing = self.get_entry(key)
         assert existing is not None, "erasing missing entry"
         if kb not in self._previous:
+            # `existing` is the parent's state here (anything recorded in
+            # _changes implies _previous was already recorded)
             self._previous[kb] = existing.to_xdr()
+            self._prev_objs[kb] = _copy_entry(existing)
+        self._cur_blobs.pop(kb, None)
         self._changes[kb] = None
 
     def erase_without_loading(self, key: LedgerKey) -> None:
@@ -197,9 +253,8 @@ class LedgerTxn(AbstractLedgerTxnParent):
         erasing an absent key is a no-op record of absence, not an error."""
         self._assert_open()
         kb = _kb(key)
-        if kb not in self._previous:
-            base = self._parent.get_entry(key)
-            self._previous[kb] = base.to_xdr() if base is not None else None
+        self._record_previous(kb)
+        self._cur_blobs.pop(kb, None)
         self._changes[kb] = None
 
     # -- order book ---------------------------------------------------------
@@ -333,7 +388,8 @@ class LedgerTxn(AbstractLedgerTxnParent):
         # (e.g. sqlite "database is locked" at the root) must leave this
         # txn open and registered so the caller can roll back — otherwise
         # the parent's child slot is bricked for every future txn
-        self._parent.commit_child(self._changes, self._header)
+        self._parent.commit_child(self._changes, self._header,
+                                  self._cur_blobs or None)
         self._open = False
         self._parent._clear_child(self)
 
@@ -343,14 +399,20 @@ class LedgerTxn(AbstractLedgerTxnParent):
             self._child.rollback()
         self._open = False
         self._changes.clear()
+        self._prev_objs.clear()
+        self._cur_blobs.clear()
         self._parent._clear_child(self)
 
     def commit_child(self, changes: Dict[bytes, Optional[LedgerEntry]],
-                     header: LedgerHeader) -> None:
+                     header: LedgerHeader,
+                     blobs: Optional[Dict[bytes, bytes]] = None) -> None:
         for kb, e in changes.items():
-            if kb not in self._previous:
-                cur = self._parent.get_entry(LedgerKey.from_xdr(kb))
-                self._previous[kb] = cur.to_xdr() if cur is not None else None
+            self._record_previous(kb)
+            b = blobs.get(kb) if (blobs and e is not None) else None
+            if b is not None:
+                self._cur_blobs[kb] = b
+            else:
+                self._cur_blobs.pop(kb, None)
             self._changes[kb] = e
         # adopt the child's header VALUES in place: callers hold references
         # from load_header(), and replacing the object would silently orphan
@@ -361,17 +423,48 @@ class LedgerTxn(AbstractLedgerTxnParent):
             setattr(self._header, n, getattr(new, n))
 
     # -- delta (meta + invariants) ------------------------------------------
-    def get_delta(self) -> List[Tuple[LedgerKey, Optional[LedgerEntry],
-                                      Optional[LedgerEntry]]]:
-        """[(key, previous, current)] for every touched-and-changed entry."""
+    def get_delta(self, need_prev: bool = True, raw_keys: bool = False
+                  ) -> List[Tuple[LedgerKey, Optional[LedgerEntry],
+                                  Optional[LedgerEntry]]]:
+        """[(key, previous, current)] for every touched-and-changed entry.
+
+        need_prev=False skips materializing the parsed pre-image for
+        native-injected deltas (blob-only): `previous` is then the raw
+        pre-image XDR for those entries — callers that only test
+        `prev is None` (the close's init/live/dead split) must not read
+        into it. Parsed pre-images recorded by load() are returned parsed
+        either way.
+
+        raw_keys=True returns the raw LedgerKey XDR instead of a parsed
+        LedgerKey — the close path only needs key OBJECTS for deleted
+        entries (bucket dead keys), so it parses those itself instead of
+        paying ~one parse per touched account per close.
+
+        The returned `current` entries are the LIVE _changes objects and
+        must be treated READ-ONLY: unlike get_entry/load, this path does
+        not invalidate _cur_blobs, so a caller mutating an entry through
+        the delta would desynchronize the cached serialized form the
+        commit path reuses."""
         out = []
         for kb, cur in self._changes.items():
             prev_b = self._previous.get(kb)
-            prev = LedgerEntry.from_xdr(prev_b) if prev_b else None
-            cur_b = cur.to_xdr() if cur is not None else None
+            if cur is None:
+                cur_b = None
+            else:
+                cur_b = self._cur_blobs.get(kb)
+                if cur_b is None:
+                    cur_b = cur.to_xdr()
             if prev_b == cur_b:
                 continue  # touched but unchanged
-            out.append((LedgerKey.from_xdr(kb), prev, cur))
+            if prev_b:
+                prev = self._prev_objs.get(kb)
+                if prev is None:   # injected native delta: blob only
+                    prev = prev_b if not need_prev \
+                        else LedgerEntry.from_xdr(prev_b)
+            else:
+                prev = None
+            key = kb if raw_keys else LedgerKey.from_xdr(kb)
+            out.append((key, prev, cur))
         return out
 
     def has_changes(self) -> bool:
@@ -407,6 +500,11 @@ class InMemoryLedgerTxnRoot(AbstractLedgerTxnParent):
     def get_entry(self, key: LedgerKey) -> Optional[LedgerEntry]:
         b = self._entries.get(_kb(key))
         return LedgerEntry.from_xdr(b) if b is not None else None
+
+    def get_entry_blob(self, kb: bytes) -> Optional[bytes]:
+        """Raw LedgerEntry XDR by key XDR — the native apply engine's
+        lookup callback (no parse, no copy)."""
+        return self._entries.get(kb)
 
     def _all_offers_for_book(self, selling, buying):
         out: Dict[bytes, LedgerEntry] = {}
@@ -445,12 +543,13 @@ class InMemoryLedgerTxnRoot(AbstractLedgerTxnParent):
                 out[kb] = LedgerEntry.from_xdr(eb)
         return out
 
-    def commit_child(self, changes, header) -> None:
+    def commit_child(self, changes, header, blobs=None) -> None:
         for kb, e in changes.items():
             if e is None:
                 self._entries.pop(kb, None)
             else:
-                self._entries[kb] = e.to_xdr()
+                b = blobs.get(kb) if blobs else None
+                self._entries[kb] = b if b is not None else e.to_xdr()
         self._header = header
 
     def count_entries(self) -> int:
@@ -498,6 +597,16 @@ class LedgerTxnRoot(AbstractLedgerTxnParent):
         if not blob:
             return None
         return LedgerEntry.from_xdr(blob)
+
+    def get_entry_blob(self, kb: bytes) -> Optional[bytes]:
+        """Raw LedgerEntry XDR by key XDR, through the entry cache — the
+        native apply engine's lookup callback."""
+        hit = self._cache.maybe_get(kb)
+        if hit is not None:
+            return hit or None
+        blob = self._select_blob(LedgerKey.from_xdr(kb))
+        self._cache.put(kb, blob if blob is not None else b"")
+        return blob
 
     def _select_blob(self, key: LedgerKey) -> Optional[bytes]:
         t = key.disc
@@ -586,7 +695,7 @@ class LedgerTxnRoot(AbstractLedgerTxnParent):
         self._cache.clear()
 
     # -- commit -------------------------------------------------------------
-    def commit_child(self, changes, header) -> None:
+    def commit_child(self, changes, header, blobs=None) -> None:
         with self._db.transaction():
             for kb, e in changes.items():
                 key = LedgerKey.from_xdr(kb)
@@ -594,8 +703,11 @@ class LedgerTxnRoot(AbstractLedgerTxnParent):
                     self._delete(key)
                     self._cache.put(kb, b"")
                 else:
-                    self._upsert(key, e)
-                    self._cache.put(kb, e.to_xdr())
+                    b = blobs.get(kb) if blobs else None
+                    if b is None:
+                        b = e.to_xdr()
+                    self._upsert(key, e, b)
+                    self._cache.put(kb, b)
             self._header = header
 
     def _delete(self, key: LedgerKey) -> None:
@@ -615,9 +727,11 @@ class LedgerTxnRoot(AbstractLedgerTxnParent):
                 "DELETE FROM accountdata WHERE accountid=? AND dataname=?",
                 (_acc_str(v.accountID), v.dataName))
 
-    def _upsert(self, key: LedgerKey, e: LedgerEntry) -> None:
+    def _upsert(self, key: LedgerKey, e: LedgerEntry,
+                blob: Optional[bytes] = None) -> None:
         t = key.disc
-        blob = e.to_xdr()
+        if blob is None:
+            blob = e.to_xdr()
         lm = e.lastModifiedLedgerSeq
         d = e.data.value
         if t == LedgerEntryType.ACCOUNT:

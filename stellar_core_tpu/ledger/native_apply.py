@@ -1,0 +1,73 @@
+"""Close-time glue for the native transaction-apply fast path.
+
+native/applyc.c implements the fee + apply phases of a ledger close for
+the replay workload's subset (plain v1 envelopes, payment /
+create-account / set_options ops, ed25519-only signer sets, protocol
+>= 10). This module decides per
+close whether the engine may run, feeds it, and installs its outputs so
+everything downstream of the apply loop — result hash, bucket-list delta,
+tx/fee history rows, close meta, invariants — runs unchanged Python over
+identical state.
+
+The engine returns None for ANY input outside its subset before mutating
+shared state, so the Python apply path (the differential-test oracle,
+tests/test_native_apply.py) remains the single source of semantics.
+
+Gate: SCT_NATIVE_APPLY=0 disables (mirroring SCT_NATIVE_XDR); an absent
+compiler disables silently.
+"""
+
+from __future__ import annotations
+
+from typing import List, Optional
+
+
+def native_apply_txset(lm, ltx, frames, base_fee: Optional[int],
+                       verifier) -> bool:
+    """Run the whole txset's fee+apply phases natively. Returns False on
+    any ineligibility/bailout with NO state mutated (the caller then runs
+    the Python phases); True means ltx, the header fee pool, and every
+    frame's result/meta are populated exactly as the Python path would
+    have."""
+    if not getattr(lm, "use_native_apply", True):
+        return False
+    from ..native import apply_engine
+    eng = apply_engine()
+    if eng is None:
+        return False
+    from ..transactions.transaction_frame import TransactionFrame
+    if ltx._changes:
+        return False  # engine reads close-start state from the root
+    header = ltx.load_header()
+    if header.ledgerVersion < 10:
+        return False
+    for f in frames:
+        if type(f) is not TransactionFrame:
+            return False  # fee bumps: Python path
+    get_blob = getattr(lm.root, "get_entry_blob", None)
+    if get_blob is None:
+        return False
+    if verifier is None:
+        from ..crypto.batch_verifier import CpuSigVerifier
+        verifier = CpuSigVerifier()
+    params = {
+        "ledgerVersion": header.ledgerVersion,
+        "ledgerSeq": header.ledgerSeq,
+        "closeTime": header.scpValue.closeTime,
+        "baseFee": header.baseFee,
+        "baseReserve": header.baseReserve,
+        "effBaseFee": base_fee if base_fee is not None else header.baseFee,
+        "feePool": header.feePool,
+    }
+    envs: List[bytes] = [f.envelope_bytes() for f in frames]
+    hashes: List[bytes] = [f.contents_hash() for f in frames]
+    out = eng.apply_close(params, envs, hashes, get_blob,
+                          verifier.prewarm_many)
+    if out is None:
+        return False
+    header.feePool = out["feePool"]
+    ltx.inject_native_changes(out["changes"])
+    for f, rb, fcb, mb in zip(frames, out["results"], out["fee_changes"],
+                              out["meta"]):
+        f.set_native_apply_output(rb, fcb, mb)
+    return True

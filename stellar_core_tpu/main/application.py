@@ -155,6 +155,11 @@ class Application:
 
     def stop(self) -> None:
         self.state = AppState.APP_STOPPING
+        # interrupt any background quorum-intersection enumeration first:
+        # joining that worker can otherwise take minutes (reference
+        # HerderImpl.cpp:140-144)
+        if self.herder is not None:
+            self.herder.interrupt_quorum_intersection()
         self.command_handler.stop_http()
         if self.overlay_manager is not None:
             self.overlay_manager.shutdown()

@@ -92,9 +92,16 @@ class CommandHandler:
     def cmd_checkquorum(self, params) -> dict:
         """Run the quorum-intersection checker over the transitive quorum
         map (reference `check-quorum` / periodic reanalysis); pass
-        critical=true to also list intersection-critical groups."""
+        critical=true to also list intersection-critical groups; pass
+        background=true to run it on a worker thread (poll `quorum` for
+        the result) so a slow enumeration never blocks the main loop."""
         crit = params.get("critical", "") in ("true", "1")
-        return self.app.herder.check_quorum_intersection(critical=crit)
+        h = self.app.herder
+        if params.get("background", "") in ("true", "1"):
+            started = h.start_quorum_intersection_check(critical=crit)
+            return {"status": "started" if started
+                    else "already recalculating"}
+        return h.check_quorum_intersection(critical=crit)
 
     def cmd_scp(self, params) -> dict:
         h = self.app.herder

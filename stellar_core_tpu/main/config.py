@@ -8,7 +8,10 @@ TPU-specific crypto-backend gate (SIG_VERIFY_BACKEND).
 
 from __future__ import annotations
 
-import tomllib
+try:
+    import tomllib
+except ImportError:       # Python < 3.11: the tomli backport is the
+    import tomli as tomllib  # same parser under its pre-stdlib name
 from typing import Dict, List, Optional
 
 from ..crypto.hashing import sha256

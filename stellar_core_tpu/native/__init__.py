@@ -93,6 +93,10 @@ def _load() -> Optional[ctypes.CDLL]:
                 ctypes.c_void_p, ctypes.c_void_p, ctypes.c_void_p,
                 ctypes.c_void_p, ctypes.c_void_p, ctypes.c_void_p,
                 ctypes.c_void_p]
+            lib.sct_cache_keys.restype = ctypes.c_int
+            lib.sct_cache_keys.argtypes = [
+                ctypes.c_char_p, ctypes.c_char_p, ctypes.c_void_p,
+                ctypes.c_void_p, ctypes.c_int64, ctypes.c_void_p]
             _LIB = lib
         except Exception:
             _LIB = None
@@ -135,6 +139,185 @@ def prepare_batch_native(pub_arr: np.ndarray, sig_arr: np.ndarray,
     return {"ay": ay, "a_sign": a_sign, "ry": ry, "r_sign": r_sign,
             "s_nibs": s_nibs, "k_nibs": k_nibs,
             "pre_ok": pre_ok.astype(bool)}
+
+
+def cache_keys_native(triples) -> Optional[list]:
+    """[(key32, sig64, msg)] → [sha256(key‖sig‖msg)] in one C call, or
+    None (malformed lengths / library unavailable — callers fall back to
+    the per-triple hashlib path). One drain's worth of verify-cache keys
+    is ~1/3 of the host-side prewarm cost when hashed in Python."""
+    lib = _load()
+    n = len(triples)
+    if lib is None or n == 0:
+        return None
+    pubs = b"".join(t[0] for t in triples)
+    sigs = b"".join(t[1] for t in triples)
+    if len(pubs) != 32 * n or len(sigs) != 64 * n:
+        return None
+    msgs = b"".join(t[2] for t in triples)
+    off = np.zeros(n + 1, np.uint64)
+    np.cumsum([len(t[2]) for t in triples], out=off[1:])
+    msg_c = np.frombuffer(msgs, np.uint8) if msgs else np.zeros(1, np.uint8)
+    out = np.empty(32 * n, np.uint8)
+    lib.sct_cache_keys(pubs, sigs, msg_c.ctypes.data, off.ctypes.data, n,
+                       out.ctypes.data)
+    ob = out.tobytes()
+    return [ob[32 * i:32 * i + 32] for i in range(n)]
+
+
+# --------------------------------------------------------------------------
+# Native ed25519/X25519 (ed25519c.c): the CPU crypto floor when the
+# `cryptography` package is absent. Loaded via ctypes like prep.c; shares
+# the generated prep_constants.h. crypto/fallback.py holds the pure-Python
+# oracle used when no compiler is available.
+
+_ED_LIB = None
+_ED_TRIED = False
+
+
+class _Ed25519Native:
+    """Thin ctypes wrapper; one instance per process."""
+
+    def __init__(self, lib) -> None:
+        self._lib = lib
+
+    def public(self, seed: bytes) -> bytes:
+        out = ctypes.create_string_buffer(32)
+        self._lib.sct_ed25519_public(seed, out)
+        return out.raw
+
+    def sign(self, seed: bytes, msg: bytes) -> bytes:
+        out = ctypes.create_string_buffer(64)
+        self._lib.sct_ed25519_sign(seed, msg, len(msg), out)
+        return out.raw
+
+    def verify(self, pub: bytes, sig: bytes, msg: bytes) -> bool:
+        if len(pub) != 32 or len(sig) != 64:
+            return False
+        return bool(self._lib.sct_ed25519_verify(pub, sig, msg, len(msg)))
+
+    def verify_batch(self, triples) -> list:
+        """[(key32, sig64, msg)] → [bool] in one C call."""
+        n = len(triples)
+        if n == 0:
+            return []
+        pubs = b"".join(t[0] for t in triples)
+        sigs = b"".join(t[1] for t in triples)
+        if len(pubs) != 32 * n or len(sigs) != 64 * n:
+            # odd-length keys/sigs: per-item path handles rejections
+            return [self.verify(k, s, m) for (k, s, m) in triples]
+        msgs = b"".join(t[2] for t in triples)
+        off = np.zeros(n + 1, np.uint64)
+        np.cumsum([len(t[2]) for t in triples], out=off[1:])
+        out = np.empty(n, np.uint8)
+        self._lib.sct_ed25519_verify_batch(
+            pubs, sigs, msgs or b"\x00",
+            off.ctypes.data_as(ctypes.c_void_p), n,
+            out.ctypes.data_as(ctypes.c_void_p))
+        return out.astype(bool).tolist()
+
+    def x25519(self, scalar: bytes, u: bytes) -> bytes:
+        out = ctypes.create_string_buffer(32)
+        self._lib.sct_x25519(scalar, u, out)
+        return out.raw
+
+
+def ed25519_native() -> Optional[_Ed25519Native]:
+    """Build + load the native ed25519 library, or None (callers fall
+    back to the pure-Python path). Gated by SCT_NATIVE_ED25519."""
+    global _ED_LIB, _ED_TRIED
+    if _ED_TRIED:
+        return _ED_LIB
+    with _LOCK:
+        if _ED_TRIED:
+            return _ED_LIB
+        try:
+            if os.environ.get("SCT_NATIVE_ED25519", "1") == "0":
+                return None
+            import hashlib
+            os.makedirs(_BUILD, exist_ok=True)
+            src = os.path.join(_DIR, "ed25519c.c")
+            from .gen_constants import header_text
+            header = header_text()
+            with open(src, "rb") as fh:
+                digest = hashlib.sha256(
+                    fh.read() + header.encode()).hexdigest()[:16]
+            so = os.path.join(_BUILD, "libscted25519-%s.so" % digest)
+            if not os.path.exists(so):
+                hdr = os.path.join(_BUILD, "prep_constants.h")
+                with open(hdr, "w") as fh:
+                    fh.write(header)
+                if not _cc_build(src, so, _BUILD):
+                    return None
+            lib = ctypes.CDLL(so)
+            lib.sct_ed25519_public.argtypes = [
+                ctypes.c_char_p, ctypes.c_char_p]
+            lib.sct_ed25519_sign.argtypes = [
+                ctypes.c_char_p, ctypes.c_char_p, ctypes.c_uint64,
+                ctypes.c_char_p]
+            lib.sct_ed25519_verify.argtypes = [
+                ctypes.c_char_p, ctypes.c_char_p, ctypes.c_char_p,
+                ctypes.c_uint64]
+            lib.sct_ed25519_verify.restype = ctypes.c_int
+            lib.sct_ed25519_verify_batch.argtypes = [
+                ctypes.c_char_p, ctypes.c_char_p, ctypes.c_char_p,
+                ctypes.c_void_p, ctypes.c_int64, ctypes.c_void_p]
+            lib.sct_x25519.argtypes = [
+                ctypes.c_char_p, ctypes.c_char_p, ctypes.c_char_p]
+            if lib.sct_ed25519_init() != 0:
+                return None
+            _ED_LIB = _Ed25519Native(lib)
+        except Exception:
+            _ED_LIB = None
+        finally:
+            _ED_TRIED = True
+        return _ED_LIB
+
+
+# --------------------------------------------------------------------------
+# Native transaction-apply engine (_sctapply extension, applyc.c): the
+# replay-loop fast path. ledger/native_apply.py is the only caller; the
+# Python apply path stays the fallback and the differential oracle
+# (tests/test_native_apply.py).
+
+_APPLY_MOD = None
+_APPLY_TRIED = False
+
+
+def apply_engine():
+    """The _sctapply module, or None (gated by SCT_NATIVE_APPLY, absent
+    compiler, or build failure — callers fall back to Python apply)."""
+    global _APPLY_MOD, _APPLY_TRIED
+    if _APPLY_TRIED:
+        return _APPLY_MOD
+    with _LOCK:
+        if _APPLY_TRIED:
+            return _APPLY_MOD
+        _APPLY_TRIED = True
+        if os.environ.get("SCT_NATIVE_APPLY", "1") == "0":
+            return None
+        import hashlib
+        import importlib.util
+        import sysconfig
+
+        try:
+            os.makedirs(_BUILD, exist_ok=True)
+            src = os.path.join(_DIR, "applyc.c")
+            with open(src, "rb") as fh:
+                digest = hashlib.sha256(fh.read()).hexdigest()[:16]
+            tag = getattr(sys.implementation, "cache_tag", "py")
+            so = os.path.join(_BUILD, "_sctapply-%s-%s.so" % (tag, digest))
+            if not os.path.exists(so):
+                inc = sysconfig.get_paths()["include"]
+                if not _cc_build(src, so, inc):
+                    return None
+            spec = importlib.util.spec_from_file_location("_sctapply", so)
+            mod = importlib.util.module_from_spec(spec)
+            spec.loader.exec_module(mod)
+            _APPLY_MOD = mod
+        except Exception:
+            _APPLY_MOD = None
+        return _APPLY_MOD
 
 
 # --------------------------------------------------------------------------

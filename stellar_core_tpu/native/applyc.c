@@ -1,0 +1,2072 @@
+/* Native transaction-apply fast path for catchup replay.
+ *
+ * docs/perf-replay.md proves the end-to-end replay ratio is Amdahl-capped
+ * by ~2.2 ms/tx of Python apply cost once crypto is batched; this module
+ * removes Python from the per-tx loop the same way xdrc.c removed it from
+ * serialization. It implements the fee and apply phases of a ledger close
+ * for the subset the replay workload consists of — plain v1 envelopes
+ * whose operations are CREATE_ACCOUNT and PAYMENT (native or credit
+ * assets), sources with ed25519-only signer sets, protocol >= 10 — and
+ * returns None for anything else so the Python path (the semantics oracle,
+ * tests/test_native_apply.py) handles the close instead.
+ *
+ * Contract: entry-for-entry identical output to the Python path — same
+ * LedgerTxn delta (keys, pre-images, post-images, first-touch order), same
+ * TransactionResult XDR, same fee/tx/op meta XDR — so header hashes are
+ * bit-identical whichever path applied the close.
+ *
+ * Entry points (see native/__init__.py apply_engine()):
+ *   apply_close(params, envs, hashes, lookup, verify) -> dict | None
+ *     params: header scalars; envs/hashes: per-tx envelope XDR + contents
+ *     hash; lookup(key_xdr)->entry_xdr|None reads close-start state;
+ *     verify([(key32,sig,msg)])->[bool] is the batch crypto boundary
+ *     (BatchSigVerifier.prewarm_many — cache-aware, one device batch).
+ *
+ * State model: an overlay of parsed entries keyed by LedgerKey bytes.
+ * Only balance/seqNum/existence ever mutate under the supported ops, so
+ * updated entries serialize as byte patches of their original blobs —
+ * byte-identical round-trips by construction. A 4-deep savepoint journal
+ * (close / fee+tx / ops / op) mirrors the nested-LedgerTxn commit and
+ * rollback semantics, including per-level first-touch-order deltas.
+ */
+#define PY_SSIZE_T_CLEAN
+#include <Python.h>
+#include <stdint.h>
+#include <string.h>
+
+#define LET_ACCOUNT 0
+#define LET_TRUSTLINE 1
+
+/* TransactionResultCode */
+#define txSUCCESS 0
+#define txFAILED (-1)
+#define txTOO_EARLY (-2)
+#define txTOO_LATE (-3)
+#define txMISSING_OPERATION (-4)
+#define txBAD_SEQ (-5)
+#define txBAD_AUTH (-6)
+#define txNO_ACCOUNT (-8)
+#define txINSUFFICIENT_FEE (-9)
+#define txBAD_AUTH_EXTRA (-10)
+#define txINTERNAL_ERROR (-11)
+
+/* OperationResultCode */
+#define opINNER 0
+#define opNO_ACCOUNT (-2)
+
+/* OperationType */
+#define OP_CREATE_ACCOUNT 0
+#define OP_PAYMENT 1
+#define OP_SET_OPTIONS 5
+
+/* SetOptionsResultCode */
+#define SO_SUCCESS 0
+#define SO_LOW_RESERVE (-1)
+#define SO_TOO_MANY_SIGNERS (-2)
+#define SO_INVALID_INFLATION (-4)
+#define SO_CANT_CHANGE (-5)
+
+/* AccountFlags */
+#define AUTH_IMMUTABLE_FLAG 0x4
+#define MAX_SUBENTRIES 1000
+
+/* CreateAccountResultCode */
+#define CA_SUCCESS 0
+#define CA_UNDERFUNDED (-2)
+#define CA_LOW_RESERVE (-3)
+#define CA_ALREADY_EXIST (-4)
+
+/* PaymentResultCode */
+#define PAY_SUCCESS 0
+#define PAY_UNDERFUNDED (-2)
+#define PAY_SRC_NO_TRUST (-3)
+#define PAY_SRC_NOT_AUTHORIZED (-4)
+#define PAY_NO_DESTINATION (-5)
+#define PAY_NO_TRUST (-6)
+#define PAY_NOT_AUTHORIZED (-7)
+#define PAY_LINE_FULL (-8)
+#define PAY_NO_ISSUER (-9)
+
+#define TL_AUTHORIZED 1
+#define TL_AUTH_LEVELS_MASK 3
+
+#define INT64_MAXV 0x7fffffffffffffffLL
+#define MAXLEVEL 4
+#define NBUCKETS 1024
+#define MAX_SIGNERS 20
+#define MAX_SIGS 20
+
+typedef struct {
+    char *data;
+    Py_ssize_t len, cap;
+} Buf;
+
+static int buf_put(Buf *b, const void *src, Py_ssize_t n)
+{
+    if (b->len + n > b->cap) {
+        Py_ssize_t cap = b->cap ? b->cap : 256;
+        while (cap < b->len + n)
+            cap *= 2;
+        char *p = PyMem_Realloc(b->data, cap);
+        if (!p)
+            return -1;
+        b->data = p;
+        b->cap = cap;
+    }
+    memcpy(b->data + b->len, src, n);
+    b->len += n;
+    return 0;
+}
+
+static int buf_u32(Buf *b, uint32_t v)
+{
+    unsigned char w[4] = {(unsigned char)(v >> 24), (unsigned char)(v >> 16),
+                          (unsigned char)(v >> 8), (unsigned char)v};
+    return buf_put(b, w, 4);
+}
+
+static int buf_i32(Buf *b, int32_t v) { return buf_u32(b, (uint32_t)v); }
+
+static int buf_u64(Buf *b, uint64_t v)
+{
+    unsigned char w[8];
+    int i;
+    for (i = 0; i < 8; i++)
+        w[i] = (unsigned char)(v >> (56 - 8 * i));
+    return buf_put(b, w, 8);
+}
+
+static int buf_i64(Buf *b, int64_t v) { return buf_u64(b, (uint64_t)v); }
+
+static void wr_u32_at(uint8_t *p, uint32_t v)
+{
+    p[0] = (uint8_t)(v >> 24);
+    p[1] = (uint8_t)(v >> 16);
+    p[2] = (uint8_t)(v >> 8);
+    p[3] = (uint8_t)v;
+}
+
+static void wr_i64_at(uint8_t *p, int64_t sv)
+{
+    uint64_t v = (uint64_t)sv;
+    int i;
+    for (i = 0; i < 8; i++)
+        p[i] = (uint8_t)(v >> (56 - 8 * i));
+}
+
+/* ------------------------------------------------------------- reader */
+
+typedef struct {
+    const uint8_t *p;
+    Py_ssize_t len, pos;
+} Rd;
+
+static int rd_u32(Rd *r, uint32_t *v)
+{
+    if (r->pos + 4 > r->len)
+        return -1;
+    const uint8_t *p = r->p + r->pos;
+    *v = ((uint32_t)p[0] << 24) | ((uint32_t)p[1] << 16) |
+         ((uint32_t)p[2] << 8) | p[3];
+    r->pos += 4;
+    return 0;
+}
+
+static int rd_i64(Rd *r, int64_t *v)
+{
+    if (r->pos + 8 > r->len)
+        return -1;
+    const uint8_t *p = r->p + r->pos;
+    uint64_t u = 0;
+    int i;
+    for (i = 0; i < 8; i++)
+        u = (u << 8) | p[i];
+    *v = (int64_t)u;
+    r->pos += 8;
+    return 0;
+}
+
+static int rd_u64(Rd *r, uint64_t *v)
+{
+    int64_t s;
+    if (rd_i64(r, &s) < 0)
+        return -1;
+    *v = (uint64_t)s;
+    return 0;
+}
+
+static const uint8_t *rd_take(Rd *r, Py_ssize_t n)
+{
+    if (n < 0 || r->pos + n > r->len)
+        return NULL;
+    const uint8_t *p = r->p + r->pos;
+    r->pos += n;
+    return p;
+}
+
+static int rd_skip_padded(Rd *r, Py_ssize_t n)
+{
+    Py_ssize_t pad = (4 - (n & 3)) & 3;
+    return rd_take(r, n + pad) ? 0 : -1;
+}
+
+/* ------------------------------------------------------------- entries */
+
+/* the structural (non-balance/seq) state of an entry — mutable since
+   SET_OPTIONS joined the supported subset. Snapshotted whole per save
+   level: an ~850-byte copy per first-touch is noise next to one
+   signature verify, and byte-exact rollback/diff needs the pre-image
+   (a dirty FLAG cannot reproduce Python's touched-but-unchanged
+   filtering when an op writes identical values). */
+typedef struct {
+    uint32_t numSub, flags;
+    uint8_t thresholds[4];
+    int nsigners;
+    uint8_t signer_keys[MAX_SIGNERS][32];
+    uint32_t signer_weights[MAX_SIGNERS];
+    int has_infl;
+    uint8_t infl[32];
+    int home_len;
+    uint8_t home[32];
+} StructState;
+
+typedef struct {
+    int seen, exists;
+    int64_t balance, seqNum;
+    StructState st;
+} EntrySave;
+
+typedef struct Entry {
+    struct Entry *next;
+    uint32_t hash;
+    uint8_t *keyb;
+    int keylen;
+    uint8_t *base; /* close-start LedgerEntry blob (owned); NULL if absent */
+    int baselen;
+    int type; /* LET_ACCOUNT / LET_TRUSTLINE */
+    int exists;
+    int64_t balance, seqNum;
+    StructState st;      /* live structural state */
+    StructState base_st; /* as parsed from base (patch fast-path check) */
+    uint32_t last_modified; /* base blob's lastModifiedLedgerSeq */
+    int ext_v;              /* AccountEntryExt version in base (0/1) */
+    /* parsed from base (accounts): */
+    int64_t liab_buying, liab_selling;
+    /* trustlines: */
+    int64_t tl_limit;
+    /* patch offsets into base blob: */
+    int off_balance, off_seq;
+    /* created accounts: */
+    uint8_t acc_key[32];
+    uint32_t created_seq;
+    EntrySave save[MAXLEVEL];
+} Entry;
+
+static int struct_eq(const StructState *a, const StructState *b)
+{
+    int i;
+    if (a->numSub != b->numSub || a->flags != b->flags ||
+        memcmp(a->thresholds, b->thresholds, 4) != 0 ||
+        a->nsigners != b->nsigners || a->has_infl != b->has_infl ||
+        a->home_len != b->home_len)
+        return 0;
+    if (a->has_infl && memcmp(a->infl, b->infl, 32) != 0)
+        return 0;
+    if (a->home_len && memcmp(a->home, b->home, a->home_len) != 0)
+        return 0;
+    for (i = 0; i < a->nsigners; i++)
+        if (memcmp(a->signer_keys[i], b->signer_keys[i], 32) != 0 ||
+            a->signer_weights[i] != b->signer_weights[i])
+            return 0;
+    return 1;
+}
+
+typedef struct {
+    Entry *buckets[NBUCKETS];
+    Entry **all;
+    int nall, capall;
+    Entry **touched[MAXLEVEL];
+    int ntouched[MAXLEVEL], captouched[MAXLEVEL];
+    PyObject *lookup, *verify;
+    int64_t feePool;
+    uint32_t ledgerVersion, ledgerSeq;
+    uint64_t closeTime;
+    int64_t baseFee, baseReserve, effBase;
+    int bail;  /* unsupported input: fall back to the Python path */
+    int pyerr; /* a Python exception is set: propagate */
+} Ctx;
+
+static uint32_t fnv1a(const uint8_t *p, int n)
+{
+    uint32_t h = 2166136261u;
+    int i;
+    for (i = 0; i < n; i++) {
+        h ^= p[i];
+        h *= 16777619u;
+    }
+    return h;
+}
+
+static void ctx_free(Ctx *c)
+{
+    int i;
+    for (i = 0; i < c->nall; i++) {
+        Entry *e = c->all[i];
+        PyMem_Free(e->keyb);
+        PyMem_Free(e->base);
+        PyMem_Free(e);
+    }
+    PyMem_Free(c->all);
+    for (i = 0; i < MAXLEVEL; i++)
+        PyMem_Free(c->touched[i]);
+}
+
+/* account LedgerEntry blob -> Entry fields; returns -1 on unsupported */
+static int parse_account(Ctx *c, Entry *e, const uint8_t *blob, int len)
+{
+    Rd r = {blob, len, 0};
+    uint32_t u, ktype, n;
+    int i;
+    if (rd_u32(&r, &e->last_modified) < 0)
+        return -1;
+    if (rd_u32(&r, &u) < 0 || u != LET_ACCOUNT)
+        return -1;
+    if (rd_u32(&r, &ktype) < 0 || ktype != 0)
+        return -1;
+    const uint8_t *key = rd_take(&r, 32);
+    if (!key)
+        return -1;
+    memcpy(e->acc_key, key, 32);
+    e->off_balance = (int)r.pos;
+    if (rd_i64(&r, &e->balance) < 0)
+        return -1;
+    e->off_seq = (int)r.pos;
+    if (rd_i64(&r, &e->seqNum) < 0)
+        return -1;
+    if (rd_u32(&r, &e->st.numSub) < 0)
+        return -1;
+    if (rd_u32(&r, &u) < 0 || u > 1) /* inflationDest optional */
+        return -1;
+    e->st.has_infl = (int)u;
+    if (u == 1) {
+        const uint8_t *ip;
+        if (rd_u32(&r, &ktype) < 0 || ktype != 0 ||
+            !(ip = rd_take(&r, 32)))
+            return -1;
+        memcpy(e->st.infl, ip, 32);
+    }
+    if (rd_u32(&r, &e->st.flags) < 0)
+        return -1;
+    if (rd_u32(&r, &u) < 0 || u > 32) /* homeDomain */
+        return -1;
+    e->st.home_len = (int)u;
+    if (u) {
+        Py_ssize_t at = r.pos;
+        if (rd_skip_padded(&r, u) < 0)
+            return -1;
+        memcpy(e->st.home, blob + at, u);
+    }
+    const uint8_t *th = rd_take(&r, 4);
+    if (!th)
+        return -1;
+    memcpy(e->st.thresholds, th, 4);
+    if (rd_u32(&r, &n) < 0 || n > MAX_SIGNERS)
+        return -1;
+    e->st.nsigners = (int)n;
+    for (i = 0; i < e->st.nsigners; i++) {
+        if (rd_u32(&r, &ktype) < 0 || ktype != 0)
+            return -1; /* pre-auth-tx / hash-x signers: Python path */
+        const uint8_t *sk = rd_take(&r, 32);
+        if (!sk)
+            return -1;
+        memcpy(e->st.signer_keys[i], sk, 32);
+        if (rd_u32(&r, &e->st.signer_weights[i]) < 0)
+            return -1;
+    }
+    if (rd_u32(&r, &u) < 0 || u > 1) /* AccountEntryExt */
+        return -1;
+    e->ext_v = (int)u;
+    e->liab_buying = e->liab_selling = 0;
+    if (u == 1) {
+        if (rd_i64(&r, &e->liab_buying) < 0 ||
+            rd_i64(&r, &e->liab_selling) < 0)
+            return -1;
+        if (rd_u32(&r, &u) < 0 || u != 0) /* v1 inner ext */
+            return -1;
+    }
+    if (rd_u32(&r, &u) < 0 || u != 0) /* LedgerEntry ext */
+        return -1;
+    if (r.pos != r.len)
+        return -1;
+    e->base_st = e->st;
+    return 0;
+}
+
+static int parse_trustline(Ctx *c, Entry *e, const uint8_t *blob, int len)
+{
+    Rd r = {blob, len, 0};
+    uint32_t u, atype;
+    if (rd_u32(&r, &u) < 0) /* lastModified */
+        return -1;
+    if (rd_u32(&r, &u) < 0 || u != LET_TRUSTLINE)
+        return -1;
+    if (rd_u32(&r, &u) < 0 || u != 0 || !rd_take(&r, 32))
+        return -1;
+    if (rd_u32(&r, &atype) < 0)
+        return -1;
+    if (atype == 1) {
+        if (!rd_take(&r, 4 + 4 + 32))
+            return -1;
+    } else if (atype == 2) {
+        if (!rd_take(&r, 12 + 4 + 32))
+            return -1;
+    } else
+        return -1; /* native trustlines don't exist */
+    e->off_balance = (int)r.pos;
+    if (rd_i64(&r, &e->balance) < 0)
+        return -1;
+    if (rd_i64(&r, &e->tl_limit) < 0)
+        return -1;
+    if (rd_u32(&r, &e->st.flags) < 0)
+        return -1;
+    if (rd_u32(&r, &u) < 0 || u > 1)
+        return -1;
+    e->liab_buying = e->liab_selling = 0;
+    if (u == 1) {
+        if (rd_i64(&r, &e->liab_buying) < 0 ||
+            rd_i64(&r, &e->liab_selling) < 0)
+            return -1;
+        if (rd_u32(&r, &u) < 0 || u != 0)
+            return -1;
+    }
+    if (rd_u32(&r, &u) < 0 || u != 0)
+        return -1;
+    if (r.pos != r.len)
+        return -1;
+    e->base_st = e->st;
+    return 0;
+}
+
+/* overlay get-or-load; NULL means bail/pyerr (check ctx flags) */
+static Entry *get_entry(Ctx *c, const uint8_t *keyb, int keylen)
+{
+    uint32_t h = fnv1a(keyb, keylen);
+    Entry *e = c->buckets[h & (NBUCKETS - 1)];
+    for (; e; e = e->next)
+        if (e->hash == h && e->keylen == keylen &&
+            memcmp(e->keyb, keyb, keylen) == 0)
+            return e;
+
+    PyObject *kb = PyBytes_FromStringAndSize((const char *)keyb, keylen);
+    if (!kb) {
+        c->pyerr = 1;
+        return NULL;
+    }
+    PyObject *blob = PyObject_CallFunctionObjArgs(c->lookup, kb, NULL);
+    Py_DECREF(kb);
+    if (!blob) {
+        c->pyerr = 1;
+        return NULL;
+    }
+    e = PyMem_Calloc(1, sizeof(Entry));
+    if (!e) {
+        Py_DECREF(blob);
+        c->pyerr = 1;
+        PyErr_NoMemory();
+        return NULL;
+    }
+    e->hash = h;
+    e->keylen = keylen;
+    e->keyb = PyMem_Malloc(keylen);
+    if (!e->keyb) {
+        PyMem_Free(e);
+        Py_DECREF(blob);
+        c->pyerr = 1;
+        PyErr_NoMemory();
+        return NULL;
+    }
+    memcpy(e->keyb, keyb, keylen);
+    {
+        Rd kr = {keyb, keylen, 0};
+        uint32_t kt = 0;
+        rd_u32(&kr, &kt);
+        e->type = (int)kt;
+    }
+    if (blob == Py_None) {
+        e->exists = 0;
+    } else if (PyBytes_Check(blob)) {
+        Py_ssize_t bl = PyBytes_GET_SIZE(blob);
+        e->base = PyMem_Malloc(bl > 0 ? bl : 1);
+        if (!e->base) {
+            PyMem_Free(e->keyb);
+            PyMem_Free(e);
+            Py_DECREF(blob);
+            c->pyerr = 1;
+            PyErr_NoMemory();
+            return NULL;
+        }
+        memcpy(e->base, PyBytes_AS_STRING(blob), bl);
+        e->baselen = (int)bl;
+        e->exists = 1;
+        int rc = (e->type == LET_ACCOUNT)
+                     ? parse_account(c, e, e->base, e->baselen)
+                     : (e->type == LET_TRUSTLINE)
+                           ? parse_trustline(c, e, e->base, e->baselen)
+                           : -1;
+        if (rc < 0) {
+            c->bail = 1;
+            PyMem_Free(e->keyb);
+            PyMem_Free(e->base);
+            PyMem_Free(e);
+            Py_DECREF(blob);
+            return NULL;
+        }
+    } else {
+        c->bail = 1;
+        PyMem_Free(e->keyb);
+        PyMem_Free(e);
+        Py_DECREF(blob);
+        return NULL;
+    }
+    Py_DECREF(blob);
+    if (c->nall == c->capall) {
+        int cap = c->capall ? c->capall * 2 : 64;
+        Entry **p = PyMem_Realloc(c->all, cap * sizeof(Entry *));
+        if (!p) {
+            PyMem_Free(e->keyb);
+            PyMem_Free(e->base);
+            PyMem_Free(e);
+            c->pyerr = 1;
+            PyErr_NoMemory();
+            return NULL;
+        }
+        c->all = p;
+        c->capall = cap;
+    }
+    c->all[c->nall++] = e;
+    e->next = c->buckets[h & (NBUCKETS - 1)];
+    c->buckets[h & (NBUCKETS - 1)] = e;
+    return e;
+}
+
+static Entry *get_account(Ctx *c, const uint8_t *accid)
+{
+    uint8_t keyb[40];
+    wr_u32_at(keyb, LET_ACCOUNT);
+    wr_u32_at(keyb + 4, 0); /* PUBLIC_KEY_TYPE_ED25519 */
+    memcpy(keyb + 8, accid, 32);
+    return get_entry(c, keyb, 40);
+}
+
+/* trustline key: u32 TRUSTLINE | AccountID | Asset (raw asset bytes) */
+static Entry *get_trustline(Ctx *c, const uint8_t *accid,
+                            const uint8_t *asset, int assetlen)
+{
+    uint8_t keyb[40 + 52];
+    wr_u32_at(keyb, LET_TRUSTLINE);
+    wr_u32_at(keyb + 4, 0);
+    memcpy(keyb + 8, accid, 32);
+    memcpy(keyb + 40, asset, assetlen);
+    return get_entry(c, keyb, 40 + assetlen);
+}
+
+/* ----------------------------------------------------- savepoint journal */
+
+static int touch(Ctx *c, Entry *e, int lv)
+{
+    if (e->save[lv].seen)
+        return 0;
+    e->save[lv].seen = 1;
+    e->save[lv].exists = e->exists;
+    e->save[lv].balance = e->balance;
+    e->save[lv].seqNum = e->seqNum;
+    e->save[lv].st = e->st;
+    if (c->ntouched[lv] == c->captouched[lv]) {
+        int cap = c->captouched[lv] ? c->captouched[lv] * 2 : 32;
+        Entry **p = PyMem_Realloc(c->touched[lv], cap * sizeof(Entry *));
+        if (!p) {
+            c->pyerr = 1;
+            PyErr_NoMemory();
+            return -1;
+        }
+        c->touched[lv] = p;
+        c->captouched[lv] = cap;
+    }
+    c->touched[lv][c->ntouched[lv]++] = e;
+    return 0;
+}
+
+static int commit_level(Ctx *c, int lv)
+{
+    int i;
+    for (i = 0; i < c->ntouched[lv]; i++) {
+        Entry *e = c->touched[lv][i];
+        if (!e->save[lv - 1].seen) {
+            e->save[lv - 1] = e->save[lv]; /* pre-lv state becomes the
+                                              parent's first-touch image */
+            e->save[lv - 1].seen = 1;
+            if (c->ntouched[lv - 1] == c->captouched[lv - 1]) {
+                int cap = c->captouched[lv - 1] ? c->captouched[lv - 1] * 2
+                                                : 32;
+                Entry **p = PyMem_Realloc(c->touched[lv - 1],
+                                          cap * sizeof(Entry *));
+                if (!p) {
+                    c->pyerr = 1;
+                    PyErr_NoMemory();
+                    return -1;
+                }
+                c->touched[lv - 1] = p;
+                c->captouched[lv - 1] = cap;
+            }
+            c->touched[lv - 1][c->ntouched[lv - 1]++] = e;
+        }
+        e->save[lv].seen = 0;
+    }
+    c->ntouched[lv] = 0;
+    return 0;
+}
+
+static void rollback_level(Ctx *c, int lv)
+{
+    int i;
+    for (i = 0; i < c->ntouched[lv]; i++) {
+        Entry *e = c->touched[lv][i];
+        e->exists = e->save[lv].exists;
+        e->balance = e->save[lv].balance;
+        e->seqNum = e->save[lv].seqNum;
+        e->st = e->save[lv].st;
+        e->save[lv].seen = 0;
+    }
+    c->ntouched[lv] = 0;
+}
+
+/* -------------------------------------------------------- serialization */
+
+/* append the LedgerEntry blob for state (exists assumed) */
+static int ser_entry(Ctx *c, Entry *e, int64_t balance, int64_t seqNum,
+                     const StructState *st, Buf *out)
+{
+    if (e->base && struct_eq(st, &e->base_st)) {
+        /* structure untouched: reuse the base blob bitwise, patching
+           only balance/seq — zero re-encode risk on the payment path */
+        Py_ssize_t at = out->len;
+        if (buf_put(out, e->base, e->baselen) < 0)
+            return -1;
+        uint8_t *p = (uint8_t *)out->data + at;
+        wr_i64_at(p + e->off_balance, balance);
+        if (e->type == LET_ACCOUNT)
+            wr_i64_at(p + e->off_seq, seqNum);
+        return 0;
+    }
+    if (e->type != LET_ACCOUNT)
+        return -1; /* structural trustline change: unreachable */
+    /* full AccountEntry build: structure changed (SET_OPTIONS) or the
+       account was created this close. Byte layout mirrors
+       xdr/ledger_entries.py AccountEntry / make_account_entry exactly;
+       lastModified stays the base's value (the Python path never
+       rewrites it on update). */
+    uint32_t lm = e->base ? e->last_modified : e->created_seq;
+    if (buf_u32(out, lm) < 0 || buf_u32(out, LET_ACCOUNT) < 0 ||
+        buf_u32(out, 0) < 0 || buf_put(out, e->acc_key, 32) < 0 ||
+        buf_i64(out, balance) < 0 || buf_i64(out, seqNum) < 0 ||
+        buf_u32(out, st->numSub) < 0 ||
+        buf_u32(out, (uint32_t)st->has_infl) < 0)
+        return -1;
+    if (st->has_infl &&
+        (buf_u32(out, 0) < 0 || buf_put(out, st->infl, 32) < 0))
+        return -1;
+    if (buf_u32(out, st->flags) < 0 ||
+        buf_u32(out, (uint32_t)st->home_len) < 0)
+        return -1;
+    if (st->home_len) {
+        static const uint8_t zpad[4] = {0, 0, 0, 0};
+        int pad = (4 - (st->home_len & 3)) & 3;
+        if (buf_put(out, st->home, st->home_len) < 0 ||
+            (pad && buf_put(out, zpad, pad) < 0))
+            return -1;
+    }
+    if (buf_put(out, st->thresholds, 4) < 0 ||
+        buf_u32(out, (uint32_t)st->nsigners) < 0)
+        return -1;
+    for (int i = 0; i < st->nsigners; i++) {
+        if (buf_u32(out, 0) < 0 /* SIGNER_KEY_TYPE_ED25519 */ ||
+            buf_put(out, st->signer_keys[i], 32) < 0 ||
+            buf_u32(out, st->signer_weights[i]) < 0)
+            return -1;
+    }
+    if (buf_u32(out, (uint32_t)e->ext_v) < 0)
+        return -1;
+    if (e->ext_v == 1 &&
+        (buf_i64(out, e->liab_buying) < 0 ||
+         buf_i64(out, e->liab_selling) < 0 ||
+         buf_u32(out, 0) < 0 /* v1 inner ext */))
+        return -1;
+    if (buf_u32(out, 0) < 0 /* LedgerEntry ext v0 */)
+        return -1;
+    return 0;
+}
+
+static int entry_changed_since(Entry *e, EntrySave *s)
+{
+    if (s->exists != e->exists)
+        return 1;
+    if (!e->exists)
+        return 0;
+    if (s->balance != e->balance)
+        return 1;
+    if (e->type == LET_ACCOUNT && s->seqNum != e->seqNum)
+        return 1;
+    if (!struct_eq(&e->st, &s->st))
+        return 1; /* signers/thresholds/flags/... (SET_OPTIONS) */
+    return 0;
+}
+
+/* LedgerEntryChanges blob for level lv (does NOT commit/rollback).
+   Mirrors LedgerTxn.get_delta + delta_to_changes: entries in first-touch
+   order, touched-but-unchanged filtered, STATE before UPDATED, CREATED
+   alone. Deletions cannot occur under the supported ops. */
+static PyObject *delta_changes_blob(Ctx *c, int lv)
+{
+    Buf b = {NULL, 0, 0};
+    uint32_t n = 0;
+    int i;
+    if (buf_u32(&b, 0) < 0)
+        goto fail;
+    for (i = 0; i < c->ntouched[lv]; i++) {
+        Entry *e = c->touched[lv][i];
+        EntrySave *s = &e->save[lv];
+        if (!entry_changed_since(e, s))
+            continue;
+        if (s->exists && e->exists) {
+            if (buf_u32(&b, 3) < 0 || /* LEDGER_ENTRY_STATE */
+                ser_entry(c, e, s->balance, s->seqNum, &s->st, &b) < 0)
+                goto fail;
+            if (buf_u32(&b, 1) < 0 || /* LEDGER_ENTRY_UPDATED */
+                ser_entry(c, e, e->balance, e->seqNum, &e->st, &b) < 0)
+                goto fail;
+            n += 2;
+        } else if (!s->exists && e->exists) {
+            if (buf_u32(&b, 0) < 0 || /* LEDGER_ENTRY_CREATED */
+                ser_entry(c, e, e->balance, e->seqNum, &e->st, &b) < 0)
+                goto fail;
+            n += 1;
+        } else {
+            goto fail; /* deletion: unreachable in the supported subset */
+        }
+    }
+    wr_u32_at((uint8_t *)b.data, n);
+    {
+        PyObject *r = PyBytes_FromStringAndSize(b.data, b.len);
+        PyMem_Free(b.data);
+        if (!r)
+            c->pyerr = 1;
+        return r;
+    }
+fail:
+    PyMem_Free(b.data);
+    if (!PyErr_Occurred())
+        c->bail = 1;
+    else
+        c->pyerr = 1;
+    return NULL;
+}
+
+/* ------------------------------------------------------------ tx parsing */
+
+typedef struct {
+    int has_src;
+    uint8_t src[32];
+    int optype;
+    uint8_t dest[32];
+    int64_t amount; /* PAYMENT amount / CREATE_ACCOUNT startingBalance */
+    int asset_native;
+    uint8_t asset[52]; /* raw Asset XDR bytes */
+    int assetlen;
+    const uint8_t *issuer; /* into asset[] */
+    /* SET_OPTIONS (every field optional on the wire) */
+    int so_has_infl, so_has_clear, so_has_set;
+    int so_has_mw, so_has_lt, so_has_mt, so_has_ht;
+    int so_has_home, so_has_signer;
+    uint8_t so_infl[32];
+    uint32_t so_clear, so_set, so_mw, so_lt, so_mt, so_ht;
+    int so_home_len;
+    uint8_t so_home[32];
+    uint8_t so_signer_key[32];
+    uint32_t so_signer_w;
+} Op;
+
+typedef struct {
+    uint8_t src[32];
+    uint32_t fee;
+    int64_t seqNum;
+    int has_tb;
+    uint64_t minTime, maxTime;
+    int nops;
+    Op *ops;
+    int nsigs;
+    struct {
+        uint8_t hint[4];
+        const uint8_t *sig;
+        int siglen;
+        PyObject *sig_obj; /* lazily-built bytes for the verify callback */
+        int used;
+    } sigs[MAX_SIGS];
+    const uint8_t *hash; /* borrowed from hashes list */
+    PyObject *hash_obj;  /* borrowed */
+    int64_t feeCharged;
+} Tx;
+
+/* MuxedAccount, ed25519 arm only (muxed sub-ids: Python path) */
+static int rd_muxed(Rd *r, uint8_t *out32)
+{
+    uint32_t kt;
+    if (rd_u32(r, &kt) < 0 || kt != 0)
+        return -1;
+    const uint8_t *p = rd_take(r, 32);
+    if (!p)
+        return -1;
+    memcpy(out32, p, 32);
+    return 0;
+}
+
+static int rd_asset(Rd *r, Op *op)
+{
+    Py_ssize_t at = r->pos;
+    uint32_t atype;
+    if (rd_u32(r, &atype) < 0)
+        return -1;
+    if (atype == 0) {
+        op->asset_native = 1;
+        op->assetlen = 4;
+    } else if (atype == 1 || atype == 2) {
+        int codelen = (atype == 1) ? 4 : 12;
+        uint32_t kt;
+        if (!rd_take(r, codelen))
+            return -1;
+        if (rd_u32(r, &kt) < 0 || kt != 0)
+            return -1;
+        if (!rd_take(r, 32))
+            return -1;
+        op->asset_native = 0;
+        op->assetlen = (int)(r->pos - at);
+    } else
+        return -1;
+    memcpy(op->asset, r->p + at, r->pos - at);
+    op->issuer = op->asset + op->assetlen - 32;
+    return 0;
+}
+
+static int parse_envelope(Ctx *c, const uint8_t *blob, Py_ssize_t len,
+                          Tx *t)
+{
+    Rd r = {blob, len, 0};
+    uint32_t u, n;
+    int i;
+    if (rd_u32(&r, &u) < 0 || u != 2) /* ENVELOPE_TYPE_TX */
+        return -1;
+    if (rd_muxed(&r, t->src) < 0)
+        return -1;
+    if (rd_u32(&r, &t->fee) < 0 || rd_i64(&r, &t->seqNum) < 0)
+        return -1;
+    if (rd_u32(&r, &u) < 0 || u > 1)
+        return -1;
+    t->has_tb = (int)u;
+    if (t->has_tb &&
+        (rd_u64(&r, &t->minTime) < 0 || rd_u64(&r, &t->maxTime) < 0))
+        return -1;
+    if (rd_u32(&r, &u) < 0) /* memo */
+        return -1;
+    switch (u) {
+    case 0:
+        break;
+    case 1: {
+        uint32_t sl;
+        if (rd_u32(&r, &sl) < 0 || sl > 28 || rd_skip_padded(&r, sl) < 0)
+            return -1;
+        break;
+    }
+    case 2:
+        if (!rd_take(&r, 8))
+            return -1;
+        break;
+    case 3:
+    case 4:
+        if (!rd_take(&r, 32))
+            return -1;
+        break;
+    default:
+        return -1;
+    }
+    if (rd_u32(&r, &n) < 0 || n > 100)
+        return -1;
+    t->nops = (int)n;
+    t->ops = PyMem_Calloc(n ? n : 1, sizeof(Op));
+    if (!t->ops) {
+        c->pyerr = 1;
+        PyErr_NoMemory();
+        return -1;
+    }
+    for (i = 0; i < t->nops; i++) {
+        Op *op = &t->ops[i];
+        if (rd_u32(&r, &u) < 0 || u > 1)
+            return -1;
+        op->has_src = (int)u;
+        if (op->has_src && rd_muxed(&r, op->src) < 0)
+            return -1;
+        if (rd_u32(&r, &u) < 0)
+            return -1;
+        op->optype = (int)u;
+        if (op->optype == OP_CREATE_ACCOUNT) {
+            uint32_t kt;
+            if (rd_u32(&r, &kt) < 0 || kt != 0)
+                return -1;
+            const uint8_t *p = rd_take(&r, 32);
+            if (!p)
+                return -1;
+            memcpy(op->dest, p, 32);
+            if (rd_i64(&r, &op->amount) < 0)
+                return -1;
+        } else if (op->optype == OP_PAYMENT) {
+            if (rd_muxed(&r, op->dest) < 0)
+                return -1;
+            if (rd_asset(&r, op) < 0)
+                return -1;
+            if (rd_i64(&r, &op->amount) < 0)
+                return -1;
+        } else if (op->optype == OP_SET_OPTIONS) {
+            uint32_t kt;
+            /* inflationDest: optional AccountID */
+            if (rd_u32(&r, &u) < 0 || u > 1)
+                return -1;
+            op->so_has_infl = (int)u;
+            if (u) {
+                const uint8_t *p;
+                if (rd_u32(&r, &kt) < 0 || kt != 0 ||
+                    !(p = rd_take(&r, 32)))
+                    return -1;
+                memcpy(op->so_infl, p, 32);
+            }
+            /* clearFlags / setFlags / the four weights: optional u32 */
+            struct {
+                int *has;
+                uint32_t *val;
+            } ou32[6] = {
+                {&op->so_has_clear, &op->so_clear},
+                {&op->so_has_set, &op->so_set},
+                {&op->so_has_mw, &op->so_mw},
+                {&op->so_has_lt, &op->so_lt},
+                {&op->so_has_mt, &op->so_mt},
+                {&op->so_has_ht, &op->so_ht},
+            };
+            for (int k = 0; k < 6; k++) {
+                if (rd_u32(&r, &u) < 0 || u > 1)
+                    return -1;
+                *ou32[k].has = (int)u;
+                if (u && rd_u32(&r, ou32[k].val) < 0)
+                    return -1;
+            }
+            /* thresholds > 255 make the Python oracle raise mid-close
+               (bytearray assignment); keep it the oracle */
+            if ((op->so_has_mw && op->so_mw > 255) ||
+                (op->so_has_lt && op->so_lt > 255) ||
+                (op->so_has_mt && op->so_mt > 255) ||
+                (op->so_has_ht && op->so_ht > 255))
+                return -1;
+            /* homeDomain: optional string32 */
+            if (rd_u32(&r, &u) < 0 || u > 1)
+                return -1;
+            op->so_has_home = (int)u;
+            if (u) {
+                uint32_t sl;
+                if (rd_u32(&r, &sl) < 0 || sl > 32)
+                    return -1;
+                Py_ssize_t at = r.pos;
+                if (rd_skip_padded(&r, sl) < 0)
+                    return -1;
+                op->so_home_len = (int)sl;
+                memcpy(op->so_home, r.p + at, sl);
+            }
+            /* signer: optional; ed25519 keys only (pre-auth-tx / hash-x
+               signers keep the whole close on the Python path, like
+               parse_account) */
+            if (rd_u32(&r, &u) < 0 || u > 1)
+                return -1;
+            op->so_has_signer = (int)u;
+            if (u) {
+                const uint8_t *p;
+                if (rd_u32(&r, &kt) < 0 || kt != 0 ||
+                    !(p = rd_take(&r, 32)))
+                    return -1;
+                memcpy(op->so_signer_key, p, 32);
+                if (rd_u32(&r, &op->so_signer_w) < 0)
+                    return -1;
+            }
+        } else
+            return -1; /* other op types: Python path */
+    }
+    if (rd_u32(&r, &u) < 0 || u != 0) /* tx ext */
+        return -1;
+    if (rd_u32(&r, &n) < 0 || n > MAX_SIGS)
+        return -1;
+    t->nsigs = (int)n;
+    for (i = 0; i < t->nsigs; i++) {
+        const uint8_t *h = rd_take(&r, 4);
+        if (!h)
+            return -1;
+        memcpy(t->sigs[i].hint, h, 4);
+        uint32_t sl;
+        if (rd_u32(&r, &sl) < 0 || sl > 64)
+            return -1;
+        Py_ssize_t pad = (4 - (sl & 3)) & 3;
+        const uint8_t *sp = rd_take(&r, sl + pad);
+        if (!sp)
+            return -1;
+        t->sigs[i].sig = sp;
+        t->sigs[i].siglen = (int)sl;
+    }
+    if (r.pos != r.len)
+        return -1;
+    return 0;
+}
+
+/* ---------------------------------------------------- signature checking */
+
+typedef struct {
+    uint8_t key[32];
+    int sigidx;
+    int ok;
+} VPair;
+
+typedef struct {
+    VPair *pairs;
+    int n, cap;
+} VSet;
+
+static int vset_add(Ctx *c, VSet *vs, const uint8_t *key, int sigidx)
+{
+    int i;
+    for (i = 0; i < vs->n; i++)
+        if (vs->pairs[i].sigidx == sigidx &&
+            memcmp(vs->pairs[i].key, key, 32) == 0)
+            return 0;
+    if (vs->n == vs->cap) {
+        int cap = vs->cap ? vs->cap * 2 : 32;
+        VPair *p = PyMem_Realloc(vs->pairs, cap * sizeof(VPair));
+        if (!p) {
+            c->pyerr = 1;
+            PyErr_NoMemory();
+            return -1;
+        }
+        vs->pairs = p;
+        vs->cap = cap;
+    }
+    memcpy(vs->pairs[vs->n].key, key, 32);
+    vs->pairs[vs->n].sigidx = sigidx;
+    vs->pairs[vs->n].ok = 0;
+    vs->n++;
+    return 0;
+}
+
+static int vset_ok(VSet *vs, const uint8_t *key, int sigidx)
+{
+    int i;
+    for (i = 0; i < vs->n; i++)
+        if (vs->pairs[i].sigidx == sigidx &&
+            memcmp(vs->pairs[i].key, key, 32) == 0)
+            return vs->pairs[i].ok;
+    return 0;
+}
+
+/* signer key set of one account as the checker sees it: account signers
+   in stored order, master key appended iff master weight > 0; for a
+   missing account, the raw key with weight 1 */
+static int account_signers(Entry *a, const uint8_t *accid,
+                           const uint8_t *keys[MAX_SIGNERS + 1],
+                           uint32_t weights[MAX_SIGNERS + 1])
+{
+    int n = 0, i;
+    if (a && a->exists) {
+        for (i = 0; i < a->st.nsigners; i++) {
+            keys[n] = a->st.signer_keys[i];
+            weights[n++] = a->st.signer_weights[i];
+        }
+        if (a->st.thresholds[0] > 0) {
+            keys[n] = a->acc_key;
+            weights[n++] = a->st.thresholds[0];
+        }
+    } else {
+        keys[n] = accid;
+        weights[n++] = 1;
+    }
+    return n;
+}
+
+/* collect hint-matching (key, sig) pairs for one account's signer set */
+static int vset_collect(Ctx *c, VSet *vs, Tx *t, Entry *a,
+                        const uint8_t *accid)
+{
+    const uint8_t *keys[MAX_SIGNERS + 1];
+    uint32_t weights[MAX_SIGNERS + 1];
+    int n = account_signers(a, accid, keys, weights);
+    int i, j;
+    for (j = 0; j < n; j++)
+        for (i = 0; i < t->nsigs; i++)
+            if (memcmp(t->sigs[i].hint, keys[j] + 28, 4) == 0)
+                if (vset_add(c, vs, keys[j], i) < 0)
+                    return -1;
+    return 0;
+}
+
+/* one batch verify callback for the whole tx's candidate pairs */
+static int vset_verify(Ctx *c, VSet *vs, Tx *t)
+{
+    if (vs->n == 0)
+        return 0;
+    PyObject *lst = PyList_New(vs->n);
+    int i;
+    if (!lst) {
+        c->pyerr = 1;
+        return -1;
+    }
+    for (i = 0; i < vs->n; i++) {
+        int si = vs->pairs[i].sigidx;
+        if (!t->sigs[si].sig_obj) {
+            t->sigs[si].sig_obj = PyBytes_FromStringAndSize(
+                (const char *)t->sigs[si].sig, t->sigs[si].siglen);
+            if (!t->sigs[si].sig_obj) {
+                Py_DECREF(lst);
+                c->pyerr = 1;
+                return -1;
+            }
+        }
+        PyObject *key = PyBytes_FromStringAndSize(
+            (const char *)vs->pairs[i].key, 32);
+        if (!key) {
+            Py_DECREF(lst);
+            c->pyerr = 1;
+            return -1;
+        }
+        PyObject *tup = PyTuple_Pack(3, key, t->sigs[si].sig_obj,
+                                     t->hash_obj);
+        Py_DECREF(key);
+        if (!tup) {
+            Py_DECREF(lst);
+            c->pyerr = 1;
+            return -1;
+        }
+        PyList_SET_ITEM(lst, i, tup);
+    }
+    PyObject *res = PyObject_CallFunctionObjArgs(c->verify, lst, NULL);
+    Py_DECREF(lst);
+    if (!res) {
+        c->pyerr = 1;
+        return -1;
+    }
+    PyObject *seq = PySequence_Fast(res, "verify() must return a sequence");
+    Py_DECREF(res);
+    if (!seq) {
+        c->pyerr = 1;
+        return -1;
+    }
+    if (PySequence_Fast_GET_SIZE(seq) != vs->n) {
+        Py_DECREF(seq);
+        c->bail = 1;
+        return -1;
+    }
+    for (i = 0; i < vs->n; i++)
+        vs->pairs[i].ok =
+            PyObject_IsTrue(PySequence_Fast_GET_ITEM(seq, i)) == 1;
+    Py_DECREF(seq);
+    return 0;
+}
+
+/* SignatureChecker.check_signature over ed25519 signers only (the bail
+   rules keep pre-auth-tx / hash-x signers off this path). Mirrors the
+   Python loop exactly: signatures in order, each consuming the first
+   remaining hint-matched verified signer; weights capped at 255; zero
+   thresholds still need one valid signer. */
+static int check_sig(Tx *t, VSet *vs, Entry *a, const uint8_t *accid,
+                     int level)
+{
+    const uint8_t *keys[MAX_SIGNERS + 1];
+    uint32_t weights[MAX_SIGNERS + 1];
+    int n = account_signers(a, accid, keys, weights);
+    uint32_t needed =
+        (a && a->exists) ? a->st.thresholds[1 + level] : 0;
+    uint32_t total = 0;
+    int i, j;
+    for (i = 0; i < t->nsigs; i++) {
+        for (j = 0; j < n; j++) {
+            if (memcmp(t->sigs[i].hint, keys[j] + 28, 4) != 0)
+                continue;
+            if (!vset_ok(vs, keys[j], i))
+                continue;
+            t->sigs[i].used = 1;
+            total += weights[j] > 255 ? 255 : weights[j];
+            if (total >= needed)
+                return 1;
+            /* consume signer j */
+            memmove(&keys[j], &keys[j + 1],
+                    (n - j - 1) * sizeof(keys[0]));
+            memmove(&weights[j], &weights[j + 1],
+                    (n - j - 1) * sizeof(weights[0]));
+            n--;
+            break;
+        }
+    }
+    return 0;
+}
+
+/* ------------------------------------------------------- balance helpers */
+
+/* transactions/account_helpers.py add_balance, protocol >= 10.
+   delta is 128-bit: Python's unbounded ints make -INT64_MIN well-defined
+   (the range checks reject it), so the C arithmetic must too. */
+static int add_balance(Ctx *c, Entry *e, __int128 delta)
+{
+    __int128 newb = (__int128)e->balance + delta;
+    if (newb < 0 || newb > INT64_MAXV)
+        return 0;
+    if (delta < 0) {
+        __int128 minb = (__int128)(2 + e->st.numSub) * c->baseReserve;
+        if (newb - minb < e->liab_selling)
+            return 0;
+    }
+    if (newb > (__int128)INT64_MAXV - e->liab_buying)
+        return 0;
+    e->balance = (int64_t)newb;
+    return 1;
+}
+
+/* transactions/account_helpers.py add_trust_balance, protocol >= 10 */
+static int add_trust_balance(Entry *e, __int128 delta)
+{
+    if (delta == 0)
+        return 1;
+    if (!(e->st.flags & TL_AUTH_LEVELS_MASK))
+        return 0;
+    __int128 newb = (__int128)e->balance + delta;
+    if (newb < 0 || newb > e->tl_limit)
+        return 0;
+    if (newb < e->liab_selling)
+        return 0;
+    if (newb > (__int128)e->tl_limit - e->liab_buying)
+        return 0;
+    e->balance = (int64_t)newb;
+    return 1;
+}
+
+/* ----------------------------------------------------------- op results */
+
+typedef struct {
+    int code;       /* OperationResultCode */
+    int optype;     /* valid when code == opINNER */
+    int inner_code; /* op-specific result code */
+} OpRes;
+
+static int buf_op_result(Buf *b, OpRes *r)
+{
+    if (buf_i32(b, r->code) < 0)
+        return -1;
+    if (r->code != opINNER)
+        return 0;
+    if (buf_i32(b, r->optype) < 0 || buf_i32(b, r->inner_code) < 0)
+        return -1;
+    return 0; /* both supported ops have void success arms */
+}
+
+static PyObject *build_result(Ctx *c, int64_t fee, int code, int nops,
+                              OpRes *ops)
+{
+    Buf b = {NULL, 0, 0};
+    int i;
+    if (buf_i64(&b, fee) < 0 || buf_i32(&b, code) < 0)
+        goto fail;
+    if (code == txSUCCESS || code == txFAILED) {
+        if (buf_u32(&b, (uint32_t)nops) < 0)
+            goto fail;
+        for (i = 0; i < nops; i++)
+            if (buf_op_result(&b, &ops[i]) < 0)
+                goto fail;
+    }
+    if (buf_u32(&b, 0) < 0) /* TransactionResult ext */
+        goto fail;
+    {
+        PyObject *r = PyBytes_FromStringAndSize(b.data, b.len);
+        PyMem_Free(b.data);
+        if (!r)
+            c->pyerr = 1;
+        return r;
+    }
+fail:
+    PyMem_Free(b.data);
+    c->pyerr = 1;
+    if (!PyErr_Occurred())
+        PyErr_NoMemory();
+    return NULL;
+}
+
+/* TransactionMeta v1 from the tx-level changes + per-op changes blobs */
+static PyObject *build_meta(Ctx *c, PyObject *tx_changes, int nops,
+                            PyObject **op_changes)
+{
+    Buf b = {NULL, 0, 0};
+    int i;
+    if (buf_u32(&b, 1) < 0) /* TransactionMeta disc v1 */
+        goto fail;
+    if (buf_put(&b, PyBytes_AS_STRING(tx_changes),
+                PyBytes_GET_SIZE(tx_changes)) < 0)
+        goto fail;
+    if (buf_u32(&b, (uint32_t)nops) < 0)
+        goto fail;
+    for (i = 0; i < nops; i++) {
+        if (op_changes && op_changes[i]) {
+            if (buf_put(&b, PyBytes_AS_STRING(op_changes[i]),
+                        PyBytes_GET_SIZE(op_changes[i])) < 0)
+                goto fail;
+        } else if (buf_u32(&b, 0) < 0)
+            goto fail;
+    }
+    {
+        PyObject *r = PyBytes_FromStringAndSize(b.data, b.len);
+        PyMem_Free(b.data);
+        if (!r)
+            c->pyerr = 1;
+        return r;
+    }
+fail:
+    PyMem_Free(b.data);
+    c->pyerr = 1;
+    if (!PyErr_Occurred())
+        PyErr_NoMemory();
+    return NULL;
+}
+
+static PyObject *empty_changes(Ctx *c)
+{
+    static const char z[4] = {0, 0, 0, 0};
+    PyObject *r = PyBytes_FromStringAndSize(z, 4);
+    if (!r)
+        c->pyerr = 1;
+    return r;
+}
+
+/* ------------------------------------------------------------ op applies */
+
+static int apply_create_account(Ctx *c, Tx *t, Op *op,
+                                const uint8_t *src_id, OpRes *res)
+{
+    res->code = opINNER;
+    res->optype = OP_CREATE_ACCOUNT;
+    Entry *dest = get_account(c, op->dest); /* load_without_record */
+    if (!dest)
+        return -1;
+    if (dest->exists) {
+        res->inner_code = CA_ALREADY_EXIST;
+        return 0;
+    }
+    if ((__int128)op->amount < (__int128)2 * c->baseReserve) {
+        res->inner_code = CA_LOW_RESERVE;
+        return 0;
+    }
+    Entry *src = get_account(c, src_id);
+    if (!src)
+        return -1;
+    if (touch(c, src, 3) < 0)
+        return -1;
+    if (!add_balance(c, src, -(__int128)op->amount)) {
+        res->inner_code = CA_UNDERFUNDED;
+        return 0;
+    }
+    if (touch(c, dest, 3) < 0)
+        return -1;
+    dest->exists = 1;
+    dest->type = LET_ACCOUNT;
+    memcpy(dest->acc_key, op->dest, 32);
+    dest->balance = op->amount;
+    dest->seqNum = (int64_t)((uint64_t)c->ledgerSeq << 32);
+    dest->created_seq = c->ledgerSeq;
+    memset(&dest->st, 0, sizeof(dest->st));
+    dest->st.thresholds[0] = 1;
+    dest->ext_v = 0;
+    dest->liab_buying = dest->liab_selling = 0;
+    res->inner_code = CA_SUCCESS;
+    return 0;
+}
+
+static int apply_payment(Ctx *c, Tx *t, Op *op, const uint8_t *src_id,
+                         OpRes *res)
+{
+    res->code = opINNER;
+    res->optype = OP_PAYMENT;
+    Entry *dest_acc = get_account(c, op->dest);
+    if (!dest_acc)
+        return -1;
+    if (touch(c, dest_acc, 3) < 0) /* ltx.load records before the check */
+        return -1;
+    if (!dest_acc->exists) {
+        res->inner_code = PAY_NO_DESTINATION;
+        return 0;
+    }
+    if (op->asset_native) {
+        Entry *src = get_account(c, src_id);
+        if (!src)
+            return -1;
+        if (touch(c, src, 3) < 0)
+            return -1;
+        if (memcmp(src_id, op->dest, 32) != 0) {
+            if (!add_balance(c, src, -(__int128)op->amount)) {
+                res->inner_code = PAY_UNDERFUNDED;
+                return 0;
+            }
+            if (!add_balance(c, dest_acc, op->amount)) {
+                res->inner_code = PAY_LINE_FULL;
+                return 0;
+            }
+        }
+        res->inner_code = PAY_SUCCESS;
+        return 0;
+    }
+    /* credit asset: source side */
+    if (memcmp(src_id, op->issuer, 32) != 0) {
+        Entry *stl = get_trustline(c, src_id, op->asset, op->assetlen);
+        if (!stl)
+            return -1;
+        if (touch(c, stl, 3) < 0)
+            return -1;
+        if (!stl->exists) {
+            res->inner_code = PAY_SRC_NO_TRUST;
+            return 0;
+        }
+        if (!(stl->st.flags & TL_AUTHORIZED)) {
+            res->inner_code = PAY_SRC_NOT_AUTHORIZED;
+            return 0;
+        }
+        if (!add_trust_balance(stl, -(__int128)op->amount)) {
+            res->inner_code = PAY_UNDERFUNDED;
+            return 0;
+        }
+    } else {
+        Entry *iss = get_account(c, op->issuer);
+        if (!iss)
+            return -1;
+        if (touch(c, iss, 3) < 0)
+            return -1;
+        if (!iss->exists) {
+            res->inner_code = PAY_NO_ISSUER;
+            return 0;
+        }
+    }
+    /* destination side */
+    if (memcmp(op->dest, op->issuer, 32) != 0) {
+        Entry *dtl = get_trustline(c, op->dest, op->asset, op->assetlen);
+        if (!dtl)
+            return -1;
+        if (touch(c, dtl, 3) < 0)
+            return -1;
+        if (!dtl->exists) {
+            res->inner_code = PAY_NO_TRUST;
+            return 0;
+        }
+        if (!(dtl->st.flags & TL_AUTHORIZED)) {
+            res->inner_code = PAY_NOT_AUTHORIZED;
+            return 0;
+        }
+        if (!add_trust_balance(dtl, op->amount)) {
+            res->inner_code = PAY_LINE_FULL;
+            return 0;
+        }
+    }
+    res->inner_code = PAY_SUCCESS;
+    return 0;
+}
+
+/* account_helpers.py change_subentries: reserve check (incl. selling
+   liabilities at v10+) on add; the remove arm cannot fail and Python
+   ignores its return value there */
+static int change_subentries(Ctx *c, Entry *e, int delta)
+{
+    int64_t nc = (int64_t)e->st.numSub + delta;
+    if (nc < 0 || nc > MAX_SUBENTRIES)
+        return 0;
+    __int128 effmin = (__int128)(2 + nc) * c->baseReserve;
+    if (c->ledgerVersion >= 10)
+        effmin += e->liab_selling;
+    if (delta > 0 && (__int128)e->balance < effmin)
+        return 0;
+    e->st.numSub = (uint32_t)nc;
+    return 1;
+}
+
+/* SetOptionsOpFrame.do_apply, arm for arm and in the same order.
+   do_check_valid does NOT run at apply (OperationFrame.apply), so no
+   validity checks here beyond what the Python apply itself would do. */
+static int apply_set_options(Ctx *c, Tx *t, Op *op, const uint8_t *src_id,
+                             OpRes *res)
+{
+    res->code = opINNER;
+    res->optype = OP_SET_OPTIONS;
+    Entry *src = get_account(c, src_id); /* exists checked by caller */
+    if (!src)
+        return -1;
+    if (touch(c, src, 3) < 0)
+        return -1;
+    if (op->so_has_infl) {
+        Entry *d = get_account(c, op->so_infl); /* load_without_record */
+        if (!d)
+            return -1;
+        if (!d->exists) {
+            res->inner_code = SO_INVALID_INFLATION;
+            return 0;
+        }
+        src->st.has_infl = 1;
+        memcpy(src->st.infl, op->so_infl, 32);
+    }
+    if (op->so_has_clear) {
+        if (src->st.flags & AUTH_IMMUTABLE_FLAG) {
+            res->inner_code = SO_CANT_CHANGE;
+            return 0;
+        }
+        src->st.flags &= ~op->so_clear;
+    }
+    if (op->so_has_set) {
+        if (src->st.flags & AUTH_IMMUTABLE_FLAG) {
+            res->inner_code = SO_CANT_CHANGE;
+            return 0;
+        }
+        src->st.flags |= op->so_set;
+    }
+    if (op->so_has_mw)
+        src->st.thresholds[0] = (uint8_t)op->so_mw;
+    if (op->so_has_lt)
+        src->st.thresholds[1] = (uint8_t)op->so_lt;
+    if (op->so_has_mt)
+        src->st.thresholds[2] = (uint8_t)op->so_mt;
+    if (op->so_has_ht)
+        src->st.thresholds[3] = (uint8_t)op->so_ht;
+    if (op->so_has_home) {
+        src->st.home_len = op->so_home_len;
+        if (op->so_home_len)
+            memcpy(src->st.home, op->so_home, op->so_home_len);
+    }
+    if (op->so_has_signer) {
+        StructState *st = &src->st;
+        int idx = -1, i;
+        for (i = 0; i < st->nsigners; i++)
+            if (memcmp(st->signer_keys[i], op->so_signer_key, 32) == 0) {
+                idx = i;
+                break;
+            }
+        if (op->so_signer_w == 0) {
+            if (idx >= 0) {
+                memmove(st->signer_keys[idx], st->signer_keys[idx + 1],
+                        (st->nsigners - idx - 1) * 32);
+                memmove(&st->signer_weights[idx],
+                        &st->signer_weights[idx + 1],
+                        (st->nsigners - idx - 1) * sizeof(uint32_t));
+                st->nsigners--;
+                change_subentries(c, src, -1); /* rc ignored, like Python */
+            }
+        } else if (idx >= 0) {
+            st->signer_weights[idx] = op->so_signer_w;
+        } else {
+            if (st->nsigners >= MAX_SIGNERS) {
+                res->inner_code = SO_TOO_MANY_SIGNERS;
+                return 0;
+            }
+            if (!change_subentries(c, src, +1)) {
+                res->inner_code = SO_LOW_RESERVE;
+                return 0;
+            }
+            memcpy(st->signer_keys[st->nsigners], op->so_signer_key, 32);
+            st->signer_weights[st->nsigners] = op->so_signer_w;
+            st->nsigners++;
+        }
+        /* Python re-sorts the WHOLE list after every signer arm (by
+           key.to_xdr(); all keys share the ed25519 type prefix, so raw
+           key bytes compare identically). Stable insertion sort. */
+        for (i = 1; i < st->nsigners; i++) {
+            uint8_t k[32];
+            uint32_t w = st->signer_weights[i];
+            int j = i;
+            memcpy(k, st->signer_keys[i], 32);
+            while (j > 0 &&
+                   memcmp(k, st->signer_keys[j - 1], 32) < 0) {
+                memcpy(st->signer_keys[j], st->signer_keys[j - 1], 32);
+                st->signer_weights[j] = st->signer_weights[j - 1];
+                j--;
+            }
+            memcpy(st->signer_keys[j], k, 32);
+            st->signer_weights[j] = w;
+        }
+    }
+    res->inner_code = SO_SUCCESS;
+    return 0;
+}
+
+/* ----------------------------------------------------------- the close */
+
+static int params_i64(PyObject *params, const char *name, int64_t *out)
+{
+    PyObject *v = PyDict_GetItemString(params, name);
+    if (!v) {
+        PyErr_Format(PyExc_KeyError, "params missing %s", name);
+        return -1;
+    }
+    *out = PyLong_AsLongLong(v);
+    if (*out == -1 && PyErr_Occurred())
+        return -1;
+    return 0;
+}
+
+static PyObject *apply_close(PyObject *self, PyObject *args)
+{
+    PyObject *params, *envs, *hashes, *lookup, *verify;
+    if (!PyArg_ParseTuple(args, "OOOOO", &params, &envs, &hashes, &lookup,
+                          &verify))
+        return NULL;
+
+    Ctx c;
+    memset(&c, 0, sizeof(c));
+    c.lookup = lookup;
+    c.verify = verify;
+
+    int64_t v;
+    if (params_i64(params, "ledgerVersion", &v) < 0)
+        return NULL;
+    c.ledgerVersion = (uint32_t)v;
+    if (params_i64(params, "ledgerSeq", &v) < 0)
+        return NULL;
+    c.ledgerSeq = (uint32_t)v;
+    if (params_i64(params, "closeTime", &v) < 0)
+        return NULL;
+    c.closeTime = (uint64_t)v;
+    if (params_i64(params, "baseFee", &c.baseFee) < 0 ||
+        params_i64(params, "baseReserve", &c.baseReserve) < 0 ||
+        params_i64(params, "effBaseFee", &c.effBase) < 0 ||
+        params_i64(params, "feePool", &c.feePool) < 0)
+        return NULL;
+
+    if (c.ledgerVersion < 10) /* pre-10 fee/seq semantics: Python path */
+        Py_RETURN_NONE;
+
+    Py_ssize_t ntx = PySequence_Length(envs);
+    if (ntx < 0)
+        return NULL;
+    if (PySequence_Length(hashes) != ntx) {
+        PyErr_SetString(PyExc_ValueError, "envs/hashes length mismatch");
+        return NULL;
+    }
+
+    Tx *txs = PyMem_Calloc(ntx ? ntx : 1, sizeof(Tx));
+    if (!txs)
+        return PyErr_NoMemory();
+
+    PyObject *results = NULL, *fee_changes = NULL, *metas = NULL;
+    PyObject *changes = NULL, *out = NULL;
+    int bailing = 0;
+    Py_ssize_t ti;
+    int i;
+
+    /* parse every envelope up front: one unsupported tx fails the whole
+       close over to Python BEFORE any state mutates */
+    for (ti = 0; ti < ntx; ti++) {
+        PyObject *env = PySequence_GetItem(envs, ti);
+        PyObject *h = PySequence_GetItem(hashes, ti);
+        if (!env || !h || !PyBytes_Check(env) || !PyBytes_Check(h) ||
+            PyBytes_GET_SIZE(h) != 32) {
+            Py_XDECREF(env);
+            Py_XDECREF(h);
+            if (!PyErr_Occurred())
+                c.bail = 1;
+            else
+                c.pyerr = 1;
+            goto done;
+        }
+        /* keep borrowed views alive: envs/hashes lists own them for the
+           duration of the call (caller holds the lists) */
+        txs[ti].hash = (const uint8_t *)PyBytes_AS_STRING(h);
+        txs[ti].hash_obj = h; /* borrow; DECREF now, list keeps it alive */
+        int rc = parse_envelope(&c, (const uint8_t *)PyBytes_AS_STRING(env),
+                                PyBytes_GET_SIZE(env), &txs[ti]);
+        Py_DECREF(env);
+        Py_DECREF(h);
+        if (rc < 0) {
+            if (!c.pyerr)
+                c.bail = 1;
+            goto done;
+        }
+    }
+
+    results = PyList_New(0);
+    fee_changes = PyList_New(0);
+    metas = PyList_New(0);
+    if (!results || !fee_changes || !metas) {
+        c.pyerr = 1;
+        goto done;
+    }
+
+    /* ---- phase 1: fees + (v10+: nothing else) per tx, in apply order */
+    for (ti = 0; ti < ntx; ti++) {
+        Tx *t = &txs[ti];
+        __int128 fee128 = (__int128)c.effBase *
+                          (t->nops > 1 ? t->nops : 1);
+        int64_t fee = fee128 > (__int128)t->fee ? (int64_t)t->fee
+                                                : (int64_t)fee128;
+        Entry *src = get_account(&c, t->src);
+        if (!src)
+            goto done;
+        if (!src->exists) {
+            c.bail = 1; /* Python asserts here; let it */
+            goto done;
+        }
+        if (touch(&c, src, 1) < 0)
+            goto done;
+        int64_t cap = src->balance > 0 ? src->balance : 0;
+        if (fee > cap)
+            fee = cap;
+        src->balance -= fee;
+        c.feePool += fee;
+        t->feeCharged = fee;
+        PyObject *fc = delta_changes_blob(&c, 1);
+        if (!fc)
+            goto done;
+        if (PyList_Append(fee_changes, fc) < 0) {
+            Py_DECREF(fc);
+            c.pyerr = 1;
+            goto done;
+        }
+        Py_DECREF(fc);
+        if (commit_level(&c, 1) < 0)
+            goto done;
+    }
+
+    /* ---- phase 2: apply each tx */
+    for (ti = 0; ti < ntx; ti++) {
+        Tx *t = &txs[ti];
+        int code = txSUCCESS;
+        Entry *src = NULL;
+        VSet vs = {NULL, 0, 0};
+        PyObject *txch = NULL, *meta = NULL, *resb = NULL;
+        OpRes *opres = NULL;
+        PyObject **opch = NULL;
+
+        for (i = 0; i < t->nsigs; i++)
+            t->sigs[i].used = 0;
+
+        /* _common_valid (applying): TransactionFrame.cpp:443-502 order */
+        if (t->has_tb && t->minTime && c.closeTime < t->minTime)
+            code = txTOO_EARLY;
+        else if (t->has_tb && t->maxTime && c.closeTime > t->maxTime)
+            code = txTOO_LATE;
+        else if (t->nops == 0)
+            code = txMISSING_OPERATION;
+        else {
+            __int128 minfee = (__int128)c.baseFee *
+                              (t->nops > 1 ? t->nops : 1);
+            if ((__int128)t->fee < minfee)
+                code = txINSUFFICIENT_FEE;
+        }
+        if (code == txSUCCESS) {
+            src = get_account(&c, t->src);
+            if (!src)
+                goto txfail;
+            if (!src->exists)
+                code = txNO_ACCOUNT;
+            else {
+                if (touch(&c, src, 1) < 0) /* load_account records */
+                    goto txfail;
+                if (src->seqNum == INT64_MAXV ||
+                    t->seqNum != src->seqNum + 1)
+                    code = txBAD_SEQ;
+                else {
+                    /* collect + verify this tx's candidate pairs once;
+                       covers the tx-level LOW check and every op check */
+                    if (vset_collect(&c, &vs, t, src, t->src) < 0)
+                        goto txfail;
+                    for (i = 0; i < t->nops; i++) {
+                        const uint8_t *osrc = t->ops[i].has_src
+                                                  ? t->ops[i].src
+                                                  : t->src;
+                        Entry *oa = get_account(&c, osrc);
+                        if (!oa)
+                            goto txfail;
+                        if (vset_collect(&c, &vs, t, oa, osrc) < 0)
+                            goto txfail;
+                    }
+                    if (vset_verify(&c, &vs, t) < 0)
+                        goto txfail;
+                    if (!check_sig(t, &vs, src, t->src, 0 /* LOW */))
+                        code = txBAD_AUTH;
+                }
+            }
+        }
+
+        int pre_seq = (code == txTOO_EARLY || code == txTOO_LATE ||
+                       code == txMISSING_OPERATION ||
+                       code == txINSUFFICIENT_FEE ||
+                       code == txNO_ACCOUNT || code == txBAD_SEQ);
+        if (!pre_seq) {
+            if (src->seqNum > t->seqNum) {
+                /* Python raises -> txINTERNAL_ERROR, tx txn rolled back */
+                rollback_level(&c, 1);
+                resb = build_result(&c, t->feeCharged, txINTERNAL_ERROR, 0,
+                                    NULL);
+                txch = empty_changes(&c);
+                if (!resb || !txch)
+                    goto txfail;
+                meta = build_meta(&c, txch, 0, NULL);
+                if (!meta)
+                    goto txfail;
+                goto txemit;
+            }
+            if (touch(&c, src, 1) < 0)
+                goto txfail;
+            src->seqNum = t->seqNum;
+        }
+
+        int sigs_ok = 1;
+        if (code == txSUCCESS) {
+            /* processSignatures: every op's source at its threshold.
+               Any op-level failure leaves sibling result slots unset in
+               the Python frame (unserializable mix) — bail to the oracle
+               rather than guess. */
+            for (i = 0; i < t->nops; i++) {
+                Op *o = &t->ops[i];
+                const uint8_t *osrc = o->has_src ? o->src : t->src;
+                Entry *oa = get_account(&c, osrc);
+                if (!oa)
+                    goto txfail;
+                /* SetOptionsOpFrame.threshold_level: HIGH when touching
+                   thresholds or signers, else MEDIUM (all other
+                   supported ops are MEDIUM) */
+                int level = 1;
+                if (o->optype == OP_SET_OPTIONS &&
+                    (o->so_has_mw || o->so_has_lt || o->so_has_mt ||
+                     o->so_has_ht || o->so_has_signer))
+                    level = 2;
+                if (!check_sig(t, &vs, oa->exists ? oa : NULL, osrc,
+                               level)) {
+                    c.bail = 1;
+                    goto txfail;
+                }
+            }
+            /* _remove_one_time_signer: no pre-auth signers on this path
+               (parse_account bails on them) — a structural no-op */
+            for (i = 0; i < t->nsigs; i++)
+                if (!t->sigs[i].used) {
+                    sigs_ok = 0;
+                    break;
+                }
+        }
+
+        txch = delta_changes_blob(&c, 1);
+        if (!txch)
+            goto txfail;
+        if (commit_level(&c, 1) < 0)
+            goto txfail;
+
+        if (code != txSUCCESS) {
+            resb = build_result(&c, t->feeCharged, code, 0, NULL);
+            if (!resb)
+                goto txfail;
+            meta = build_meta(&c, txch, 0, NULL);
+            if (!meta)
+                goto txfail;
+            goto txemit;
+        }
+        if (!sigs_ok) {
+            resb = build_result(&c, t->feeCharged, txBAD_AUTH_EXTRA, 0,
+                                NULL);
+            if (!resb)
+                goto txfail;
+            meta = build_meta(&c, txch, 0, NULL);
+            if (!meta)
+                goto txfail;
+            goto txemit;
+        }
+
+        /* ops phase: every op applies in its own nested txn; any failure
+           rolls the whole ops txn back (fees/seq already committed) */
+        opres = PyMem_Calloc(t->nops, sizeof(OpRes));
+        opch = PyMem_Calloc(t->nops, sizeof(PyObject *));
+        if (!opres || !opch) {
+            c.pyerr = 1;
+            PyErr_NoMemory();
+            goto txfail;
+        }
+        int ok = 1;
+        for (i = 0; i < t->nops; i++) {
+            Op *op = &t->ops[i];
+            const uint8_t *osrc = op->has_src ? op->src : t->src;
+            Entry *oa = get_account(&c, osrc);
+            if (!oa)
+                goto txfail;
+            int op_ok = 0;
+            if (!oa->exists) {
+                opres[i].code = opNO_ACCOUNT;
+            } else {
+                int rc = (op->optype == OP_CREATE_ACCOUNT)
+                             ? apply_create_account(&c, t, op, osrc,
+                                                    &opres[i])
+                             : (op->optype == OP_SET_OPTIONS)
+                                   ? apply_set_options(&c, t, op, osrc,
+                                                       &opres[i])
+                                   : apply_payment(&c, t, op, osrc,
+                                                   &opres[i]);
+                if (rc < 0)
+                    goto txfail;
+                op_ok = (opres[i].code == opINNER &&
+                         opres[i].inner_code == 0);
+            }
+            if (op_ok) {
+                opch[i] = delta_changes_blob(&c, 3);
+                if (!opch[i])
+                    goto txfail;
+                if (commit_level(&c, 3) < 0)
+                    goto txfail;
+            } else {
+                rollback_level(&c, 3);
+                ok = 0;
+            }
+        }
+        if (ok) {
+            if (commit_level(&c, 2) < 0 || commit_level(&c, 1) < 0)
+                goto txfail;
+            resb = build_result(&c, t->feeCharged, txSUCCESS, t->nops,
+                                opres);
+            if (!resb)
+                goto txfail;
+            meta = build_meta(&c, txch, t->nops, opch);
+            if (!meta)
+                goto txfail;
+        } else {
+            rollback_level(&c, 2);
+            resb = build_result(&c, t->feeCharged, txFAILED, t->nops,
+                                opres);
+            if (!resb)
+                goto txfail;
+            meta = build_meta(&c, txch, t->nops, NULL); /* metas wiped */
+            if (!meta)
+                goto txfail;
+        }
+
+    txemit:
+        if (PyList_Append(results, resb) < 0 ||
+            PyList_Append(metas, meta) < 0) {
+            c.pyerr = 1;
+            goto txfail;
+        }
+        Py_CLEAR(resb);
+        Py_CLEAR(meta);
+        Py_CLEAR(txch);
+        PyMem_Free(vs.pairs);
+        PyMem_Free(opres);
+        if (opch)
+            for (i = 0; i < t->nops; i++)
+                Py_XDECREF(opch[i]);
+        PyMem_Free(opch);
+        continue;
+
+    txfail:
+        Py_XDECREF(resb);
+        Py_XDECREF(meta);
+        Py_XDECREF(txch);
+        PyMem_Free(vs.pairs);
+        PyMem_Free(opres);
+        if (opch)
+            for (i = 0; i < t->nops; i++)
+                Py_XDECREF(opch[i]);
+        PyMem_Free(opch);
+        goto done;
+    }
+
+    /* ---- outputs: close-level changed entries, first-touch order */
+    changes = PyList_New(0);
+    if (!changes) {
+        c.pyerr = 1;
+        goto done;
+    }
+    for (i = 0; i < c.ntouched[0]; i++) {
+        Entry *e = c.touched[0][i];
+        EntrySave *s = &e->save[0];
+        if (!entry_changed_since(e, s))
+            continue;
+        PyObject *key = PyBytes_FromStringAndSize((const char *)e->keyb,
+                                                  e->keylen);
+        PyObject *prev = NULL, *cur = NULL;
+        if (key && s->exists) {
+            Buf b = {NULL, 0, 0};
+            if (ser_entry(&c, e, s->balance, s->seqNum, &s->st, &b) == 0)
+                prev = PyBytes_FromStringAndSize(b.data, b.len);
+            PyMem_Free(b.data);
+        } else if (key) {
+            prev = Py_None;
+            Py_INCREF(prev);
+        }
+        if (key && prev && e->exists) {
+            Buf b = {NULL, 0, 0};
+            if (ser_entry(&c, e, e->balance, e->seqNum, &e->st, &b) == 0)
+                cur = PyBytes_FromStringAndSize(b.data, b.len);
+            PyMem_Free(b.data);
+        } else if (key && prev) {
+            cur = Py_None;
+            Py_INCREF(cur);
+        }
+        PyObject *tup = (key && prev && cur)
+                            ? PyTuple_Pack(3, key, prev, cur)
+                            : NULL;
+        Py_XDECREF(key);
+        Py_XDECREF(prev);
+        Py_XDECREF(cur);
+        if (!tup || PyList_Append(changes, tup) < 0) {
+            Py_XDECREF(tup);
+            c.pyerr = 1;
+            goto done;
+        }
+        Py_DECREF(tup);
+    }
+
+    out = Py_BuildValue("{s:L,s:O,s:O,s:O,s:O}", "feePool",
+                        (long long)c.feePool, "changes", changes,
+                        "results", results, "fee_changes", fee_changes,
+                        "meta", metas);
+    if (!out)
+        c.pyerr = 1;
+
+done:
+    bailing = c.bail && !c.pyerr;
+    for (ti = 0; ti < ntx; ti++) {
+        PyMem_Free(txs[ti].ops);
+        for (i = 0; i < txs[ti].nsigs; i++)
+            Py_XDECREF(txs[ti].sigs[i].sig_obj);
+    }
+    PyMem_Free(txs);
+    Py_XDECREF(results);
+    Py_XDECREF(fee_changes);
+    Py_XDECREF(metas);
+    Py_XDECREF(changes);
+    ctx_free(&c);
+    if (c.pyerr)
+        return NULL;
+    if (bailing || !out)
+        Py_RETURN_NONE;
+    return out;
+}
+
+static PyMethodDef methods[] = {
+    {"apply_close", apply_close, METH_VARARGS,
+     "apply_close(params, envs, hashes, lookup, verify) -> dict | None"},
+    {NULL, NULL, 0, NULL},
+};
+
+static struct PyModuleDef moduledef = {
+    PyModuleDef_HEAD_INIT, "_sctapply",
+    "Native transaction-apply fast path (see module docstring in source).",
+    -1, methods,
+};
+
+PyMODINIT_FUNC PyInit__sctapply(void)
+{
+    return PyModule_Create(&moduledef);
+}

@@ -304,3 +304,124 @@ int sct_prepare_batch(const uint8_t *pubs,      /* n*32 */
     }
     return 0;
 }
+
+/* ------------------------------------------------- verify-cache keys */
+
+/* SHA-256 (FIPS 180-4), used only for the verify-cache keys below —
+   the result cache in crypto/keys.py hashes (key ‖ sig ‖ msg) with
+   SHA-256, and the whole-checkpoint drain computes one key per triple
+   (hashlib per-call overhead is ~1/3 of the drain's host cost). */
+
+static const uint32_t SHA256_K[64] = {
+    0x428a2f98, 0x71374491, 0xb5c0fbcf, 0xe9b5dba5, 0x3956c25b,
+    0x59f111f1, 0x923f82a4, 0xab1c5ed5, 0xd807aa98, 0x12835b01,
+    0x243185be, 0x550c7dc3, 0x72be5d74, 0x80deb1fe, 0x9bdc06a7,
+    0xc19bf174, 0xe49b69c1, 0xefbe4786, 0x0fc19dc6, 0x240ca1cc,
+    0x2de92c6f, 0x4a7484aa, 0x5cb0a9dc, 0x76f988da, 0x983e5152,
+    0xa831c66d, 0xb00327c8, 0xbf597fc7, 0xc6e00bf3, 0xd5a79147,
+    0x06ca6351, 0x14292967, 0x27b70a85, 0x2e1b2138, 0x4d2c6dfc,
+    0x53380d13, 0x650a7354, 0x766a0abb, 0x81c2c92e, 0x92722c85,
+    0xa2bfe8a1, 0xa81a664b, 0xc24b8b70, 0xc76c51a3, 0xd192e819,
+    0xd6990624, 0xf40e3585, 0x106aa070, 0x19a4c116, 0x1e376c08,
+    0x2748774c, 0x34b0bcb5, 0x391c0cb3, 0x4ed8aa4a, 0x5b9cca4f,
+    0x682e6ff3, 0x748f82ee, 0x78a5636f, 0x84c87814, 0x8cc70208,
+    0x90befffa, 0xa4506ceb, 0xbef9a3f7, 0xc67178f2,
+};
+
+static inline uint32_t rotr32(uint32_t x, int n)
+{
+    return (x >> n) | (x << (32 - n));
+}
+
+static void sha256_block(uint32_t st[8], const uint8_t *p)
+{
+    uint32_t w[64];
+    for (int i = 0; i < 16; i++)
+        w[i] = ((uint32_t)p[4 * i] << 24) | ((uint32_t)p[4 * i + 1] << 16) |
+               ((uint32_t)p[4 * i + 2] << 8) | p[4 * i + 3];
+    for (int i = 16; i < 64; i++) {
+        uint32_t s0 = rotr32(w[i - 15], 7) ^ rotr32(w[i - 15], 18) ^
+                      (w[i - 15] >> 3);
+        uint32_t s1 = rotr32(w[i - 2], 17) ^ rotr32(w[i - 2], 19) ^
+                      (w[i - 2] >> 10);
+        w[i] = w[i - 16] + s0 + w[i - 7] + s1;
+    }
+    uint32_t a = st[0], b = st[1], c = st[2], d = st[3];
+    uint32_t e = st[4], f = st[5], g = st[6], h = st[7];
+    for (int i = 0; i < 64; i++) {
+        uint32_t S1 = rotr32(e, 6) ^ rotr32(e, 11) ^ rotr32(e, 25);
+        uint32_t ch = (e & f) ^ (~e & g);
+        uint32_t t1 = h + S1 + ch + SHA256_K[i] + w[i];
+        uint32_t S0 = rotr32(a, 2) ^ rotr32(a, 13) ^ rotr32(a, 22);
+        uint32_t maj = (a & b) ^ (a & c) ^ (b & c);
+        uint32_t t2 = S0 + maj;
+        h = g; g = f; f = e; e = d + t1;
+        d = c; c = b; b = a; a = t1 + t2;
+    }
+    st[0] += a; st[1] += b; st[2] += c; st[3] += d;
+    st[4] += e; st[5] += f; st[6] += g; st[7] += h;
+}
+
+/* digest of key32 ‖ sig64 ‖ msg (the _cache_key layout) */
+static void sha256_ksm(const uint8_t *key, const uint8_t *sig,
+                       const uint8_t *msg, uint64_t mlen, uint8_t out[32])
+{
+    static const uint32_t H0[8] = {
+        0x6a09e667, 0xbb67ae85, 0x3c6ef372, 0xa54ff53a,
+        0x510e527f, 0x9b05688c, 0x1f83d9ab, 0x5be0cd19,
+    };
+    uint32_t st[8];
+    uint8_t buf[64];
+    memcpy(st, H0, sizeof st);
+    uint64_t total = 96 + mlen;
+
+    /* block 1: key ‖ sig[0:32]; block 2: sig[32:64] ‖ msg[0:32] ... */
+    memcpy(buf, key, 32);
+    memcpy(buf + 32, sig, 32);
+    sha256_block(st, buf);
+    memcpy(buf, sig + 32, 32);
+    uint64_t take = mlen < 32 ? mlen : 32;
+    memcpy(buf + 32, msg, take);
+    uint64_t used = 32 + take;
+    const uint8_t *rest = msg + take;
+    uint64_t rlen = mlen - take;
+    if (used == 64) {
+        sha256_block(st, buf);
+        while (rlen >= 64) {
+            sha256_block(st, rest);
+            rest += 64;
+            rlen -= 64;
+        }
+        memcpy(buf, rest, rlen);
+        used = rlen;
+    }
+    buf[used++] = 0x80;
+    if (used > 56) {
+        memset(buf + used, 0, 64 - used);
+        sha256_block(st, buf);
+        used = 0;
+    }
+    memset(buf + used, 0, 56 - used);
+    uint64_t bits = total * 8;
+    for (int i = 0; i < 8; i++)
+        buf[56 + i] = (uint8_t)(bits >> (56 - 8 * i));
+    sha256_block(st, buf);
+    for (int i = 0; i < 8; i++) {
+        out[4 * i] = (uint8_t)(st[i] >> 24);
+        out[4 * i + 1] = (uint8_t)(st[i] >> 16);
+        out[4 * i + 2] = (uint8_t)(st[i] >> 8);
+        out[4 * i + 3] = (uint8_t)st[i];
+    }
+}
+
+/* one call per drain: n (key ‖ sig ‖ msg) triples -> n*32 digests.
+   Layout matches sct_prepare_batch (pubs n*32, sigs n*64, msgs+offsets) */
+int sct_cache_keys(const uint8_t *pubs, const uint8_t *sigs,
+                   const uint8_t *msgs, const uint64_t *msg_off,
+                   int64_t n, uint8_t *out)
+{
+    for (int64_t i = 0; i < n; i++)
+        sha256_ksm(pubs + 32 * i, sigs + 64 * i, msgs + msg_off[i],
+                   msg_off[i + 1] - msg_off[i], out + 32 * i);
+    return 0;
+}

@@ -130,16 +130,20 @@ class TransactionFrame:
         self.signatures = envelope.value.signatures
         self.op_frames = [make_operation_frame(op, self)
                           for op in self.tx.operations]
-        self.result: TransactionResult = _make_result(
+        self._result: Optional[TransactionResult] = _make_result(
             0, TransactionResultCode.txSUCCESS,
             [None] * len(self.op_frames))
+        self._native_result_b: Optional[bytes] = None
         self._contents_hash: Optional[bytes] = None
         self._env_bytes: Optional[bytes] = None
         self._full_hash: Optional[bytes] = None
         self._env_sig_fp: tuple = ()
+        self._sig_frozen = False
         self.op_metas: List[list] = []     # per-op LedgerEntryChanges
-        self.fee_meta: list = []           # fee/seq processing changes
+        self._fee_meta: list = []          # fee/seq processing changes
         self.tx_changes: list = []         # apply-time seq/signer changes
+        self._native_meta_b: Optional[bytes] = None  # TransactionMeta XDR
+        self._native_fee_b: Optional[bytes] = None   # LedgerEntryChanges
 
     # -- identity -----------------------------------------------------------
     @classmethod
@@ -187,13 +191,26 @@ class TransactionFrame:
     def _sig_fingerprint(self) -> tuple:
         return tuple((ds.hint, ds.signature) for ds in self.signatures)
 
+    def freeze_signatures(self) -> None:
+        """Promise that this frame's signature list will never change
+        (history-replay frames parsed from immutable wire): the
+        envelope_bytes fingerprint re-check is skipped from now on. The
+        fingerprint walk is ~20 tuple builds per call on the bench's
+        multisig frames and replay serializes each frame several times
+        per close."""
+        self.envelope_bytes()   # prime the cache under the full check
+        self._sig_frozen = True
+
     def envelope_bytes(self) -> bytes:
         """Canonical wire bytes of the signed envelope, cached —
         serialized once per frame for hashing, txset hashing, history
         rows, and flood messages. The cache is guarded by a fingerprint
         of the signature list (the one surface callers mutate directly,
         e.g. test harnesses and the fuzz corpus), so any signature change
-        recomputes."""
+        recomputes — unless freeze_signatures() declared the list
+        immutable."""
+        if self._sig_frozen and self._env_bytes is not None:
+            return self._env_bytes
         fp = self._sig_fingerprint()
         if self._env_bytes is None or fp != self._env_sig_fp:
             self._env_bytes = self.envelope.to_xdr()
@@ -231,9 +248,78 @@ class TransactionFrame:
         """TransactionMeta v1 for the last apply (reference txmeta column;
         downstream-consumer form — not part of any consensus hash)."""
         from ..xdr import OperationMeta, TransactionMeta, TransactionMetaV1
+        if self._native_meta_b is not None:
+            return TransactionMeta.from_xdr(self._native_meta_b)
         return TransactionMeta(1, TransactionMetaV1(
             txChanges=list(self.tx_changes),
             operations=[OperationMeta(changes=ch) for ch in self.op_metas]))
+
+    def set_native_apply_output(self, result_b: bytes, fee_changes_b: bytes,
+                                meta_b: bytes) -> None:
+        """Install the native apply engine's per-tx outputs (all XDR
+        bytes): the TransactionResult, the fee-phase LedgerEntryChanges,
+        and the TransactionMeta. Downstream consumers (result_pair,
+        fee_meta rows, tx_meta) then behave exactly as after a Python
+        apply — both meta parses are deferred until someone reads the
+        object form, and the history writers take the bytes directly
+        (fee_meta_xdr / tx_meta_xdr / result_pair_xdr), so the hot
+        replay path never parses them at all."""
+        self._result = None     # parsed lazily from _native_result_b
+        self._native_result_b = result_b
+        self._fee_meta = None
+        self._native_fee_b = fee_changes_b
+        self._native_meta_b = meta_b
+
+    @property
+    def result(self) -> TransactionResult:
+        if self._result is None and self._native_result_b is not None:
+            self._result = TransactionResult.from_xdr(
+                self._native_result_b)
+        return self._result
+
+    @result.setter
+    def result(self, r: TransactionResult) -> None:
+        self._result = r
+        self._native_result_b = None
+
+    def result_pair_xdr(self) -> bytes:
+        """TransactionResultPair wire bytes (transactionHash ‖ result) —
+        the native engine's result bytes verbatim when it applied this
+        tx, so the close's result-set hash and the txhistory row never
+        parse or re-serialize the result on the replay fast path."""
+        rb = self._native_result_b
+        if rb is None:
+            rb = self.result.to_xdr()
+        return self.contents_hash() + rb
+
+    @property
+    def fee_meta(self) -> list:
+        if self._fee_meta is None and self._native_fee_b is not None:
+            from ..xdr import LedgerEntryChanges
+            from ..xdr.codec import xdr_from
+            self._fee_meta = xdr_from(LedgerEntryChanges,
+                                      self._native_fee_b)
+        return self._fee_meta
+
+    @fee_meta.setter
+    def fee_meta(self, changes: list) -> None:
+        self._fee_meta = changes
+        self._native_fee_b = None
+
+    def fee_meta_xdr(self) -> bytes:
+        """LedgerEntryChanges wire bytes of the fee phase — the native
+        engine's output verbatim when it applied this tx."""
+        if self._native_fee_b is not None:
+            return self._native_fee_b
+        from ..xdr import LedgerEntryChanges
+        from ..xdr.codec import xdr_bytes
+        return xdr_bytes(LedgerEntryChanges, self._fee_meta)
+
+    def tx_meta_xdr(self) -> bytes:
+        """TransactionMeta wire bytes of the last apply."""
+        if self._native_meta_b is not None:
+            return self._native_meta_b
+        return self.tx_meta().to_xdr()
 
     def candidate_sig_triples(self, ltx, signer_cache: Optional[dict] = None
                               ) -> List[Tuple[bytes, bytes, bytes]]:
@@ -447,6 +533,7 @@ class TransactionFrame:
         verifier = verifier or CpuSigVerifier()
         checker = SignatureChecker(self.contents_hash(), self.signatures,
                                    verifier)
+        self._native_meta_b = None   # this apply owns the meta again
         fee = self.result.feeCharged
         # phase 1 — tx-level txn: apply-time commonValid re-check (state
         # may have changed since nomination) against the SAME checker as
@@ -573,6 +660,7 @@ class FeeBumpTransactionFrame:
         self._env_bytes: Optional[bytes] = None
         self._full_hash: Optional[bytes] = None
         self._env_sig_fp: tuple = ()
+        self._sig_frozen = False
         self.fee_meta: list = []
 
     @property
@@ -581,6 +669,14 @@ class FeeBumpTransactionFrame:
 
     def tx_meta(self):
         return self.inner.tx_meta()
+
+    def tx_meta_xdr(self) -> bytes:
+        return self.inner.tx_meta_xdr()
+
+    def fee_meta_xdr(self) -> bytes:
+        from ..xdr import LedgerEntryChanges
+        from ..xdr.codec import xdr_bytes
+        return xdr_bytes(LedgerEntryChanges, self.fee_meta)
 
     def source_account_id(self) -> PublicKey:
         return self.fee_bump.feeSource.account_id
@@ -621,7 +717,17 @@ class FeeBumpTransactionFrame:
         return (tuple((ds.hint, ds.signature) for ds in self.signatures),
                 self.inner._sig_fingerprint())
 
+    def freeze_signatures(self) -> None:
+        self.inner.freeze_signatures()
+        self.envelope_bytes()   # prime under the full check
+        self._sig_frozen = True
+
+    def result_pair_xdr(self) -> bytes:
+        return self.contents_hash() + self.result.to_xdr()
+
     def envelope_bytes(self) -> bytes:
+        if self._sig_frozen and self._env_bytes is not None:
+            return self._env_bytes
         fp = self._sig_fingerprint()
         if self._env_bytes is None or fp != self._env_sig_fp:
             self._env_bytes = self.envelope.to_xdr()

@@ -304,6 +304,12 @@ def test_prewarm_batches_checkpoint_sigs(publisher):
     app_b = make_app(tmp_path, 5, archive_root, writable=False)
     cv = CountingVerifier()
     app_b.sig_verifier = cv
+    # the CPU-backend + native-apply combination skips the bulk
+    # checkpoint drain entirely (the engine resolves signer sets in C
+    # per tx, and batching buys nothing on a synchronous backend —
+    # DownloadApplyTxsWork._prewarm_redundant); pin the Python apply
+    # path, the consumer the whole-checkpoint prewarm exists to feed
+    app_b.ledger_manager.use_native_apply = False
 
     # the prewarm must cache under the exact (key, sig, contents-hash)
     # the apply-time SignatureChecker looks up: after the per-checkpoint
@@ -314,14 +320,24 @@ def test_prewarm_batches_checkpoint_sigs(publisher):
     _keys.flush_verify_cache()
     raw_calls = [0]
     orig_raw = _keys.raw_verify
+    orig_batch = _keys.raw_verify_batch
     _keys.raw_verify = lambda k, s, m: (
         raw_calls.__setitem__(0, raw_calls[0] + 1) or orig_raw(k, s, m))
+
+    def counting_batch(triples):
+        # CpuSigVerifier.verify_many drains misses through ONE native
+        # batch call now; count each triple like a raw verify
+        raw_calls[0] += len(triples)
+        return orig_batch(triples)
+
+    _keys.raw_verify_batch = counting_batch
     try:
         work = app_b.catchup_manager.start_catchup(
             CatchupConfiguration.complete())
         assert run_work(app_b, work) == State.SUCCESS
     finally:
         _keys.raw_verify = orig_raw
+        _keys.raw_verify_batch = orig_batch
     # one bulk batch per checkpoint covering many ledgers' signatures,
     # plus per-ledger incremental prewarms that are cache-covered no-ops
     assert len(cv.batches) >= 2

@@ -185,6 +185,11 @@ def test_corrupted_op_aborts_real_close():
     root = ad.root_account()
     a = root.create(10**9)
 
+    # the corruption below monkeypatches the PYTHON op frame; the native
+    # apply engine would apply the correct payment instead, so pin the
+    # Python path (invariants themselves run on the close delta either way)
+    app.ledger_manager.use_native_apply = False
+
     real_apply = PaymentOpFrame.do_apply
 
     def minting_apply(self, ltx):

@@ -1,0 +1,423 @@
+"""Differential test: native transaction-apply ≡ Python apply.
+
+The native engine (native/applyc.c via ledger/native_apply.py) must be
+entry-for-entry identical to the Python fee+apply phases: same ledger
+state, same TransactionResult XDR, same fee/tx meta XDR, same header
+hash. Two LedgerManagers close identical LedgerCloseData — one with the
+engine enabled, one pinned to the Python path — and every close compares
+the full observable surface. The randomized matrix drives the
+payment/create-account/multisig workload of the replay bench plus every
+failure arm the engine claims to implement; unsupported ops exercise the
+bail-to-Python contract (both sides must still agree).
+"""
+
+import random
+
+import pytest
+
+from stellar_core_tpu.crypto.hashing import sha256
+from stellar_core_tpu.crypto.keys import SecretKey
+from stellar_core_tpu.herder.txset import TxSetFrame
+from stellar_core_tpu.ledger.ledger_manager import (
+    LedgerCloseData, LedgerManager,
+)
+from stellar_core_tpu.native import apply_engine
+from stellar_core_tpu.testing import (
+    TESTING_NETWORK_ID, TestAccount, root_secret_key,
+)
+from stellar_core_tpu.transactions.transaction_frame import TransactionFrame
+from stellar_core_tpu.xdr import (
+    Asset, LedgerEntryChanges, StellarValue, StellarValueExt, TimeBounds,
+    TransactionEnvelope, TransactionResultCode,
+)
+from stellar_core_tpu.xdr.codec import Unpacker, xdr_bytes
+
+pytestmark = pytest.mark.skipif(
+    apply_engine() is None, reason="native apply engine unavailable")
+
+FEE = 100
+RESERVE = 5_000_000
+MIN0 = 2 * RESERVE
+
+
+class _StubConfig:
+    DATABASE = "in-memory"
+    LEDGER_PROTOCOL_VERSION = 13
+    GENESIS_TOTAL_COINS = 10 ** 17
+    TESTING_UPGRADE_DESIRED_FEE = FEE
+    TESTING_UPGRADE_RESERVE = RESERVE
+    TESTING_UPGRADE_MAX_TX_SET_SIZE = 1000
+    network_id = TESTING_NETWORK_ID
+
+
+class _StubApp:
+    config = _StubConfig()
+
+    def network_root_key(self):
+        return root_secret_key()
+
+
+class _Shim:
+    """TestAccount's ledger surface over one side's root (seq/header
+    reads for tx building only)."""
+
+    def __init__(self, lm):
+        self.lm = lm
+        self.network_id = TESTING_NETWORK_ID
+
+    def header(self):
+        return self.lm.root.get_header()
+
+    def seq_num(self, account_id):
+        from stellar_core_tpu.xdr import LedgerKey
+        e = self.lm.root.get_entry(LedgerKey.account(account_id))
+        return e.data.value.seqNum if e is not None else 0
+
+
+class DiffHarness:
+    """Two LedgerManagers over identical genesis; every close applies the
+    same envelopes to both and asserts the full observable surface
+    matches. Transactions are BUILT against the native side's state (the
+    states are asserted identical after every close)."""
+
+    def __init__(self):
+        self.native = self._mk(True)
+        self.python = self._mk(False)
+        self.shim = _Shim(self.native)
+        self.closes_native = 0  # closes the engine actually handled
+
+    @staticmethod
+    def _mk(native):
+        lm = LedgerManager(_StubApp())
+        lm.start_new_ledger()
+        lm.use_native_apply = native
+        return lm
+
+    def account(self, sk):
+        return TestAccount(self.shim, sk)
+
+    def close(self, frames):
+        """Close one ledger on both sides from the same wire bytes;
+        returns the native side's frames (results installed)."""
+        blobs = [f.envelope_bytes() for f in frames]
+        out = []
+        for lm in (self.native, self.python):
+            fr = [TransactionFrame.make_from_wire(
+                TESTING_NETWORK_ID, TransactionEnvelope.from_xdr(b))
+                for b in blobs]
+            header = lm.root.get_header()
+            ts = TxSetFrame(TESTING_NETWORK_ID, lm.lcl_hash, fr)
+            value = StellarValue(
+                txSetHash=ts.get_contents_hash(),
+                closeTime=header.scpValue.closeTime + 5,
+                upgrades=[], ext=StellarValueExt(0, None))
+            lm.close_ledger(
+                LedgerCloseData(header.ledgerSeq + 1, ts, value))
+            out.append(ts.sort_for_apply())
+        nat, pyf = out
+        self._compare(nat, pyf)
+        if any(f._native_meta_b is not None for f in nat):
+            assert all(f._native_meta_b is not None for f in nat)
+            self.closes_native += 1
+        return nat
+
+    def _compare(self, nat_frames, py_frames):
+        # header hash covers txSetResultHash, bucketListHash and feePool
+        assert self.native.lcl_hash == self.python.lcl_hash, \
+            "header hash diverged"
+        ents_n = sorted(e.to_xdr() for e in self.native.root.all_entries())
+        ents_p = sorted(e.to_xdr() for e in self.python.root.all_entries())
+        assert ents_n == ents_p, "ledger state diverged"
+        for fn, fp in zip(nat_frames, py_frames):
+            assert fn.contents_hash() == fp.contents_hash()
+            assert fn.result.to_xdr() == fp.result.to_xdr(), \
+                "tx result diverged for %s" % fn.contents_hash().hex()[:8]
+            assert xdr_bytes(LedgerEntryChanges, fn.fee_meta) == \
+                xdr_bytes(LedgerEntryChanges, fp.fee_meta), \
+                "fee meta diverged"
+            assert fn.tx_meta().to_xdr() == fp.tx_meta().to_xdr(), \
+                "tx meta diverged"
+
+
+def _mk_accounts(h, n_users=6):
+    """Fund users/issuers, configure multisig + trustlines through the
+    (both-sides-Python) setup closes; returns the account handles."""
+    root = h.account(root_secret_key())
+    users = [h.account(SecretKey.from_seed(sha256(b"user%d" % i)))
+             for i in range(n_users)]
+    ix = h.account(SecretKey.from_seed(sha256(b"issuer-x")))
+    iy = h.account(SecretKey.from_seed(sha256(b"issuer-y")))
+
+    h.close([root.tx(
+        [root.op_create_account(u.account_id, 50 * MIN0) for u in users] +
+        [root.op_create_account(a.account_id, 50 * MIN0)
+         for a in (ix, iy)])])
+
+    # u0: 2 extra signers, med threshold 3 (master 1 + 1 + 1)
+    # u1: 19 extra signers, med threshold 20 (the bench's 20-of-20 shape)
+    u0_sks = [SecretKey.from_seed(sha256(b"u0-s%d" % i)) for i in range(2)]
+    u1_sks = [SecretKey.from_seed(sha256(b"u1-s%d" % i)) for i in range(19)]
+    from stellar_core_tpu.xdr import AccountFlags
+    h.close([
+        users[0].tx([users[0].op_add_signer(sk.public_key.key_bytes)
+                     for sk in u0_sks] +
+                    [users[0].op_set_options(med=3)]),
+        users[1].tx([users[1].op_add_signer(sk.public_key.key_bytes)
+                     for sk in u1_sks] +
+                    [users[1].op_set_options(med=20)]),
+        iy.tx([iy.op_set_options(
+            set_flags=AccountFlags.AUTH_REQUIRED_FLAG)]),
+    ])
+
+    X = Asset.credit("USD", ix.account_id)
+    Y = Asset.credit("EURO12CHARSX", iy.account_id)
+    h.close([
+        users[2].tx([users[2].op_change_trust(X, 10 ** 12)]),
+        users[3].tx([users[3].op_change_trust(X, 10 ** 12),
+                     users[3].op_change_trust(Y, 10 ** 12)]),
+        users[4].tx([users[4].op_change_trust(X, 1000)]),
+    ])
+    # seed credit balances (issuer-source arm of the native engine)
+    h.close([ix.tx([ix.op_payment(users[2].account_id, 10 ** 9, X),
+                    ix.op_payment(users[3].account_id, 10 ** 9, X)])])
+    return root, users, ix, iy, X, Y, u0_sks, u1_sks
+
+
+def test_native_apply_smoke():
+    """Tier-1 smoke: success + core failure arms agree native-vs-Python
+    on a small ledger, and the engine actually handled the payment
+    closes (differential equality is vacuous otherwise)."""
+    h = DiffHarness()
+    root, users, ix, iy, X, Y, u0_sks, u1_sks = _mk_accounts(h)
+    ghost = SecretKey.from_seed(sha256(b"ghost"))
+
+    frames = h.close([
+        users[2].tx([users[2].op_payment(users[3].account_id, 12345, X)]),
+        users[3].tx([users[3].op_payment(users[4].account_id, 500, X),
+                     users[3].op_payment(root.account_id, 777)]),
+        users[0].tx([users[0].op_payment(root.account_id, 1)],
+                    extra_signers=u0_sks),
+        users[1].tx([users[1].op_payment(root.account_id, 1)],
+                    extra_signers=u1_sks),
+        users[5].tx([users[5].op_payment(ghost.public_key, 5)]),
+        users[4].tx([users[4].op_payment(users[2].account_id, 10 ** 14)]),
+    ])
+    codes = [f.result.code for f in frames]
+    assert codes.count(TransactionResultCode.txSUCCESS) == 4
+    assert codes.count(TransactionResultCode.txFAILED) == 2
+    assert h.closes_native >= 1, "engine never ran — test is vacuous"
+
+    # bad seq / insufficient fee / time bounds / bad auth arms
+    frames = h.close([
+        users[2].tx([users[2].op_payment(root.account_id, 1)],
+                    seq=users[2].next_seq() + 7),
+        users[3].tx([users[3].op_payment(root.account_id, 1)], fee=1),
+        users[5].tx([users[5].op_payment(root.account_id, 1)],
+                    time_bounds=TimeBounds(minTime=2 ** 40, maxTime=0)),
+        root.tx([root.op_payment(users[0].account_id, 1)],
+                extra_signers=[ghost]),   # extra unused sig
+    ])
+    assert sorted(f.result.code for f in frames) == sorted([
+        TransactionResultCode.txBAD_SEQ,
+        TransactionResultCode.txINSUFFICIENT_FEE,
+        TransactionResultCode.txTOO_EARLY,
+        TransactionResultCode.txBAD_AUTH_EXTRA,
+    ])  # frames come back in sort_for_apply order
+    assert h.closes_native >= 2
+
+
+def test_native_apply_set_options_arms():
+    """SET_OPTIONS joined the engine's subset (the bench's multisig-
+    arming ledgers are 100% set_options): every arm the Python frame
+    implements must agree entry-for-entry — signer add/update/remove,
+    thresholds, flags (incl. immutable lockout), homeDomain,
+    inflationDest, TOO_MANY_SIGNERS and LOW_RESERVE failures."""
+    from stellar_core_tpu.xdr import AccountFlags, Signer, SignerKey
+
+    h = DiffHarness()
+    root = h.account(root_secret_key())
+    a = h.account(SecretKey.from_seed(sha256(b"so-a")))
+    b = h.account(SecretKey.from_seed(sha256(b"so-b")))
+    poor = h.account(SecretKey.from_seed(sha256(b"so-poor")))
+    h.close([root.tx([root.op_create_account(a.account_id, 50 * MIN0),
+                      root.op_create_account(b.account_id, 50 * MIN0),
+                      root.op_create_account(poor.account_id, MIN0)])])
+    sks = [SecretKey.from_seed(sha256(b"so-s%d" % i)) for i in range(21)]
+
+    # add, update weight, remove, thresholds, homeDomain, inflationDest
+    frames = h.close([
+        a.tx([a.op_add_signer(sks[0].public_key.key_bytes, 5),
+              a.op_add_signer(sks[1].public_key.key_bytes, 7),
+              a.op_add_signer(sks[0].public_key.key_bytes, 9),   # update
+              a.op_add_signer(sks[1].public_key.key_bytes, 0),   # remove
+              a.op_set_options(master_weight=11, low=1, med=15, high=20,
+                               home_domain="example.com",
+                               inflation_dest=b.account_id)]),
+        b.tx([b.op_set_options(set_flags=AccountFlags.AUTH_REQUIRED_FLAG |
+                               AccountFlags.AUTH_REVOCABLE_FLAG),
+              b.op_set_options(clear_flags=AccountFlags.AUTH_REVOCABLE_FLAG)]),
+        poor.tx([poor.op_set_options(
+            inflation_dest=SecretKey.from_seed(
+                sha256(b"so-ghost")).public_key)]),  # INVALID_INFLATION
+    ])
+    codes = [f.result.code for f in frames]  # sort_for_apply order
+    assert codes.count(TransactionResultCode.txSUCCESS) == 2
+    assert codes.count(TransactionResultCode.txFAILED) == 1  # poor: infl
+    assert h.closes_native >= 2
+
+    # the updated signer set actually gates auth: MED is 15, so the
+    # master (11) alone cannot move a payment — sks[0] (weight 9,
+    # updated from 5) must be consumed too
+    frames = h.close([
+        a.tx([a.op_payment(root.account_id, 1)], extra_signers=[sks[0]]),
+    ])
+    assert frames[0].result.code == TransactionResultCode.txSUCCESS
+
+    # immutable lockout + TOO_MANY_SIGNERS + LOW_RESERVE arms
+    h.close([b.tx([b.op_set_options(
+        set_flags=AccountFlags.AUTH_IMMUTABLE_FLAG)])])
+    frames = h.close([
+        b.tx([b.op_set_options(clear_flags=1)]),          # CANT_CHANGE
+        a.tx([a.op_add_signer(sk.public_key.key_bytes) for sk in sks],
+             extra_signers=[sks[0]]),                     # 21st: TOO_MANY
+        poor.tx([poor.op_add_signer(sks[2].public_key.key_bytes)]),
+    ])
+    assert [f.result.code for f in frames].count(
+        TransactionResultCode.txFAILED) == 3  # poor: LOW_RESERVE
+    assert h.closes_native >= 5
+
+
+def test_native_apply_unsupported_ops_bail():
+    """Closes containing ops outside the engine's subset fall back to
+    Python on the native side — and both sides still agree."""
+    h = DiffHarness()
+    root = h.account(root_secret_key())
+    a = h.account(SecretKey.from_seed(sha256(b"bail-a")))
+    h.close([root.tx([root.op_create_account(a.account_id, 20 * MIN0)])])
+    before = h.closes_native
+    Z = Asset.credit("ZZZ", root.account_id)
+    frames = h.close([
+        a.tx([a.op_change_trust(Z, 100),            # unsupported op
+              a.op_payment(root.account_id, 5)]),
+    ])
+    assert h.closes_native == before  # engine declined the mixed close
+    assert frames[0].result.code == TransactionResultCode.txSUCCESS
+
+
+def test_native_apply_differential_randomized():
+    """Randomized matrix over the engine's whole claimed subset: native
+    payments, credit payments (incl. issuer source/dest, unauthorized
+    lines, small limits), create-account arms, multisig sources, bad
+    seq/fee/timebounds/auth, multi-op txs with distinct op sources."""
+    rng = random.Random(0xAB1E)
+    h = DiffHarness()
+    root, users, ix, iy, X, Y, u0_sks, u1_sks = _mk_accounts(h)
+    ghost = SecretKey.from_seed(sha256(b"rand-ghost"))
+    fresh_n = 0
+
+    def rand_frames():
+        nonlocal fresh_n
+        frames = []
+        # each close: every account is a tx source at most once, so the
+        # builder's seq reads stay truthful whatever fails
+        sources = [root, users[2], users[3], users[4], users[5],
+                   users[0], users[1]]
+        rng.shuffle(sources)
+        for src in sources:
+            if rng.random() < 0.25:
+                continue
+            kind = rng.random()
+            extra = None
+            kwargs = {}
+            if src is users[0]:
+                extra = u0_sks
+            elif src is users[1]:
+                extra = u1_sks
+            if kind < 0.30:   # native payment, occasionally absurd amount
+                amt = rng.choice([1, 10 ** 6, 10 ** 15, 10 ** 18])
+                ops = [src.op_payment(
+                    rng.choice(users + [root]).account_id, amt)]
+            elif kind < 0.50:  # credit payment on X
+                amt = rng.choice([1, 500, 10 ** 8, 5 * 10 ** 9])
+                dest = rng.choice([users[2], users[3], users[4],
+                                   users[5], ix])
+                ops = [src.op_payment(dest.account_id, amt, X)]
+            elif kind < 0.60:  # Y arms: unauthorized / no trust
+                ops = [src.op_payment(
+                    rng.choice([users[3], iy]).account_id, 10, Y)]
+            elif kind < 0.75:  # create-account arms
+                fresh_n += 1
+                dest = rng.choice([
+                    SecretKey.from_seed(sha256(b"fresh%d" % fresh_n))
+                    .public_key,
+                    users[3].account_id,          # ALREADY_EXIST
+                ])
+                amt = rng.choice([MIN0 - 1, MIN0, 3 * MIN0, 10 ** 17])
+                ops = [src.op_create_account(dest, amt)]
+            elif kind < 0.80:  # set_options arms (engine-native): random
+                # signer/threshold/flag/home/inflation mutations — lockouts
+                # and stale-signer auth failures are fair game, both sides
+                # must just agree
+                from stellar_core_tpu.xdr import Signer, SignerKey
+                kw = {}
+                if rng.random() < 0.5:
+                    kw["signer"] = Signer(
+                        key=SignerKey.ed25519(SecretKey.from_seed(
+                            sha256(b"so-rnd%d" % rng.randrange(3)))
+                            .public_key.key_bytes),
+                        weight=rng.choice([0, 1, 2]))
+                if rng.random() < 0.35:
+                    kw["low"] = rng.choice([0, 1])
+                    kw["med"] = rng.choice([0, 1])
+                    kw["high"] = rng.choice([0, 1])
+                if rng.random() < 0.3:
+                    kw["home_domain"] = rng.choice(
+                        ["", "a.example", "x" * 32])
+                if rng.random() < 0.3:
+                    kw["inflation_dest"] = rng.choice(
+                        [users[2].account_id, ghost.public_key])
+                if rng.random() < 0.3:
+                    kw["set_flags" if rng.random() < 0.5
+                       else "clear_flags"] = rng.choice([1, 2, 3])
+                ops = [src.op_set_options(**kw)]
+            elif kind < 0.85:  # multi-op, second op from another source
+                if src is users[1]:
+                    continue  # 19 signers + other + master > 20-sig cap
+                other = rng.choice([u for u in users[2:] if u is not src])
+                ops = [src.op_payment(other.account_id, 100),
+                       other.op(other.op_payment(
+                           src.account_id, 50).body,
+                           source=other.account_id)]
+                extra = (extra or []) + [other.sk]
+            elif kind < 0.90:  # bad seq
+                frames.append(src.tx(
+                    [src.op_payment(root.account_id, 1)],
+                    seq=src.next_seq() + rng.choice([1, 5]),
+                    extra_signers=extra))
+                continue
+            elif kind < 0.95:  # fee / time bounds
+                ops = [src.op_payment(root.account_id, 1)]
+                if rng.random() < 0.5:
+                    kwargs["fee"] = rng.choice([1, 99])
+                else:
+                    kwargs["time_bounds"] = rng.choice([
+                        TimeBounds(minTime=2 ** 40, maxTime=0),
+                        TimeBounds(minTime=0, maxTime=1),
+                    ])
+            else:              # auth failure: unconsumable extra sig
+                if src is users[1]:
+                    continue  # 19 signers + master leave no room for a
+                    # 21st signature under the envelope cap
+                ops = [src.op_payment(root.account_id, 1)]
+                extra = (extra or []) + [ghost]   # BAD_AUTH_EXTRA
+            frames.append(src.tx(ops, extra_signers=extra, **kwargs))
+        return frames
+
+    seen = set()
+    for _ in range(6):
+        for f in h.close(rand_frames()):
+            seen.add(f.result.code)
+    assert h.closes_native >= 4, \
+        "engine handled too few closes (%d)" % h.closes_native
+    assert TransactionResultCode.txSUCCESS in seen
+    assert TransactionResultCode.txFAILED in seen

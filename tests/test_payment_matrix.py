@@ -1,22 +1,48 @@
-"""Payment/merge interleaving matrix, section-for-section against the
-reference's PaymentTests.cpp (/root/reference/src/transactions/test/
-PaymentTests.cpp:105-1490, modern protocol arms) beyond the basics in
-test_transactions.py: multi-op transactions where an account merges away
-mid-tx and later ops reference it — the account-lifecycle edge cases
-where atomic-rollback semantics decide the chain."""
+"""Payment matrix, section-for-section against the reference's
+PaymentTests.cpp (src/transactions/test/PaymentTests.cpp, 2,218 LoC,
+modern protocol arms) beyond the basics in test_transactions.py.
+
+Mapping table (reference arm → here; arms whose semantics this repo's
+model makes meaningless are listed rather than silently dropped):
+
+| reference arm                            | here                          |
+|------------------------------------------|-------------------------------|
+| merge/payment interleavings (:438-1090)  | merge section (below)         |
+| send to self / rescue account (:151-254) | test_rescue_account_below_    |
+|                                          | reserve, test_pay_self_*      |
+| two payments, first breaks 2nd (:105)    | test_two_payments_first_      |
+|                                          | breaking_second               |
+| simple credit: no trust / underfunded /  | cross-asset section           |
+| line full / issuer mint+burn (:256-380)  |                               |
+| payment through issuer (:381-436)        | covered by                    |
+|                                          | test_path_payment_matrix.py   |
+| auth required / revocable arms           | authorization section         |
+| (:1492-1600, AllowTrustOpFrame side in   | (payment-visible products     |
+| AllowTrustTests.cpp)                     | only; flag transitions live   |
+|                                          | in test_allow_trust_matrix)   |
+| liabilities cross-products (:1601-2218)  | liability section             |
+| receive limited by NATIVE buying         | skipped: needs balances near  |
+| liabilities at INT64_MAX (:1680)         | INT64_MAX, unreachable under  |
+|                                          | GENESIS_TOTAL_COINS           |
+| pre-8 / pre-10 protocol arms             | skipped: floor here is v9,    |
+|                                          | liabilities pinned at v13     |
+"""
 
 import pytest
 
 from stellar_core_tpu.testing import TestAccount, TestLedger, root_secret_key
-from stellar_core_tpu.transactions.operations import PaymentResultCode
+from stellar_core_tpu.transactions.operations import (
+    AllowTrustResultCode, PaymentResultCode,
+)
 from stellar_core_tpu.xdr import (
-    LedgerKey, OperationBody, OperationResultCode, OperationType,
-    TransactionResultCode,
+    AccountFlags, Asset, LedgerKey, OperationBody, OperationResultCode,
+    OperationType, TransactionResultCode, TrustLineFlags,
 )
 
 FEE = 100
 RESERVE = 5_000_000
 MIN0 = 2 * RESERVE
+MIN1 = 3 * RESERVE     # one subentry (a trustline or an offer)
 
 
 @pytest.fixture
@@ -180,3 +206,229 @@ def test_merge_source_then_recreate_in_same_close(ledger, root):
     assert ledger.close_with([t1, t2]) == [True, True]
     assert ledger.account_exists(a_id)
     assert ledger.balance(a_id) == MIN0
+
+
+# ------------------------------------------------------------- cross-asset
+# reference "simple credit" arms (:256-380): every trustline precondition
+# on both sides of a credit payment, plus issuer mint/burn.
+
+@pytest.fixture
+def v13():
+    return TestLedger(ledger_version=13)
+
+
+@pytest.fixture
+def root13(v13):
+    return TestAccount(v13, root_secret_key())
+
+
+def usd(issuer: TestAccount) -> Asset:
+    return Asset.credit("USD", issuer.account_id)
+
+
+def setup_credit(root, amount=200):
+    """issuer + two holders with authorized USD lines; a holds `amount`."""
+    issuer = root.create(MIN0 + 10**8)
+    a = root.create(MIN1 + 10**7)
+    b = root.create(MIN1 + 10**7)
+    cur = usd(issuer)
+    assert a.change_trust(cur, 10**9)
+    assert b.change_trust(cur, 10**9)
+    assert issuer.pay(a, amount, cur)
+    return issuer, a, b, cur
+
+
+def test_credit_payment_roundtrip(v13, root13):
+    issuer, a, b, cur = setup_credit(root13)
+    assert a.pay(b, 150, cur)
+    assert v13.trust_balance(a.account_id, cur) == 50
+    assert v13.trust_balance(b.account_id, cur) == 150
+
+
+def test_credit_payment_dest_no_trust(v13, root13):
+    issuer, a, b, cur = setup_credit(root13)
+    ghost = root13.create(MIN0 + 10**6)
+    f = a.tx([a.op_payment(ghost.account_id, 10, cur)])
+    assert not v13.apply_frame(f)
+    assert inner(f, 0).disc == PaymentResultCode.NO_TRUST
+
+
+def test_credit_payment_src_no_trust(v13, root13):
+    issuer, a, b, cur = setup_credit(root13)
+    c = root13.create(MIN0 + 10**6)
+    f = c.tx([c.op_payment(b.account_id, 10, cur)])
+    assert not v13.apply_frame(f)
+    assert inner(f, 0).disc == PaymentResultCode.SRC_NO_TRUST
+
+
+def test_credit_payment_underfunded(v13, root13):
+    issuer, a, b, cur = setup_credit(root13, amount=200)
+    f = a.tx([a.op_payment(b.account_id, 201, cur)])
+    assert not v13.apply_frame(f)
+    assert inner(f, 0).disc == PaymentResultCode.UNDERFUNDED
+    assert v13.trust_balance(a.account_id, cur) == 200
+
+
+def test_credit_payment_line_full(v13, root13):
+    issuer, a, b, cur = setup_credit(root13, amount=200)
+    c = root13.create(MIN1 + 10**7)
+    assert c.change_trust(cur, 100)   # tight limit
+    f = a.tx([a.op_payment(c.account_id, 101, cur)])
+    assert not v13.apply_frame(f)
+    assert inner(f, 0).disc == PaymentResultCode.LINE_FULL
+    assert a.pay(c, 100, cur)         # exactly to the limit is fine
+    assert v13.trust_balance(c.account_id, cur) == 100
+
+
+def test_issuer_mints_and_burns(v13, root13):
+    """The issuer pays without a source trustline (mint) and receives
+    without a destination trustline (burn)."""
+    issuer, a, b, cur = setup_credit(root13, amount=200)
+    # mint: total held grows with no trustline on the issuer side
+    assert issuer.pay(b, 70, cur)
+    assert v13.trust_balance(b.account_id, cur) == 70
+    # burn: paying the issuer just destroys the credit
+    assert b.pay(issuer, 70, cur)
+    assert v13.trust_balance(b.account_id, cur) == 0
+    assert v13.root.get_entry(
+        LedgerKey.trustline(issuer.account_id, cur)) is None
+
+
+def test_credit_pay_self_is_noop(v13, root13):
+    issuer, a, b, cur = setup_credit(root13, amount=200)
+    f = a.tx([a.op_payment(a.account_id, 150, cur)])
+    assert v13.apply_frame(f), f.result
+    assert v13.trust_balance(a.account_id, cur) == 200
+
+
+# ---------------------------------------------------------- authorization
+# reference auth-required/revocable arms: the payment-visible cross
+# product of trustline auth states × payment direction. Flag-transition
+# semantics themselves live in test_allow_trust_matrix.py.
+
+def setup_auth_required(root, revocable=True):
+    issuer = root.create(MIN0 + 10**8)
+    flags = AccountFlags.AUTH_REQUIRED_FLAG | (
+        AccountFlags.AUTH_REVOCABLE_FLAG if revocable else 0)
+    assert root.ledger.apply_frame(
+        issuer.tx([issuer.op_set_options(set_flags=flags)]))
+    a = root.create(MIN1 + 10**7)
+    b = root.create(MIN1 + 10**7)
+    cur = usd(issuer)
+    assert a.change_trust(cur, 10**9)
+    assert b.change_trust(cur, 10**9)
+    return issuer, a, b, cur
+
+
+def allow(ledger, issuer, trustor, authorize):
+    f = issuer.tx([issuer.op_allow_trust(trustor.account_id,
+                                         authorize=authorize)])
+    ok = ledger.apply_frame(f)
+    return ok, f
+
+
+def test_auth_required_dest_not_authorized(v13, root13):
+    issuer, a, b, cur = setup_auth_required(root13)
+    ok, _ = allow(v13, issuer, a, 1)
+    assert ok
+    assert issuer.pay(a, 100, cur)
+    # b's line exists but is unauthorized: receiving fails
+    f = a.tx([a.op_payment(b.account_id, 10, cur)])
+    assert not v13.apply_frame(f)
+    assert inner(f, 0).disc == PaymentResultCode.NOT_AUTHORIZED
+    # authorize b → same payment succeeds
+    ok, _ = allow(v13, issuer, b, 1)
+    assert ok
+    assert a.pay(b, 10, cur)
+
+
+def test_auth_revoked_source_cannot_send(v13, root13):
+    issuer, a, b, cur = setup_auth_required(root13)
+    for t in (a, b):
+        ok, _ = allow(v13, issuer, t, 1)
+        assert ok
+    assert issuer.pay(a, 100, cur)
+    ok, _ = allow(v13, issuer, a, 0)   # revoke the funded source
+    assert ok
+    f = a.tx([a.op_payment(b.account_id, 10, cur)])
+    assert not v13.apply_frame(f)
+    assert inner(f, 0).disc == PaymentResultCode.SRC_NOT_AUTHORIZED
+    # the balance is frozen, not seized
+    assert v13.trust_balance(a.account_id, cur) == 100
+
+
+def test_maintain_liabilities_blocks_payments_both_ways(v13, root13):
+    """v13 AUTHORIZED_TO_MAINTAIN_LIABILITIES: the trustor can neither
+    send nor receive — only existing offers persist."""
+    issuer, a, b, cur = setup_auth_required(root13)
+    for t in (a, b):
+        ok, _ = allow(v13, issuer, t, 1)
+        assert ok
+    assert issuer.pay(a, 100, cur)
+    ok, _ = allow(
+        v13, issuer, a,
+        TrustLineFlags.AUTHORIZED_TO_MAINTAIN_LIABILITIES_FLAG)
+    assert ok
+    f = a.tx([a.op_payment(b.account_id, 10, cur)])
+    assert not v13.apply_frame(f)
+    assert inner(f, 0).disc == PaymentResultCode.SRC_NOT_AUTHORIZED
+    f = issuer.tx([issuer.op_payment(a.account_id, 10, cur)])
+    assert not v13.apply_frame(f)
+    assert inner(f, 0).disc == PaymentResultCode.NOT_AUTHORIZED
+
+
+def test_unauthorized_line_cannot_receive_from_issuer(v13, root13):
+    issuer, a, b, cur = setup_auth_required(root13)
+    f = issuer.tx([issuer.op_payment(a.account_id, 10, cur)])
+    assert not v13.apply_frame(f)
+    assert inner(f, 0).disc == PaymentResultCode.NOT_AUTHORIZED
+
+
+# ------------------------------------------------------------- liabilities
+# reference liabilities arms (:1601-2218): offers encumber balance
+# (selling side) and headroom (buying side); payments must respect both.
+
+def test_native_payment_blocked_by_selling_liabilities(v13, root13):
+    issuer, a, b, cur = setup_credit(root13)
+    bal = a.balance()
+    sell = 10**6
+    # a sells native for USD: native selling liabilities = sell
+    assert v13.apply_frame(a.tx(
+        [a.op_manage_sell_offer(Asset.native(), cur, sell, 1, 1)]))
+    bal = bal - FEE          # offer reserve comes from min-balance, fee paid
+    avail = bal - (MIN1 + RESERVE) - sell   # trustline + offer subentries
+    f = a.tx([a.op_payment(b.account_id, avail + 1)])
+    assert not v13.apply_frame(f)
+    assert inner(f, 0).disc == PaymentResultCode.UNDERFUNDED
+    # the failed attempt still burned its fee; everything left after two
+    # fees moves in one payment
+    f = a.tx([a.op_payment(b.account_id, avail - 2 * FEE)])
+    assert v13.apply_frame(f), f.result
+
+
+def test_credit_payment_blocked_by_selling_liabilities(v13, root13):
+    issuer, a, b, cur = setup_credit(root13, amount=200)
+    # a sells USD for native: USD selling liabilities = 150
+    assert v13.apply_frame(a.tx(
+        [a.op_manage_sell_offer(cur, Asset.native(), 150, 1, 1)]))
+    f = a.tx([a.op_payment(b.account_id, 51, cur)])
+    assert not v13.apply_frame(f)
+    assert inner(f, 0).disc == PaymentResultCode.UNDERFUNDED
+    assert a.pay(b, 50, cur)   # the unencumbered remainder moves freely
+
+
+def test_credit_receive_blocked_by_buying_liabilities(v13, root13):
+    issuer, a, b, cur = setup_credit(root13, amount=200)
+    c = root13.create(MIN1 + RESERVE + 10**7)
+    assert c.change_trust(cur, 100)
+    # c buys 60 more USD with an offer: buying liabilities = 60, so only
+    # 40 of the 100 limit is receivable headroom
+    assert v13.apply_frame(c.tx(
+        [c.op_manage_sell_offer(Asset.native(), cur, 60, 1, 1)]))
+    f = a.tx([a.op_payment(c.account_id, 41, cur)])
+    assert not v13.apply_frame(f)
+    assert inner(f, 0).disc == PaymentResultCode.LINE_FULL
+    assert a.pay(c, 40, cur)
+    # raising the limit restores headroom
+    assert c.change_trust(cur, 200)
+    assert a.pay(c, 41, cur)

@@ -275,3 +275,89 @@ def test_pubnet_scale_interrupt_honored():
     with pytest.raises(InterruptedError):
         c.network_enjoys_quorum_intersection()
     assert time.monotonic() - t0 < 5.0
+
+
+# --------------------------------------------- herder background worker
+
+def _make_app(tmp_path):
+    from stellar_core_tpu.main.application import Application
+    from stellar_core_tpu.main.config import Config
+    from stellar_core_tpu.util.timer import ClockMode, VirtualClock
+    cfg = Config.test_config(0)
+    cfg.DATABASE = "sqlite3://:memory:"
+    app = Application(VirtualClock(ClockMode.VIRTUAL_TIME), cfg)
+    app.start()
+    return app
+
+
+def test_background_check_completes_and_installs_result(tmp_path):
+    """start_quorum_intersection_check runs off-thread and posts the
+    result back to the main loop."""
+    app = _make_app(tmp_path)
+    h = app.herder
+    assert h.start_quorum_intersection_check() is True
+    assert h.quorum_check_recalculating is True
+    # result arrives via post_to_main on a later crank
+    assert app.crank_until(
+        lambda: not h.quorum_check_recalculating, 100000)
+    res = h.last_quorum_intersection
+    assert res is not None and res["intersection"] is True
+    assert h.get_json_info()["transitive"]["recalculating"] is False
+    app.stop()
+
+
+def test_long_check_does_not_stall_close_and_is_interruptible(tmp_path):
+    """A check that would run forever neither blocks ledger close nor
+    survives interrupt_quorum_intersection() from the main loop
+    (reference HerderImpl.cpp:140-144)."""
+    import time
+    from stellar_core_tpu.herder import quorum_intersection as qi
+    app = _make_app(tmp_path)
+    h = app.herder
+
+    def hang_until_interrupted(self):
+        while not self.interrupted:
+            time.sleep(0.005)
+        raise InterruptedError("quorum intersection check interrupted")
+
+    orig = qi.QuorumIntersectionChecker.network_enjoys_quorum_intersection
+    qi.QuorumIntersectionChecker.network_enjoys_quorum_intersection = \
+        hang_until_interrupted
+    try:
+        assert h.start_quorum_intersection_check() is True
+        # a second request while one is in flight is refused, not queued
+        assert h.start_quorum_intersection_check() is False
+        lcl = app.ledger_manager.last_closed_ledger_num()
+        for _ in range(3):
+            app.manual_close()   # closes proceed while the worker "runs"
+        assert app.ledger_manager.last_closed_ledger_num() == lcl + 3
+        assert h.quorum_check_recalculating is True
+        h.interrupt_quorum_intersection()
+        deadline = time.monotonic() + 30.0
+        while h.quorum_check_recalculating and \
+                time.monotonic() < deadline:
+            app.clock.crank(False)
+            time.sleep(0.001)
+        assert h.quorum_check_recalculating is False
+        assert h.last_quorum_intersection.get("interrupted") is True
+    finally:
+        qi.QuorumIntersectionChecker.\
+            network_enjoys_quorum_intersection = orig
+        app.stop()
+
+
+def test_interrupt_reaches_criticality_scan_inner_checkers():
+    """The criticality scan builds a throwaway checker per candidate
+    group; the outer checker's interrupt flag must reach them (reference
+    threads ONE shared flag through the whole reanalysis), otherwise a
+    shutdown-time interrupt lands between groups and the worker burns on."""
+    from stellar_core_tpu.herder.quorum_intersection import (
+        intersection_critical_groups,
+    )
+    ks = keys(5)
+    q = qs(4, ks)                  # symmetric 4-of-5: candidates exist
+    qmap = qmap_of(ks, [q] * 5)
+    outer = QuorumIntersectionChecker(qmap)
+    outer.interrupted = True       # set BEFORE the scan starts
+    with pytest.raises(InterruptedError):
+        intersection_critical_groups(qmap, parent=outer)
