@@ -300,6 +300,11 @@ def replay_bench(backend: str, n_checkpoints: int = 4,
             with _keys._cache_lock:
                 _keys._verify_cache.clear()  # earlier runs filled it
             app = make_app(1, False, backend)
+            # span tracer on for the whole replay: BENCH artifacts carry
+            # a machine-generated phase_breakdown instead of a prose
+            # Amdahl estimate (ISSUE 2; docs/observability.md). Capacity
+            # sized so no replay span is ever evicted (~110 spans/ledger).
+            app.tracer.enable(capacity=65536)
             # account time spent inside the verifier's batch drain: the
             # crypto-subsystem speedup (whole-checkpoint batch path)
             # reported alongside the end-to-end ratio
@@ -339,6 +344,11 @@ def replay_bench(backend: str, n_checkpoints: int = 4,
             n_ledgers = got - 1   # replayed from genesis
             # only dense closes inside the replayed range count
             n_txs = (dense - dense_past_tip) * txs_per_ledger
+            # span-derived phase attribution: exclusive per-phase totals
+            # (+ untraced remainder) sum to the measured wall; verify
+            # drains key by configured backend AND actual platform, so a
+            # fallback leg can never masquerade as device time
+            phase_breakdown = app.tracer.phase_breakdown(wall_s=wall)
             return {"backend": backend, "ledgers": n_ledgers,
                     "dense_ledgers": dense, "wall_s": round(wall, 3),
                     "ledgers_per_sec": round(n_ledgers / wall, 2),
@@ -346,7 +356,8 @@ def replay_bench(backend: str, n_checkpoints: int = 4,
                     "txs_per_ledger": txs_per_ledger,
                     "sigs_per_tx": sigs_per_tx,
                     "crypto_s": round(crypto["s"], 3),
-                    "crypto_sigs": crypto["sigs"]}
+                    "crypto_sigs": crypto["sigs"],
+                    "phase_breakdown": phase_breakdown}
 
         repeats = int(os.environ.get("BENCH_REPLAY_REPEATS", "2"))
         best = None

@@ -77,6 +77,13 @@ class BatchSigVerifier:
     # ones — TxSetFrame.check_or_trim prewarms the whole set's signatures
     # through verify_many before walking txs (two-phase validation).
     wants_prewarm = False
+    # span tracer (util/tracing.py), installed by make_verifier; None
+    # keeps direct constructions (tests, native-apply fallback) silent
+    tracer = None
+
+    def _span(self, name: str, **tags):
+        from ..util.tracing import tracer_span
+        return tracer_span(self.tracer, name, cat="crypto", **tags)
 
     def enqueue(self, key: PublicKey, sig: bytes, msg: bytes) -> VerifyFuture:
         raise NotImplementedError
@@ -93,31 +100,34 @@ class BatchSigVerifier:
         synchronous per-signature checks all hit. Already-cached triples
         are not re-dispatched. Cache keys for the whole drain hash in one
         native call (prep.c sct_cache_keys) when available."""
-        cks = None
-        if len(triples) >= 256:   # below this the fixed numpy/ctypes
-            # marshalling cost exceeds hashlib's per-triple overhead
-            # (the native apply engine calls here once per tx, ~20-ish
-            # triples; checkpoint drains come in by the thousand)
-            from ..native import cache_keys_native
-            cks = cache_keys_native(triples)
-        if cks is None:
-            cks = [_keys._cache_key(k, s, m) for (k, s, m) in triples]
-        out: List[Optional[bool]] = [None] * len(triples)
-        todo: List[Tuple[int, Triple, bytes]] = []   # (idx, triple, key)
-        with _keys._cache_lock:
-            for i, (t, ck) in enumerate(zip(triples, cks)):
-                hit = _keys._verify_cache.maybe_get(ck)
-                if hit is not None:
-                    out[i] = hit
-                else:
-                    todo.append((i, t, ck))
-        if todo:
-            results = self.verify_many([t for (_i, t, _ck) in todo])
+        with self._span("crypto.prewarm", backend=self.name,
+                        n=len(triples)) as sp:
+            cks = None
+            if len(triples) >= 256:   # below this the fixed numpy/ctypes
+                # marshalling cost exceeds hashlib's per-triple overhead
+                # (the native apply engine calls here once per tx, ~20-ish
+                # triples; checkpoint drains come in by the thousand)
+                from ..native import cache_keys_native
+                cks = cache_keys_native(triples)
+            if cks is None:
+                cks = [_keys._cache_key(k, s, m) for (k, s, m) in triples]
+            out: List[Optional[bool]] = [None] * len(triples)
+            todo: List[Tuple[int, Triple, bytes]] = []  # (idx, triple, key)
             with _keys._cache_lock:
-                for ((i, _t, ck), ok) in zip(todo, results):
-                    _keys._verify_cache.put(ck, ok)
-                    out[i] = ok
-        return out  # type: ignore[return-value]
+                for i, (t, ck) in enumerate(zip(triples, cks)):
+                    hit = _keys._verify_cache.maybe_get(ck)
+                    if hit is not None:
+                        out[i] = hit
+                    else:
+                        todo.append((i, t, ck))
+            sp.set_tag("cache_hits", len(triples) - len(todo))
+            if todo:
+                results = self.verify_many([t for (_i, t, _ck) in todo])
+                with _keys._cache_lock:
+                    for ((i, _t, ck), ok) in zip(todo, results):
+                        _keys._verify_cache.put(ck, ok)
+                        out[i] = ok
+            return out  # type: ignore[return-value]
 
     def pending(self) -> int:
         return 0
@@ -137,7 +147,9 @@ class CpuSigVerifier(BatchSigVerifier):
         pass
 
     def verify_many(self, triples: Sequence[Triple]) -> List[bool]:
-        return _keys.raw_verify_batch(triples)
+        with self._span("crypto.verify_many", backend=self.name,
+                        n=len(triples)):
+            return _keys.raw_verify_batch(triples)
 
 
 class TpuSigVerifier(BatchSigVerifier):
@@ -167,6 +179,7 @@ class TpuSigVerifier(BatchSigVerifier):
         self._warmed = False
         self._warmup_thread: Optional[threading.Thread] = None
         self._sharded_fn = None  # lazy; multi-device dp dispatch
+        self._platform: Optional[str] = None  # actual jax platform, lazy
         if shard_threshold is not None:
             self.SHARD_MIN_BATCH = shard_threshold
 
@@ -273,27 +286,45 @@ class TpuSigVerifier(BatchSigVerifier):
         from ..ops import ed25519 as _e
         from ..parallel.mesh import pad_batch_to
         import numpy as np
+        import jax
         import jax.numpy as jnp
 
+        if self._platform is None:
+            # the ACTUAL backing platform ("tpu"/"cpu"/…): a jax-on-CPU
+            # run of this verifier is a fallback and must trace as one
+            self._platform = jax.devices()[0].platform
         out: List[bool] = []
-        i = 0
-        while i < len(triples):
-            chunk = triples[i:i + self.BUCKETS[-1]]
-            n = len(chunk)
-            fn, ndev = self._device_fn(self._bucket(n))
-            b = -(-self._bucket(n) // ndev) * ndev
-            prep = _e.prepare_batch(
-                [t[0] for t in chunk], [t[1] for t in chunk],
-                [t[2] for t in chunk])
-            padded = pad_batch_to(prep, b)  # pad lanes are pre_ok=False
-            ok = np.asarray(fn(
-                jnp.asarray(padded["ay"]), jnp.asarray(padded["a_sign"]),
-                jnp.asarray(padded["ry"]), jnp.asarray(padded["r_sign"]),
-                jnp.asarray(padded["s_nibs"]), jnp.asarray(padded["k_nibs"])))
-            out.extend((ok[:n] & prep["pre_ok"]).tolist())
-            self.batches_dispatched += 1
-            self.sigs_verified += n
-            i += n
+        with self._span("crypto.verify_many", backend=self.name,
+                        platform=self._platform, n=len(triples)) as sp:
+            i = 0
+            batches = 0
+            pad_waste = 0
+            while i < len(triples):
+                chunk = triples[i:i + self.BUCKETS[-1]]
+                n = len(chunk)
+                fn, ndev = self._device_fn(self._bucket(n))
+                b = -(-self._bucket(n) // ndev) * ndev
+                with self._span("crypto.dispatch", backend=self.name,
+                                n=n, bucket=b, pad=b - n):
+                    prep = _e.prepare_batch(
+                        [t[0] for t in chunk], [t[1] for t in chunk],
+                        [t[2] for t in chunk])
+                    padded = pad_batch_to(prep, b)  # pad lanes pre_ok=False
+                    ok = np.asarray(fn(
+                        jnp.asarray(padded["ay"]),
+                        jnp.asarray(padded["a_sign"]),
+                        jnp.asarray(padded["ry"]),
+                        jnp.asarray(padded["r_sign"]),
+                        jnp.asarray(padded["s_nibs"]),
+                        jnp.asarray(padded["k_nibs"])))
+                out.extend((ok[:n] & prep["pre_ok"]).tolist())
+                self.batches_dispatched += 1
+                self.sigs_verified += n
+                batches += 1
+                pad_waste += b - n
+                i += n
+            sp.set_tag("batches", batches)
+            sp.set_tag("pad_waste", pad_waste)
         return out
 
 
@@ -355,7 +386,17 @@ class ThreadedBatchVerifier(BatchSigVerifier):
 
         def work() -> None:
             triples = [t for (t, _f, _t0) in batch]
-            results = self._inner.verify_many(triples)
+            # queue-wait: enqueue → dispatch start, per batch; dispatch
+            # time is the span's own duration (inner verify_many nests)
+            t_disp = time.perf_counter()
+            waits = [t_disp - t0 for (_t, _f, t0) in batch]
+            with self._span("crypto.batch_dispatch",
+                            backend="threaded:%s" % self._inner.name,
+                            n=len(batch),
+                            queue_wait_max_ms=round(max(waits) * 1e3, 3),
+                            queue_wait_mean_ms=round(
+                                sum(waits) / len(waits) * 1e3, 3)):
+                results = self._inner.verify_many(triples)
 
             def complete() -> None:
                 done = time.perf_counter()
@@ -386,17 +427,20 @@ class ThreadedBatchVerifier(BatchSigVerifier):
 def make_verifier(backend: str = "cpu", clock=None,
                   max_pending: int = 8192,
                   compile_cache_dir: Optional[str] = None,
-                  metrics=None) -> BatchSigVerifier:
+                  metrics=None, tracer=None) -> BatchSigVerifier:
     """Config-gated backend selection (Config.SIG_VERIFY_BACKEND)."""
     if backend == "cpu":
-        return CpuSigVerifier()
-    if backend == "tpu":
-        return TpuSigVerifier(max_pending=max_pending,
-                              compile_cache_dir=compile_cache_dir)
-    if backend == "tpu-async":
+        v: BatchSigVerifier = CpuSigVerifier()
+    elif backend == "tpu":
+        v = TpuSigVerifier(max_pending=max_pending,
+                           compile_cache_dir=compile_cache_dir)
+    elif backend == "tpu-async":
         assert clock is not None
-        return ThreadedBatchVerifier(
-            TpuSigVerifier(max_pending=max_pending,
-                           compile_cache_dir=compile_cache_dir), clock,
-            metrics=metrics)
-    raise ValueError("unknown sig verify backend %r" % backend)
+        inner = TpuSigVerifier(max_pending=max_pending,
+                               compile_cache_dir=compile_cache_dir)
+        inner.tracer = tracer
+        v = ThreadedBatchVerifier(inner, clock, metrics=metrics)
+    else:
+        raise ValueError("unknown sig verify backend %r" % backend)
+    v.tracer = tracer
+    return v

@@ -46,6 +46,9 @@ class HerderSCPDriver(SCPDriver):
 
     def __init__(self, herder: "Herder") -> None:
         self.herder = herder
+        # SCPDriver trace hooks (scp/driver.py) emit ballot/nomination
+        # instants against the application tracer
+        self.tracer = getattr(herder.app, "tracer", None)
 
     # -- envelope signing ----------------------------------------------------
     def _envelope_sign_bytes(self, st) -> bytes:
@@ -239,6 +242,7 @@ class HerderSCPDriver(SCPDriver):
         self.herder.value_externalized(slot_index, value)
 
     def ballot_did_hear_from_quorum(self, slot_index, ballot) -> None:
+        super().ballot_did_hear_from_quorum(slot_index, ballot)
         self.herder.track_heartbeat()
 
 
@@ -321,6 +325,14 @@ class Herder:
 
     def _lost_sync(self) -> None:
         log.warning("lost consensus sync (stuck timer fired)")
+        # SCP-stall flight dump: the spans/metrics leading into the stall
+        # are the evidence that outlives the wedge (ISSUE 2: a stalled
+        # relay went unexplained for a round)
+        recorder = getattr(self.app, "flight_recorder", None)
+        if recorder is not None:
+            recorder.dump("scp-stall",
+                          extra={"tracking_slot": self.tracking_slot,
+                                 "state": "syncing"})
         self.state = HerderState.HERDER_SYNCING_STATE
         hook = getattr(self.app, "out_of_sync_recovery", None)
         if hook is not None:
@@ -565,6 +577,7 @@ class Herder:
 
     # -- nomination ----------------------------------------------------------
     def trigger_next_ledger(self, ledger_seq_to_trigger: int) -> None:
+        from ..util.tracing import app_span
         lm = self.app.ledger_manager
         cfg = self.app.config
         lcl = lm.lcl_header
@@ -573,13 +586,16 @@ class Herder:
             log.debug("stale trigger for %d (slot %d)",
                       ledger_seq_to_trigger, slot)
             return
-        txset = self.tx_queue.to_txset(lm.lcl_hash, cfg.network_id)
-        removed = txset.trim_invalid(lm.ltx_root(), self.verifier)
-        if removed:
-            self.tx_queue.ban([f.full_hash() for f in removed])
-        txset.surge_pricing_filter(lcl)
-        h = txset.get_contents_hash()
-        self.pending.add_tx_set(h, txset)
+        with app_span(self.app, "herder.trigger", cat="scp",
+                      slot=slot) as tsp:
+            txset = self.tx_queue.to_txset(lm.lcl_hash, cfg.network_id)
+            removed = txset.trim_invalid(lm.ltx_root(), self.verifier)
+            if removed:
+                self.tx_queue.ban([f.full_hash() for f in removed])
+            txset.surge_pricing_filter(lcl)
+            tsp.set_tag("txs", len(txset.frames))
+            h = txset.get_contents_hash()
+            self.pending.add_tx_set(h, txset)
 
         close_time = max(self.app.clock.system_now(),
                          lcl.scpValue.closeTime + 1)
@@ -615,13 +631,21 @@ class Herder:
             s: t for s, t in self._nominate_started.items()
             if s > slot_index}   # drop stale never-externalized slots
         m = self._metrics()
+        lat = (max(0.0, self.app.clock.now() - t0)
+               if t0 is not None else None)
         if m is not None:
             m.new_meter("scp.value.externalized").mark()
-            if t0 is not None:
+            if lat is not None:
                 # reference scp.timing.externalized: nomination-start →
                 # externalize latency per slot
-                m.new_timer("scp.timing.externalized").update(
-                    max(0.0, self.app.clock.now() - t0))
+                m.new_timer("scp.timing.externalized").update(lat)
+        tracer = getattr(self.app, "tracer", None)
+        if tracer is not None and tracer.enabled:
+            # round timing rides as a tag: the latency is measured on the
+            # app clock, not the tracer clock, so it can't be a span
+            tracer.instant("scp.externalize", cat="scp", slot=slot_index,
+                           **({} if lat is None else
+                              {"nominate_to_externalize_s": round(lat, 6)}))
         sv = StellarValue.from_xdr(value)
         txset = self.pending.get_tx_set(sv.txSetHash)
         assert txset is not None, "externalized unknown txset"

@@ -190,32 +190,42 @@ class ApplyCheckpointWork(BasicWork):
         """Collect candidate triples against CURRENT ledger state and
         drain them through the batch verifier (cached triples are skipped
         inside prewarm_many — a fully-covered call dispatches nothing)."""
+        from ..util.tracing import app_span
         verifier = getattr(self.app, "sig_verifier", None)
         if verifier is None or not frames or self._prewarm_redundant():
             return
         from ..ledger.ledgertxn import LedgerTxn
-        ltx = LedgerTxn(self.app.ledger_manager.ltx_root())
-        try:
-            triples = checkpoint_verify_triples(frames, ltx)
-        finally:
-            ltx.rollback()
+        # sig-batch prep (triple collection + signer-set resolution) and
+        # the verify drain trace separately: prep is host CPU, the drain
+        # is the backend-attributed phase
+        with app_span(self.app, "catchup.sig_prep", cat="catchup",
+                      frames=len(frames)):
+            ltx = LedgerTxn(self.app.ledger_manager.ltx_root())
+            try:
+                triples = checkpoint_verify_triples(frames, ltx)
+            finally:
+                ltx.rollback()
         if triples:
             verifier.prewarm_many(triples)
 
     def _prewarm(self) -> None:
         """One device batch for the whole checkpoint's signatures."""
         from ..herder.txset import TxSetFrame
+        from ..util.tracing import app_span
         net = self.app.config.network_id
         frames = []
-        for seq in range(self.first_seq, self.last_seq + 1):
-            ts = self._txsets.get(seq)
-            if ts is None:
-                continue
-            fr = TxSetFrame.from_wire(net, ts)
-            self._frames[seq] = fr       # reused at apply: parse once
-            for f in fr.frames:          # history wire is immutable:
-                f.freeze_signatures()    # skip per-serialize fp checks
-            frames.extend(fr.frames)
+        with app_span(self.app, "catchup.txset_parse", cat="catchup",
+                      checkpoint=self.checkpoint) as psp:
+            for seq in range(self.first_seq, self.last_seq + 1):
+                ts = self._txsets.get(seq)
+                if ts is None:
+                    continue
+                fr = TxSetFrame.from_wire(net, ts)
+                self._frames[seq] = fr       # reused at apply: parse once
+                for f in fr.frames:          # history wire is immutable:
+                    f.freeze_signatures()    # skip per-serialize fp checks
+                frames.extend(fr.frames)
+            psp.set_tag("txs", len(frames))
         self._prewarm_frames(frames)
         log.debug("prewarmed checkpoint %08x (%d txs)",
                   self.checkpoint, len(frames))
@@ -259,7 +269,11 @@ class ApplyCheckpointWork(BasicWork):
         from ..ledger.ledger_manager import LedgerCloseData
 
         if not self._loaded:
-            if not self._load():
+            from ..util.tracing import app_span
+            with app_span(self.app, "catchup.load_files", cat="catchup",
+                          checkpoint=self.checkpoint):
+                ok = self._load()
+            if not ok:
                 return FAILURE
             self._prewarm()
             self._loaded = True
@@ -284,7 +298,10 @@ class ApplyCheckpointWork(BasicWork):
                      TxSetFrame(net, entry.header.previousLedgerHash, []))
         self._prewarm_ledger(txset)
         lcd = LedgerCloseData(seq, txset, entry.header.scpValue)
-        lm.close_ledger(lcd)
+        from ..util.tracing import app_span
+        with app_span(self.app, "catchup.apply_ledger", cat="catchup",
+                      seq=seq, checkpoint=self.checkpoint):
+            lm.close_ledger(lcd)
         if not self._sig_state_dirty and self._mutates_signers(txset):
             self._sig_state_dirty = True
         if lm.lcl_hash != entry.hash:

@@ -183,6 +183,13 @@ class LedgerManager:
         verifier = getattr(self.app, "sig_verifier", None)
         metrics = getattr(self.app, "metrics", None)
         from ..util.slow_execution import LogSlowExecution
+        from ..util.tracing import app_span
+        recorder = getattr(self.app, "flight_recorder", None)
+        on_slow = (None if recorder is None else
+                   lambda elapsed: recorder.dump(
+                       "slow-close",
+                       extra={"ledger_seq": lcd.ledger_seq,
+                              "elapsed_s": elapsed}))
         db = getattr(self.app, "database", None)
         ltx = LedgerTxn(self.root)
         try:
@@ -194,7 +201,10 @@ class LedgerManager:
             sql_before = db.total_query_seconds if db is not None else 0.0
             t0 = _time.perf_counter()
             try:
-                with LogSlowExecution("ledger close"):
+                with LogSlowExecution("ledger close", on_slow=on_slow), \
+                        app_span(self.app, "ledger.close", cat="ledger",
+                                 seq=lcd.ledger_seq,
+                                 txs=len(lcd.tx_set.frames)):
                     self._close_ledger_in(ltx, lcd, header_prev, verifier)
             finally:
                 if metrics is not None:
@@ -211,20 +221,28 @@ class LedgerManager:
                     len(lcd.tx_set.frames))
                 metrics.new_counter("ledger.ledger.num").set_count(
                     lcd.ledger_seq)
-        except BaseException:
+        except BaseException as e:
             if ltx._open:
                 ltx.rollback()   # drop children too: no dangling state
+            # black box for the postmortem: spans + metrics at the moment
+            # of a failed close (KeyboardInterrupt/SystemExit excluded —
+            # an operator ^C is not a crash)
+            if recorder is not None and isinstance(e, Exception):
+                recorder.dump("close-exception", exc=e,
+                              extra={"ledger_seq": lcd.ledger_seq})
             raise
 
     def _close_ledger_in(self, ltx, lcd: LedgerCloseData,
                          header_prev: LedgerHeader, verifier) -> None:
+        from ..util.tracing import app_span
         header = ltx.load_header()
         header.ledgerSeq = lcd.ledger_seq
         header.previousLedgerHash = self.lcl_hash
         header.scpValue = lcd.value
 
-        frames = lcd.tx_set.sort_for_apply()
-        base_fee = lcd.tx_set.base_fee(header)
+        with app_span(self.app, "close.txset_sort", cat="ledger"):
+            frames = lcd.tx_set.sort_for_apply()
+            base_fee = lcd.tx_set.base_fee(header)
 
         # fast path: the native engine runs BOTH phases in one C call and
         # installs per-frame results/meta + the close-level delta; any
@@ -232,31 +250,38 @@ class LedgerManager:
         # mutated (ledger/native_apply.py)
         from ..ledger.ledgertxn import delta_to_changes
         from ..ledger.native_apply import native_apply_txset
-        if not native_apply_txset(self, ltx, frames, base_fee, verifier):
-            # phase 1: fees + seq nums for every tx, each in a nested txn
-            # so the per-tx fee-processing changes become txfeehistory
-            # meta (reference saves these LedgerEntryChanges per tx)
-            for f in frames:
-                fee_ltx = LedgerTxn(ltx)
-                try:
-                    f.process_fee_seq_num(fee_ltx, base_fee)
-                    f.fee_meta = delta_to_changes(fee_ltx.get_delta())
-                    fee_ltx.commit()
-                except BaseException:
-                    if fee_ltx._open:
-                        fee_ltx.rollback()
-                    raise
-            # phase 2: apply, collecting results (+ invariant checks)
-            for f in frames:
-                f.apply(ltx, verifier)
+        with app_span(self.app, "close.apply", cat="ledger",
+                      txs=len(frames)) as apply_sp:
+            if native_apply_txset(self, ltx, frames, base_fee, verifier):
+                apply_sp.set_tag("apply_path", "native")
+            else:
+                apply_sp.set_tag("apply_path", "python")
+                # phase 1: fees + seq nums for every tx, each in a nested
+                # txn so the per-tx fee-processing changes become
+                # txfeehistory meta (reference saves these
+                # LedgerEntryChanges per tx)
+                for f in frames:
+                    fee_ltx = LedgerTxn(ltx)
+                    try:
+                        f.process_fee_seq_num(fee_ltx, base_fee)
+                        f.fee_meta = delta_to_changes(fee_ltx.get_delta())
+                        fee_ltx.commit()
+                    except BaseException:
+                        if fee_ltx._open:
+                            fee_ltx.rollback()
+                        raise
+                # phase 2: apply, collecting results (+ invariant checks)
+                for f in frames:
+                    f.apply(ltx, verifier)
         # result hash in apply order, assembled from wire bytes:
         # TransactionResultSet XDR is count ‖ pairs, and each frame holds
         # (or lazily serializes) its own pair bytes — on the native fast
         # path no TransactionResult is ever parsed or re-serialized here
         # (tests/test_native_apply.py pins this layout against the codec)
-        header.txSetResultHash = sha256(
-            _be_u32(len(frames)) +
-            b"".join(f.result_pair_xdr() for f in frames))
+        with app_span(self.app, "close.result_hash", cat="ledger"):
+            header.txSetResultHash = sha256(
+                _be_u32(len(frames)) +
+                b"".join(f.result_pair_xdr() for f in frames))
 
         # invariants see the TX-phase delta under the pre-upgrade header:
         # the reference hooks invariants per operation only, so upgrade
@@ -315,47 +340,55 @@ class LedgerManager:
         # every pre-image entry; raw_keys=True: only DEAD entries need a
         # parsed LedgerKey (bucket dead keys), live/init keys would be
         # parsed once per touched account per close just to be dropped
-        delta = ltx.get_delta(need_prev=False, raw_keys=True)
-        bl = self._bucket_manager()
-        if bl is not None:
-            init_entries, live_entries, dead_keys = [], [], []
-            for kb, prev, cur in delta:
-                if cur is None:
-                    dead_keys.append(LedgerKey.from_xdr(kb))
-                elif prev is None:
-                    init_entries.append(cur)
-                else:
-                    live_entries.append(cur)
-            bl.add_batch(header.ledgerSeq, header.ledgerVersion,
-                         init_entries, live_entries, dead_keys)
-            bl.snapshot_ledger(header)
-        else:
-            h = SHA256()
-            h.add(header_prev.bucketListHash)
-            for kb, prev, cur in sorted(delta, key=lambda t: t[0]):
-                h.add(kb)
-                h.add(cur.to_xdr() if cur is not None else b"\xff" * 4)
-            header.bucketListHash = h.finish()
-            # skipList advances identically with or without a real bucket
-            # list — it hangs off whatever stands in bucketListHash
-            from ..bucket.bucket_manager import calculate_skip_values
-            calculate_skip_values(header)
+        with app_span(self.app, "close.bucket_add", cat="ledger") as bsp:
+            delta = ltx.get_delta(need_prev=False, raw_keys=True)
+            bl = self._bucket_manager()
+            bsp.set_tag("entries", len(delta))
+            if bl is not None:
+                init_entries, live_entries, dead_keys = [], [], []
+                for kb, prev, cur in delta:
+                    if cur is None:
+                        dead_keys.append(LedgerKey.from_xdr(kb))
+                    elif prev is None:
+                        init_entries.append(cur)
+                    else:
+                        live_entries.append(cur)
+                bl.add_batch(header.ledgerSeq, header.ledgerVersion,
+                             init_entries, live_entries, dead_keys)
+                bl.snapshot_ledger(header)
+            else:
+                h = SHA256()
+                h.add(header_prev.bucketListHash)
+                for kb, prev, cur in sorted(delta, key=lambda t: t[0]):
+                    h.add(kb)
+                    h.add(cur.to_xdr() if cur is not None else b"\xff" * 4)
+                header.bucketListHash = h.finish()
+                # skipList advances identically with or without a real
+                # bucket list — it hangs off whatever stands in
+                # bucketListHash
+                from ..bucket.bucket_manager import calculate_skip_values
+                calculate_skip_values(header)
 
         # invariants on the tx phase of the close (upgrade deltas exempt)
         if inv is not None:
             inv.check_on_ledger_close(tx_phase_delta, header_prev,
                                       tx_phase_header)
 
-        ltx.commit()
-        self.lcl_hash = sha256(self.root.get_header().to_xdr())
-        self._store_header(self.root.get_header())
-        self._store_txs(lcd, frames)
-        # after the in-memory commit, like txhistory: a close that fails
-        # mid-upgrade must leave no pending history rows in the sqlite
-        # transaction (a catchup retry would hit the PRIMARY KEY)
-        for up, changes, index in applied_upgrades:
-            self._store_upgrade_history(lcd.ledger_seq, up, changes, index)
-        self._store_local_has()
+        with app_span(self.app, "close.commit", cat="ledger"):
+            ltx.commit()
+        with app_span(self.app, "close.header_hash", cat="ledger"):
+            self.lcl_hash = sha256(self.root.get_header().to_xdr())
+        with app_span(self.app, "close.sql_commit", cat="ledger"):
+            self._store_header(self.root.get_header())
+            self._store_txs(lcd, frames)
+            # after the in-memory commit, like txhistory: a close that
+            # fails mid-upgrade must leave no pending history rows in the
+            # sqlite transaction (a catchup retry would hit the PRIMARY
+            # KEY)
+            for up, changes, index in applied_upgrades:
+                self._store_upgrade_history(lcd.ledger_seq, up, changes,
+                                            index)
+            self._store_local_has()
         self._emit_close_meta(lcd, frames, applied_upgrades)
         hm = getattr(self.app, "history_manager", None)
         if hm is not None:

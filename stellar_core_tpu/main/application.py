@@ -43,6 +43,17 @@ class Application:
         from ..util.status_manager import StatusManager
         self.status_manager = StatusManager()
 
+        # span tracer + flight recorder (util/tracing.py): constructed
+        # before every subsystem so each can hold a direct reference;
+        # disabled tracing costs one attribute check per span site
+        from ..util.tracing import FlightRecorder, Tracer
+        self.tracer = Tracer(capacity=config.TRACE_CAPACITY)
+        if config.TRACE_ENABLED:
+            self.tracer.enable()
+        self.flight_recorder = FlightRecorder(
+            self.tracer, metrics=self.metrics,
+            out_dir=config.FLIGHT_RECORDER_DIR or None)
+
         # database (None in pure in-memory test mode)
         if config.DATABASE == "in-memory":
             self.database: Optional[Database] = None
@@ -59,7 +70,7 @@ class Application:
             config.SIG_VERIFY_BACKEND, clock,
             config.SIG_VERIFY_MAX_BATCH,
             config.SIG_VERIFY_COMPILE_CACHE_DIR,
-            metrics=self.metrics)
+            metrics=self.metrics, tracer=self.tracer)
 
         self.invariant_manager = InvariantManager(self.metrics)
         for pattern in config.INVARIANT_CHECKS:

@@ -67,7 +67,11 @@ class CommandHandler:
         return info
 
     def cmd_metrics(self, params) -> dict:
-        out = self.app.metrics.to_json()
+        """`metrics[?filter=<prefix>]` — with a filter, only metrics whose
+        name starts with the prefix are serialized (operators and tests
+        fetch `crypto.` or `ledger.` without paying for the registry)."""
+        prefix = params.get("filter") or None
+        out = self.app.metrics.to_json(prefix=prefix)
         # crypto-boundary metrics live outside the registry (global cache,
         # per-verifier counters); merge them in medida-style names
         from ..crypto import keys as _keys
@@ -80,7 +84,48 @@ class CommandHandler:
             out["crypto.verify.batch-dispatch"] = {
                 "count": inner.batches_dispatched}
             out["crypto.verify.sigs"] = {"count": inner.sigs_verified}
+        if prefix:
+            out = {k: v2 for k, v2 in out.items() if k.startswith(prefix)}
         return out
+
+    def cmd_trace(self, params) -> dict:
+        """Span-tracer control + export (ISSUE 2 tentpole):
+        `trace?action=status|start|stop|clear|dump|flight`.
+        `start` takes optional `capacity=N`; `dump` (the default action)
+        returns Chrome-trace-event JSON (load in chrome://tracing or
+        Perfetto), optional `limit=N` for the last N spans; `flight`
+        forces a flight-recorder dump and returns its path."""
+        tracer = self.app.tracer
+        action = params.get("action", "dump")
+        if action == "start":
+            cap = params.get("capacity")
+            tracer.enable(capacity=int(cap) if cap else None)
+            return {"status": "tracing", "capacity": tracer.capacity}
+        if action == "stop":
+            tracer.disable()
+            return {"status": "stopped", "spans": len(tracer.spans())}
+        if action == "clear":
+            tracer.clear()
+            return {"status": "cleared"}
+        if action == "status":
+            return {"enabled": tracer.enabled,
+                    "spans": len(tracer.spans()),
+                    "capacity": tracer.capacity,
+                    "dropped": tracer.dropped,
+                    "flight_dumps": self.app.flight_recorder.dumps,
+                    "flight_suppressed": self.app.flight_recorder.suppressed,
+                    "last_flight_path": self.app.flight_recorder.last_path}
+        if action == "flight":
+            # operator-requested: bypasses the per-reason dump cooldown
+            path = self.app.flight_recorder.dump(
+                params.get("reason", "manual"), force=True)
+            return {"status": "dumped", "path": path}
+        if action == "dump":
+            limit = params.get("limit")
+            return tracer.to_chrome_trace(
+                last_n=int(limit) if limit else None)
+        return {"error": "action must be "
+                         "status|start|stop|clear|dump|flight"}
 
     def cmd_peers(self, params) -> dict:
         om = self.app.overlay_manager

@@ -109,6 +109,16 @@ class Config:
         # persistent XLA compilation cache (None = env or ~/.cache default)
         self.SIG_VERIFY_COMPILE_CACHE_DIR: Optional[str] = None
 
+        # observability: span tracer (util/tracing.py). Enabled at
+        # startup when True; always toggleable at runtime via the admin
+        # `trace` endpoint. Capacity bounds the span ring buffer.
+        self.TRACE_ENABLED = False
+        self.TRACE_CAPACITY = 16384
+        # flight-recorder dump directory ("" = the SCT_FLIGHT_DIR env
+        # override, else the system tempdir); dumps fire on unhandled
+        # close exceptions and SCP-stall / slow-close watchdog triggers
+        self.FLIGHT_RECORDER_DIR = ""
+
         # maintenance
         self.AUTOMATIC_MAINTENANCE_PERIOD = 359.0
         self.AUTOMATIC_MAINTENANCE_COUNT = 50000
@@ -151,7 +161,8 @@ class Config:
             "EXPECTED_LEDGER_CLOSE_TIME", "MAX_SLOTS_TO_REMEMBER",
             "INVARIANT_CHECKS", "WORKER_THREADS",
             "MAX_CONCURRENT_SUBPROCESSES", "SIG_VERIFY_BACKEND",
-            "SIG_VERIFY_MAX_BATCH", "CHECKPOINT_FREQUENCY",
+            "SIG_VERIFY_MAX_BATCH", "TRACE_ENABLED", "TRACE_CAPACITY",
+            "FLIGHT_RECORDER_DIR", "CHECKPOINT_FREQUENCY",
             "CATCHUP_COMPLETE", "CATCHUP_RECENT",
             "PEER_TIMEOUT", "PEER_STRAGGLER_TIMEOUT",
             "MAX_BATCH_WRITE_COUNT", "MAX_BATCH_WRITE_BYTES",

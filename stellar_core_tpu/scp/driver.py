@@ -28,6 +28,18 @@ class SCPTimerID:
 
 
 class SCPDriver:
+    # span tracer (util/tracing.py). When attached (Herder wires the
+    # application's), the protocol notification hooks below emit instant
+    # events so a Chrome-trace of a round shows nomination/ballot
+    # progression between the close spans. None (the default) keeps
+    # standalone/test drivers silent.
+    tracer = None
+
+    def _trace_instant(self, name: str, slot_index: int, **tags) -> None:
+        from ..util.tracing import tracer_instant
+        tracer_instant(self.tracer, name, cat="scp", slot=slot_index,
+                       **tags)
+
     # -- values -------------------------------------------------------------
     def validate_value(self, slot_index: int, value: bytes,
                        nomination: bool) -> ValidationLevel:
@@ -63,31 +75,40 @@ class SCPDriver:
         MAX_TIMEOUT_SECONDS=30*60)."""
         return float(min(round_number, 30 * 60))
 
-    # -- notifications (all optional hooks) ---------------------------------
+    # -- notifications (optional hooks; base emits trace instants so any
+    # subclass calling super() keeps round/ballot timing visible) ----------
     def value_externalized(self, slot_index: int, value: bytes) -> None:
+        # no instant here: Herder overrides this and emits the richer
+        # "scp.externalize" event (with nominate→externalize latency);
+        # a base-class event would just be a dead near-duplicate
         pass
 
     def nominating_value(self, slot_index: int, value: bytes) -> None:
-        pass
+        self._trace_instant("scp.nominating", slot_index)
 
     def updated_candidate_value(self, slot_index: int,
                                 value: bytes) -> None:
-        pass
+        self._trace_instant("scp.candidate_updated", slot_index)
 
     def started_ballot_protocol(self, slot_index: int, ballot) -> None:
-        pass
+        self._trace_instant("scp.ballot.start", slot_index,
+                            counter=getattr(ballot, "counter", None))
 
     def accepted_ballot_prepared(self, slot_index: int, ballot) -> None:
-        pass
+        self._trace_instant("scp.ballot.accept_prepared", slot_index,
+                            counter=getattr(ballot, "counter", None))
 
     def confirmed_ballot_prepared(self, slot_index: int, ballot) -> None:
-        pass
+        self._trace_instant("scp.ballot.confirm_prepared", slot_index,
+                            counter=getattr(ballot, "counter", None))
 
     def accepted_commit(self, slot_index: int, ballot) -> None:
-        pass
+        self._trace_instant("scp.ballot.accept_commit", slot_index,
+                            counter=getattr(ballot, "counter", None))
 
     def ballot_did_hear_from_quorum(self, slot_index: int, ballot) -> None:
-        pass
+        self._trace_instant("scp.ballot.heard_quorum", slot_index,
+                            counter=getattr(ballot, "counter", None))
 
     # -- hashing for nomination leader election -----------------------------
     HASH_N = 1

@@ -50,12 +50,19 @@ class Meter:
             b[-1] = (sec, b[-1][1] + n)
         else:
             b.append((sec, n))
-            cutoff = sec - 900
-            while b and b[0][0] < cutoff:
-                b.popleft()
+            self._prune(sec)
+
+    def _prune(self, sec: int) -> None:
+        cutoff = sec - 900
+        b = self._buckets
+        while b and b[0][0] < cutoff:
+            b.popleft()
 
     def rate(self, window: float) -> float:
         t = self._now()
+        # prune on reads too: an idle meter must decay to 0 and drop its
+        # stale buckets, not report them forever
+        self._prune(int(t))
         total = sum(n for (sec, n) in self._buckets if sec >= t - window)
         return total / window if window > 0 else 0.0
 
@@ -92,40 +99,49 @@ class Histogram:
             self._samples[self._i % self.MAX_SAMPLES] = v
             self._i += 1
 
-    def percentile(self, q: float) -> float:
-        if not self._samples:
+    @staticmethod
+    def _pick(sorted_samples: List[float], q: float) -> float:
+        if not sorted_samples:
             return 0.0
-        s = sorted(self._samples)
-        idx = min(int(q * len(s)), len(s) - 1)
-        return s[idx]
+        idx = min(int(q * len(sorted_samples)), len(sorted_samples) - 1)
+        return sorted_samples[idx]
+
+    def percentile(self, q: float) -> float:
+        return self._pick(sorted(self._samples), q)
 
     def mean(self) -> float:
         return self.total / self.count if self.count else 0.0
 
     def to_json(self) -> dict:
+        # one sort shared by every percentile in the export
+        s = sorted(self._samples)
         return {"type": "histogram", "count": self.count, "mean": self.mean(),
                 "min": self.min or 0.0, "max": self.max or 0.0,
-                "median": self.percentile(0.5), "p75": self.percentile(0.75),
-                "p99": self.percentile(0.99)}
+                "median": self._pick(s, 0.5), "p75": self._pick(s, 0.75),
+                "p95": self._pick(s, 0.95), "p99": self._pick(s, 0.99)}
 
 
 class Timer(Histogram):
-    """Histogram of durations (seconds) + a context-manager helper."""
+    """Histogram of durations (seconds) + a context-manager helper.
 
-    def __init__(self, now_fn: Callable[[], float]) -> None:
+    Durations are measured with the registry's injected `now_fn` so
+    virtual-clock tests control them; `perf_counter` is only the
+    default when no clock was injected."""
+
+    def __init__(self, now_fn: Callable[[], float] | None = None) -> None:
         super().__init__()
-        self._now = now_fn
+        self._now = now_fn or time.perf_counter
 
     class _Ctx:
         def __init__(self, t: "Timer") -> None:
             self._t = t
 
         def __enter__(self):
-            self._start = time.perf_counter()
+            self._start = self._t._now()
             return self
 
         def __exit__(self, *exc):
-            self._t.update(time.perf_counter() - self._start)
+            self._t.update(self._t._now() - self._start)
             return False
 
     def time(self) -> "Timer._Ctx":
@@ -140,6 +156,9 @@ class Timer(Histogram):
 class MetricsRegistry:
     def __init__(self, now_fn: Callable[[], float] | None = None) -> None:
         self._now = now_fn or time.monotonic
+        # timers measure with the injected clock (virtual-clock tests
+        # control durations); with no injection they keep perf_counter
+        self._timer_now = now_fn
         self._metrics: Dict[str, object] = {}
 
     def _get(self, name: str, factory):
@@ -156,10 +175,15 @@ class MetricsRegistry:
         return self._get(name, lambda: Meter(self._now))
 
     def new_timer(self, name: str) -> Timer:
-        return self._get(name, lambda: Timer(self._now))
+        return self._get(name, lambda: Timer(self._timer_now))
 
     def new_histogram(self, name: str) -> Histogram:
         return self._get(name, Histogram)
 
-    def to_json(self) -> dict:
-        return {name: m.to_json() for name, m in sorted(self._metrics.items())}
+    def to_json(self, prefix: str | None = None) -> dict:
+        """Export the registry; with `prefix`, serialize only metrics
+        whose name starts with it (the admin `metrics?filter=` path —
+        operators fetching `crypto.` must not pay for `ledger.*`)."""
+        return {name: m.to_json()
+                for name, m in sorted(self._metrics.items())
+                if prefix is None or name.startswith(prefix)}

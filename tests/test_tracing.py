@@ -1,0 +1,339 @@
+"""Tracing subsystem tests (ISSUE 2): span nesting, ring bounding,
+Chrome-trace export, phase attribution, flight-recorder triggers, the
+admin `trace` endpoint, and the disabled-overhead guard.
+"""
+
+import json
+import os
+import time
+
+import pytest
+
+from stellar_core_tpu.main.application import Application
+from stellar_core_tpu.main.config import Config
+from stellar_core_tpu.util.timer import ClockMode, VirtualClock
+from stellar_core_tpu.util.tracing import (
+    FlightRecorder, Tracer, _NOOP, app_span,
+)
+
+
+class FakeClock:
+    """Hand-cranked now_fn so span durations are exact."""
+
+    def __init__(self) -> None:
+        self.t = 0.0
+
+    def __call__(self) -> float:
+        return self.t
+
+    def advance(self, dt: float) -> None:
+        self.t += dt
+
+
+def make_app(tmp_path=None, trace=False):
+    cfg = Config.test_config(0)
+    cfg.DATABASE = "sqlite3://:memory:"
+    if tmp_path is not None:
+        cfg.FLIGHT_RECORDER_DIR = str(tmp_path)
+    cfg.TRACE_ENABLED = trace
+    app = Application(VirtualClock(ClockMode.VIRTUAL_TIME), cfg)
+    app.start()
+    return app
+
+
+# ---------------------------------------------------------------- tracer core
+
+def test_span_nesting_parent_links_and_tags():
+    clk = FakeClock()
+    tr = Tracer(now_fn=clk)
+    tr.enable()
+    with tr.span("outer", cat="test", seq=7) as outer:
+        clk.advance(1.0)
+        with tr.span("inner") as inner:
+            clk.advance(0.25)
+            inner.set_tag("n", 3)
+        clk.advance(0.5)
+    spans = tr.spans()
+    assert [s.name for s in spans] == ["inner", "outer"]  # close order
+    si, so = spans
+    assert si.parent == so.sid and so.parent == 0
+    assert si.dur == 0.25 and so.dur == 1.75
+    assert so.tags == {"seq": 7} and si.tags == {"n": 3}
+    # nesting is per-thread state and unwinds fully
+    assert tr.open_spans() == []
+
+
+def test_disabled_tracer_is_noop_and_records_nothing():
+    tr = Tracer()
+    sp = tr.span("x", whatever=1)
+    assert sp is _NOOP
+    with sp as s:
+        s.set_tag("a", 1)   # must not raise
+    tr.instant("y")
+    assert tr.spans() == []
+    # app_span tolerates absent tracers entirely
+    class Bare:
+        pass
+    assert app_span(Bare(), "z") is _NOOP
+
+
+def test_ring_buffer_bounding_and_dropped_count():
+    tr = Tracer(capacity=8)
+    tr.enable()
+    for i in range(20):
+        with tr.span("s%d" % i):
+            pass
+    assert len(tr.spans()) == 8
+    assert tr.dropped == 12
+    assert [s.name for s in tr.spans()] == ["s%d" % i for i in range(12, 20)]
+    assert tr.spans(last_n=3) == tr.spans()[-3:]
+    assert tr.spans(last_n=0) == []   # not the whole buffer
+
+
+def test_span_exception_tags_error_and_unwinds():
+    tr = Tracer()
+    tr.enable()
+    with pytest.raises(ValueError):
+        with tr.span("boom"):
+            raise ValueError("x")
+    (s,) = tr.spans()
+    assert s.tags["error"] == "ValueError"
+    assert tr.open_spans() == []
+
+
+def test_chrome_trace_export_validity():
+    clk = FakeClock()
+    tr = Tracer(now_fn=clk)
+    tr.enable()
+    with tr.span("work", cat="test", n=2):
+        clk.advance(0.002)
+        tr.instant("marker", slot=5)
+    out = tr.to_chrome_trace()
+    # must be valid JSON with Chrome trace-event required fields
+    blob = json.loads(json.dumps(out))
+    evs = blob["traceEvents"]
+    assert len(evs) == 2
+    for ev in evs:
+        assert {"name", "cat", "ph", "ts", "pid", "tid"} <= set(ev)
+    marker = next(e for e in evs if e["name"] == "marker")
+    assert marker["ph"] == "i" and marker["args"]["slot"] == 5
+    work = next(e for e in evs if e["name"] == "work")
+    assert work["ph"] == "X" and work["dur"] == pytest.approx(2000.0)
+
+
+def test_phase_breakdown_self_time_sums_to_wall():
+    clk = FakeClock()
+    tr = Tracer(now_fn=clk)
+    tr.enable()
+    # root A (4s total: 1s self, 3s in child verify tagged tpu@cpu)
+    with tr.span("apply"):
+        clk.advance(1.0)
+        with tr.span("verify", backend="tpu", platform="cpu"):
+            clk.advance(3.0)
+    # root B, 2s, cpu backend
+    with tr.span("verify", backend="cpu"):
+        clk.advance(2.0)
+    pb = tr.phase_breakdown(wall_s=8.0)
+    ph = pb["phases"]
+    assert ph["apply"]["total_s"] == pytest.approx(1.0)
+    # fallback attribution: configured-tpu-on-cpu keys as @cpu
+    assert ph["verify:tpu@cpu"]["total_s"] == pytest.approx(3.0)
+    assert ph["verify:cpu"]["total_s"] == pytest.approx(2.0)
+    assert ph["untraced"]["total_s"] == pytest.approx(2.0)
+    total = sum(p["total_s"] for p in ph.values())
+    assert total == pytest.approx(8.0)
+    assert pb["accounted_s"] == pytest.approx(8.0)
+    assert ph["verify:cpu"]["pct_of_wall"] == pytest.approx(25.0)
+
+
+# ------------------------------------------------------------ flight recorder
+
+def test_flight_recorder_dump_on_close_exception(tmp_path, monkeypatch):
+    app = make_app(tmp_path, trace=True)
+    try:
+        from stellar_core_tpu.ledger.ledger_manager import LedgerManager
+
+        def explode(self, *a, **k):
+            raise RuntimeError("injected close failure")
+
+        monkeypatch.setattr(LedgerManager, "_close_ledger_in", explode)
+        with pytest.raises(RuntimeError, match="injected close failure"):
+            app.manual_close()
+    finally:
+        app.stop()
+    path = os.path.join(str(tmp_path), "sct-flight-close-exception.json")
+    assert os.path.exists(path)
+    with open(path) as fh:
+        blob = json.load(fh)
+    assert blob["reason"] == "close-exception"
+    assert blob["exception"]["type"] == "RuntimeError"
+    assert "injected close failure" in blob["exception"]["message"]
+    assert blob["extra"]["ledger_seq"] == 2
+    assert isinstance(blob["spans"], list)
+    assert "metrics" in blob
+    assert app.flight_recorder.dumps == 1
+    assert app.flight_recorder.last_path == path
+
+
+def test_flight_recorder_dump_on_scp_stall(tmp_path):
+    app = make_app(tmp_path)
+    try:
+        app.herder._lost_sync()
+    finally:
+        app.stop()
+    path = os.path.join(str(tmp_path), "sct-flight-scp-stall.json")
+    assert os.path.exists(path)
+    with open(path) as fh:
+        blob = json.load(fh)
+    assert blob["reason"] == "scp-stall"
+    assert "tracking_slot" in blob["extra"]
+
+
+def test_flight_recorder_never_raises(tmp_path):
+    tr = Tracer()
+    fr = FlightRecorder(tr, out_dir=str(tmp_path / "does" / "not" / "exist"))
+    assert fr.dump("broken") is None   # logged, not raised
+
+
+def test_flight_recorder_per_reason_cooldown(tmp_path):
+    """A burst of same-reason triggers (every slow close in a slow patch)
+    must not re-serialize and overwrite the first incident's evidence;
+    force=True (the operator endpoint) bypasses the cooldown."""
+    tr = Tracer()
+    fr = FlightRecorder(tr, out_dir=str(tmp_path), min_interval_s=3600.0)
+    assert fr.dump("slow-close", extra={"n": 1}) is not None
+    assert fr.dump("slow-close", extra={"n": 2}) is None   # suppressed
+    assert fr.dump("other-reason") is not None             # independent
+    assert fr.dump("slow-close", force=True) is not None
+    assert fr.dumps == 3 and fr.suppressed == 1
+
+
+def test_phase_breakdown_concurrent_worker_roots_do_not_deflate_untraced():
+    """Worker-thread root spans overlap main-thread wall time; only the
+    dominant thread's roots count against `untraced`."""
+    clk = FakeClock()
+    tr = Tracer(now_fn=clk)
+    tr.enable()
+    with tr.span("main.work"):          # main thread: 6s root
+        clk.advance(6.0)
+    # fake a concurrent worker-thread root (4s, overlapping the above)
+    s = tr.span("worker.dispatch", backend="threaded:tpu")
+    tr._push(s)
+    s.tid = 999999           # different thread id
+    clk.advance(4.0)
+    tr._pop(s)
+    pb = tr.phase_breakdown(wall_s=8.0)
+    ph = pb["phases"]
+    # untraced = wall - dominant(6s) = 2s, NOT wall - 10s clamped to 0
+    assert ph["untraced"]["total_s"] == pytest.approx(2.0)
+    assert ph["main.work"]["total_s"] == pytest.approx(6.0)
+    assert ph["worker.dispatch:threaded:tpu"]["total_s"] == \
+        pytest.approx(4.0)
+
+
+# ------------------------------------------------------------- admin endpoint
+
+def test_trace_endpoint_start_close_dump_stop(tmp_path):
+    app = make_app(tmp_path)
+    try:
+        def cmd(name, **params):
+            return app.command_handler.handle_command(
+                name, {k: str(v) for k, v in params.items()})
+
+        st, body = cmd("trace", action="status")
+        assert st == 200 and body["enabled"] is False
+        st, body = cmd("trace", action="start", capacity=4096)
+        assert st == 200 and body["status"] == "tracing"
+        app.manual_close()
+        st, dump = cmd("trace")   # default action=dump
+        assert st == 200
+        names = {e["name"] for e in dump["traceEvents"]}
+        assert "ledger.close" in names
+        assert "close.apply" in names and "close.bucket_add" in names
+        close = next(e for e in dump["traceEvents"]
+                     if e["name"] == "ledger.close")
+        assert close["args"]["seq"] == 2
+        apply_ev = next(e for e in dump["traceEvents"]
+                        if e["name"] == "close.apply")
+        assert apply_ev["args"]["apply_path"] in ("native", "python")
+        json.dumps(dump)   # endpoint body must serialize
+        st, body = cmd("trace", action="stop")
+        assert st == 200 and body["spans"] > 0
+        st, body = cmd("trace", action="flight")
+        assert st == 200 and os.path.exists(body["path"])
+    finally:
+        app.stop()
+
+
+def test_metrics_filter_prefix(tmp_path):
+    app = make_app(tmp_path)
+    try:
+        app.manual_close()
+        st, full = app.command_handler.handle_command("metrics", {})
+        assert st == 200
+        assert any(k.startswith("ledger.") for k in full)
+        assert any(k.startswith("crypto.") for k in full)
+        st, led = app.command_handler.handle_command(
+            "metrics", {"filter": "ledger."})
+        assert st == 200 and led
+        assert all(k.startswith("ledger.") for k in led)
+        st, cry = app.command_handler.handle_command(
+            "metrics", {"filter": "crypto."})
+        assert all(k.startswith("crypto.") for k in cry)
+        assert "crypto.verify.cache-hit" in cry
+    finally:
+        app.stop()
+
+
+# -------------------------------------------------------------- overhead guard
+
+def test_disabled_tracing_close_overhead_within_noise():
+    """A traced-but-disabled close must cost the same as an
+    uninstrumented one: every span site degrades to one attribute check.
+    Medians over repeated closes; generous bound to stay flake-free on
+    loaded CI."""
+
+    def median_close_s(app, n=15):
+        samples = []
+        for _ in range(n):
+            t0 = time.perf_counter()
+            app.manual_close()
+            samples.append(time.perf_counter() - t0)
+        samples.sort()
+        return samples[len(samples) // 2]
+
+    app = make_app()
+    try:
+        median_close_s(app, n=3)   # warm caches/JIT paths
+        app.tracer = None          # uninstrumented: no tracer at all
+        app.sig_verifier.tracer = None
+        base = median_close_s(app)
+        app.tracer = Tracer()      # present but disabled
+        app.sig_verifier.tracer = app.tracer
+        disabled = median_close_s(app)
+    finally:
+        app.stop()
+    assert disabled <= base * 2.0 + 0.005, (disabled, base)
+
+
+# ----------------------------------------------------------- end-to-end bench
+
+@pytest.mark.slow
+def test_replay_phase_breakdown_accounts_for_wall():
+    """Acceptance: the bench replay's span-derived phase_breakdown sums
+    to within 5% of measured wall, with verify drains attributed to
+    their backend."""
+    import bench
+    r = bench.replay_bench("cpu", n_checkpoints=1, txs_per_ledger=5,
+                           sigs_per_tx=2)
+    pb = r["phase_breakdown"]
+    total = sum(p["total_s"] for p in pb["phases"].values())
+    assert total == pytest.approx(r["wall_s"], rel=0.05)
+    assert pb["dropped_spans"] == 0
+    verify_phases = [k for k in pb["phases"]
+                     if k.startswith("crypto.verify_many")
+                     or k.startswith("crypto.prewarm")]
+    assert verify_phases, pb["phases"].keys()
+    assert all(":cpu" in k for k in verify_phases)
+    assert any(k.startswith("catchup.apply_ledger")
+               for k in pb["phases"])

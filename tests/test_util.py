@@ -91,6 +91,51 @@ def test_metrics_registry():
     assert j["e.f"]["count"] == 1
 
 
+def test_timer_uses_injected_clock():
+    """Timer durations come from the registry's now_fn, so virtual-clock
+    tests control them; perf_counter is only the uninjected default."""
+    t = {"now": 100.0}
+    m = MetricsRegistry(now_fn=lambda: t["now"])
+    with m.new_timer("e.f").time():
+        t["now"] += 2.5
+    j = m.to_json()["e.f"]
+    assert j["count"] == 1
+    assert j["max"] == 2.5 and j["mean"] == 2.5
+
+
+def test_histogram_to_json_has_p95():
+    m = MetricsRegistry()
+    h = m.new_histogram("h")
+    for v in range(100):
+        h.update(float(v))
+    j = h.to_json()
+    assert j["median"] == 50.0 and j["p75"] == 75.0
+    assert j["p95"] == 95.0 and j["p99"] == 99.0
+
+
+def test_idle_meter_rate_decays_and_prunes():
+    t = {"now": 0.0}
+    m = MetricsRegistry(now_fn=lambda: t["now"])
+    meter = m.new_meter("idle")
+    meter.mark(30)
+    assert meter.one_minute_rate() == 30 / 60.0
+    # idle: no further mark() calls — reads alone must decay the rate
+    # to 0 AND drop the stale buckets
+    t["now"] = 2000.0
+    assert meter.one_minute_rate() == 0.0
+    assert len(meter._buckets) == 0
+    assert meter.count == 30   # lifetime count survives the prune
+
+
+def test_metrics_to_json_prefix_filter():
+    m = MetricsRegistry(now_fn=lambda: 0.0)
+    m.new_counter("crypto.a").inc()
+    m.new_counter("ledger.b").inc()
+    m.new_counter("crypto.c").inc()
+    assert set(m.to_json(prefix="crypto.")) == {"crypto.a", "crypto.c"}
+    assert set(m.to_json()) == {"crypto.a", "crypto.c", "ledger.b"}
+
+
 def test_xdr_stream_roundtrip():
     with TmpDir("xdrs") as d:
         path = d.join("hdrs.xdr")
