@@ -372,6 +372,61 @@ def replay_bench(backend: str, n_checkpoints: int = 4,
         shutil.rmtree(tmp, ignore_errors=True)
 
 
+def chaos_smoke(n_ledgers: int = 30, txs_per_ledger: int = 10) -> dict:
+    """`bench.py --chaos`: close-latency p95 with the fault schedule on
+    vs off (ISSUE 3; docs/robustness.md). Both legs run the same seeded
+    standalone load through the cpu-resilient backend; the chaos leg
+    injects device-dispatch failures at p=0.2, so drains pay the
+    failed-dispatch-plus-fallback cost and the breaker occasionally
+    trips. Pure-Python (no jax import): safe to run inline."""
+    from stellar_core_tpu.crypto import keys as _keys
+    from stellar_core_tpu.main.application import Application
+    from stellar_core_tpu.main.config import Config
+    from stellar_core_tpu.simulation.load_generator import LoadGenerator
+    from stellar_core_tpu.util import rnd
+    from stellar_core_tpu.util.timer import ClockMode, VirtualClock
+
+    def one_leg(faults_on: bool) -> dict:
+        rnd.reseed(0xC4A05)
+        _keys.flush_verify_cache()
+        cfg = Config.test_config(60, backend="cpu-resilient")
+        cfg.SIG_VERIFY_BREAKER_COOLDOWN = 0.5
+        app = Application(VirtualClock(ClockMode.VIRTUAL_TIME), cfg)
+        app.start()
+        if faults_on:
+            app.faults.configure("device.dispatch", probability=0.2)
+        lg = LoadGenerator(app)
+        lg.generate_accounts(20)
+        app.manual_close()
+        for _ in range(n_ledgers):
+            lg.generate_payments(txs_per_ledger)
+            # cold verify cache per close: every drain actually dispatches
+            _keys.flush_verify_cache()
+            app.clock.set_virtual_time(app.clock.now() + 1.0)
+            app.manual_close()
+        t = app.metrics.new_timer("ledger.ledger.close")
+        m = app.metrics.to_json()
+        return {
+            "close_p95_ms": round(t.percentile(0.95) * 1e3, 3),
+            "close_mean_ms": round(t.mean() * 1e3, 3),
+            "ledgers": n_ledgers,
+            "breaker_trips": app.sig_verifier.breaker.trips,
+            "fallback_drains": m.get("crypto.verify.fallback-drain",
+                                     {}).get("count", 0),
+            "injected": m.get("fault.injected.device.dispatch",
+                              {}).get("count", 0),
+        }
+
+    off = one_leg(False)
+    on = one_leg(True)
+    out = {"metric": "chaos_close_latency_p95", "unit": "ms",
+           "faults_off": off, "faults_on": on}
+    if off["close_p95_ms"] > 0:
+        out["p95_ratio_on_vs_off"] = round(
+            on["close_p95_ms"] / off["close_p95_ms"], 3)
+    return out
+
+
 def _scrubbed_cpu_env() -> dict:
     # single source of truth for the axon-env scrub lives in __graft_entry__
     from __graft_entry__ import _scrubbed_env
@@ -721,4 +776,9 @@ def main() -> None:
 
 
 if __name__ == "__main__":
-    main()
+    if "--chaos" in sys.argv:
+        # chaos smoke leg: close-latency p95 with faults on vs off; does
+        # not touch jax or the device relay
+        print(json.dumps(chaos_smoke()))
+    else:
+        main()

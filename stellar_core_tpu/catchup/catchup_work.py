@@ -25,7 +25,7 @@ from ..util.log import get_logger
 from ..util.tmpdir import TmpDir
 from ..util.xdrstream import XDRInputFileStream
 from ..work.basic_work import (FAILURE, RETRY_NEVER, RUNNING, SUCCESS,
-                               BasicWork, State)
+                               WAITING, BasicWork, State)
 from ..xdr import LedgerHeaderHistoryEntry
 from .range import CatchupConfiguration, CatchupRange, \
     calculate_catchup_range
@@ -46,7 +46,9 @@ class CatchupWork(BasicWork):
         super().__init__(app.clock, "catchup", RETRY_NEVER)
         self.app = app
         self.config = config or CatchupConfiguration.complete()
-        self.archive = archive or app.history_manager.readable_archive()
+        # default to the health-scored failover pool over every readable
+        # archive; an explicit single archive (tests, CLI) still works
+        self.archive = archive or app.history_manager.readable_pool()
         self.trusted_hash = trusted_hash     # optional (seq, hash) pin
         self.download_dir = TmpDir("catchup")
         self._phase = self.GET_HAS
@@ -64,7 +66,7 @@ class CatchupWork(BasicWork):
                 c._parent = self
                 c.start()
         for c in self._children:
-            if not c.is_done():
+            if c.is_crankable():
                 c.crank_work()
         if any(c.state in (State.FAILURE, State.ABORTED)
                for c in self._children):
@@ -81,7 +83,12 @@ class CatchupWork(BasicWork):
         if self._children:
             st = self._run_children()
             if st is None:
-                return RUNNING
+                # park when every child is blocked (WAITING on a
+                # subprocess or RETRYING on a backoff timer); the child
+                # wake chain re-arms this work
+                if any(c.is_crankable() for c in self._children):
+                    return RUNNING
+                return WAITING
             self._children = []
             if st == FAILURE:
                 return FAILURE

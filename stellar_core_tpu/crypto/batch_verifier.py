@@ -18,6 +18,11 @@ Backends:
 - ThreadedBatchVerifier — wraps either backend so dispatch happens off the
   main thread and futures complete on the VirtualClock main loop, keeping
   the single-threaded consensus invariant (docs/architecture.md:23-26).
+- ResilientBatchVerifier — circuit breaker between a primary (device)
+  backend and a fallback: N consecutive dispatch failures trip to the
+  fallback for a cooldown window with periodic reprobe, so a lost TPU
+  degrades throughput instead of killing a ledger close
+  (docs/robustness.md; DSig-style degraded operating mode).
 
 The global verify-result cache (keys.py) sits in front of every backend;
 cache hits never enqueue.
@@ -77,9 +82,12 @@ class BatchSigVerifier:
     # ones — TxSetFrame.check_or_trim prewarms the whole set's signatures
     # through verify_many before walking txs (two-phase validation).
     wants_prewarm = False
-    # span tracer (util/tracing.py), installed by make_verifier; None
-    # keeps direct constructions (tests, native-apply fallback) silent
+    # span tracer (util/tracing.py), metrics registry and fault injector
+    # (util/faults.py), installed by make_verifier; None keeps direct
+    # constructions (tests, native-apply fallback) silent
     tracer = None
+    metrics = None
+    faults = None
 
     def _span(self, name: str, **tags):
         from ..util.tracing import tracer_span
@@ -131,6 +139,52 @@ class BatchSigVerifier:
 
     def pending(self) -> int:
         return 0
+
+    # -- shared pending-queue machinery (batch backends) ---------------------
+    # TpuSigVerifier and ResilientBatchVerifier share one accumulate/
+    # dispatch protocol: cache-probe on enqueue, self-flush at
+    # _max_pending, one verify_many per flush, futures completed and the
+    # cache fed from the results; a raising dispatch re-completes the
+    # batch on the synchronous CPU path instead of stranding futures.
+
+    def _batch_enqueue(self, key: PublicKey, sig: bytes,
+                       msg: bytes) -> VerifyFuture:
+        ck = _keys._cache_key(key.key_bytes, sig, msg)
+        with _keys._cache_lock:
+            hit = _keys._verify_cache.maybe_get(ck)
+        f = VerifyFuture()
+        if hit is not None:
+            f._complete(hit)
+            return f
+        self._pending.append(((key.key_bytes, sig, msg), f))
+        if len(self._pending) >= self._max_pending:
+            self.flush()
+        return f
+
+    def _batch_flush(self) -> None:
+        if not self._pending:
+            return
+        batch, self._pending = self._pending, []
+        triples = [t for (t, _f) in batch]
+        try:
+            results = self.verify_many(triples)
+        except Exception as e:
+            log.warning("batch dispatch failed (%s); completing %d "
+                        "verifies on CPU fallback", e, len(batch))
+            results = _flush_fallback(self, triples)
+        for ((k, s, m), f), ok in zip(batch, results):
+            with _keys._cache_lock:
+                _keys._verify_cache.put(_keys._cache_key(k, s, m), ok)
+            f._complete(ok)
+
+
+def _flush_fallback(verifier, triples: Sequence[Triple]) -> List[bool]:
+    """Synchronous CPU re-verify used when a backend's dispatch raises
+    mid-flush; counts the event so a silent degradation is visible."""
+    m = getattr(verifier, "metrics", None)
+    if m is not None:
+        m.new_meter("crypto.verify.flush-fallback").mark(len(triples))
+    return _keys.raw_verify_batch(triples)
 
 
 class CpuSigVerifier(BatchSigVerifier):
@@ -249,32 +303,13 @@ class TpuSigVerifier(BatchSigVerifier):
             log.warning("verify kernel warmup failed: %s", e)
 
     def enqueue(self, key: PublicKey, sig: bytes, msg: bytes) -> VerifyFuture:
-        # L0: result cache
-        ck = _keys._cache_key(key.key_bytes, sig, msg)
-        with _keys._cache_lock:
-            hit = _keys._verify_cache.maybe_get(ck)
-        f = VerifyFuture()
-        if hit is not None:
-            f._complete(hit)
-            return f
-        self._pending.append(((key.key_bytes, sig, msg), f))
-        if len(self._pending) >= self._max_pending:
-            self.flush()
-        return f
+        return self._batch_enqueue(key, sig, msg)
 
     def pending(self) -> int:
         return len(self._pending)
 
     def flush(self) -> None:
-        if not self._pending:
-            return
-        batch, self._pending = self._pending, []
-        triples = [t for (t, _f) in batch]
-        results = self.verify_many(triples)
-        for ((k, s, m), f), ok in zip(batch, results):
-            with _keys._cache_lock:
-                _keys._verify_cache.put(_keys._cache_key(k, s, m), ok)
-            f._complete(ok)
+        self._batch_flush()
 
     def _bucket(self, n: int) -> int:
         for b in self.BUCKETS:
@@ -328,6 +363,202 @@ class TpuSigVerifier(BatchSigVerifier):
         return out
 
 
+class CircuitBreaker:
+    """closed → open → half-open → closed over the device-dispatch path.
+
+    CLOSED: dispatches flow to the primary; `threshold` CONSECUTIVE
+    failures trip to OPEN. OPEN: primary is bypassed until `cooldown_s`
+    elapses on the injected clock, then the next allow() becomes the
+    HALF-OPEN probe. HALF-OPEN: one success re-closes (recover), one
+    failure re-opens for another cooldown. Time comes from `now_fn`
+    (virtual clock in tests/simulation) so trips and reprobes are
+    deterministic."""
+
+    CLOSED, OPEN, HALF_OPEN = "closed", "open", "half-open"
+    _STATE_CODE = {CLOSED: 0, OPEN: 1, HALF_OPEN: 2}
+
+    def __init__(self, threshold: int = 3, cooldown_s: float = 30.0,
+                 now_fn: Optional[Callable[[], float]] = None,
+                 on_trip: Optional[Callable[[], None]] = None,
+                 on_recover: Optional[Callable[[], None]] = None) -> None:
+        self.threshold = max(1, threshold)
+        self.cooldown_s = cooldown_s
+        self._now = now_fn or time.monotonic
+        self.on_trip = on_trip
+        self.on_recover = on_recover
+        self.state = self.CLOSED
+        self.consecutive_failures = 0
+        self.trips = 0
+        self.recoveries = 0
+        self._retry_at = 0.0
+
+    def allow(self) -> bool:
+        """May the next dispatch try the primary?"""
+        if self.state == self.CLOSED:
+            return True
+        if self.state == self.OPEN and self._now() >= self._retry_at:
+            self.state = self.HALF_OPEN
+            return True
+        return self.state == self.HALF_OPEN
+
+    def record_success(self) -> None:
+        recovered = self.state == self.HALF_OPEN
+        self.state = self.CLOSED
+        self.consecutive_failures = 0
+        if recovered:
+            self.recoveries += 1
+            if self.on_recover is not None:
+                self.on_recover()
+
+    def record_failure(self) -> bool:
+        """Returns True when this failure tripped (or re-opened) the
+        breaker."""
+        self.consecutive_failures += 1
+        if self.state == self.HALF_OPEN or \
+                self.consecutive_failures >= self.threshold:
+            reopened = self.state != self.CLOSED
+            self.state = self.OPEN
+            self._retry_at = self._now() + self.cooldown_s
+            if not reopened:
+                self.trips += 1
+                if self.on_trip is not None:
+                    self.on_trip()
+            return True
+        return False
+
+    def state_code(self) -> int:
+        return self._STATE_CODE[self.state]
+
+    def to_json(self) -> dict:
+        return {"state": self.state,
+                "consecutive_failures": self.consecutive_failures,
+                "trips": self.trips, "recoveries": self.recoveries,
+                "threshold": self.threshold, "cooldown_s": self.cooldown_s,
+                "retry_at": self._retry_at}
+
+
+class ResilientBatchVerifier(BatchSigVerifier):
+    """Primary backend behind a circuit breaker, CPU fallback beside it.
+
+    Every dispatch-shaped call (verify_many; flush routes through it)
+    asks the breaker whether the primary may be tried; a raising primary
+    records a failure and the batch re-runs on the fallback, so callers
+    always get results. A trip emits metrics + a flight-recorder dump;
+    recovery (first successful half-open probe) emits the matching
+    recover marker — the signals the chaos soak asserts on."""
+
+    name = "resilient"
+
+    def __init__(self, primary: BatchSigVerifier,
+                 fallback: BatchSigVerifier,
+                 breaker: Optional[CircuitBreaker] = None,
+                 max_pending: int = 8192) -> None:
+        self.primary = primary
+        self.fallback = fallback
+        self.breaker = breaker or CircuitBreaker()
+        self.breaker.on_trip = self._on_trip
+        self.breaker.on_recover = self._on_recover
+        self.flight_recorder = None   # installed by make_verifier
+        self._pending: List[Tuple[Triple, VerifyFuture]] = []
+        self._max_pending = max_pending
+
+    # -- breaker events ------------------------------------------------------
+    def _breaker_mark(self, event: str) -> None:
+        if self.metrics is not None:
+            self.metrics.new_meter("crypto.breaker.%s" % event).mark()
+            self.metrics.new_counter("crypto.breaker.state").set_count(
+                self.breaker.state_code())
+        from ..util.tracing import tracer_instant
+        tracer_instant(self.tracer, "crypto.breaker.%s" % event,
+                       cat="crypto", primary=self.primary.name,
+                       failures=self.breaker.consecutive_failures)
+
+    def _on_trip(self) -> None:
+        log.warning("verify breaker TRIPPED: %d consecutive %s-dispatch "
+                    "failures; falling back to %s for %.0fs",
+                    self.breaker.consecutive_failures, self.primary.name,
+                    self.fallback.name, self.breaker.cooldown_s)
+        self._breaker_mark("trip")
+        if self.flight_recorder is not None:
+            self.flight_recorder.dump(
+                "verify-breaker-trip",
+                extra={"primary": self.primary.name,
+                       "breaker": self.breaker.to_json()})
+
+    def _on_recover(self) -> None:
+        log.info("verify breaker recovered: %s backend healthy again",
+                 self.primary.name)
+        self._breaker_mark("recover")
+
+    # -- delegation ----------------------------------------------------------
+    @property
+    def wants_prewarm(self) -> bool:
+        return self.primary.wants_prewarm
+
+    @property
+    def inner(self) -> BatchSigVerifier:
+        return self.primary
+
+    @property
+    def batches_dispatched(self) -> int:
+        return getattr(self.primary, "batches_dispatched", 0)
+
+    @property
+    def sigs_verified(self) -> int:
+        return getattr(self.primary, "sigs_verified", 0)
+
+    def warmup(self, wait: bool = False) -> None:
+        w = getattr(self.primary, "warmup", None)
+        if w is not None:
+            w(wait)
+
+    # -- verify paths --------------------------------------------------------
+    def verify_many(self, triples: Sequence[Triple]) -> List[bool]:
+        if self.breaker.allow():
+            try:
+                # the primary attempt gets its own span so an injected
+                # (or real) dispatch failure is tagged on the drain it
+                # killed, not floating free on the timeline
+                with self._span("crypto.dispatch_primary",
+                                backend=self.primary.name,
+                                n=len(triples)):
+                    if self.faults is not None:
+                        self.faults.fire_point("device.dispatch")
+                    out = self.primary.verify_many(triples)
+                self.breaker.record_success()
+                return out
+            except Exception as e:
+                if self.metrics is not None:
+                    self.metrics.new_meter(
+                        "crypto.verify.dispatch-failure").mark()
+                tripped = self.breaker.record_failure()
+                if not tripped:
+                    log.warning("%s dispatch failed (%s): %d/%d toward "
+                                "breaker trip", self.primary.name, e,
+                                self.breaker.consecutive_failures,
+                                self.breaker.threshold)
+        if self.metrics is not None:
+            # drains served by the fallback while the primary is failing
+            # or the breaker is open — the "completed on fallback" signal
+            # the chaos soak asserts on
+            self.metrics.new_meter("crypto.verify.fallback-drain").mark()
+        with self._span("crypto.verify_fallback", backend=self.name,
+                        n=len(triples), breaker=self.breaker.state):
+            return self.fallback.verify_many(triples)
+
+    def enqueue(self, key: PublicKey, sig: bytes, msg: bytes) -> VerifyFuture:
+        return self._batch_enqueue(key, sig, msg)
+
+    def pending(self) -> int:
+        return len(self._pending)
+
+    def flush(self) -> None:
+        # verify_many (almost) never raises here: a primary failure is
+        # absorbed by the breaker and the batch re-runs on the fallback —
+        # a trip mid-drain still completes every future correctly
+        self._batch_flush()
+
+
 class ThreadedBatchVerifier(BatchSigVerifier):
     """Async wrapper: dispatch runs on a worker thread, futures complete on
     the main loop via clock.post_to_main — the enqueue-and-continue protocol
@@ -353,7 +584,13 @@ class ThreadedBatchVerifier(BatchSigVerifier):
 
     @property
     def inner(self) -> BatchSigVerifier:
-        return self._inner
+        """The DEVICE verifier (unwrapping a resilient layer): callers
+        tune BUCKETS / read dispatch counters on the actual backend."""
+        return getattr(self._inner, "inner", self._inner)
+
+    @property
+    def breaker(self):
+        return getattr(self._inner, "breaker", None)
 
     def warmup(self, wait: bool = False) -> None:
         w = getattr(self._inner, "warmup", None)
@@ -396,7 +633,15 @@ class ThreadedBatchVerifier(BatchSigVerifier):
                             queue_wait_max_ms=round(max(waits) * 1e3, 3),
                             queue_wait_mean_ms=round(
                                 sum(waits) / len(waits) * 1e3, 3)):
-                results = self._inner.verify_many(triples)
+                try:
+                    results = self._inner.verify_many(triples)
+                except Exception as e:
+                    # the worker thread must neither die with futures
+                    # pending nor leave _inflight latched (that would
+                    # no-op every later flush — a permanent wedge)
+                    log.warning("threaded dispatch failed (%s); completing "
+                                "%d verifies on CPU fallback", e, len(batch))
+                    results = _flush_fallback(self, triples)
 
             def complete() -> None:
                 done = time.perf_counter()
@@ -427,20 +672,49 @@ class ThreadedBatchVerifier(BatchSigVerifier):
 def make_verifier(backend: str = "cpu", clock=None,
                   max_pending: int = 8192,
                   compile_cache_dir: Optional[str] = None,
-                  metrics=None, tracer=None) -> BatchSigVerifier:
-    """Config-gated backend selection (Config.SIG_VERIFY_BACKEND)."""
+                  metrics=None, tracer=None, faults=None,
+                  flight_recorder=None,
+                  breaker_threshold: int = 3,
+                  breaker_cooldown: float = 30.0) -> BatchSigVerifier:
+    """Config-gated backend selection (Config.SIG_VERIFY_BACKEND).
+
+    Device backends ("tpu", "tpu-async") are always wrapped in a
+    ResilientBatchVerifier with a CPU fallback; "cpu-resilient" wraps the
+    CPU backend in the same breaker machinery so chaos runs exercise the
+    device failure domain on device-less containers."""
+    now_fn = clock.now if clock is not None else None
+
+    def resilient(primary: BatchSigVerifier) -> ResilientBatchVerifier:
+        primary.tracer = tracer
+        primary.metrics = metrics
+        fb = CpuSigVerifier()
+        fb.tracer = tracer
+        r = ResilientBatchVerifier(
+            primary, fb,
+            CircuitBreaker(threshold=breaker_threshold,
+                           cooldown_s=breaker_cooldown, now_fn=now_fn),
+            max_pending=max_pending)
+        r.tracer = tracer
+        r.flight_recorder = flight_recorder
+        return r
+
     if backend == "cpu":
         v: BatchSigVerifier = CpuSigVerifier()
+    elif backend == "cpu-resilient":
+        v = resilient(CpuSigVerifier())
     elif backend == "tpu":
-        v = TpuSigVerifier(max_pending=max_pending,
-                           compile_cache_dir=compile_cache_dir)
+        v = resilient(TpuSigVerifier(max_pending=max_pending,
+                                     compile_cache_dir=compile_cache_dir))
     elif backend == "tpu-async":
         assert clock is not None
-        inner = TpuSigVerifier(max_pending=max_pending,
-                               compile_cache_dir=compile_cache_dir)
-        inner.tracer = tracer
+        inner = resilient(TpuSigVerifier(max_pending=max_pending,
+                                         compile_cache_dir=compile_cache_dir))
+        inner.metrics = metrics
+        inner.faults = faults
         v = ThreadedBatchVerifier(inner, clock, metrics=metrics)
     else:
         raise ValueError("unknown sig verify backend %r" % backend)
     v.tracer = tracer
+    v.metrics = metrics
+    v.faults = faults
     return v

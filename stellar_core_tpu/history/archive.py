@@ -17,7 +17,8 @@ from __future__ import annotations
 import os
 import shlex
 import subprocess
-from typing import Callable, Optional
+import time
+from typing import Callable, Dict, List, Optional, Sequence
 
 from ..util.log import get_logger
 
@@ -102,3 +103,116 @@ class HistoryArchive:
         r = subprocess.run(self.put_cmd(local, remote), shell=True,
                            capture_output=True)
         return r.returncode == 0
+
+
+class _ArchiveHealth:
+    """Per-archive failure bookkeeping inside an ArchivePool."""
+
+    __slots__ = ("successes", "failures", "consecutive_failures",
+                 "next_attempt", "last_error_at")
+
+    def __init__(self) -> None:
+        self.successes = 0
+        self.failures = 0
+        self.consecutive_failures = 0
+        self.next_attempt = 0.0
+        self.last_error_at = 0.0
+
+    def score(self) -> float:
+        """Success fraction, optimistic for the untried (a fresh archive
+        should be probed before a known-flaky one is retried)."""
+        total = self.successes + self.failures
+        return (self.successes + 1.0) / (total + 1.0)
+
+    def to_json(self) -> dict:
+        return {"successes": self.successes, "failures": self.failures,
+                "consecutive_failures": self.consecutive_failures,
+                "score": round(self.score(), 3),
+                "next_attempt": self.next_attempt}
+
+
+class ArchivePool:
+    """Multi-archive failover for history downloads (docs/robustness.md).
+
+    Tracks a health score per archive and an exponential backoff on
+    consecutive failures; `pick()` returns the healthiest archive that
+    is not backing off, excluding names the caller already tried for the
+    current file. When every archive is excluded or backing off it
+    returns the least-bad one anyway — liveness beats politeness when
+    the whole archive set is flaky. Works that hold a pool re-pick on
+    every retry, so a corrupt or short download from archive A is
+    re-fetched from archive B."""
+
+    BACKOFF_BASE = 2.0
+    BACKOFF_CAP = 300.0
+
+    def __init__(self, archives: Sequence[HistoryArchive],
+                 now_fn: Optional[Callable[[], float]] = None,
+                 metrics=None) -> None:
+        self.archives: List[HistoryArchive] = list(archives)
+        self._by_name: Dict[str, HistoryArchive] = {
+            a.name: a for a in self.archives}
+        self._health: Dict[str, _ArchiveHealth] = {
+            a.name: _ArchiveHealth() for a in self.archives}
+        self._now = now_fn or time.monotonic
+        self.metrics = metrics
+        self.failovers = 0
+
+    # a pool quacks enough like an archive for works that only read gets
+    def has_get(self) -> bool:
+        return any(a.has_get() for a in self.archives)
+
+    def health(self, name: str) -> _ArchiveHealth:
+        return self._health[name]
+
+    def pick(self, exclude: Sequence[str] = ()) -> Optional[HistoryArchive]:
+        if not self.archives:
+            return None
+        now = self._now()
+        ex = set(exclude)
+        ready = [a for a in self.archives
+                 if a.name not in ex
+                 and self._health[a.name].next_attempt <= now]
+        if ready:
+            best = max(ready, key=lambda a: (self._health[a.name].score(),
+                                             a.name))
+            return best
+        # everyone tried or backing off: least consecutive failures wins
+        # (ignore both the exclusion and the backoff rather than stall)
+        return min(self.archives,
+                   key=lambda a: (self._health[a.name].consecutive_failures,
+                                  a.name))
+
+    def report_success(self, archive: HistoryArchive) -> None:
+        h = self._health.get(archive.name)
+        if h is None:
+            return
+        h.successes += 1
+        h.consecutive_failures = 0
+        h.next_attempt = 0.0
+
+    def report_failure(self, archive: HistoryArchive) -> None:
+        h = self._health.get(archive.name)
+        if h is None:
+            return
+        h.failures += 1
+        h.consecutive_failures += 1
+        h.last_error_at = self._now()
+        h.next_attempt = self._now() + min(
+            self.BACKOFF_CAP,
+            self.BACKOFF_BASE * (2.0 ** (h.consecutive_failures - 1)))
+        if len(self.archives) > 1:
+            self.failovers += 1
+        if self.metrics is not None:
+            self.metrics.new_meter(
+                "history.archive.failure.%s" % archive.name).mark()
+        log.warning("archive %s failed (%d consecutive); next attempt "
+                    "in %.0fs", archive.name, h.consecutive_failures,
+                    h.next_attempt - self._now())
+
+    def to_json(self) -> dict:
+        return {"archives": {n: h.to_json()
+                             for n, h in sorted(self._health.items())},
+                "failovers": self.failovers}
+
+

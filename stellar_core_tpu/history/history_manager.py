@@ -15,7 +15,8 @@ from typing import Dict, List, Optional
 
 from ..util.log import get_logger
 from ..util.tmpdir import TmpDir
-from .archive import HistoryArchive, WELL_KNOWN, bucket_path, category_path
+from .archive import (ArchivePool, HistoryArchive, WELL_KNOWN, bucket_path,
+                      category_path)
 from .archive_state import HistoryArchiveState
 from .checkpoints import is_last_in_checkpoint
 from .snapshot import StateSnapshot, gzip_file
@@ -32,10 +33,12 @@ class HistoryManager:
         self.publish_queue_dir = TmpDir("history-publish")
         self.published_checkpoints = 0
         self.failed_publishes = 0
+        self._readable_pool: Optional[ArchivePool] = None
 
     # -- archive selection ---------------------------------------------------
     def add_archive(self, archive: HistoryArchive) -> None:
         self.archives[archive.name] = archive
+        self._readable_pool = None   # rebuilt on next readable_pool()
 
     def writable_archives(self) -> List[HistoryArchive]:
         return [a for a in self.archives.values() if a.has_put()]
@@ -45,6 +48,20 @@ class HistoryManager:
             if a.has_get():
                 return a
         return None
+
+    def readable_pool(self) -> Optional[ArchivePool]:
+        """All readable archives behind one health-scored failover pool
+        (docs/robustness.md). One pool instance per manager, so health
+        accumulated by one catchup informs the next."""
+        pool = getattr(self, "_readable_pool", None)
+        if pool is None:
+            readable = [a for a in self.archives.values() if a.has_get()]
+            if not readable:
+                return None
+            pool = ArchivePool(readable, now_fn=self.app.clock.now,
+                               metrics=getattr(self.app, "metrics", None))
+            self._readable_pool = pool
+        return pool
 
     def has_any_writable_history_archive(self) -> bool:
         return bool(self.writable_archives())

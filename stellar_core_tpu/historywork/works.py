@@ -16,7 +16,8 @@ import os
 from typing import Dict, List, Optional
 
 from ..crypto.hashing import sha256
-from ..history.archive import HistoryArchive, category_path, bucket_path
+from ..history.archive import (ArchivePool, HistoryArchive, bucket_path,
+                               category_path)
 from ..history.archive_state import HistoryArchiveState
 from ..history.checkpoints import checkpoints_in_range
 from ..history.snapshot import gunzip_file, gzip_file
@@ -67,22 +68,79 @@ class RunCommandWork(BasicWork):
 
 
 class GetRemoteFileWork(RunCommandWork):
-    """Download archive:remote -> local (reference GetRemoteFileWork)."""
+    """Download archive:remote -> local (reference GetRemoteFileWork).
 
-    def __init__(self, app, archive: HistoryArchive, remote: str,
-                 local: str) -> None:
+    `archive` may be a single HistoryArchive or an ArchivePool: with a
+    pool, every attempt re-picks the healthiest archive not yet tried
+    for THIS file, so a retry after a transport failure (or after a
+    downstream corruption detection excluded the culprit) lands on a
+    different archive (docs/robustness.md failover). Fault points
+    `archive.get-fail` / `archive.corrupt` / `archive.short-read`
+    (util/faults.py) simulate a broken transfer, a bit-flipped file and
+    a truncated file respectively."""
+
+    def __init__(self, app, archive, remote: str, local: str) -> None:
         super().__init__(app, "get-remote-file %s" % remote)
         self.archive = archive
+        self.pool = archive if isinstance(archive, ArchivePool) else None
+        self.current_archive: Optional[HistoryArchive] = \
+            None if self.pool is not None else archive
+        self._tried: List[str] = []   # archive names tried for this file
         self.remote = remote
         self.local = local
 
     def get_command(self) -> str:
+        if self.pool is not None:
+            self.current_archive = self.pool.pick(exclude=self._tried)
+        if self.current_archive is None:
+            return ""
         os.makedirs(os.path.dirname(self.local) or ".", exist_ok=True)
-        return self.archive.get_cmd(self.remote, self.local)
+        return self.current_archive.get_cmd(self.remote, self.local)
+
+    def exclude_current(self) -> None:
+        """Mark the archive of the last attempt as tried (called by this
+        work and by parents that detect corruption downstream)."""
+        if self.current_archive is not None and \
+                self.current_archive.name not in self._tried:
+            self._tried.append(self.current_archive.name)
+
+    def on_run(self) -> State:
+        st = super().on_run()
+        if st != SUCCESS:
+            return st
+        faults = getattr(self.app, "faults", None)
+        if faults is not None:
+            if faults.should_fire("archive.get-fail"):
+                return FAILURE
+            if faults.should_fire("archive.corrupt") and \
+                    os.path.exists(self.local):
+                size = os.path.getsize(self.local)
+                with open(self.local, "r+b") as f:
+                    if size:
+                        f.seek(size // 2)
+                        b = f.read(1)
+                        f.seek(size // 2)
+                        f.write(bytes([b[0] ^ 0xFF]))
+                    else:
+                        # an empty file "corrupts" by growing garbage
+                        f.write(b"\xff")
+            if faults.should_fire("archive.short-read") and \
+                    os.path.exists(self.local):
+                with open(self.local, "r+b") as f:
+                    f.truncate(os.path.getsize(self.local) // 2)
+        if self.pool is not None and self.current_archive is not None:
+            self.pool.report_success(self.current_archive)
+        return SUCCESS
 
     def on_failure_retry(self) -> None:
         if os.path.exists(self.local):
             os.unlink(self.local)
+        if self.pool is not None and self.current_archive is not None:
+            self.pool.report_failure(self.current_archive)
+            self.exclude_current()
+
+    def on_failure_raise(self) -> None:
+        self.on_failure_retry()
 
 
 class PutRemoteFileWork(RunCommandWork):
@@ -149,14 +207,20 @@ class GzipFileWork(BasicWork):
 
 class GetAndUnzipRemoteFileWork(WorkSequence):
     """Download then gunzip, optionally verifying the sha256 of the
-    decompressed file (reference GetAndUnzipRemoteFileWork)."""
+    decompressed file (reference GetAndUnzipRemoteFileWork). A failure
+    detected AFTER the download succeeded — gunzip error on a truncated
+    file, content-hash mismatch on a corrupted one — indicts the archive
+    that served the bytes: it is reported to the pool and excluded, so
+    the sequence retry re-downloads from a different archive."""
 
-    def __init__(self, app, archive: HistoryArchive, remote_gz: str,
+    def __init__(self, app, archive, remote_gz: str,
                  local: str, expected_hash: Optional[bytes] = None) -> None:
         self.local = local
         self.expected_hash = expected_hash
+        self._get = GetRemoteFileWork(app, archive, remote_gz,
+                                      local + ".gz")
         seq: List[BasicWork] = [
-            GetRemoteFileWork(app, archive, remote_gz, local + ".gz"),
+            self._get,
             GunzipFileWork(app, local + ".gz"),
         ]
         super().__init__(app.clock, "get-and-unzip %s" % remote_gz, seq)
@@ -170,12 +234,30 @@ class GetAndUnzipRemoteFileWork(WorkSequence):
                     return FAILURE
         return st
 
+    def _blame_archive(self) -> None:
+        g = self._get
+        # only a post-download failure is news here; a transport failure
+        # already reported itself inside GetRemoteFileWork's own retries
+        if g.state == State.SUCCESS and g.pool is not None and \
+                g.current_archive is not None:
+            g.pool.report_failure(g.current_archive)
+            g.exclude_current()
+
+    def on_failure_retry(self) -> None:
+        self._blame_archive()
+        for p in (self.local, self.local + ".gz"):
+            if os.path.exists(p):
+                os.unlink(p)
+
+    def on_failure_raise(self) -> None:
+        self._blame_archive()
+
 
 class GetHistoryArchiveStateWork(BasicWork):
     """Fetch a HistoryArchiveState JSON — the well-known (archive tip) or
     a specific checkpoint's (reference GetHistoryArchiveStateWork)."""
 
-    def __init__(self, app, archive: HistoryArchive, local_dir: str,
+    def __init__(self, app, archive, local_dir: str,
                  checkpoint: Optional[int] = None) -> None:
         super().__init__(app.clock, "get-history-archive-state",
                          RETRY_A_FEW)
@@ -188,6 +270,10 @@ class GetHistoryArchiveStateWork(BasicWork):
                              else "%08x" % checkpoint))
         self.has: Optional[HistoryArchiveState] = None
         self._get: Optional[GetRemoteFileWork] = None
+        # archive names to avoid, SHARED into every inner download so a
+        # corrupt-HAS blame survives this work's own retries (on_reset
+        # rebuilds the download work)
+        self._tried: List[str] = []
 
     def _remote(self) -> str:
         from ..history.archive import WELL_KNOWN
@@ -203,15 +289,29 @@ class GetHistoryArchiveStateWork(BasicWork):
         if self._get is None:
             self._get = GetRemoteFileWork(self.app, self.archive,
                                           self._remote(), self.local)
+            self._get._tried = self._tried
             self._get._parent = self
             self._get.start()
         if not self._get.is_done():
             self._get.crank_work()
-            return RUNNING
+            if not self._get.is_done():
+                return RUNNING if self._get.is_crankable() else WAITING
         if self._get.state != State.SUCCESS:
             return FAILURE
-        with open(self.local) as f:
-            self.has = HistoryArchiveState.from_json(f.read())
+        try:
+            with open(self.local) as f:
+                self.has = HistoryArchiveState.from_json(f.read())
+        except Exception as e:
+            # the bytes arrived but don't parse: the serving archive is
+            # corrupt for this file — blame it so the retry (our own
+            # on_reset rebuilds the download) picks a different one
+            log.warning("unparseable HistoryArchiveState from %s: %s",
+                        getattr(self._get.current_archive, "name", "?"), e)
+            g = self._get
+            if g.pool is not None and g.current_archive is not None:
+                g.pool.report_failure(g.current_archive)
+                g.exclude_current()
+            return FAILURE
         return SUCCESS
 
 
@@ -219,7 +319,7 @@ class BatchDownloadWork(BatchWork):
     """Download-and-unzip one category file per checkpoint over a ledger
     range, bounded-parallel (reference BatchDownloadWork.cpp)."""
 
-    def __init__(self, app, archive: HistoryArchive, category: str,
+    def __init__(self, app, archive, category: str,
                  first_ledger: int, last_ledger: int, download_dir: str,
                  max_concurrent: int = 8) -> None:
         super().__init__(app.clock, "batch-download %s [%d..%d]"
@@ -278,7 +378,7 @@ class DownloadBucketsWork(BatchWork):
     DownloadBucketsWork.cpp). Buckets already in the local store are
     skipped (content addressing makes this safe)."""
 
-    def __init__(self, app, archive: HistoryArchive, hashes: List[str],
+    def __init__(self, app, archive, hashes: List[str],
                  download_dir: str, max_concurrent: int = 8) -> None:
         super().__init__(app.clock, "download-buckets(%d)" % len(hashes),
                          max_concurrent)

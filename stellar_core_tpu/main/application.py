@@ -54,6 +54,24 @@ class Application:
             self.tracer, metrics=self.metrics,
             out_dir=config.FLIGHT_RECORDER_DIR or None)
 
+        # fault injector (util/faults.py): armed from config and/or the
+        # SCT_FAULTS env spec; every subsystem reaches it through
+        # app.faults (or a direct reference installed below), and an
+        # unconfigured injector is a dict miss per check
+        import os as _os
+        from ..util.faults import FaultInjector
+        self.faults = FaultInjector(
+            seed=int(_os.environ.get("SCT_FAULTS_SEED",
+                                     config.FAULTS_SEED)),
+            metrics=self.metrics, tracer=self.tracer)
+        for site, d in config.FAULTS.items():
+            self.faults.configure(
+                site, probability=float(d.get("p", 1.0)),
+                count=d.get("n"), after=int(d.get("after", 0)))
+        env_spec = _os.environ.get("SCT_FAULTS")
+        if env_spec:
+            self.faults.configure_from_spec(env_spec)
+
         # database (None in pure in-memory test mode)
         if config.DATABASE == "in-memory":
             self.database: Optional[Database] = None
@@ -65,12 +83,16 @@ class Application:
         self.persistent_state = (PersistentState(self.database)
                                  if self.database else None)
 
-        # crypto backend (config-gated; the TPU boundary)
+        # crypto backend (config-gated; the TPU boundary); device
+        # backends sit behind a circuit breaker with a CPU fallback
         self.sig_verifier = make_verifier(
             config.SIG_VERIFY_BACKEND, clock,
             config.SIG_VERIFY_MAX_BATCH,
             config.SIG_VERIFY_COMPILE_CACHE_DIR,
-            metrics=self.metrics, tracer=self.tracer)
+            metrics=self.metrics, tracer=self.tracer,
+            faults=self.faults, flight_recorder=self.flight_recorder,
+            breaker_threshold=config.SIG_VERIFY_BREAKER_THRESHOLD,
+            breaker_cooldown=config.SIG_VERIFY_BREAKER_COOLDOWN)
 
         self.invariant_manager = InvariantManager(self.metrics)
         for pattern in config.INVARIANT_CHECKS:

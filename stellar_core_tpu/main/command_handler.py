@@ -127,6 +127,41 @@ class CommandHandler:
         return {"error": "action must be "
                          "status|start|stop|clear|dump|flight"}
 
+    def cmd_faults(self, params) -> dict:
+        """Fault-injection control (ISSUE 3 tentpole; docs/robustness.md):
+        `faults?action=status|set|clear`. `set` arms one site:
+        `faults?action=set&site=device.dispatch&p=1.0&n=3&after=2`
+        (probability, max fire count, evaluations to skip first); `clear`
+        disarms one `site` or, with no site, everything. `status` (the
+        default) reports every armed site's schedule and fire counts,
+        the verify breaker, and archive health."""
+        faults = self.app.faults
+        action = params.get("action", "status")
+        if action == "set":
+            site = params.get("site")
+            if not site:
+                return {"error": "missing 'site' param"}
+            faults.configure(
+                site, probability=float(params.get("p", 1.0)),
+                count=int(params["n"]) if "n" in params else None,
+                after=int(params.get("after", 0)))
+            return {"status": "armed", **faults.to_json()}
+        if action == "clear":
+            faults.clear(params.get("site"))
+            return {"status": "cleared", **faults.to_json()}
+        if action == "status":
+            out = faults.to_json()
+            v = getattr(self.app, "sig_verifier", None)
+            breaker = getattr(v, "breaker", None)
+            if breaker is not None:
+                out["verify_breaker"] = breaker.to_json()
+            hm = self.app.history_manager
+            pool = hm.readable_pool() if hm is not None else None
+            if pool is not None:
+                out["archives"] = pool.to_json()
+            return out
+        return {"error": "action must be status|set|clear"}
+
     def cmd_peers(self, params) -> dict:
         om = self.app.overlay_manager
         return om.get_peers_info() if om is not None else {"peers": []}

@@ -109,6 +109,20 @@ class Config:
         # persistent XLA compilation cache (None = env or ~/.cache default)
         self.SIG_VERIFY_COMPILE_CACHE_DIR: Optional[str] = None
 
+        # device-dispatch circuit breaker (crypto/batch_verifier.py,
+        # docs/robustness.md): consecutive dispatch failures before the
+        # verifier trips to the CPU fallback, and how long it stays
+        # there before the half-open reprobe
+        self.SIG_VERIFY_BREAKER_THRESHOLD = 3
+        self.SIG_VERIFY_BREAKER_COOLDOWN = 30.0
+
+        # fault injection (util/faults.py, docs/robustness.md): TOML table
+        # of site name -> {p, n, after}; merged with the SCT_FAULTS env
+        # spec ("site:p=0.5,n=3;site2") at Application construction.
+        # FAULTS_SEED keys every site's deterministic schedule.
+        self.FAULTS: Dict[str, dict] = {}
+        self.FAULTS_SEED = 0
+
         # observability: span tracer (util/tracing.py). Enabled at
         # startup when True; always toggleable at runtime via the admin
         # `trace` endpoint. Capacity bounds the span ring buffer.
@@ -167,6 +181,8 @@ class Config:
             "PEER_TIMEOUT", "PEER_STRAGGLER_TIMEOUT",
             "MAX_BATCH_WRITE_COUNT", "MAX_BATCH_WRITE_BYTES",
             "PEER_SEND_QUEUE_LIMIT_BYTES", "METADATA_OUTPUT_STREAM",
+            "SIG_VERIFY_BREAKER_THRESHOLD", "SIG_VERIFY_BREAKER_COOLDOWN",
+            "FAULTS_SEED",
         ]
         for k in simple_keys:
             if k in data:
@@ -177,6 +193,8 @@ class Config:
             cfg.QUORUM_SET = cls._parse_qset(data["QUORUM_SET"])
         if "HISTORY" in data:
             cfg.HISTORY = data["HISTORY"]
+        if "FAULTS" in data:
+            cfg.FAULTS = data["FAULTS"]
         cfg.validate()
         return cfg
 

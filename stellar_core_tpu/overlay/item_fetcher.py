@@ -20,6 +20,13 @@ log = get_logger("Overlay")
 
 MS_TO_WAIT_FOR_FETCH_REPLY = 1.5
 MAX_REBUILD_FETCH_LIST = 1000
+# retry-delay growth cap (multiplier saturates here) and the give-up
+# bound: after this many full candidate-list rebuilds with no answer the
+# tracker stops polling and counts an `overlay.item-fetcher.giveup` —
+# an unfetchable txset becomes a visible metric instead of an eternal
+# silent poll (docs/robustness.md)
+MAX_DELAY_REBUILDS = 10
+GIVEUP_REBUILDS = 32
 
 
 class Tracker:
@@ -36,6 +43,9 @@ class Tracker:
         self.timer = VirtualTimer(overlay.app.clock)
         self.num_list_rebuild = 0
         self._stopped = False
+        # called (with self) when the tracker abandons the fetch, so the
+        # owning ItemFetcher can drop it from its registry
+        self.on_giveup: Optional[Callable[["Tracker"], None]] = None
 
     def listen(self, env: SCPEnvelope) -> None:
         if len(self.waiting) < MAX_REBUILD_FETCH_LIST:
@@ -44,7 +54,8 @@ class Tracker:
     def try_next_peer(self) -> None:
         """Ask one peer we haven't asked this round; when all are
         exhausted, rebuild the candidate list and back off slightly
-        (reference Tracker::tryNextPeer)."""
+        (reference Tracker::tryNextPeer). After GIVEUP_REBUILDS fruitless
+        rebuilds the tracker gives up instead of polling forever."""
         if self._stopped:
             return
         peers = self.overlay.authenticated_peer_ids()
@@ -52,6 +63,9 @@ class Tracker:
         if not candidates:
             self.peers_asked = []
             self.num_list_rebuild += 1
+            if self.num_list_rebuild >= GIVEUP_REBUILDS:
+                self._give_up()
+                return
             candidates = list(peers)
         if candidates:
             pid = candidates[rnd.g_random.randrange(len(candidates))]
@@ -61,9 +75,20 @@ class Tracker:
             if peer is not None:
                 peer.send_message(self.make_request(self.item_hash))
         delay = MS_TO_WAIT_FOR_FETCH_REPLY * (1 + min(
-            self.num_list_rebuild, 10))
+            self.num_list_rebuild, MAX_DELAY_REBUILDS))
         self.timer.expires_from_now(delay)
         self.timer.async_wait(self.try_next_peer)
+
+    def _give_up(self) -> None:
+        log.warning("giving up fetching %s after %d peer-list rebuilds "
+                    "(%d envelopes waiting)", self.item_hash.hex()[:8],
+                    self.num_list_rebuild, len(self.waiting))
+        m = getattr(self.overlay.app, "metrics", None)
+        if m is not None:
+            m.new_meter("overlay.item-fetcher.giveup").mark()
+        self.stop()
+        if self.on_giveup is not None:
+            self.on_giveup(self)
 
     def doesnt_have(self, peer_id: str) -> None:
         if peer_id == self.last_asked_peer:
@@ -90,6 +115,7 @@ class ItemFetcher:
         tr = self.trackers.get(item_hash)
         if tr is None:
             tr = Tracker(self.overlay, item_hash, self.make_request)
+            tr.on_giveup = lambda t: self.trackers.pop(t.item_hash, None)
             self.trackers[item_hash] = tr
             if envelope is not None:
                 tr.listen(envelope)

@@ -18,6 +18,11 @@ from ..xdr import IPAddr, PeerAddress, PublicKey
 log = get_logger("Overlay")
 
 MAX_FAILURES = 10
+# decorrelated-jitter reconnect backoff (docs/robustness.md): delay_k is
+# uniform in [BASE, 3 * delay_{k-1}] capped — reconnect attempts from many
+# nodes that lost the same peer spread out instead of storming it in sync
+RECONNECT_BACKOFF_BASE = 2.0
+RECONNECT_BACKOFF_CAP = 120.0
 
 
 def parse_peer_address(s: str, default_port: int = 11625
@@ -47,7 +52,7 @@ def from_xdr_address(pa: PeerAddress) -> Tuple[str, int]:
 
 class PeerRecord:
     __slots__ = ("host", "port", "num_failures", "next_attempt",
-                 "preferred", "outbound")
+                 "preferred", "outbound", "last_backoff")
 
     def __init__(self, host: str, port: int) -> None:
         self.host = host
@@ -56,6 +61,7 @@ class PeerRecord:
         self.next_attempt = 0.0
         self.preferred = False
         self.outbound = False
+        self.last_backoff = 0.0
 
 
 class PeerManager:
@@ -109,14 +115,24 @@ class PeerManager:
     def on_connect_failure(self, host: str, port: int) -> None:
         rec = self.ensure_exists(host, port)
         rec.num_failures += 1
-        # linear backoff by failure count (reference backoff role)
-        rec.next_attempt = self.app.clock.now() + min(
-            rec.num_failures, MAX_FAILURES) * 10.0
+        # exponential backoff with decorrelated jitter: the growth comes
+        # from tripling the PREVIOUS delay, the desynchronization from the
+        # uniform draw (deterministic under the seeded global RNG)
+        prev = rec.last_backoff or RECONNECT_BACKOFF_BASE
+        delay = min(RECONNECT_BACKOFF_CAP,
+                    rnd.g_random.uniform(RECONNECT_BACKOFF_BASE,
+                                         prev * 3.0))
+        rec.last_backoff = delay
+        rec.next_attempt = self.app.clock.now() + delay
+        m = getattr(self.app, "metrics", None)
+        if m is not None:
+            m.new_meter("overlay.connection.failure").mark()
 
     def on_connect_success(self, host: str, port: int) -> None:
         rec = self.ensure_exists(host, port)
         rec.num_failures = 0
         rec.next_attempt = 0.0
+        rec.last_backoff = 0.0
         rec.outbound = True
 
     def candidates_to_connect(self, n: int,

@@ -27,6 +27,7 @@ from typing import Callable, Deque, Dict, Optional, Tuple
 
 from ..util import rnd
 from ..util.log import get_logger
+from ..util.timer import VirtualTimer
 
 log = get_logger("Overlay")
 
@@ -119,6 +120,90 @@ class LoopbackTransport(Transport):
         if not self.closed:
             self.closed = True
             self.on_closed()
+
+
+class ChaosTransport(Transport):
+    """Fault-injecting wrapper around another Transport (loopback pipes in
+    simulations, but any Transport works): drop / delay / duplicate /
+    reorder outbound frames by seeded FaultInjector schedule
+    (`overlay.drop` / `overlay.delay` / `overlay.duplicate` /
+    `overlay.reorder` sites, util/faults.py), plus a hard `partitioned`
+    toggle that severs BOTH directions until healed — the knob the chaos
+    soak uses to run a partition-and-heal scenario. The owning Peer sees
+    a normal Transport; all chaos happens underneath it."""
+
+    # delay applied to frames the `overlay.delay` site selects; virtual
+    # seconds in simulations
+    delay_s = 0.25
+
+    def __init__(self, inner: Transport, clock, faults=None,
+                 site_prefix: str = "overlay") -> None:
+        self.inner = inner
+        self.clock = clock            # the owning (sending) side's clock
+        self.faults = faults
+        self.site_prefix = site_prefix
+        self.partitioned = False
+        self.dropped = 0              # frames eaten (faults + partition)
+        self.delayed = 0
+        self.on_frame = lambda raw: None
+        self.on_closed = lambda: None
+        self._reorder_held: Optional[bytes] = None
+        inner.on_frame = self._rx
+        inner.on_closed = lambda: self.on_closed()
+
+    def _fire(self, site: str) -> bool:
+        from ..util.faults import check_faults
+        return check_faults(self, self.site_prefix + "." + site)
+
+    def send_frame(self, raw: bytes) -> None:
+        if self.partitioned or self._fire("drop"):
+            self.dropped += 1
+            return
+        frames = [raw]
+        if self._fire("duplicate"):
+            frames.append(raw)
+        if self._fire("reorder") and self._reorder_held is None:
+            # hold this frame; it rides behind the NEXT send
+            self._reorder_held = raw
+            return
+        if self._reorder_held is not None:
+            frames.append(self._reorder_held)
+            self._reorder_held = None
+        for f in frames:
+            if self._fire("delay"):
+                self.delayed += 1
+                t = VirtualTimer(self.clock)
+                t.expires_from_now(self.delay_s)
+                t.async_wait(lambda f=f: self._send_now(f))
+            else:
+                self._send_now(f)
+
+    def _send_now(self, raw: bytes) -> None:
+        # re-check the partition at (delayed) delivery time: a frame held
+        # over a partition start must not leak through
+        if not self.partitioned:
+            self.inner.send_frame(raw)
+        else:
+            self.dropped += 1
+
+    def _rx(self, raw: bytes) -> None:
+        if self.partitioned:
+            self.dropped += 1
+            return
+        self.on_frame(raw)
+
+    def set_partitioned(self, on: bool) -> None:
+        self.partitioned = on
+
+    def close(self) -> None:
+        self.inner.close()
+
+    def oldest_unsent_age(self) -> float:
+        return self.inner.oldest_unsent_age()
+
+    @property
+    def closed(self) -> bool:
+        return getattr(self.inner, "closed", False)
 
 
 class TCPReactor:

@@ -78,6 +78,7 @@ class Simulation:
         self.mode = mode
         self.network_passphrase = network_passphrase
         self.nodes: Dict[str, SimNode] = {}
+        self._chaos_links: Dict[tuple, tuple] = {}
 
     # -- topology -----------------------------------------------------------
     def add_node(self, secret: SecretKey, qset: SCPQuorumSet,
@@ -119,19 +120,46 @@ class Simulation:
         self.nodes[b].channels.append(ch)
         return ch
 
-    def connect_peers(self, a: str, b: str):
+    def connect_peers(self, a: str, b: str, chaos: bool = False):
         """Real overlay connection over an in-process pipe: `a` plays the
-        initiator (WE_CALLED_REMOTE)."""
-        from ..overlay.transport import LoopbackTransport
+        initiator (WE_CALLED_REMOTE). With chaos=True each end is wrapped
+        in a ChaosTransport driven by its own app's fault injector
+        (overlay.drop/delay/duplicate/reorder sites + hard partition),
+        registered under `self._chaos_links[(a, b)]`."""
+        from ..overlay.transport import ChaosTransport, LoopbackTransport
         app_a = self.nodes[a].app
         app_b = self.nodes[b].app
         # each end is owned by (and delivers onto the clock of) one app
         ta, tb = LoopbackTransport.pair(app_a.clock, app_b.clock)
+        if chaos:
+            ta = ChaosTransport(ta, app_a.clock,
+                                faults=getattr(app_a, "faults", None))
+            tb = ChaosTransport(tb, app_b.clock,
+                                faults=getattr(app_b, "faults", None))
+            self._chaos_links[tuple(sorted((a, b)))] = (ta, tb)
         app_b.overlay_manager.add_loopback_peer(tb, outbound=False,
                                                 address=(a, 0))
         app_a.overlay_manager.add_loopback_peer(ta, outbound=True,
                                                 address=(b, 0))
         return ta, tb
+
+    # -- chaos ---------------------------------------------------------------
+    def set_partition(self, a: str, b: str, on: bool = True) -> None:
+        """Sever (or heal) the a<->b link in either simulation mode — the
+        chaos soak's partition-and-heal scenario."""
+        if self.mode == Simulation.OVER_PEERS:
+            link = self._chaos_links.get(tuple(sorted((a, b))))
+            assert link is not None, \
+                "partition needs connect_peers(..., chaos=True)"
+            for t in link:
+                t.set_partitioned(on)
+            return
+        for ch in self.nodes[a].channels:
+            if set(ch.ends) == {a, b}:
+                ch.enabled = not on
+
+    def heal_partition(self, a: str, b: str) -> None:
+        self.set_partition(a, b, on=False)
 
     def start_all_nodes(self) -> None:
         for node in self.nodes.values():

@@ -1,0 +1,167 @@
+"""Deterministic fault injection: named fault points with seeded
+per-site schedules.
+
+Role parity: the reference sneaks fault knobs into individual classes
+(LoopbackPeer drop/damage probabilities, `ARTIFICIALLY_*` config flags);
+DSig-style offload pipelines (PAPERS.md, arXiv:2406.07215) treat verifier
+failure and degraded operation as first-class operating modes instead.
+This module is the one registry every failure domain pulls from:
+
+- `FaultInjector`: named fault points ("device.dispatch",
+  "overlay.drop", "archive.corrupt", ...) each with an independent
+  seeded RNG and a schedule (probability, max fire count, skip-first-N),
+  so a chaos run replays identically from its seed.
+- Every injection is counted in metrics (`fault.injected.<site>`) and
+  tagged on the active span + emitted as a tracer instant, so a flight
+  dump from a chaos run shows exactly which faults fired where.
+- Configured from Config.FAULTS (TOML table), the `SCT_FAULTS` env spec,
+  or at runtime via the admin `faults?action=...` endpoint
+  (docs/robustness.md catalogs the sites and knobs).
+
+`should_fire(site)` on an unconfigured site is one dict miss — cheap
+enough to leave the check permanently on hot paths, the same contract
+the tracer makes for disabled spans.
+"""
+
+from __future__ import annotations
+
+import random
+from typing import Dict, Optional
+
+from .log import get_logger
+
+log = get_logger("Fault")
+
+
+class InjectedFault(Exception):
+    """Raised by call sites that turn a fired fault point into an
+    exception (e.g. the device-dispatch site in the batch verifier)."""
+
+
+class FaultSite:
+    """Schedule for one named fault point."""
+
+    __slots__ = ("name", "probability", "remaining", "skip", "rng",
+                 "fired", "evaluated")
+
+    def __init__(self, name: str, probability: float = 1.0,
+                 count: Optional[int] = None, after: int = 0,
+                 seed: int = 0) -> None:
+        self.name = name
+        self.probability = probability
+        self.remaining = count          # None = unlimited
+        self.skip = after               # evaluations to pass through first
+        # per-site stream: adding/removing one site never shifts another
+        # site's schedule (str seeding is stable across processes)
+        self.rng = random.Random("%d:%s" % (seed, name))
+        self.fired = 0
+        self.evaluated = 0
+
+    def to_json(self) -> dict:
+        return {"probability": self.probability,
+                "remaining": self.remaining, "skip": self.skip,
+                "fired": self.fired, "evaluated": self.evaluated}
+
+
+class FaultInjector:
+    """Registry of fault points; see module docstring."""
+
+    def __init__(self, seed: int = 0, metrics=None, tracer=None) -> None:
+        self.seed = seed
+        self.metrics = metrics
+        self.tracer = tracer
+        self._sites: Dict[str, FaultSite] = {}
+
+    # -- configuration -------------------------------------------------------
+    def configure(self, name: str, probability: float = 1.0,
+                  count: Optional[int] = None, after: int = 0) -> FaultSite:
+        site = FaultSite(name, probability, count, after, seed=self.seed)
+        self._sites[name] = site
+        log.info("fault point %s armed: p=%g count=%s after=%d",
+                 name, probability, count, after)
+        return site
+
+    def configure_from_spec(self, spec: str) -> None:
+        """Parse `site:p=0.5,n=3,after=2;site2` (missing fields default to
+        p=1, unlimited, no skip) — the SCT_FAULTS env format."""
+        for part in spec.split(";"):
+            part = part.strip()
+            if not part:
+                continue
+            name, _, argstr = part.partition(":")
+            kwargs: dict = {}
+            for kv in argstr.split(","):
+                kv = kv.strip()
+                if not kv:
+                    continue
+                k, _, v = kv.partition("=")
+                k = k.strip()
+                if k in ("p", "probability"):
+                    kwargs["probability"] = float(v)
+                elif k in ("n", "count"):
+                    kwargs["count"] = int(v)
+                elif k == "after":
+                    kwargs["after"] = int(v)
+                else:
+                    raise ValueError("unknown fault arg %r in %r" % (k, part))
+            self.configure(name.strip(), **kwargs)
+
+    def clear(self, name: Optional[str] = None) -> None:
+        if name is None:
+            self._sites.clear()
+        else:
+            self._sites.pop(name, None)
+
+    def configured(self) -> bool:
+        return bool(self._sites)
+
+    # -- the hot check -------------------------------------------------------
+    def should_fire(self, name: str) -> bool:
+        site = self._sites.get(name)
+        if site is None:
+            return False
+        site.evaluated += 1
+        if site.skip > 0:
+            site.skip -= 1
+            return False
+        if site.remaining is not None and site.remaining <= 0:
+            return False
+        if site.probability < 1.0 and site.rng.random() >= site.probability:
+            return False
+        if site.remaining is not None:
+            site.remaining -= 1
+        site.fired += 1
+        self._mark(site)
+        return True
+
+    def fire_point(self, name: str) -> None:
+        """`should_fire` + raise: for sites whose effect is an exception."""
+        if self.should_fire(name):
+            raise InjectedFault(name)
+
+    def _mark(self, site: FaultSite) -> None:
+        if self.metrics is not None:
+            self.metrics.new_meter("fault.injected.%s" % site.name).mark()
+        t = self.tracer
+        if t is not None and t.enabled:
+            # tag the innermost open span (the operation the fault landed
+            # in) and drop an instant so the timeline shows the injection
+            stack = t._stack()
+            if stack:
+                stack[-1].set_tag("fault", site.name)
+            t.instant("fault.%s" % site.name, cat="fault",
+                      fired=site.fired)
+
+    # -- introspection -------------------------------------------------------
+    def to_json(self) -> dict:
+        return {"seed": self.seed,
+                "sites": {n: s.to_json()
+                          for n, s in sorted(self._sites.items())}}
+
+
+def check_faults(owner, name: str) -> bool:
+    """`should_fire` against an `owner.faults` that may be absent or None
+    — call sites (verifier, transports, works) must never require an
+    injector, mirroring tracing.app_span's contract."""
+    f = getattr(owner, "faults", None)
+    return f is not None and f.should_fire(name)

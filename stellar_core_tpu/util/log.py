@@ -12,6 +12,7 @@ import sys
 PARTITIONS = [
     "Fs", "SCP", "Bucket", "Database", "History", "Process", "Ledger",
     "Overlay", "Herder", "Tx", "LoadGen", "Work", "Invariant", "Perf",
+    "Fault",
 ]
 
 _FMT = "%(asctime)s [%(name)s %(levelname)s] %(message)s"

@@ -42,6 +42,13 @@ RETRY_A_LOT = 32
 
 
 class BasicWork:
+    # decorrelated-jitter retry backoff (docs/robustness.md): delay_k is
+    # uniform in [BASE, 3 * delay_{k-1}], capped — a fleet of works that
+    # failed on one shared cause (archive outage, dead peer) desyncs
+    # instead of re-firing as a synchronized retry storm
+    RETRY_BACKOFF_BASE = 0.5
+    RETRY_BACKOFF_CAP = 256.0
+
     def __init__(self, clock: VirtualClock, name: str,
                  max_retries: int = RETRY_A_FEW) -> None:
         self.clock = clock
@@ -50,6 +57,7 @@ class BasicWork:
         self.retries = 0
         self.state = State.PENDING
         self._retry_timer = VirtualTimer(clock)
+        self._last_retry_delay = 0.0
         self._on_done: Optional[Callable[[State], None]] = None
 
     # -- subclass hooks -----------------------------------------------------
@@ -78,11 +86,17 @@ class BasicWork:
                               State.ABORTED)
         self._on_done = on_done
         self.retries = 0
+        self._last_retry_delay = 0.0
         self.on_reset()
         self.state = State.RUNNING
 
     def is_done(self) -> bool:
         return self.state in (State.SUCCESS, State.FAILURE, State.ABORTED)
+
+    def is_crankable(self) -> bool:
+        """True when crank_work would actually run a step (WAITING and
+        RETRYING work only progresses via wake_up / its retry timer)."""
+        return self.state in (State.RUNNING, State.ABORTING)
 
     def crank_work(self) -> None:
         if self.is_done() or self.state in (State.WAITING, State.RETRYING,
@@ -112,7 +126,12 @@ class BasicWork:
     def _schedule_retry(self) -> None:
         self.on_failure_retry()
         self.state = State.RETRYING
-        delay = min(2.0 ** self.retries, 256.0)
+        from ..util import rnd
+        prev = self._last_retry_delay or self.RETRY_BACKOFF_BASE
+        delay = min(self.RETRY_BACKOFF_CAP,
+                    rnd.g_random.uniform(self.RETRY_BACKOFF_BASE,
+                                         prev * 3.0))
+        self._last_retry_delay = delay
         self.retries += 1
 
         def fire() -> None:
@@ -121,15 +140,12 @@ class BasicWork:
                 self.state = State.RUNNING
                 self.wake_up()
 
-        from ..util.timer import ClockMode
-        if getattr(self.clock, "mode", None) == ClockMode.VIRTUAL_TIME:
-            # virtual-time runs (tests, simulation) crank continuously, so a
-            # backoff timer could starve behind posted actions; retry on the
-            # next turn instead — the retry *count* still bounds the work
-            self.clock.post(fire)
-        else:
-            self._retry_timer.expires_from_now(delay)
-            self._retry_timer.async_wait(fire)
+        # always a real timer, virtual clocks included: WAITING/RETRYING
+        # propagates up the work tree (work.py) so the scheduler goes
+        # idle, the virtual clock advances to this deadline, and the
+        # jittered delays keep co-failed works off the same tick
+        self._retry_timer.expires_from_now(delay)
+        self._retry_timer.async_wait(fire)
 
     def wake_up(self) -> None:
         if self.state == State.WAITING:
@@ -137,6 +153,10 @@ class BasicWork:
         cb = getattr(self, "_wake_cb", None)
         if cb is not None:
             cb()
+        # a woken child must wake the whole ancestor chain: parents park
+        # in WAITING when every child is blocked, and the scheduler only
+        # re-cranks on a root wake
+        self.wake_up_parent()
 
     def set_wake_cb(self, cb: Callable[[], None]) -> None:
         self._wake_cb = cb

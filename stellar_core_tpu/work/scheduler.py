@@ -37,7 +37,11 @@ class WorkScheduler:
         for w in live:
             w.crank_work()
         self._roots = [w for w in self._roots if not w.is_done()]
-        if self._roots:
+        # repost only while a root can actually take a step: parked
+        # (WAITING/RETRYING) roots re-arm via their wake_cb, and an idle
+        # action queue is what lets the virtual clock advance to the
+        # retry/backoff timers those roots are sleeping on
+        if any(w.is_crankable() for w in self._roots):
             self._schedule_crank()
 
     def all_done(self) -> bool:

@@ -44,17 +44,20 @@ class Work(BasicWork):
         return all(c.is_done() for c in self.children)
 
     def on_run(self) -> State:
-        progressed = False
         for c in self.children:
-            if not c.is_done():
+            if c.is_crankable():
                 c.crank_work()
-                progressed = True
                 break
         if self._any_failed():
             return FAILURE
-        if not self._all_done():
+        if self._all_done():
+            return self.do_work()
+        # every live child is WAITING/RETRYING: park; their wake_up (or
+        # retry timer) propagates up and re-arms this work — busy-cranking
+        # here would pin the virtual clock and starve those very timers
+        if any(c.is_crankable() for c in self.children):
             return RUNNING
-        return self.do_work()
+        return WAITING
 
 
 class WorkSequence(BasicWork):
@@ -79,10 +82,14 @@ class WorkSequence(BasicWork):
             return SUCCESS
         cur = self.sequence[self._idx]
         if cur.state == State.PENDING:
+            cur._parent = self
             cur.start()
         if not cur.is_done():
             cur.crank_work()
-            return RUNNING
+            if not cur.is_done():
+                # park while the child WAITs/RETRIes; its wake_up (or
+                # retry timer) re-arms this sequence
+                return RUNNING if cur.is_crankable() else WAITING
         if cur.state != State.SUCCESS:
             return FAILURE
         self._idx += 1
@@ -120,16 +127,22 @@ class BatchWork(Work):
                 break
             self.add_work(w)
         for c in self.children:
-            if not c.is_done():
+            if c.is_crankable():
                 c.crank_work()
         if self.children:
-            return RUNNING
+            if any(c.is_crankable() or c.is_done() for c in self.children):
+                return RUNNING   # finished children are harvested next crank
+            return WAITING       # all blocked; children wake us
         return self.do_work() if self._exhausted else RUNNING
 
 
 class ConditionalWork(BasicWork):
     """Runs inner work once a condition becomes true (reference
     ConditionalWork)."""
+
+    # re-check cadence while parked on a false condition (reference
+    # ConditionalWork sleepDelay); virtual seconds cost nothing in tests
+    POLL_DELAY = 0.1
 
     def __init__(self, clock, name, condition: Callable[[], bool],
                  inner: BasicWork) -> None:
@@ -138,6 +151,8 @@ class ConditionalWork(BasicWork):
         self.inner = inner
         self._condition_met = False   # latched once true (reference
         inner._parent = self          # ConditionalWork clears mConditionFn)
+        from ..util.timer import VirtualTimer
+        self._poll_timer = VirtualTimer(clock)
 
     def on_reset(self) -> None:
         self._condition_met = False
@@ -147,13 +162,19 @@ class ConditionalWork(BasicWork):
     def on_run(self) -> State:
         if not self._condition_met:
             if not self.condition():
-                return RUNNING
+                # park instead of busy-polling (the poll would pin the
+                # scheduler and starve sibling retry timers); the timer
+                # re-checks on a cadence
+                self._poll_timer.expires_from_now(self.POLL_DELAY)
+                self._poll_timer.async_wait(self.wake_up)
+                return WAITING
             self._condition_met = True
         if self.inner.state == State.PENDING:
             self.inner.start()
         if not self.inner.is_done():
             self.inner.crank_work()
-            return RUNNING
+            if not self.inner.is_done():
+                return RUNNING if self.inner.is_crankable() else WAITING
         return SUCCESS if self.inner.state == State.SUCCESS else FAILURE
 
 

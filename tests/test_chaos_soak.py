@@ -1,0 +1,333 @@
+"""Chaos soak (ISSUE 3 capstone): seeded multi-node simulations closing
+ledgers under a fault schedule — device-dispatch failures tripping the
+verify circuit breaker mid-run, message loss on a flaky link, one
+partition healed — asserting liveness (every node externalizes the
+target) and safety (identical header hashes at every common height), and
+a catchup completing against a flaky archive pair with failover.
+
+The tier-1 legs run a small ledger count; the @slow variants run the
+full ~50-ledger soak. Every leg is deterministic per seed: the global
+RNG, each node's FaultInjector streams, and the virtual clocks replay
+identically.
+"""
+
+import os
+
+import pytest
+
+from stellar_core_tpu.main.application import Application
+from stellar_core_tpu.main.config import Config
+from stellar_core_tpu.simulation import topologies
+from stellar_core_tpu.simulation.simulation import Simulation
+from stellar_core_tpu.testing import AppLedgerAdapter
+from stellar_core_tpu.util import rnd
+from stellar_core_tpu.util.timer import ClockMode, VirtualClock
+
+FREQ = 8
+
+
+def _clear_verify_cache():
+    from stellar_core_tpu.crypto import keys as _keys
+    _keys.flush_verify_cache()
+
+
+# ------------------------------------------------------------ the soak
+
+def _soak_tweak(seed):
+    def tweak(cfg):
+        cfg.SIG_VERIFY_BACKEND = "cpu-resilient"
+        cfg.SIG_VERIFY_BREAKER_THRESHOLD = 3
+        # ledgers close every ~1ms of accelerated virtual time; a 20ms
+        # cooldown keeps the breaker open across many closes before the
+        # half-open reprobe, so "a ledger closed on the fallback" is
+        # observable in every seed
+        cfg.SIG_VERIFY_BREAKER_COOLDOWN = 0.02
+        cfg.DATABASE = "sqlite3://:memory:"
+        cfg.FAULTS_SEED = seed
+    return tweak
+
+
+def run_chaos_soak(seed: int, target: int) -> None:
+    rnd.reseed(seed)
+    _clear_verify_cache()
+    sim = topologies.core(3, 2, cfg_tweak=_soak_tweak(seed))
+    sim.start_all_nodes()
+    names = list(sim.nodes)
+    a = sim.nodes[names[0]].app
+    a.tracer.enable()          # fault instants + breaker markers recorded
+    breaker = a.sig_verifier.breaker
+
+    # flaky link for the whole run: 10% message loss between node 0/1
+    sim.nodes[names[0]].channels[0].drop_probability = 0.10
+
+    # phase 1: clean start
+    assert sim.crank_until(lambda: sim.have_all_externalized(2), 20000)
+
+    # phase 2: device loss on node A — the next 3 dispatches fail, which
+    # is exactly the breaker threshold
+    _clear_verify_cache()      # force fresh dispatches on every node
+    a.faults.configure("device.dispatch", count=3)
+    assert sim.crank_until(lambda: breaker.trips >= 1, 40000), \
+        "device faults never tripped the breaker"
+    lcl_at_trip = a.ledger_manager.last_closed_ledger_num()
+    assert breaker.state == "open"
+    # span timeline at the trip (snapshotted before the ring evicts it):
+    # the injection instants, the drains they landed in (fault-tagged),
+    # and the trip marker
+    spans_at_trip = a.tracer.spans()
+    names_at_trip = [s.name for s in spans_at_trip]
+    assert names_at_trip.count("fault.device.dispatch") == 3
+    assert "crypto.breaker.trip" in names_at_trip
+    assert len([s for s in spans_at_trip
+                if s.tags and s.tags.get("fault") == "device.dispatch"]) \
+        == 3
+
+    # phase 3: consensus keeps going on the CPU fallback while open, and
+    # the half-open reprobe recovers the primary within the window
+    assert sim.crank_until(lambda: breaker.recoveries >= 1, 60000), \
+        "breaker never recovered after the cooldown window"
+    assert breaker.state == "closed"
+    assert "crypto.breaker.recover" in \
+        [s.name for s in a.tracer.spans(last_n=64)]
+    # every failed dispatch's drain completed on the fallback
+    assert a.metrics.to_json()[
+        "crypto.verify.fallback-drain"]["count"] >= 3
+    assert sim.crank_until(
+        lambda: sim.have_all_externalized(lcl_at_trip + 1), 40000), \
+        "liveness lost across the device trip"
+
+    # phase 4: partition 0<->1 (consensus survives via node 2), then heal
+    mid = a.ledger_manager.last_closed_ledger_num()
+    sim.set_partition(names[0], names[1], True)
+    assert sim.crank_until(lambda: sim.have_all_externalized(mid + 2),
+                           60000), "no liveness under partition"
+    sim.heal_partition(names[0], names[1])
+
+    # phase 5: run to target
+    assert sim.crank_until(lambda: sim.have_all_externalized(target),
+                           300000), \
+        {n: v.app.ledger_manager.last_closed_ledger_num()
+         for n, v in sim.nodes.items()}
+
+    # every injected fault is visible in metrics, tagged by site
+    mjson = a.metrics.to_json()
+    assert mjson["fault.injected.device.dispatch"]["count"] == 3
+    assert mjson["crypto.breaker.trip"]["count"] == breaker.trips
+    assert mjson["crypto.breaker.recover"]["count"] == breaker.recoveries
+
+    # safety: identical header hash at every common height
+    by_node = {}
+    for node in sim.nodes.values():
+        rows = node.app.database.execute(
+            "SELECT ledgerseq, ledgerhash FROM ledgerheaders").fetchall()
+        by_node[node.name] = dict(rows)
+    common = set.intersection(*(set(h) for h in by_node.values()))
+    assert max(common) >= target
+    for seq in sorted(common):
+        hashes = {by_node[nm][seq] for nm in by_node}
+        assert len(hashes) == 1, "fork at ledger %d: %r" % (seq, hashes)
+
+
+@pytest.mark.chaos
+@pytest.mark.parametrize("seed", [1, 2, 3])
+def test_chaos_soak_deterministic(seed):
+    run_chaos_soak(seed, target=12)
+
+
+@pytest.mark.chaos
+@pytest.mark.slow
+@pytest.mark.parametrize("seed", [1, 2, 3])
+def test_chaos_soak_long(seed):
+    run_chaos_soak(seed, target=50)
+
+
+# -------------------------------------------- chaos links over real overlay
+
+@pytest.mark.chaos
+def test_chaos_transport_partition_heals_over_real_overlay():
+    """Full overlay stack over ChaosTransport-wrapped pipes: consensus
+    under seeded frame drops, a partition (liveness via the third node),
+    and progress after heal."""
+    rnd.reseed(7)
+    sim = Simulation(mode=Simulation.OVER_PEERS)
+    from stellar_core_tpu.crypto.hashing import sha256
+    from stellar_core_tpu.crypto.keys import SecretKey
+    from stellar_core_tpu.xdr import SCPQuorumSet
+    keys = [SecretKey.from_seed(sha256(b"chaos" + bytes([i])))
+            for i in range(3)]
+    qset = SCPQuorumSet(threshold=2,
+                        validators=[k.public_key for k in keys],
+                        innerSets=[])
+    names = [sim.add_node(k, qset).name for k in keys]
+    sim.connect_peers(names[0], names[1], chaos=True)
+    sim.connect_peers(names[1], names[2], chaos=True)
+    sim.connect_peers(names[0], names[2], chaos=True)
+    # seeded frame loss on every node's outbound chaos ends; the first
+    # frames are spared so the one-shot loopback handshakes complete (a
+    # dropped HELLO would kill the link permanently — sims don't redial)
+    for node in sim.nodes.values():
+        node.app.faults.configure("overlay.drop", probability=0.03,
+                                  after=80)
+    sim.start_all_nodes()
+    assert sim.crank_until(lambda: sim.have_all_externalized(2), 60000), \
+        {n: v.app.ledger_manager.last_closed_ledger_num()
+         for n, v in sim.nodes.items()}
+    sim.set_partition(names[0], names[1], True)
+    mid = max(v.app.ledger_manager.last_closed_ledger_num()
+              for v in sim.nodes.values())
+    assert sim.crank_until(lambda: sim.have_all_externalized(mid + 2),
+                           90000), "no liveness under overlay partition"
+    sim.heal_partition(names[0], names[1])
+    final = mid + 4
+    assert sim.crank_until(lambda: sim.have_all_externalized(final), 90000)
+    # the chaos ends actually dropped traffic
+    dropped = sum(t.dropped for pair in sim._chaos_links.values()
+                  for t in pair)
+    assert dropped > 0
+
+
+# ------------------------------------------------- flaky archive catchup
+
+def _archive_cfg(n, roots, writable):
+    from stellar_core_tpu.history.archive import HistoryArchive
+    cfg = Config.test_config(n)
+    cfg.DATABASE = "sqlite3://:memory:"
+    cfg.CHECKPOINT_FREQUENCY = FREQ
+    hist = {}
+    for name, root in roots.items():
+        arch = HistoryArchive.local_dir(name, str(root))
+        d = {"get": arch.get_tmpl, "mkdir": arch.mkdir_tmpl}
+        if writable:
+            d["put"] = arch.put_tmpl
+        hist[name] = d
+    cfg.HISTORY = hist
+    return cfg
+
+
+def _make_app(tmp_path, n, roots, writable):
+    cfg = _archive_cfg(n, roots, writable)
+    app = Application(VirtualClock(ClockMode.VIRTUAL_TIME), cfg)
+    app.enable_buckets(str(tmp_path / ("buckets-%d" % n)))
+    app.start()
+    return app
+
+
+@pytest.mark.chaos
+def test_catchup_completes_against_flaky_archive_pair(tmp_path):
+    """Multi-archive failover: catchup succeeds although downloads from
+    the pool hit injected transfer failures, a corrupted file and a
+    short read — each detected and re-fetched from the other archive."""
+    rnd.reseed(11)
+    roots = {"a": tmp_path / "archive-a", "b": tmp_path / "archive-b"}
+    for r in roots.values():
+        os.makedirs(r, exist_ok=True)
+    pub = _make_app(tmp_path, 0, roots, writable=True)
+    adapter = AppLedgerAdapter(pub)
+    root = adapter.root_account()
+    alice = root.create(10**10)
+    while pub.ledger_manager.last_closed_ledger_num() < 2 * FREQ + 2:
+        pub.submit_transaction(
+            alice.tx([alice.op_payment(root.account_id, 1000)]))
+        pub.manual_close()
+    pub.crank_until(lambda: pub.history_manager.publish_queue() == [],
+                    max_cranks=20000)
+    assert pub.history_manager.published_checkpoints >= 2
+
+    app = _make_app(tmp_path, 1, roots, writable=False)
+    # deterministic injury schedule for the downloads
+    app.faults.configure("archive.get-fail", count=2)
+    app.faults.configure("archive.corrupt", count=1, after=3)
+    app.faults.configure("archive.short-read", count=1, after=5)
+    work = app.catchup_manager.start_catchup()
+    for _ in range(300000):
+        if work.is_done():
+            break
+        app.crank(False)
+    from stellar_core_tpu.work.basic_work import State
+    assert work.state == State.SUCCESS, "catchup failed under archive chaos"
+    assert app.ledger_manager.last_closed_ledger_num() >= 2 * FREQ - 1
+    # the injuries actually happened and the pool failed over
+    mjson = app.metrics.to_json()
+    assert mjson["fault.injected.archive.get-fail"]["count"] == 2
+    assert mjson["fault.injected.archive.corrupt"]["count"] == 1
+    pool = app.history_manager.readable_pool()
+    js = pool.to_json()
+    assert js["failovers"] >= 1
+    assert sum(h["failures"] for h in js["archives"].values()) >= 1
+    # replayed chain matches the publisher's
+    row = pub.database.execute(
+        "SELECT ledgerhash FROM ledgerheaders WHERE ledgerseq = ?",
+        (app.ledger_manager.last_closed_ledger_num(),)).fetchone()
+    assert row is not None
+    assert app.ledger_manager.lcl_hash.hex() == row[0]
+
+
+@pytest.mark.chaos
+def test_catchup_fails_over_from_corrupt_has(tmp_path):
+    """A corrupt HistoryArchiveState JSON (the very first catchup
+    download) blames the serving archive and the retry re-fetches it
+    from the other one."""
+    import shutil
+    rnd.reseed(17)
+    roots = {"a": tmp_path / "archive-a", "b": tmp_path / "archive-b"}
+    os.makedirs(roots["a"], exist_ok=True)
+    pub = _make_app(tmp_path, 0, {"a": roots["a"]}, writable=True)
+    while pub.ledger_manager.last_closed_ledger_num() < FREQ + 2:
+        pub.manual_close()
+    pub.crank_until(lambda: pub.history_manager.publish_queue() == [],
+                    max_cranks=20000)
+
+    # archive b = copy of a with an unparseable well-known HAS; the
+    # fresh pool prefers "b" on the tie-break, so the corrupt file is
+    # what catchup reads first
+    shutil.copytree(roots["a"], roots["b"])
+    with open(roots["b"] / ".well-known" / "stellar-history.json",
+              "w") as f:
+        f.write("{ not json")
+    app = _make_app(tmp_path, 1, roots, writable=False)
+    pool = app.history_manager.readable_pool()
+    assert pool.pick().name == "b"
+    work = app.catchup_manager.start_catchup()
+    for _ in range(300000):
+        if work.is_done():
+            break
+        app.crank(False)
+    from stellar_core_tpu.work.basic_work import State
+    assert work.state == State.SUCCESS
+    assert app.ledger_manager.last_closed_ledger_num() >= FREQ - 1
+    assert pool.to_json()["archives"]["b"]["failures"] >= 1
+
+
+@pytest.mark.chaos
+def test_catchup_fails_over_from_dead_archive(tmp_path):
+    """One archive of the pair is entirely absent on disk: every download
+    from it fails, health collapses, and catchup completes from the
+    healthy one."""
+    rnd.reseed(13)
+    roots = {"a": tmp_path / "archive-a", "b": tmp_path / "archive-b"}
+    os.makedirs(roots["a"], exist_ok=True)
+    pub = _make_app(tmp_path, 0, {"a": roots["a"]}, writable=True)
+    adapter = AppLedgerAdapter(pub)
+    root = adapter.root_account()
+    while pub.ledger_manager.last_closed_ledger_num() < FREQ + 2:
+        pub.manual_close()
+    pub.crank_until(lambda: pub.history_manager.publish_queue() == [],
+                    max_cranks=20000)
+    del adapter, root
+
+    # the catching-up node believes in BOTH archives; "b" never existed
+    os.makedirs(roots["b"], exist_ok=True)   # empty dir: every get fails
+    app = _make_app(tmp_path, 1, roots, writable=False)
+    work = app.catchup_manager.start_catchup()
+    for _ in range(300000):
+        if work.is_done():
+            break
+        app.crank(False)
+    from stellar_core_tpu.work.basic_work import State
+    assert work.state == State.SUCCESS
+    assert app.ledger_manager.last_closed_ledger_num() >= FREQ - 1
+    pool = app.history_manager.readable_pool()
+    health = pool.to_json()["archives"]
+    # "b" may or may not have been probed first, but if it was, its
+    # failures are recorded and "a" carried the catchup
+    assert health["a"]["successes"] > 0
