@@ -427,6 +427,55 @@ def chaos_smoke(n_ledgers: int = 30, txs_per_ledger: int = 10) -> dict:
     return out
 
 
+def fleet_bench(n_nodes: int = 3, n_ledgers: int = 12) -> dict:
+    """`bench.py --fleet`: the multi-node leg (ISSUE 4;
+    docs/observability.md#fleet-view). Runs an n-node loopback
+    simulation with per-node tracing on, closes >= n_ledgers ledgers,
+    and reports the fleet aggregate — slot-latency p50/p95, externalize
+    skew across nodes, straggler counts — from the merged slot
+    timelines. Pure Python (no jax import): safe to run inline."""
+    from stellar_core_tpu.simulation import topologies
+    from stellar_core_tpu.util import rnd
+
+    rnd.reseed(0xF1EE7)
+    sim = topologies.core(
+        n_nodes, max(2, (n_nodes * 2 + 1) // 3),
+        cfg_tweak=lambda c: setattr(c, "TRACE_ENABLED", True))
+    sim.start_all_nodes()
+    target = 1 + n_ledgers   # genesis is seq 1; n_ledgers consensus closes
+    ok = sim.crank_until(lambda: sim.have_all_externalized(target),
+                         200000)
+    agg = sim.fleet()     # one aggregation feeds both views
+    stats = agg.fleet_stats()
+    trace = agg.merged_chrome_trace()
+    summary = stats["summary"]
+    out = {
+        "metric": "fleet_slot_latency",
+        "unit": "ms",
+        "nodes": n_nodes,
+        "ledgers_closed": min(
+            n.app.ledger_manager.last_closed_ledger_num()
+            for n in sim.nodes.values()) - 1,
+        "converged": bool(ok),
+        "fleet": {
+            "slot_count": summary["slot_count"],
+            "slot_latency_p50_ms": round(
+                summary["slot_latency_p50_s"] * 1e3, 3),
+            "slot_latency_p95_ms": round(
+                summary["slot_latency_p95_s"] * 1e3, 3),
+            "externalize_skew_p50_ms": round(
+                summary["externalize_skew_p50_s"] * 1e3, 3),
+            "externalize_skew_max_ms": round(
+                summary["externalize_skew_max_s"] * 1e3, 3),
+            "stragglers": summary["stragglers"],
+            "trace_events": len(trace["traceEvents"]),
+            "dropped_spans": trace["dropped_spans"],
+        },
+    }
+    sim.stop_all_nodes()
+    return out
+
+
 def _scrubbed_cpu_env() -> dict:
     # single source of truth for the axon-env scrub lives in __graft_entry__
     from __graft_entry__ import _scrubbed_env
@@ -780,5 +829,10 @@ if __name__ == "__main__":
         # chaos smoke leg: close-latency p95 with faults on vs off; does
         # not touch jax or the device relay
         print(json.dumps(chaos_smoke()))
+    elif "--fleet" in sys.argv:
+        # multi-node leg: 3-node consensus with merged timelines; emits
+        # the `fleet` block (slot-latency p50/p95, externalize skew);
+        # does not touch jax or the device relay
+        print(json.dumps(fleet_bench()))
     else:
         main()

@@ -47,8 +47,10 @@ class HerderSCPDriver(SCPDriver):
     def __init__(self, herder: "Herder") -> None:
         self.herder = herder
         # SCPDriver trace hooks (scp/driver.py) emit ballot/nomination
-        # instants against the application tracer
+        # instants against the application tracer, and journal the same
+        # progression into the per-slot timeline (always on)
         self.tracer = getattr(herder.app, "tracer", None)
+        self.timeline = getattr(herder.app, "slot_timeline", None)
 
     # -- envelope signing ----------------------------------------------------
     def _envelope_sign_bytes(self, st) -> bytes:
@@ -552,6 +554,17 @@ class Herder:
     def recv_tx_set(self, h: bytes, txset: TxSetFrame) -> bool:
         if txset.get_contents_hash() != h:
             return False
+        tl = getattr(self.app, "slot_timeline", None)
+        if tl is not None and txset.previous_ledger_hash == \
+                self.app.ledger_manager.lcl_hash:
+            # journal only txsets actually pinned to the OPEN slot
+            # (previous_ledger_hash == LCL): a late fetch for an
+            # already-closed slot must not be misfiled under the next
+            # one. Dedupe by hash, not sender — two competing nominated
+            # txsets are two distinct fetch records.
+            tl.record(self.current_slot(), "txset.fetched", dedupe=True,
+                      dedupe_key=h.hex(),
+                      hash=h.hex()[:8], txs=len(txset.frames))
         self.pending.add_tx_set(h, txset)
         return True
 
@@ -613,6 +626,10 @@ class Herder:
         m = self._metrics()
         if m is not None:
             m.new_meter("scp.value.nominated").mark()
+        tl = getattr(self.app, "slot_timeline", None)
+        if tl is not None:
+            tl.record(slot, "nominate.trigger", dedupe=True,
+                      txs=len(txset.frames))
         self.scp.nominate(slot, value.to_xdr(), prev)
 
     def _arm_trigger_timer(self) -> None:
@@ -646,6 +663,11 @@ class Herder:
             tracer.instant("scp.externalize", cat="scp", slot=slot_index,
                            **({} if lat is None else
                               {"nominate_to_externalize_s": round(lat, 6)}))
+        tl = getattr(self.app, "slot_timeline", None)
+        if tl is not None:
+            tl.record(slot_index, "externalize", dedupe=True,
+                      **({} if lat is None else
+                         {"nominate_to_externalize_s": round(lat, 6)}))
         sv = StellarValue.from_xdr(value)
         txset = self.pending.get_tx_set(sv.txSetHash)
         assert txset is not None, "externalized unknown txset"

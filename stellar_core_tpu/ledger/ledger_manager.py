@@ -221,6 +221,13 @@ class LedgerManager:
                     len(lcd.tx_set.frames))
                 metrics.new_counter("ledger.ledger.num").set_count(
                     lcd.ledger_seq)
+            tl = getattr(self.app, "slot_timeline", None)
+            if tl is not None:
+                # closes the slot's journal: externalize → applied is the
+                # local apply cost the fleet view separates from
+                # propagation skew
+                tl.record(lcd.ledger_seq, "ledger.applied",
+                          txs=len(lcd.tx_set.frames))
         except BaseException as e:
             if ltx._open:
                 ltx.rollback()   # drop children too: no dangling state

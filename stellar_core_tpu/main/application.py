@@ -52,7 +52,15 @@ class Application:
             self.tracer.enable()
         self.flight_recorder = FlightRecorder(
             self.tracer, metrics=self.metrics,
-            out_dir=config.FLIGHT_RECORDER_DIR or None)
+            out_dir=config.FLIGHT_RECORDER_DIR or None,
+            node_name=config.node_name(), now_fn=clock.now)
+
+        # per-slot consensus event journal (util/slot_timeline.py):
+        # always on (one dict append per event), fed by SCP/herder/ledger
+        # hooks and merged fleet-wide by util/fleet.py
+        from ..util.slot_timeline import SlotTimeline
+        self.slot_timeline = SlotTimeline(
+            now_fn=clock.now, max_slots=config.SLOT_TIMELINE_SLOTS)
 
         # fault injector (util/faults.py): armed from config and/or the
         # SCT_FAULTS env spec; every subsystem reaches it through

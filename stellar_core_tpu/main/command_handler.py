@@ -24,6 +24,31 @@ from ..util.log import get_log_levels, get_logger, set_log_level
 log = get_logger("Overlay")
 
 
+class CommandParamError(ValueError):
+    """A malformed request parameter: surfaces as a 400 with an error
+    dict instead of a 500 stack trace out of the HTTP thread."""
+
+
+def _int_param(params: Dict[str, str], key: str,
+               default: Optional[int] = None,
+               minimum: Optional[int] = None) -> Optional[int]:
+    """Validated numeric query param: non-numeric or below-minimum
+    values raise CommandParamError (-> 400) rather than ValueError deep
+    inside a handler."""
+    raw = params.get(key)
+    if raw is None or raw == "":
+        return default
+    try:
+        v = int(raw)
+    except (TypeError, ValueError):
+        raise CommandParamError(
+            "parameter %r must be an integer, got %r" % (key, raw))
+    if minimum is not None and v < minimum:
+        raise CommandParamError(
+            "parameter %r must be >= %d, got %d" % (key, minimum, v))
+    return v
+
+
 class CommandHandler:
     def __init__(self, app) -> None:
         self.app = app
@@ -32,14 +57,18 @@ class CommandHandler:
 
     # -- dispatch ------------------------------------------------------------
     def handle_command(self, name: str,
-                       params: Dict[str, str]) -> Tuple[int, dict]:
-        """Returns (http_status, json-serializable body)."""
+                       params: Dict[str, str]) -> Tuple[int, object]:
+        """Returns (http_status, body) — body is a JSON-serializable
+        dict, or a plain string served as text/plain (the Prometheus
+        exposition path)."""
         fn = getattr(self, "cmd_" + name.replace("-", "_"), None)
         if fn is None:
             return 404, {"error": "unknown command %r" % name,
                          "commands": self.command_names()}
         try:
             return 200, fn(params)
+        except CommandParamError as e:
+            return 400, {"error": str(e)}
         except Exception as e:
             return 500, {"error": "%s: %s" % (type(e).__name__, e)}
 
@@ -66,10 +95,13 @@ class CommandHandler:
         info["ledger"]["synced"] = lm.is_synced()
         return info
 
-    def cmd_metrics(self, params) -> dict:
-        """`metrics[?filter=<prefix>]` — with a filter, only metrics whose
-        name starts with the prefix are serialized (operators and tests
-        fetch `crypto.` or `ledger.` without paying for the registry)."""
+    def cmd_metrics(self, params):
+        """`metrics[?filter=<prefix>][&format=prometheus]` — with a
+        filter, only metrics whose name starts with the prefix are
+        serialized (operators and tests fetch `crypto.` or `ledger.`
+        without paying for the registry); `format=prometheus` renders
+        the same export in text exposition format for standard scrapers
+        (docs/metrics.md#prometheus-exposition)."""
         prefix = params.get("filter") or None
         out = self.app.metrics.to_json(prefix=prefix)
         # crypto-boundary metrics live outside the registry (global cache,
@@ -86,6 +118,9 @@ class CommandHandler:
             out["crypto.verify.sigs"] = {"count": inner.sigs_verified}
         if prefix:
             out = {k: v2 for k, v2 in out.items() if k.startswith(prefix)}
+        if params.get("format") == "prometheus":
+            from ..util.metrics import render_prometheus
+            return render_prometheus(out)
         return out
 
     def cmd_trace(self, params) -> dict:
@@ -98,8 +133,8 @@ class CommandHandler:
         tracer = self.app.tracer
         action = params.get("action", "dump")
         if action == "start":
-            cap = params.get("capacity")
-            tracer.enable(capacity=int(cap) if cap else None)
+            cap = _int_param(params, "capacity", None, minimum=1)
+            tracer.enable(capacity=cap)
             return {"status": "tracing", "capacity": tracer.capacity}
         if action == "stop":
             tracer.disable()
@@ -121,9 +156,8 @@ class CommandHandler:
                 params.get("reason", "manual"), force=True)
             return {"status": "dumped", "path": path}
         if action == "dump":
-            limit = params.get("limit")
-            return tracer.to_chrome_trace(
-                last_n=int(limit) if limit else None)
+            limit = _int_param(params, "limit", None, minimum=0)
+            return tracer.to_chrome_trace(last_n=limit)
         return {"error": "action must be "
                          "status|start|stop|clear|dump|flight"}
 
@@ -184,11 +218,30 @@ class CommandHandler:
         return h.check_quorum_intersection(critical=crit)
 
     def cmd_scp(self, params) -> dict:
+        """`scp[?limit=N][&slot=N&timeline=true]` — SCP slot
+        introspection; with `slot` + `timeline=true` the response also
+        carries that slot's consensus event journal
+        (util/slot_timeline.py, docs/observability.md#fleet-view)."""
         h = self.app.herder
-        limit = int(params.get("limit", 2))
+        limit = _int_param(params, "limit", 2, minimum=0)
         scp = getattr(h, "scp", None)
         out = scp.get_json_info(limit) if scp is not None else {}
         out["tracking"] = h.current_slot()
+        slot = _int_param(params, "slot", None, minimum=0)
+        if slot is not None and params.get("timeline") in ("true", "1"):
+            out["timeline"] = self.app.slot_timeline.events(slot)
+        return out
+
+    def cmd_timeline(self, params) -> dict:
+        """`timeline[?slot=N]` — the per-slot consensus event journal:
+        one slot's events, or every retained slot. Events are stamped
+        with the app clock (`t`) and `perf_counter` (`pc`); `node` names
+        the sending node where applicable. The fleet aggregator
+        (util/fleet.py) consumes this endpoint on live nodes."""
+        slot = _int_param(params, "slot", None, minimum=0)
+        out = self.app.slot_timeline.to_json(slot)
+        out["node"] = self.app.config.node_name()
+        out["node_id"] = self.app.config.node_id().key_bytes.hex()
         return out
 
     # -- transactions --------------------------------------------------------
@@ -320,7 +373,10 @@ class CommandHandler:
         return {"status": "stopped"}
 
     def cmd_getsurveyresult(self, params) -> dict:
-        return self.app.overlay_manager.survey_manager.get_results()
+        sm = self.app.overlay_manager.survey_manager
+        # "stats" is the compact shape the fleet aggregator stores for
+        # every node (util/fleet.py add_http mirrors add_app.get_stats)
+        return {**sm.get_results(), "stats": sm.get_stats()}
 
     def cmd_loadinfo(self, params) -> dict:
         return {"load": self.app.overlay_manager.load_manager
@@ -470,10 +526,18 @@ class CommandHandler:
                 status, body = result[0]
                 self._reply(status, body)
 
-            def _reply(self, status: int, body: dict) -> None:
-                data = json.dumps(body, indent=1).encode()
+            def _reply(self, status: int, body) -> None:
+                if isinstance(body, str):
+                    # Prometheus exposition (and any future text body):
+                    # version=0.0.4 is the text-format content type
+                    # scrapers negotiate on
+                    data = body.encode()
+                    ctype = "text/plain; version=0.0.4; charset=utf-8"
+                else:
+                    data = json.dumps(body, indent=1).encode()
+                    ctype = "application/json"
                 self.send_response(status)
-                self.send_header("Content-Type", "application/json")
+                self.send_header("Content-Type", ctype)
                 self.send_header("Content-Length", str(len(data)))
                 self.end_headers()
                 self.wfile.write(data)

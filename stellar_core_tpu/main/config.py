@@ -32,6 +32,9 @@ class Config:
         self.NODE_SEED: Optional[SecretKey] = None
         self.NODE_IS_VALIDATOR = True
         self.NODE_HOME_DOMAIN = ""
+        # human-readable node name: flight-recorder filenames, fleet
+        # aggregation lanes; defaults to the strkey prefix (node_name())
+        self.NODE_NAME = ""
         self.QUORUM_SET: Optional[SCPQuorumSet] = None
         self.UNSAFE_QUORUM = False
         self.FAILURE_SAFETY = -1
@@ -128,6 +131,9 @@ class Config:
         # `trace` endpoint. Capacity bounds the span ring buffer.
         self.TRACE_ENABLED = False
         self.TRACE_CAPACITY = 16384
+        # per-slot consensus event journal (util/slot_timeline.py):
+        # always on; bounds how many recent slots are retained
+        self.SLOT_TIMELINE_SLOTS = 64
         # flight-recorder dump directory ("" = the SCT_FLIGHT_DIR env
         # override, else the system tempdir); dumps fire on unhandled
         # close exceptions and SCP-stall / slow-close watchdog triggers
@@ -150,6 +156,15 @@ class Config:
     def node_id(self) -> PublicKey:
         assert self.NODE_SEED is not None
         return self.NODE_SEED.public_key
+
+    def node_name(self) -> str:
+        """Display name: explicit NODE_NAME, else the strkey prefix the
+        simulation layer also uses for node naming."""
+        if self.NODE_NAME:
+            return self.NODE_NAME
+        if self.NODE_SEED is not None:
+            return self.NODE_SEED.strkey_public()[:5]
+        return "node"
 
     def self_qset(self) -> SCPQuorumSet:
         return SCPQuorumSet(threshold=1, validators=[self.node_id()],
@@ -176,6 +191,7 @@ class Config:
             "INVARIANT_CHECKS", "WORKER_THREADS",
             "MAX_CONCURRENT_SUBPROCESSES", "SIG_VERIFY_BACKEND",
             "SIG_VERIFY_MAX_BATCH", "TRACE_ENABLED", "TRACE_CAPACITY",
+            "SLOT_TIMELINE_SLOTS", "NODE_NAME",
             "FLIGHT_RECORDER_DIR", "CHECKPOINT_FREQUENCY",
             "CATCHUP_COMPLETE", "CATCHUP_RECENT",
             "PEER_TIMEOUT", "PEER_STRAGGLER_TIMEOUT",

@@ -247,3 +247,13 @@ class SurveyManager:
         return {"surveyInProgress": self.running,
                 "badResponses": self.bad_responses,
                 "topology": self.results}
+
+    def get_stats(self) -> dict:
+        """Compact survey health for the fleet aggregate (util/fleet.py):
+        enough to see, across N nodes at once, who surveyed whom and who
+        dropped responses — without shipping full topologies."""
+        return {"running": self.running,
+                "surveyed": len(self._surveyed),
+                "results": len(self.results),
+                "backlog": len(self._backlog),
+                "bad_responses": self.bad_responses}

@@ -81,6 +81,14 @@ class BallotProtocol:
     def _driver(self):
         return self.slot.scp.driver
 
+    def _journal_phase(self, phase_name: str, **tags) -> None:
+        """Ballot phase transitions (PREPARE→CONFIRM→EXTERNALIZE) into
+        the per-slot timeline (util/slot_timeline.py)."""
+        tl = getattr(self.slot.scp.driver, "timeline", None)
+        if tl is not None:
+            tl.record(self.slot.slot_index, "ballot.phase." + phase_name,
+                      dedupe=True, **tags)
+
     def _local(self) -> LocalNode:
         return self.slot.scp.local_node
 
@@ -614,6 +622,7 @@ class BallotProtocol:
             did = True
         if self.phase == SCPPhase.PREPARE:
             self.phase = SCPPhase.CONFIRM
+            self._journal_phase("confirm", counter=h[0])
             if self.b is not None and not less_and_compatible(h, self.b):
                 self._bump_to_ballot(h, False)
             self.pp = None
@@ -650,6 +659,7 @@ class BallotProtocol:
         self.h = h
         self._update_current_if_needed(h)
         self.phase = SCPPhase.EXTERNALIZE
+        self._journal_phase("externalize", counter=c[0])
         self._emit_current_statement()
         self.slot.stop_nomination()
         self._driver().value_externalized(self.slot.slot_index, c[1])

@@ -34,11 +34,20 @@ class SCPDriver:
     # progression between the close spans. None (the default) keeps
     # standalone/test drivers silent.
     tracer = None
+    # per-slot event journal (util/slot_timeline.py). When attached, the
+    # same hooks (plus the envelope-seen sites in scp/slot.py and the
+    # vote/accept sites in scp/nomination.py) journal the slot's
+    # consensus progression for the fleet aggregator — always on, unlike
+    # the tracer.
+    timeline = None
 
     def _trace_instant(self, name: str, slot_index: int, **tags) -> None:
         from ..util.tracing import tracer_instant
         tracer_instant(self.tracer, name, cat="scp", slot=slot_index,
                        **tags)
+        tl = self.timeline
+        if tl is not None:
+            tl.record(slot_index, name, dedupe=True, **tags)
 
     # -- values -------------------------------------------------------------
     def validate_value(self, slot_index: int, value: bytes,

@@ -33,6 +33,13 @@ class NominationProtocol:
     def _driver(self):
         return self.slot.scp.driver
 
+    def _journal(self, event: str, **tags) -> None:
+        """First occurrence of a nomination milestone (own vote, value
+        accepted, candidate confirmed) into the slot timeline."""
+        tl = getattr(self.slot.scp.driver, "timeline", None)
+        if tl is not None:
+            tl.record(self.slot.slot_index, event, dedupe=True, **tags)
+
     def _local(self) -> LocalNode:
         return self.slot.scp.local_node
 
@@ -113,6 +120,7 @@ class NominationProtocol:
             v = self._pick_leader_value(envelope)
             if v is not None:
                 self.votes.add(v)
+                self._journal("nominate.vote", round=self.round_number)
                 self._driver().nominating_value(self.slot.slot_index, v)
                 modified = True
         # federated voting on each known value
@@ -130,12 +138,16 @@ class NominationProtocol:
                     v = alt
                 self.accepted.add(v)
                 self.votes.add(v)
+                self._journal("nominate.accept",
+                              accepted=len(self.accepted))
                 modified = True
         for v in sorted(self.accepted):
             if v in self.candidates:
                 continue
             if self._federated_ratify_value(v):
                 self.candidates.add(v)
+                self._journal("nominate.candidate",
+                              candidates=len(self.candidates))
                 new_candidates = True
         if modified:
             self._emit_nomination()
@@ -190,6 +202,7 @@ class NominationProtocol:
         if self._local().node_id.key_bytes in self.round_leaders:
             if value not in self.votes:
                 self.votes.add(value)
+                self._journal("nominate.vote", round=self.round_number)
                 modified = True
             self._driver().nominating_value(self.slot.slot_index, value)
         # regardless of own leadership, adopt the best new value from every

@@ -15,6 +15,16 @@ from ..xdr import (
 from .ballot import BallotProtocol
 from .nomination import NominationProtocol
 
+# slot-timeline event per statement type: "first time we saw <phase>
+# from <sender>" (dedup per sender keeps the journal bounded while
+# preserving first-arrival times for fleet flood-latency attribution)
+_SEEN_EVENT = {
+    SCPStatementType.SCP_ST_NOMINATE: "nominate.seen",
+    SCPStatementType.SCP_ST_PREPARE: "prepare.seen",
+    SCPStatementType.SCP_ST_CONFIRM: "confirm.seen",
+    SCPStatementType.SCP_ST_EXTERNALIZE: "externalize.seen",
+}
+
 
 class Slot:
     def __init__(self, slot_index: int, scp) -> None:
@@ -35,6 +45,12 @@ class Slot:
                          is_self: bool = False) -> int:
         st = envelope.statement
         assert st.slotIndex == self.slot_index
+        tl = getattr(self.scp.driver, "timeline", None)
+        if tl is not None and not is_self and \
+                st.nodeID.key_bytes != self.scp.local_node.node_id.key_bytes:
+            # a flood echo of our own statement is not a peer arrival
+            tl.record(self.slot_index, _SEEN_EVENT[st.pledges.disc],
+                      node=st.nodeID.key_bytes.hex(), dedupe=True)
         if st.pledges.disc == SCPStatementType.SCP_ST_NOMINATE:
             return self.nomination.process_envelope(envelope)
         return self.ballot.process_envelope(envelope, is_self)

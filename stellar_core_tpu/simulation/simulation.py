@@ -89,6 +89,9 @@ class Simulation:
         cfg = Config()
         cfg.NETWORK_PASSPHRASE = self.network_passphrase
         cfg.NODE_SEED = secret
+        # sim node name flows into flight-recorder filenames and the
+        # fleet aggregator's process lanes
+        cfg.NODE_NAME = name
         cfg.NODE_IS_VALIDATOR = True
         cfg.QUORUM_SET = qset
         cfg.UNSAFE_QUORUM = True
@@ -238,6 +241,23 @@ class Simulation:
     def have_all_externalized(self, seq: int) -> bool:
         return all(n.app.ledger_manager.last_closed_ledger_num() >= seq
                    for n in self.nodes.values())
+
+    # -- fleet observability (util/fleet.py) --------------------------------
+    def fleet(self):
+        """FleetAggregator over every node: merged Chrome trace (one
+        lane per node) + per-slot cross-node stats. In-process nodes
+        share one perf_counter, so no rebasing is needed here."""
+        from ..util.fleet import FleetAggregator
+        agg = FleetAggregator()
+        for name, node in self.nodes.items():
+            agg.add_app(name, node.app)
+        return agg
+
+    def merged_chrome_trace(self) -> dict:
+        return self.fleet().merged_chrome_trace()
+
+    def fleet_stats(self) -> dict:
+        return self.fleet().fleet_stats()
 
     def stop_all_nodes(self) -> None:
         for n in self.nodes.values():

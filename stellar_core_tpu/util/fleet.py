@@ -1,0 +1,293 @@
+"""Fleet aggregation: N nodes' traces + slot timelines on one axis.
+
+Role parity: none in the reference — operators eyeball N dashboards.
+The committee-consensus measurement literature (arXiv:2302.00418, DSig
+arXiv:2406.07215 in PAPERS.md) attributes commit latency fleet-wide:
+propagation and stragglers dominate, per-node compute doesn't. This
+module merges every node's span ring (util/tracing.py) and slot
+timeline (util/slot_timeline.py) into
+
+- one Chrome-trace JSON with one *process lane per node* (metadata
+  `process_name` events), timeline events injected as instants — drop
+  the file in chrome://tracing / Perfetto and read a slot across the
+  quorum;
+- per-slot fleet stats: externalize skew across nodes, flood latency
+  from first sender to last receiver, straggler attribution, and
+  slot-latency percentiles — what `bench.py --fleet` records as the
+  `fleet` block.
+
+Alignment: timeline events carry two stamps (util/slot_timeline.py) —
+`t` (per-node app clock) and `pc` (`time.perf_counter()`). In-process
+simulations share one perf_counter, so `pc` IS the fleet clock there.
+Against live HTTP nodes each host's perf_counter has its own epoch;
+the aggregator then rebases each node so its first externalize of the
+earliest common slot lands at the same instant (`align="externalize"`),
+which preserves intra-node deltas and makes skew read as dispersion
+around that anchor — exact cross-host clock sync is explicitly out of
+scope (NTP is assumed for wall-clock interpretation).
+"""
+
+from __future__ import annotations
+
+import json
+from typing import Dict, List, Optional
+
+from .metrics import Histogram
+
+SEEN_SUFFIX = ".seen"
+
+
+def _percentile(values: List[float], q: float) -> float:
+    # one quantile semantics repo-wide: reuse the histogram pick
+    return Histogram._pick(sorted(values), q)
+
+
+class FleetAggregator:
+    """Collects per-node observability exports and merges them.
+
+    Nodes are added either in-process (`add_app`, the simulation path)
+    or from a live admin API (`add_http`). Every node entry holds the
+    same shape: name, node_id (hex), chrome trace dict, timeline JSON,
+    optional survey stats — so the merge/stat code has one input form.
+    """
+
+    def __init__(self) -> None:
+        self.nodes: List[dict] = []
+
+    # -- intake --------------------------------------------------------------
+    def add_app(self, name: str, app) -> None:
+        survey = None
+        sm = getattr(getattr(app, "overlay_manager", None),
+                     "survey_manager", None)
+        if sm is not None:
+            survey = sm.get_stats()
+        self.nodes.append({
+            "name": name,
+            "node_id": app.config.node_id().key_bytes.hex(),
+            "trace": app.tracer.to_chrome_trace(),
+            "timeline": app.slot_timeline.to_json(),
+            "survey": survey,
+        })
+
+    def add_http(self, base_url: str, name: Optional[str] = None,
+                 timeout: float = 5.0) -> None:
+        """Aggregate a live node via its admin API: `timeline`,
+        `trace?action=dump`, and `getsurveyresult`."""
+        from urllib.request import urlopen
+
+        def get(path: str) -> Optional[dict]:
+            try:
+                with urlopen(base_url.rstrip("/") + path,
+                             timeout=timeout) as r:
+                    return json.loads(r.read().decode())
+            except Exception:
+                return None
+
+        tl = get("/timeline")
+        if tl is None:
+            raise RuntimeError("node %s: timeline endpoint unreachable"
+                               % base_url)
+        # same compact shape as add_app's get_stats() — the endpoint
+        # carries it under "stats" precisely so both intake paths store
+        # one input form
+        survey = (get("/getsurveyresult") or {}).get("stats")
+        self.nodes.append({
+            "name": name or tl.get("node") or base_url,
+            "node_id": tl.get("node_id"),
+            "trace": get("/trace") or {"traceEvents": []},
+            "timeline": tl,
+            "survey": survey,
+        })
+
+    # -- cross-host alignment ------------------------------------------------
+    def rebase_on_externalize(self) -> bool:
+        """Live-node alignment: pick the earliest slot every node
+        externalized, and shift each node's `pc` stamps (timeline AND
+        span ring) so those externalize events coincide. Intra-node
+        deltas are preserved; cross-node skew for *other* slots then
+        reads as dispersion around the anchor. Returns False (no-op)
+        when the nodes share no externalized slot."""
+        per_node_ext: List[Dict[int, float]] = []
+        for node in self.nodes:
+            exts: Dict[int, float] = {}
+            tl = node.get("timeline") or {}
+            for slot_str, evs in tl.get("slots", {}).items():
+                for ev in evs:
+                    if ev["event"] == "externalize":
+                        exts.setdefault(int(slot_str), ev["pc"])
+            per_node_ext.append(exts)
+        if not per_node_ext:
+            return False
+        common = set(per_node_ext[0])
+        for exts in per_node_ext[1:]:
+            common &= set(exts)
+        if not common:
+            return False
+        anchor = min(common)
+        anchors = [exts[anchor] for exts in per_node_ext]
+        base = min(anchors)
+        for node, at in zip(self.nodes, anchors):
+            off = at - base
+            if off == 0.0:
+                continue
+            tl = node.get("timeline") or {}
+            for evs in tl.get("slots", {}).values():
+                for ev in evs:
+                    ev["pc"] -= off
+            trace = node.get("trace") or {}
+            for ev in trace.get("traceEvents", ()):
+                if "ts" in ev:
+                    ev["ts"] -= off * 1e6
+        return True
+
+    # -- name resolution -----------------------------------------------------
+    def _id_to_name(self) -> Dict[str, str]:
+        return {n["node_id"]: n["name"] for n in self.nodes
+                if n.get("node_id")}
+
+    def resolve(self, node_id_hex: Optional[str]) -> str:
+        if node_id_hex is None:
+            return "?"
+        return self._id_to_name().get(node_id_hex, node_id_hex[:8])
+
+    # -- merged Chrome trace -------------------------------------------------
+    def merged_chrome_trace(self) -> dict:
+        """One process lane per node: every node's span-ring events get
+        that node's pid, plus its timeline events injected as instant
+        events (`timeline.<event>`, cat `slot`) so the consensus journal
+        and the span view line up on one axis."""
+        events: List[dict] = []
+        dropped = 0
+        id2name = self._id_to_name()
+        for i, node in enumerate(self.nodes):
+            events.append({"name": "process_name", "ph": "M", "pid": i,
+                           "tid": 0, "args": {"name": node["name"]}})
+            events.append({"name": "process_sort_index", "ph": "M",
+                           "pid": i, "tid": 0, "args": {"sort_index": i}})
+            trace = node.get("trace") or {}
+            dropped += trace.get("dropped_spans", 0)
+            for ev in trace.get("traceEvents", ()):
+                ev = dict(ev)
+                ev["pid"] = i
+                events.append(ev)
+            tl = node.get("timeline") or {}
+            for slot_str, evs in sorted(tl.get("slots", {}).items(),
+                                        key=lambda kv: int(kv[0])):
+                for ev in evs:
+                    args = {k: v for k, v in ev.items()
+                            if k not in ("event", "pc")}
+                    args["slot"] = int(slot_str)
+                    if "node" in args:
+                        args["node"] = id2name.get(
+                            args["node"], (args["node"] or "?")[:8])
+                    events.append({
+                        "name": "timeline.%s" % ev["event"],
+                        "cat": "slot", "ph": "i", "s": "t",
+                        "ts": round(ev["pc"] * 1e6, 1),
+                        "pid": i, "tid": 0, "args": args})
+        return {"traceEvents": events, "displayTimeUnit": "ms",
+                "dropped_spans": dropped,
+                "nodes": [n["name"] for n in self.nodes]}
+
+    # -- per-slot fleet stats ------------------------------------------------
+    def _slot_events(self) -> Dict[int, Dict[str, List[dict]]]:
+        """slot -> node name -> that node's journal for the slot."""
+        out: Dict[int, Dict[str, List[dict]]] = {}
+        for node in self.nodes:
+            tl = node.get("timeline") or {}
+            for slot_str, evs in tl.get("slots", {}).items():
+                out.setdefault(int(slot_str), {})[node["name"]] = list(evs)
+        return out
+
+    def fleet_stats(self) -> dict:
+        """Per-slot cross-node stats + fleet summary percentiles.
+
+        Per slot:
+        - `externalize`: skew (max-min externalize `pc` across nodes),
+          first/last node, straggler = last node with its lag;
+        - `flood`: the earliest envelope-seen event names the first
+          *sender* (flood origin); latency runs from that first arrival
+          to the last arrival anywhere in the fleet;
+        - `slot_latency_s`: first timeline activity anywhere -> last
+          externalize anywhere — the whole-quorum slot cost.
+        """
+        by_slot = self._slot_events()
+        id2name = self._id_to_name()
+        slots: Dict[str, dict] = {}
+        latencies: List[float] = []
+        skews: List[float] = []
+        stragglers: Dict[str, int] = {}
+        for slot in sorted(by_slot):
+            per_node = by_slot[slot]
+            entry: dict = {}
+            ext = {}
+            first_pc = None
+            for name, evs in per_node.items():
+                for ev in evs:
+                    pc = ev["pc"]
+                    if first_pc is None or pc < first_pc:
+                        first_pc = pc
+                    if ev["event"] == "externalize" and name not in ext:
+                        ext[name] = ev
+            if ext:
+                ordered = sorted(ext.items(), key=lambda kv: kv[1]["pc"])
+                lo, hi = ordered[0], ordered[-1]
+                skew = hi[1]["pc"] - lo[1]["pc"]
+                entry["externalize"] = {
+                    "nodes": len(ext), "skew_s": round(skew, 6),
+                    "first": lo[0], "last": hi[0],
+                    "straggler": hi[0], "lag_s": round(skew, 6),
+                }
+                full = len(ext) == len(self.nodes)
+                if full and len(self.nodes) > 1:
+                    skews.append(skew)
+                    stragglers[hi[0]] = stragglers.get(hi[0], 0) + 1
+                if first_pc is not None:
+                    lat = hi[1]["pc"] - first_pc
+                    entry["slot_latency_s"] = round(lat, 6)
+                    # summary percentiles only over fully-observed slots:
+                    # a slot some node's ring already evicted would feed
+                    # a truncated latency and bias p50/p95 downward
+                    if full:
+                        latencies.append(lat)
+            seen = []
+            for name, evs in per_node.items():
+                for ev in evs:
+                    if ev["event"].endswith(SEEN_SUFFIX):
+                        seen.append((ev["pc"], name, ev))
+            if seen:
+                seen.sort(key=lambda t: t[0])
+                first = seen[0]
+                last = seen[-1]
+                entry["flood"] = {
+                    "first_sender": id2name.get(
+                        first[2].get("node"),
+                        (first[2].get("node") or "?")[:8]),
+                    "first_seen_by": first[1],
+                    "last_seen_by": last[1],
+                    "latency_s": round(last[0] - first[0], 6),
+                    "arrivals": len(seen),
+                }
+            if entry:
+                slots[str(slot)] = entry
+        out = {
+            "nodes": [n["name"] for n in self.nodes],
+            "slots": slots,
+            "summary": {
+                "slot_count": len(slots),
+                "slot_latency_p50_s": round(
+                    _percentile(latencies, 0.50), 6),
+                "slot_latency_p95_s": round(
+                    _percentile(latencies, 0.95), 6),
+                "externalize_skew_p50_s": round(
+                    _percentile(skews, 0.50), 6),
+                "externalize_skew_max_s": round(
+                    max(skews), 6) if skews else 0.0,
+                "stragglers": stragglers,
+            },
+        }
+        surveys = {n["name"]: n["survey"] for n in self.nodes
+                   if n.get("survey")}
+        if surveys:
+            out["survey"] = surveys
+        return out

@@ -9,6 +9,7 @@ EWMA; percentiles from a bounded reservoir.
 from __future__ import annotations
 
 import bisect
+import threading
 import time
 from collections import deque
 from typing import Deque, Callable, Dict, List
@@ -86,18 +87,25 @@ class Histogram:
         self.max = None
         self._samples: List[float] = []
         self._i = 0
+        # update vs snapshot: worker threads (threaded verify dispatch)
+        # update timers while the main loop exports — the lock makes the
+        # count/sum/reservoir capture one consistent cut (the sort runs
+        # on the copy, outside the lock)
+        self._lock = threading.Lock()
 
     def update(self, v: float) -> None:
-        self.count += 1
-        self.total += v
-        self.min = v if self.min is None else min(self.min, v)
-        self.max = v if self.max is None else max(self.max, v)
-        if len(self._samples) < self.MAX_SAMPLES:
-            self._samples.append(v)
-        else:
-            # deterministic ring replacement keeps a recent-biased reservoir
-            self._samples[self._i % self.MAX_SAMPLES] = v
-            self._i += 1
+        with self._lock:
+            self.count += 1
+            self.total += v
+            self.min = v if self.min is None else min(self.min, v)
+            self.max = v if self.max is None else max(self.max, v)
+            if len(self._samples) < self.MAX_SAMPLES:
+                self._samples.append(v)
+            else:
+                # deterministic ring replacement keeps a recent-biased
+                # reservoir
+                self._samples[self._i % self.MAX_SAMPLES] = v
+                self._i += 1
 
     @staticmethod
     def _pick(sorted_samples: List[float], q: float) -> float:
@@ -112,13 +120,28 @@ class Histogram:
     def mean(self) -> float:
         return self.total / self.count if self.count else 0.0
 
-    def to_json(self) -> dict:
-        # one sort shared by every percentile in the export
-        s = sorted(self._samples)
-        return {"type": "histogram", "count": self.count, "mean": self.mean(),
-                "min": self.min or 0.0, "max": self.max or 0.0,
+    def snapshot(self) -> dict:
+        """Atomic export: count/sum/min/max and the reservoir are
+        captured under the update lock, then the (single) sort runs on
+        the captured copy — so the quantiles always describe exactly the
+        population `count` reports, even with worker threads updating
+        mid-export. Every exporter (JSON, Prometheus) goes through
+        here."""
+        with self._lock:
+            count, total = self.count, self.total
+            mn, mx = self.min, self.max
+            samples = list(self._samples)
+        s = sorted(samples)
+        return {"count": count, "sum": total,
+                "mean": (total / count) if count else 0.0,
+                "min": mn or 0.0, "max": mx or 0.0,
                 "median": self._pick(s, 0.5), "p75": self._pick(s, 0.75),
                 "p95": self._pick(s, 0.95), "p99": self._pick(s, 0.99)}
+
+    def to_json(self) -> dict:
+        snap = self.snapshot()
+        del snap["sum"]
+        return {"type": "histogram", **snap}
 
 
 class Timer(Histogram):
@@ -187,3 +210,98 @@ class MetricsRegistry:
         return {name: m.to_json()
                 for name, m in sorted(self._metrics.items())
                 if prefix is None or name.startswith(prefix)}
+
+
+# -- Prometheus text exposition (docs/metrics.md#prometheus-exposition) ------
+#
+# `metrics?format=prometheus` renders the registry (plus the merged
+# crypto-boundary extras) in text exposition format 0.0.4 so real
+# deployments scrape nodes with stock Prometheus. The renderer consumes
+# the *JSON* export, not live metric objects: whatever the JSON endpoint
+# says is exactly what Prometheus sees, and the count/quantile pairs
+# come from one atomic Histogram.snapshot().
+
+def prometheus_name(name: str, prefix: str = "sct_") -> str:
+    """Name mangling: lowercase, every char outside [a-z0-9_] becomes
+    `_`, `sct_` namespace prefix, leading digits guarded. Documented in
+    docs/metrics.md — the drift-guard test keeps the catalog honest."""
+    out = "".join(c if (c.isascii() and (c.isalnum() or c == "_"))
+                  else "_" for c in name.lower())
+    if out and out[0].isdigit():
+        out = "_" + out
+    return prefix + out
+
+
+def _num(v) -> str:
+    if isinstance(v, bool):
+        return "1" if v else "0"
+    if isinstance(v, int):
+        return str(v)
+    return repr(float(v))
+
+
+def render_prometheus(metrics_json: Dict[str, dict],
+                      prefix: str = "sct_") -> str:
+    """Registry JSON -> exposition text. Mapping:
+
+    - counter              -> gauge (medida counters can be set/decremented)
+    - meter                -> `<n>_total` counter + `<n>_rate{window="1m|5m|15m"}` gauges
+    - timer / histogram    -> summary (`quantile` labels, `_sum`, `_count`)
+                              + `<n>_min` / `<n>_max` gauges
+    - bare `{count: N}`    -> gauge (the merged crypto-boundary extras)
+
+    Two source names that mangle to the same series keep only the
+    first (sorted source order); the duplicate is emitted as a comment
+    so the collision is visible in the scrape body.
+    """
+    lines: List[str] = []
+    emitted: set = set()
+    q_map = (("0.5", "median"), ("0.75", "p75"),
+             ("0.95", "p95"), ("0.99", "p99"))
+    for name in sorted(metrics_json):
+        m = metrics_json[name]
+        base = prometheus_name(name, prefix)
+        t = m.get("type")
+        # reserve every series this metric will emit, not just the base:
+        # a counter named "foo.total" must not collide with meter "foo"'s
+        # generated `foo_total` either
+        if t == "meter":
+            series = {base + "_total", base + "_rate"}
+        elif t in ("timer", "histogram"):
+            series = {base, base + "_sum", base + "_count",
+                      base + "_min", base + "_max"}
+        else:
+            series = {base}
+        if series & emitted:
+            lines.append("# collision: %s maps onto already-emitted "
+                         "series %s (skipped)"
+                         % (name, sorted(series & emitted)))
+            continue
+        emitted |= series
+        if t == "meter":
+            lines.append("# TYPE %s_total counter" % base)
+            lines.append("%s_total %s" % (base, _num(m["count"])))
+            lines.append("# TYPE %s_rate gauge" % base)
+            for w, k in (("1m", "1_min_rate"), ("5m", "5_min_rate"),
+                         ("15m", "15_min_rate")):
+                lines.append('%s_rate{window="%s"} %s'
+                             % (base, w, _num(m.get(k, 0.0))))
+        elif t in ("timer", "histogram"):
+            lines.append("# TYPE %s summary" % base)
+            for q, k in q_map:
+                lines.append('%s{quantile="%s"} %s'
+                             % (base, q, _num(m.get(k, 0.0))))
+            # sum reconstructed from the same snapshot's mean*count —
+            # still tear-free because both came from one snapshot()
+            lines.append("%s_sum %s" % (
+                base, _num(m.get("mean", 0.0) * m.get("count", 0))))
+            lines.append("%s_count %s" % (base, _num(m.get("count", 0))))
+            for k in ("min", "max"):
+                lines.append("# TYPE %s_%s gauge" % (base, k))
+                lines.append("%s_%s %s" % (base, k, _num(m.get(k, 0.0))))
+        elif "count" in m:   # counter or merged bare-count extra
+            lines.append("# TYPE %s gauge" % base)
+            lines.append("%s %s" % (base, _num(m["count"])))
+        # anything else (malformed entry) is skipped silently: the JSON
+        # endpoint remains the lossless export
+    return "\n".join(lines) + "\n"

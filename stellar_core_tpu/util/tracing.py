@@ -312,19 +312,30 @@ class Tracer:
 
 class FlightRecorder:
     """Black box: on a trigger, snapshot the tracer ring + open spans +
-    metrics registry to `<dir>/sct-flight-<reason>.json`. Dump failures
-    are logged, never raised — the recorder must not turn a stall into a
-    crash."""
+    metrics registry to
+    `<dir>/sct-flight[-<node>]-<reason>-<t>-<seq>.json` (node name +
+    zero-padded app-clock stamp + per-recorder sequence: concurrent
+    multi-node chaos runs sharing a directory — and repeat dumps at an
+    unchanged virtual clock — never overwrite each other's evidence).
+    Dump failures are logged, never raised — the recorder must not turn
+    a stall into a crash."""
 
     def __init__(self, tracer: Tracer, metrics=None,
                  out_dir: Optional[str] = None,
                  max_spans: int = 512,
-                 min_interval_s: float = 60.0) -> None:
+                 min_interval_s: float = 60.0,
+                 node_name: str = "",
+                 now_fn: Optional[Callable[[], float]] = None) -> None:
         import tempfile
         self.tracer = tracer
         self.metrics = metrics
         self.out_dir = (out_dir or os.environ.get("SCT_FLIGHT_DIR")
                         or tempfile.gettempdir())
+        # node name + app-clock stamp go into every dump filename so
+        # concurrent multi-node chaos runs sharing one directory never
+        # overwrite each other's incident evidence
+        self.node_name = node_name
+        self._now = now_fn or time.monotonic
         self.max_spans = max_spans
         # per-reason cooldown: a sustained burst of triggers (every slow
         # close in a slow patch) must not re-serialize the registry on
@@ -369,9 +380,20 @@ class FlightRecorder:
                 blob["metrics"] = self.metrics.to_json()
             if extra:
                 blob["extra"] = extra
-            safe = "".join(c if c.isalnum() or c in "-_" else "-"
-                           for c in reason)
-            path = os.path.join(self.out_dir, "sct-flight-%s.json" % safe)
+            def _safe(s: str) -> str:
+                return "".join(c if c.isalnum() or c in "-_" else "-"
+                               for c in s)
+            parts = ["sct-flight"]
+            if self.node_name:
+                parts.append(_safe(self.node_name))
+            parts.append(_safe(reason))
+            # app-clock stamp + per-recorder sequence: two forced dumps
+            # at an UNCHANGED virtual clock must still get distinct
+            # paths, or the second overwrites the first's evidence
+            parts.append("%012.3f" % max(0.0, self._now()))
+            parts.append("%03d" % self.dumps)
+            path = os.path.join(self.out_dir,
+                                "-".join(parts) + ".json")
             tmp = path + ".tmp"
             with open(tmp, "w") as fh:
                 json.dump(blob, fh, indent=1, default=repr)

@@ -57,6 +57,55 @@ def test_unknown_command(app):
     assert "commands" in body and "info" in body["commands"]
 
 
+def test_numeric_param_validation_returns_400_not_500(app):
+    """ISSUE 4 satellite: negative / non-numeric limit-style params are
+    rejected as 400-style error dicts instead of raising in the HTTP
+    thread (which showed up as a 500 with a stack-trace string)."""
+    for name, params in (
+            ("scp", {"limit": "-1"}),
+            ("scp", {"limit": "abc"}),
+            ("scp", {"slot": "-2", "timeline": "true"}),
+            ("trace", {"action": "dump", "limit": "nope"}),
+            ("trace", {"action": "dump", "limit": "-5"}),
+            ("trace", {"action": "start", "capacity": "0"}),
+            ("trace", {"action": "start", "capacity": "xyz"}),
+            ("timeline", {"slot": "x"}),
+    ):
+        st, body = cmd(app, name, **params)
+        assert st == 400, (name, params, st, body)
+        assert "error" in body and "parameter" in body["error"]
+    # valid values still work after the rejects
+    st, body = cmd(app, "scp", limit="3")
+    assert st == 200
+    st, body = cmd(app, "trace", action="status")
+    assert st == 200 and body["enabled"] is False
+
+
+def test_metrics_prometheus_format_over_http(app):
+    """format=prometheus serves text exposition with the 0.0.4 content
+    type through the real HTTP server."""
+    port = app.command_handler.start_http(port=0)
+    got = []
+
+    def fetch():
+        url = "http://127.0.0.1:%d/metrics?format=prometheus" % port
+        with urllib.request.urlopen(url, timeout=10) as r:
+            got.append((r.status, r.headers["Content-Type"],
+                        r.read().decode()))
+
+    t = threading.Thread(target=fetch)
+    t.start()
+    # handler hops to the main loop; crank until the reply lands
+    app.crank_until(lambda: bool(got), max_cranks=200000)
+    t.join(timeout=5)
+    status, ctype, text = got[0]
+    assert status == 200
+    assert ctype.startswith("text/plain; version=0.0.4")
+    assert "# TYPE sct_" in text
+    assert "sct_crypto_verify_cache_hit" in text
+    app.command_handler.stop_http()
+
+
 # ------------------------------------------------------------- transactions
 
 def test_tx_submission_via_handler(app):

@@ -1,0 +1,59 @@
+"""Metric-name drift guard (ISSUE 4 satellite): every metric registered
+anywhere in `stellar_core_tpu/` must be documented in docs/metrics.md,
+so the catalog can never silently rot. Dynamic names (`"%s"`-formatted)
+are checked by their literal prefix.
+"""
+
+import os
+import re
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+PKG = os.path.join(REPO, "stellar_core_tpu")
+DOC = os.path.join(REPO, "docs", "metrics.md")
+
+# new_meter("name"), including names split onto the following line; the
+# DOTALL window is kept short so we never jump to a different call's
+# string argument
+_CALL_RE = re.compile(
+    r"new_(?:counter|meter|timer|histogram)\(\s*[\"']([^\"']+)[\"']",
+    re.DOTALL)
+
+
+def registered_metric_names():
+    names = set()
+    for dirpath, _dirnames, filenames in os.walk(PKG):
+        for fn in filenames:
+            if not fn.endswith(".py"):
+                continue
+            with open(os.path.join(dirpath, fn)) as fh:
+                src = fh.read()
+            for m in _CALL_RE.finditer(src):
+                names.add(m.group(1))
+    return names
+
+
+def test_call_site_scan_finds_the_known_core_metrics():
+    """The scanner itself must keep working: if a refactor changes the
+    registration idiom and the regex finds nothing, this fails before
+    the doc check silently passes on an empty set."""
+    names = registered_metric_names()
+    assert len(names) >= 20
+    for expected in ("ledger.ledger.close", "scp.envelope.receive",
+                     "overlay.message.broadcast",
+                     "crypto.verify.latency", "fault.injected.%s"):
+        assert expected in names
+
+
+def test_every_registered_metric_is_documented():
+    with open(DOC) as fh:
+        doc = fh.read()
+    missing = []
+    for name in sorted(registered_metric_names()):
+        # dynamic names ("fault.injected.%s") are documented by their
+        # literal prefix ("fault.injected.<site>" contains it)
+        probe = name.split("%")[0]
+        if probe not in doc:
+            missing.append(name)
+    assert not missing, (
+        "metrics registered in code but absent from docs/metrics.md "
+        "(add them to the catalog table): %s" % missing)

@@ -1,0 +1,169 @@
+"""Slot-timeline tests (ISSUE 4 tentpole): the per-slot consensus event
+journal — ring bounding, dedup, hook coverage on a standalone node, and
+the admin `timeline` / `scp?slot=N&timeline=true` exposure.
+"""
+
+import pytest
+
+from stellar_core_tpu.main.application import Application
+from stellar_core_tpu.main.config import Config
+from stellar_core_tpu.util.slot_timeline import SlotTimeline
+from stellar_core_tpu.util.timer import ClockMode, VirtualClock
+
+
+class FakeClock:
+    def __init__(self) -> None:
+        self.t = 0.0
+
+    def __call__(self) -> float:
+        return self.t
+
+
+# ------------------------------------------------------------------- unit
+
+def test_record_and_read_back_events():
+    clk = FakeClock()
+    tl = SlotTimeline(now_fn=clk)
+    clk.t = 1.5
+    assert tl.record(7, "externalize", nominate_to_externalize_s=0.25)
+    clk.t = 1.75
+    assert tl.record(7, "ledger.applied", txs=3)
+    evs = tl.events(7)
+    assert [e["event"] for e in evs] == ["externalize", "ledger.applied"]
+    assert evs[0]["t"] == 1.5 and evs[1]["txs"] == 3
+    assert "pc" in evs[0]          # shared-clock stamp for fleet merge
+    assert tl.slots() == [7]
+    assert tl.first(7, "externalize")["t"] == 1.5
+    assert tl.first(7, "missing") is None
+
+
+def test_dedupe_keeps_first_arrival_per_event_node():
+    tl = SlotTimeline(now_fn=FakeClock())
+    assert tl.record(2, "nominate.seen", node="aa", dedupe=True)
+    assert not tl.record(2, "nominate.seen", node="aa", dedupe=True)
+    assert tl.record(2, "nominate.seen", node="bb", dedupe=True)
+    assert tl.record(3, "nominate.seen", node="aa", dedupe=True)
+    assert len(tl.events(2)) == 2
+    assert tl.dropped_events == 1
+
+
+def test_slot_ring_evicts_oldest_and_refuses_stale():
+    tl = SlotTimeline(now_fn=FakeClock(), max_slots=3)
+    for s in (1, 2, 3, 4):
+        tl.record(s, "externalize")
+    assert tl.slots() == [2, 3, 4]
+    assert tl.dropped_slots == 1
+    # a straggler event for the evicted slot must not resurrect it
+    assert not tl.record(1, "late")
+    assert tl.slots() == [2, 3, 4]
+
+
+def test_per_slot_event_cap():
+    tl = SlotTimeline(now_fn=FakeClock(), max_events_per_slot=4)
+    for i in range(10):
+        tl.record(1, "e%d" % i)
+    assert len(tl.events(1)) == 4
+    assert tl.dropped_events == 6
+
+
+def test_exports_are_copies_not_aliases():
+    """The fleet aggregator rebases pc stamps in place on what these
+    return; the live journal must be immune to that."""
+    tl = SlotTimeline(now_fn=FakeClock())
+    tl.record(2, "externalize")
+    tl.to_json()["slots"]["2"][0]["pc"] = -1.0
+    assert tl.events(2)[0]["pc"] != -1.0
+    evs = tl.events(2)
+    evs[0]["pc"] = -2.0
+    assert tl.events(2)[0]["pc"] != -2.0
+
+
+def test_dedupe_key_overrides_node_identity():
+    """Competing txsets for one slot dedupe by hash, not sender: two
+    distinct keys both record, a repeat of either is dropped."""
+    tl = SlotTimeline(now_fn=FakeClock())
+    assert tl.record(2, "txset.fetched", dedupe=True, dedupe_key="aa")
+    assert tl.record(2, "txset.fetched", dedupe=True, dedupe_key="bb")
+    assert not tl.record(2, "txset.fetched", dedupe=True,
+                         dedupe_key="aa")
+    assert len(tl.events(2)) == 2
+
+
+def test_to_json_whole_ring_and_single_slot():
+    tl = SlotTimeline(now_fn=FakeClock())
+    tl.record(2, "a")
+    tl.record(3, "b")
+    whole = tl.to_json()
+    assert set(whole["slots"]) == {"2", "3"}
+    one = tl.to_json(slot=3)
+    assert set(one["slots"]) == {"3"}
+    assert one["slots"]["3"][0]["event"] == "b"
+
+
+# ---------------------------------------------------- standalone-node hooks
+
+@pytest.fixture
+def app(tmp_path):
+    cfg = Config.test_config(0)
+    cfg.DATABASE = "sqlite3://:memory:"
+    a = Application(VirtualClock(ClockMode.VIRTUAL_TIME), cfg)
+    a.start()
+    yield a
+    a.stop()
+
+
+def test_standalone_close_journals_the_slot(app):
+    app.manual_close()   # closes ledger 2
+    evs = app.slot_timeline.events(2)
+    names = [e["event"] for e in evs]
+    # nomination trigger → own vote → ballot progression → externalize →
+    # apply, in causal order, without tracing enabled
+    assert not app.tracer.enabled
+    for expected in ("nominate.trigger", "nominate.vote",
+                     "ballot.phase.externalize", "externalize",
+                     "ledger.applied"):
+        assert expected in names, names
+    assert names.index("nominate.trigger") < names.index("externalize")
+    assert names.index("externalize") < names.index("ledger.applied")
+    ext = app.slot_timeline.first(2, "externalize")
+    assert ext.get("nominate_to_externalize_s", 0.0) >= 0.0
+    # app-clock (virtual) stamps are monotone within the journal
+    ts = [e["t"] for e in evs]
+    assert ts == sorted(ts)
+
+
+def test_timeline_endpoint_and_scp_inline(app):
+    app.manual_close()
+    app.manual_close()
+
+    def cmd(name, **params):
+        return app.command_handler.handle_command(
+            name, {k: str(v) for k, v in params.items()})
+
+    st, body = cmd("timeline")
+    assert st == 200
+    assert body["node"] == app.config.node_name()
+    assert body["node_id"] == app.config.node_id().key_bytes.hex()
+    assert {"2", "3"} <= set(body["slots"])
+
+    st, one = cmd("timeline", slot=3)
+    assert st == 200 and set(one["slots"]) == {"3"}
+
+    st, scp = cmd("scp", slot=2, timeline="true")
+    assert st == 200
+    assert any(e["event"] == "externalize" for e in scp["timeline"])
+    # without timeline=true the key stays absent (no payload tax)
+    st, scp = cmd("scp", slot=2)
+    assert "timeline" not in scp
+
+    import json
+    json.dumps(body)   # endpoint bodies must serialize
+
+
+def test_timeline_param_validation(app):
+    st, body = app.command_handler.handle_command("timeline",
+                                                  {"slot": "x"})
+    assert st == 400 and "slot" in body["error"]
+    st, body = app.command_handler.handle_command("timeline",
+                                                  {"slot": "-3"})
+    assert st == 400

@@ -103,6 +103,77 @@ def test_survey_bad_signature_rejected():
     sim.stop_all_nodes()
 
 
+# --------------------------------------------------- survey under chaos loss
+
+def _chaos_core3():
+    """3 validators over the REAL overlay stack with ChaosTransport on
+    every link (drops armed later via each app's fault injector)."""
+    from stellar_core_tpu.crypto.hashing import sha256
+    from stellar_core_tpu.crypto.keys import SecretKey
+    from stellar_core_tpu.xdr import SCPQuorumSet
+    sim = Simulation(mode=Simulation.OVER_PEERS)
+    keys = [SecretKey.from_seed(sha256(b"chaos-survey" + bytes([i])))
+            for i in range(3)]
+    qset = SCPQuorumSet(threshold=2,
+                        validators=[k.public_key for k in keys],
+                        innerSets=[])
+    names = [sim.add_node(k, qset).name for k in keys]
+    for i in range(3):
+        for j in range(i + 1, 3):
+            sim.connect_peers(names[i], names[j], chaos=True)
+    return sim, names
+
+
+def test_survey_under_chaos_loss_converges_or_times_out_cleanly():
+    """ISSUE 4 satellite: a started survey under injected overlay.*
+    message loss either still converges or times out cleanly (the stop
+    timer fires, no exception out of the HTTP/main path), and survey
+    stats surface in the fleet aggregate either way."""
+    sim, names = _chaos_core3()
+    sim.start_all_nodes()
+    assert sim.crank_until(lambda: sim.have_all_externalized(2), 50000)
+
+    # arm loss on EVERY node's injector: requests, relays, and responses
+    # all cross ChaosTransport links
+    for n in sim.nodes.values():
+        n.app.faults.configure("overlay.drop", probability=0.2)
+        n.app.faults.configure("overlay.delay", probability=0.2)
+
+    surveyor = sim.nodes[names[0]].app
+    others = [sim.nodes[n].app for n in names[1:]]
+    sm = surveyor.overlay_manager.survey_manager
+    sm.start_survey(duration=30.0)
+    want = {o.config.node_id().key_bytes.hex() for o in others}
+
+    def done():
+        return want.issubset(sm.get_results()["topology"]) \
+            or not sm.running
+
+    assert sim.crank_until(done, 120000), "survey neither converged " \
+        "nor timed out: %r" % sm.get_stats()
+
+    stats = sm.get_stats()
+    if want.issubset(sm.get_results()["topology"]):
+        assert stats["results"] >= 2        # converged despite loss
+    else:
+        assert stats["running"] is False    # ...or timed out CLEANLY
+        assert stats["surveyed"] >= 1       # it did try
+    # loss was actually injected somewhere in the fleet
+    injected = sum(
+        n.app.metrics.to_json().get("fault.injected.overlay.drop",
+                                    {}).get("count", 0) +
+        n.app.metrics.to_json().get("fault.injected.overlay.delay",
+                                    {}).get("count", 0)
+        for n in sim.nodes.values())
+    assert injected > 0
+
+    # survey stats ride along in the fleet aggregate
+    fleet = sim.fleet_stats()
+    assert set(fleet["survey"]) == set(names)
+    assert fleet["survey"][names[0]]["surveyed"] == stats["surveyed"]
+    sim.stop_all_nodes()
+
+
 # ------------------------------------------------------------- load manager
 
 def test_load_manager_accounting_and_shedding():

@@ -161,8 +161,15 @@ def test_flight_recorder_dump_on_close_exception(tmp_path, monkeypatch):
             app.manual_close()
     finally:
         app.stop()
-    path = os.path.join(str(tmp_path), "sct-flight-close-exception.json")
-    assert os.path.exists(path)
+    import glob
+    # filenames carry node name + app-clock stamp (ISSUE 4 satellite:
+    # concurrent multi-node chaos runs must not overwrite evidence)
+    paths = glob.glob(os.path.join(
+        str(tmp_path), "sct-flight-*close-exception*.json"))
+    assert len(paths) == 1
+    path = paths[0]
+    node = app.config.node_name()
+    assert node and node in os.path.basename(path)
     with open(path) as fh:
         blob = json.load(fh)
     assert blob["reason"] == "close-exception"
@@ -181,8 +188,11 @@ def test_flight_recorder_dump_on_scp_stall(tmp_path):
         app.herder._lost_sync()
     finally:
         app.stop()
-    path = os.path.join(str(tmp_path), "sct-flight-scp-stall.json")
-    assert os.path.exists(path)
+    import glob
+    paths = glob.glob(os.path.join(str(tmp_path),
+                                   "sct-flight-*scp-stall*.json"))
+    assert len(paths) == 1
+    path = paths[0]
     with open(path) as fh:
         blob = json.load(fh)
     assert blob["reason"] == "scp-stall"
@@ -206,6 +216,19 @@ def test_flight_recorder_per_reason_cooldown(tmp_path):
     assert fr.dump("other-reason") is not None             # independent
     assert fr.dump("slow-close", force=True) is not None
     assert fr.dumps == 3 and fr.suppressed == 1
+
+
+def test_flight_dumps_at_unchanged_clock_get_distinct_paths(tmp_path):
+    """Virtual-clock sims can force two dumps between cranks: the
+    per-recorder sequence in the filename must keep both."""
+    tr = Tracer()
+    fr = FlightRecorder(tr, out_dir=str(tmp_path), node_name="n1",
+                        now_fn=lambda: 12.0)
+    p1 = fr.dump("manual", force=True)
+    p2 = fr.dump("manual", force=True)
+    assert p1 != p2
+    assert os.path.exists(p1) and os.path.exists(p2)
+    assert "n1" in os.path.basename(p1)
 
 
 def test_phase_breakdown_concurrent_worker_roots_do_not_deflate_untraced():

@@ -113,6 +113,45 @@ def test_histogram_to_json_has_p95():
     assert j["p95"] == 95.0 and j["p99"] == 99.0
 
 
+def test_histogram_snapshot_count_and_quantiles_are_consistent():
+    """ISSUE 4 satellite: snapshot() captures count/sum AND the
+    reservoir before its single sort, so updates landing mid-export
+    (a scraper under load) can't tear count away from the quantiles."""
+    m = MetricsRegistry()
+    h = m.new_histogram("h")
+    for v in range(10):
+        h.update(float(v))
+
+    # simulate an update racing the export: the moment sorted() is
+    # called, a new sample arrives
+    real_sorted = sorted
+    import builtins
+    calls = {"n": 0}
+
+    def racing_sorted(x, *a, **k):
+        if calls["n"] == 0:
+            calls["n"] += 1
+            h.update(1000.0)      # lands AFTER the capture
+        return real_sorted(x, *a, **k)
+
+    builtins_sorted = builtins.sorted
+    builtins.sorted = racing_sorted
+    try:
+        snap = h.snapshot()
+    finally:
+        builtins.sorted = builtins_sorted
+    # the racing update is invisible to THIS snapshot everywhere at once
+    assert snap["count"] == 10
+    assert snap["sum"] == sum(range(10))
+    assert snap["max"] == 9.0 and snap["p99"] <= 9.0
+    # ...and visible to the next one everywhere at once
+    snap2 = h.snapshot()
+    assert snap2["count"] == 11 and snap2["max"] == 1000.0
+    # to_json rides on the same snapshot (one sort per export)
+    j = h.to_json()
+    assert j["count"] == 11 and "sum" not in j
+
+
 def test_idle_meter_rate_decays_and_prunes():
     t = {"now": 0.0}
     m = MetricsRegistry(now_fn=lambda: t["now"])
