@@ -1,0 +1,41 @@
+"""sctlint: the project's determinism & thread-discipline analyzer.
+
+The framework's correctness story — per-height header-hash equality
+across nodes, seeded chaos soaks, virtual-clock replay — rests on
+invariants that generic linters cannot see. This package enforces them
+mechanically, as an AST pass with project-specific rules:
+
+- **D1** no wall-clock reads (`time.time` / `time.monotonic` /
+  `time.perf_counter` / `datetime.now` / …) outside the clock
+  abstraction and the measurement layer: consensus code gets time from
+  the injected VirtualClock, so a virtual-clock replay is bit-exact.
+- **D2** no unseeded randomness (`random.*` module-level functions,
+  argless `random.Random()`, `os.urandom`) outside `util/rnd.py` and
+  key generation: chaos runs replay from their seed.
+- **T1** thread discipline: call-graph walk from every
+  `threading.Thread(target=...)` / `executor.submit(...)` entry point;
+  reaching a `@main_thread_only`-marked function (util/threads.py
+  registry) is a violation — worker threads hand results to consensus
+  via `clock.post_to_main`, never by calling in.
+- **E1** no `except Exception: pass` in `scp/`, `herder/`, `ledger/`,
+  `bucket/`: consensus code never swallows silently.
+- **F1** every fault-site literal (`should_fire("...")`,
+  `fire_point("...")`, `check_faults(x, "...")`) must be registered in
+  `util.faults.KNOWN_SITES` and cataloged in docs/robustness.md — both
+  directions — so the admin endpoint can reject typo'd sites and the
+  chaos docs can never rot.
+- **M1** every literal metric name registered via `new_counter` /
+  `new_meter` / `new_timer` / `new_histogram` must appear in
+  docs/metrics.md (dynamic `%s` names by their literal prefix).
+
+Intentional exceptions live in `analysis/allowlist.txt`, one line per
+(rule, file) with a mandatory justification; stale entries fail the
+build. The whole pass runs as tier-1 test `tests/test_static_analysis.py`
+and standalone as `python -m stellar_core_tpu.analysis` (`tools/sctlint`).
+See docs/static-analysis.md.
+"""
+
+from .engine import (  # noqa: F401
+    AllowEntry, AnalysisResult, Finding, LintConfig, default_config,
+    load_allowlist, run_analysis,
+)

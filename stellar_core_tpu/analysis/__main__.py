@@ -1,0 +1,84 @@
+"""sctlint CLI: `python -m stellar_core_tpu.analysis [options] [files...]`
+(or via the `tools/sctlint` wrapper, which also runs ruff when present).
+
+Exit status: 0 clean, 1 violations/stale allowlist entries, 2 usage or
+parse errors — CI-gate friendly.
+"""
+
+from __future__ import annotations
+
+import argparse
+import os
+import subprocess
+import sys
+
+from .engine import default_config, run_analysis
+
+
+def _changed_files(repo_root: str) -> list:
+    """Working-tree .py files changed vs HEAD, plus untracked ones."""
+    def git(*args):
+        r = subprocess.run(["git", "-C", repo_root] + list(args),
+                           capture_output=True, text=True)
+        return r.stdout.splitlines() if r.returncode == 0 else []
+
+    names = set(git("diff", "--name-only", "HEAD")) | \
+        set(git("ls-files", "--others", "--exclude-standard"))
+    return sorted(n for n in names if n.endswith(".py"))
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(
+        prog="sctlint",
+        description="Determinism & thread-discipline analyzer "
+                    "(rules D1/D2/T1/E1/F1/M1 — docs/static-analysis.md)")
+    ap.add_argument("files", nargs="*",
+                    help="restrict per-module rules to these files "
+                         "(default: whole package)")
+    ap.add_argument("--changed", action="store_true",
+                    help="lint only .py files changed vs HEAD "
+                         "(plus untracked)")
+    ap.add_argument("--repo-root", default=None)
+    ap.add_argument("--list", action="store_true", dest="list_all",
+                    help="print every finding including allowlisted ones")
+    args = ap.parse_args(argv)
+
+    cfg = default_config(args.repo_root)
+    files = args.files or None
+    if args.changed:
+        files = _changed_files(cfg.repo_root)
+        if not files:
+            print("sctlint: no changed .py files")
+            return 0
+
+    res = run_analysis(cfg, files=files)
+
+    if args.list_all:
+        for f in res.findings:
+            print(f.format())
+        print("-- %d finding(s) before allowlist --" % len(res.findings))
+
+    for err in res.parse_errors:
+        print("PARSE-ERROR %s" % err)
+    for f in res.violations:
+        print(f.format())
+    for e in res.stale_entries:
+        print("STALE-ALLOWLIST %s:%d: '%s %s%s' matched no finding — "
+              "remove or fix the entry"
+              % (cfg.allowlist_path, e.lineno, e.rule, e.path,
+                 ("#" + e.qual) if e.qual else ""))
+
+    if res.parse_errors:
+        return 2
+    if res.violations or res.stale_entries:
+        print("sctlint: %d violation(s), %d stale allowlist entr(ies)"
+              % (len(res.violations), len(res.stale_entries)))
+        return 1
+    scope = "%d file(s)" % len(files) if files else "whole package"
+    print("sctlint: clean (%s; %d finding(s) allowlisted)"
+          % (scope, len(res.findings) - len(res.violations)))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,268 @@
+"""sctlint engine: file walking, allowlist, rule orchestration.
+
+The allowlist file (`stellar_core_tpu/analysis/allowlist.txt`) is the
+single place intentional exceptions live. One entry per line:
+
+    RULE path[#qualname-prefix] -- justification
+
+e.g.
+
+    D1 stellar_core_tpu/util/timer.py -- the clock abstraction itself
+
+An entry suppresses every finding of RULE in that file (optionally
+narrowed to functions whose qualname starts with the given prefix). A
+justification is mandatory; an entry that matches nothing is STALE and
+reported as an error — the allowlist can only shrink or be re-justified,
+never rot. `--` and the em-dash `—` are both accepted separators.
+"""
+
+from __future__ import annotations
+
+import ast
+import os
+import re
+from dataclasses import dataclass, field
+from typing import Dict, List, Optional, Sequence, Set, Tuple
+
+
+@dataclass
+class Finding:
+    rule: str
+    path: str        # repo-relative, posix separators
+    line: int
+    qualname: str    # enclosing def/class scope, "" at module level
+    message: str
+
+    def format(self) -> str:
+        where = "%s:%d" % (self.path, self.line)
+        if self.qualname:
+            where += " (%s)" % self.qualname
+        return "%s %s: %s" % (self.rule, where, self.message)
+
+
+@dataclass
+class AllowEntry:
+    rule: str
+    path: str
+    qual: str                 # "" = whole file
+    justification: str
+    lineno: int
+    matched: int = 0
+
+    def covers(self, f: Finding) -> bool:
+        return (f.rule == self.rule and f.path == self.path and
+                (not self.qual or f.qualname.startswith(self.qual)))
+
+
+@dataclass
+class LintConfig:
+    repo_root: str
+    package_dir: str                     # absolute path to the package
+    package_name: str
+    allowlist_path: Optional[str]
+    docs_metrics_path: Optional[str]
+    docs_robustness_path: Optional[str]
+    fault_registry: Optional[Set[str]]   # None = skip F1
+    fault_registry_path: str = ""
+    e1_dirs: Tuple[str, ...] = ("scp", "herder", "ledger", "bucket")
+    enabled_rules: Tuple[str, ...] = ("D1", "D2", "T1", "E1", "F1", "M1")
+
+
+@dataclass
+class AnalysisResult:
+    findings: List[Finding] = field(default_factory=list)   # pre-allowlist
+    violations: List[Finding] = field(default_factory=list)  # post-allowlist
+    stale_entries: List[AllowEntry] = field(default_factory=list)
+    parse_errors: List[str] = field(default_factory=list)
+
+    @property
+    def ok(self) -> bool:
+        return not self.violations and not self.stale_entries and \
+            not self.parse_errors
+
+
+_SEP_RE = re.compile(r"\s+(?:--|—)\s+")
+
+
+def load_allowlist(path: str) -> List[AllowEntry]:
+    entries: List[AllowEntry] = []
+    with open(path, encoding="utf-8") as fh:
+        for i, raw in enumerate(fh, 1):
+            line = raw.strip()
+            if not line or line.startswith("#"):
+                continue
+            parts = _SEP_RE.split(line, maxsplit=1)
+            if len(parts) != 2 or not parts[1].strip():
+                raise ValueError(
+                    "%s:%d: allowlist entry needs a justification "
+                    "('RULE path -- why'): %r" % (path, i, line))
+            head, justification = parts[0].split(), parts[1].strip()
+            if len(head) != 2:
+                raise ValueError(
+                    "%s:%d: expected 'RULE path[#qual]', got %r"
+                    % (path, i, parts[0]))
+            rule, target = head
+            fpath, _, qual = target.partition("#")
+            entries.append(AllowEntry(rule, fpath, qual, justification, i))
+    return entries
+
+
+def _read(path: Optional[str]) -> str:
+    if path is None or not os.path.exists(path):
+        return ""
+    with open(path, encoding="utf-8") as fh:
+        return fh.read()
+
+
+def default_config(repo_root: Optional[str] = None) -> LintConfig:
+    if repo_root is None:
+        repo_root = os.path.dirname(os.path.dirname(os.path.dirname(
+            os.path.abspath(__file__))))
+    pkg = os.path.join(repo_root, "stellar_core_tpu")
+    docs = os.path.join(repo_root, "docs")
+    # no fallback: if the registry import breaks, the lint run must die
+    # loudly rather than silently dropping the F1 rule and printing
+    # "clean" (fault_registry=None is only for fixture configs that
+    # explicitly opt out of F1)
+    from ..util.faults import KNOWN_SITES
+    registry: Optional[Set[str]] = set(KNOWN_SITES)
+    cfg = LintConfig(
+        repo_root=repo_root,
+        package_dir=pkg,
+        package_name="stellar_core_tpu",
+        allowlist_path=os.path.join(pkg, "analysis", "allowlist.txt"),
+        docs_metrics_path=os.path.join(docs, "metrics.md"),
+        docs_robustness_path=os.path.join(docs, "robustness.md"),
+        fault_registry=registry,
+        fault_registry_path="stellar_core_tpu/util/faults.py",
+    )
+    _apply_pyproject(cfg)
+    return cfg
+
+
+def _apply_pyproject(cfg: LintConfig) -> None:
+    """Honor a `[tool.sctlint]` stanza in pyproject.toml (shared config
+    home with `[tool.ruff]`, so one file drives both linters).
+
+    Deliberately NOT tomllib: the stanza is defined as flat single-line
+    `key = "value"` / `key = ["a", "b"]` entries and is parsed with the
+    same simple scanner on every interpreter, so behavior can never
+    diverge between py3.10 (no tomllib) and 3.11+. Anything the scanner
+    can't read — multi-line arrays, nested tables — yields nothing for
+    that key and the default stays: misparses FAIL SAFE to the full
+    rule set, never to a weaker gate."""
+    pp = os.path.join(cfg.repo_root, "pyproject.toml")
+    if not os.path.exists(pp):
+        return
+    data: Dict[str, object] = {}
+    in_stanza = False
+    for line in _read(pp).splitlines():
+        s = line.split("#", 1)[0].strip()
+        if s.startswith("["):
+            in_stanza = s == "[tool.sctlint]"
+            continue
+        if in_stanza and "=" in s:
+            k, _, v = s.partition("=")
+            v = v.strip()
+            if v.startswith("[") and v.endswith("]"):
+                data[k.strip()] = [x.strip().strip("\"'")
+                                  for x in v.strip("[]").split(",")
+                                  if x.strip()]
+            elif not v.startswith("["):
+                data[k.strip()] = v.strip("\"'")
+    if data.get("allowlist"):
+        cfg.allowlist_path = os.path.join(cfg.repo_root,
+                                          str(data["allowlist"]))
+    # empty lists count as "not set": an empty rules list would make
+    # the whole gate vacuously green
+    if isinstance(data.get("rules"), list) and data["rules"]:
+        cfg.enabled_rules = tuple(str(r) for r in data["rules"])
+    if isinstance(data.get("e1-dirs"), list) and data["e1-dirs"]:
+        cfg.e1_dirs = tuple(str(d) for d in data["e1-dirs"])
+
+
+def _py_files(package_dir: str) -> List[str]:
+    out = []
+    for dirpath, dirnames, filenames in os.walk(package_dir):
+        dirnames[:] = [d for d in dirnames
+                       if d not in ("__pycache__", "build")]
+        for fn in sorted(filenames):
+            if fn.endswith(".py"):
+                out.append(os.path.join(dirpath, fn))
+    return out
+
+
+def run_analysis(config: Optional[LintConfig] = None,
+                 files: Optional[Sequence[str]] = None) -> AnalysisResult:
+    """Run every enabled rule. `files` (absolute or repo-relative)
+    restricts the per-module rules (D1/D2/E1) to those files — the
+    `--changed` fast path; tree-wide rules (T1/F1/M1) always scan the
+    whole package, since their facts are cross-module."""
+    from . import rules as R
+
+    cfg = config or default_config()
+    res = AnalysisResult()
+
+    all_paths = _py_files(cfg.package_dir)
+    facts_by_path: Dict[str, "R.ModuleFacts"] = {}
+    for abspath in all_paths:
+        rel = os.path.relpath(abspath, cfg.repo_root).replace(os.sep, "/")
+        try:
+            with open(abspath, encoding="utf-8") as fh:
+                tree = ast.parse(fh.read(), filename=rel)
+        except SyntaxError as e:
+            res.parse_errors.append("%s: %s" % (rel, e))
+            continue
+        facts_by_path[rel] = R.ModuleFacts(rel, tree)
+
+    restrict: Optional[Set[str]] = None
+    if files is not None:
+        restrict = set()
+        for f in files:
+            a = f if os.path.isabs(f) else os.path.join(cfg.repo_root, f)
+            restrict.add(os.path.relpath(a, cfg.repo_root)
+                         .replace(os.sep, "/"))
+
+    all_facts = list(facts_by_path.values())
+    for rel, facts in sorted(facts_by_path.items()):
+        if restrict is not None and rel not in restrict:
+            continue
+        if "D1" in cfg.enabled_rules:
+            res.findings.extend(R.rule_d1_wallclock(facts))
+        if "D2" in cfg.enabled_rules:
+            res.findings.extend(R.rule_d2_randomness(facts))
+        if "E1" in cfg.enabled_rules:
+            res.findings.extend(
+                R.rule_e1_swallow(facts, cfg.e1_dirs, cfg.package_name))
+
+    if "T1" in cfg.enabled_rules:
+        res.findings.extend(R.rule_t1_thread_discipline(all_facts))
+    if "F1" in cfg.enabled_rules and cfg.fault_registry is not None:
+        res.findings.extend(R.rule_f1_fault_sites(
+            all_facts, set(cfg.fault_registry), cfg.fault_registry_path,
+            _read(cfg.docs_robustness_path), "docs/robustness.md"))
+    if "M1" in cfg.enabled_rules:
+        res.findings.extend(R.rule_m1_metric_catalog(
+            all_facts, _read(cfg.docs_metrics_path), "docs/metrics.md"))
+
+    entries: List[AllowEntry] = []
+    if cfg.allowlist_path and os.path.exists(cfg.allowlist_path):
+        entries = load_allowlist(cfg.allowlist_path)
+
+    for f in res.findings:
+        covered = False
+        for e in entries:
+            if e.covers(f):
+                e.matched += 1
+                covered = True
+        if not covered:
+            res.violations.append(f)
+
+    # stale entries only meaningful on full-tree runs with their rule
+    # enabled: a --changed run that skipped a file (or an M1-only run)
+    # must not flag unrelated entries as stale
+    if restrict is None:
+        res.stale_entries = [e for e in entries
+                             if e.matched == 0 and
+                             e.rule in cfg.enabled_rules]
+    return res

@@ -15,6 +15,7 @@ from concurrent.futures import ThreadPoolExecutor
 from typing import Dict, List, Optional, Sequence
 
 from ..util.log import get_logger
+from ..util.threads import main_thread_only
 from ..xdr import LedgerEntry, LedgerKey
 from .bucket import Bucket
 from .bucket_list import BucketList, K_NUM_LEVELS
@@ -103,6 +104,7 @@ class BucketManager:
         return None
 
     # -- the list ------------------------------------------------------------
+    @main_thread_only
     def add_batch(self, curr_ledger: int, curr_ledger_protocol: int,
                   init_entries: Sequence[LedgerEntry],
                   live_entries: Sequence[LedgerEntry],

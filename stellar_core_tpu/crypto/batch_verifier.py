@@ -26,15 +26,37 @@ Backends:
 
 The global verify-result cache (keys.py) sits in front of every backend;
 cache hits never enqueue.
+
+Clock/threading audit (ISSUE 5 satellite — the 9 touch points):
+1. CircuitBreaker.now_fn — injected app clock (make_verifier passes
+   clock.now); default is util.timer.real_monotonic for direct
+   constructions. Cooldown/reprobe advance deterministically under a
+   virtual clock.
+2-4. ThreadedBatchVerifier enqueue/dispatch/complete stamps — all three
+   read the injected app clock, so the queue-wait span tags and the
+   crypto.verify.latency timer are virtual-clock-deterministic in chaos
+   soaks (module-level `time` is gone from this file; the D1 static
+   rule keeps it out).
+5. ThreadedBatchVerifier._lock — TrackedLock, watched by the lock-order
+   checker (util/threads.py).
+6. ThreadedBatchVerifier worker thread — dispatch off-main; futures
+   complete via clock.post_to_main only (single-threaded consensus).
+7. TpuSigVerifier._warmup_thread — startup-only, touches JAX state, no
+   ledger/consensus objects.
+8. keys._cache_lock — TrackedLock shared with the worker thread.
+9. ResilientBatchVerifier breaker callbacks (_on_trip/_on_recover) —
+   run on whichever thread dispatched (worker under tpu-async): they
+   touch only metrics/tracer/flight-recorder, which are thread-safe.
 """
 
 from __future__ import annotations
 
 import threading
-import time
 from typing import Callable, List, Optional, Sequence, Tuple
 
 from ..util.log import get_logger
+from ..util.threads import TrackedLock
+from ..util.timer import real_monotonic
 from ..xdr import PublicKey
 from . import keys as _keys
 
@@ -383,7 +405,7 @@ class CircuitBreaker:
                  on_recover: Optional[Callable[[], None]] = None) -> None:
         self.threshold = max(1, threshold)
         self.cooldown_s = cooldown_s
-        self._now = now_fn or time.monotonic
+        self._now = now_fn or real_monotonic
         self.on_trip = on_trip
         self.on_recover = on_recover
         self.state = self.CLOSED
@@ -571,10 +593,12 @@ class ThreadedBatchVerifier(BatchSigVerifier):
         self._inner = inner
         self._clock = clock
         self._metrics = metrics
-        self._lock = threading.Lock()
-        # (triple, future, enqueue perf_counter): the timestamp feeds the
-        # crypto.verify.latency enqueue-to-complete timer (the p50/p99
-        # the live SCP path actually feels)
+        self._lock = TrackedLock("crypto.threaded-pending")
+        # (triple, future, enqueue app-clock stamp): the timestamp feeds
+        # the crypto.verify.latency enqueue-to-complete timer (the
+        # p50/p99 the live SCP path actually feels); the app clock, not
+        # wall time, so chaos soaks under a virtual clock stay
+        # deterministic
         self._pending: List[Tuple[Triple, VerifyFuture, float]] = []
         self._inflight = False
 
@@ -607,7 +631,7 @@ class ThreadedBatchVerifier(BatchSigVerifier):
             return f
         with self._lock:
             self._pending.append(
-                ((key.key_bytes, sig, msg), f, time.perf_counter()))
+                ((key.key_bytes, sig, msg), f, self._clock.now()))
         return f
 
     def pending(self) -> int:
@@ -625,7 +649,7 @@ class ThreadedBatchVerifier(BatchSigVerifier):
             triples = [t for (t, _f, _t0) in batch]
             # queue-wait: enqueue → dispatch start, per batch; dispatch
             # time is the span's own duration (inner verify_many nests)
-            t_disp = time.perf_counter()
+            t_disp = self._clock.now()
             waits = [t_disp - t0 for (_t, _f, t0) in batch]
             with self._span("crypto.batch_dispatch",
                             backend="threaded:%s" % self._inner.name,
@@ -644,7 +668,7 @@ class ThreadedBatchVerifier(BatchSigVerifier):
                     results = _flush_fallback(self, triples)
 
             def complete() -> None:
-                done = time.perf_counter()
+                done = self._clock.now()
                 lat = (self._metrics.new_timer("crypto.verify.latency")
                        if self._metrics is not None else None)
                 for ((k, s, m), f, t0), ok in zip(batch, results):

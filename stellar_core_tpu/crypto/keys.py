@@ -15,7 +15,6 @@ implements the SAME accept/reject semantics so backends are interchangeable.
 from __future__ import annotations
 
 import hashlib
-import threading
 from typing import Optional
 
 try:
@@ -34,7 +33,12 @@ from .hashing import sha256
 
 VERIFY_CACHE_SIZE = 0xFFFF
 
-_cache_lock = threading.Lock()
+# tracked: the verify cache is the one structure every thread touches
+# (main loop, threaded dispatch worker, HTTP metrics reads) — the
+# lock-order checker (util/threads.py) watches it under tests
+from ..util.threads import TrackedLock  # noqa: E402
+
+_cache_lock = TrackedLock("crypto.verify-cache")
 _verify_cache: RandomEvictionCache = RandomEvictionCache(VERIFY_CACHE_SIZE)
 
 

@@ -22,6 +22,7 @@ from ..crypto.hashing import sha256
 from ..scp.driver import SCPDriver, ValidationLevel
 from ..scp.scp import SCP
 from ..util.log import get_logger
+from ..util.threads import main_thread_only
 from ..util.timer import VirtualTimer
 from ..xdr import (
     EnvelopeType, LedgerCloseValueSignature, LedgerUpgrade, SCPEnvelope,
@@ -385,6 +386,7 @@ class Herder:
         return status
 
     # -- SCP envelope intake -------------------------------------------------
+    @main_thread_only
     def recv_scp_envelope(self, envelope: SCPEnvelope,
                           on_verified=None) -> int:
         """HOT CALLER #1. The signature verify is enqueued on the batch
@@ -589,6 +591,7 @@ class Herder:
                 StellarMessage(MessageType.SCP_MESSAGE, envelope), False)
 
     # -- nomination ----------------------------------------------------------
+    @main_thread_only
     def trigger_next_ledger(self, ledger_seq_to_trigger: int) -> None:
         from ..util.tracing import app_span
         lm = self.app.ledger_manager
@@ -642,6 +645,7 @@ class Herder:
             lambda: self.trigger_next_ledger(slot))
 
     # -- externalization -----------------------------------------------------
+    @main_thread_only
     def value_externalized(self, slot_index: int, value: bytes) -> None:
         t0 = self._nominate_started.pop(slot_index, None)
         self._nominate_started = {
@@ -781,8 +785,11 @@ class Herder:
             try:
                 env = SCPEnvelope.from_xdr(blob[i:i + n])
                 self.scp.set_state_from_envelope(env)
-            except Exception:
-                pass
+            except Exception as e:
+                # persisted-state corruption loses one envelope, not the
+                # restart; log it so an operator can see the decay (E1)
+                log.warning("discarding corrupt persisted SCP envelope "
+                            "at offset %d: %s", i, e)
             i += n
 
     # -- introspection -------------------------------------------------------

@@ -43,8 +43,11 @@ def statement_txset_hashes(st) -> List[bytes]:
         try:
             sv = StellarValue.from_xdr(v)
             out.append(sv.txSetHash)
-        except Exception:
-            pass
+        except Exception as e:
+            # a peer can pledge arbitrary bytes; an unparseable value
+            # simply names no txset to fetch — but say so (E1: no silent
+            # swallows in consensus code)
+            log.debug("ignoring unparseable StellarValue in statement: %s", e)
     return out
 
 

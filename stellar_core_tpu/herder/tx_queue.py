@@ -15,6 +15,7 @@ from typing import Dict, List, Optional, Tuple
 
 from ..ledger.ledgertxn import LedgerTxn
 from ..util.log import get_logger
+from ..util.threads import main_thread_only
 from .txset import TxSetFrame
 
 log = get_logger("Herder")
@@ -75,6 +76,7 @@ class TransactionQueue:
         return self._ledger.header().maxTxSetSize * self.pool_multiplier
 
     # -- add ----------------------------------------------------------------
+    @main_thread_only
     def try_add(self, frame) -> int:
         h = frame.full_hash()
         if h in self._known_hashes:

@@ -17,10 +17,10 @@ from __future__ import annotations
 import os
 import shlex
 import subprocess
-import time
 from typing import Callable, Dict, List, Optional, Sequence
 
 from ..util.log import get_logger
+from ..util.timer import real_monotonic
 
 log = get_logger("History")
 
@@ -154,7 +154,7 @@ class ArchivePool:
             a.name: a for a in self.archives}
         self._health: Dict[str, _ArchiveHealth] = {
             a.name: _ArchiveHealth() for a in self.archives}
-        self._now = now_fn or time.monotonic
+        self._now = now_fn or real_monotonic
         self.metrics = metrics
         self.failovers = 0
 

@@ -25,6 +25,7 @@ from ..ledger.ledgertxn import (
 )
 from ..transactions.account_helpers import make_account_entry
 from ..util.log import get_logger
+from ..util.threads import main_thread_only
 from ..xdr import (
     LedgerHeader, LedgerKey, LedgerUpgrade, StellarValue,
     StellarValueExt, TransactionHistoryEntry, TransactionSet,
@@ -151,6 +152,7 @@ class LedgerManager:
         return self.state == LedgerManagerState.LM_SYNCED_STATE
 
     # -- externalization ----------------------------------------------------
+    @main_thread_only
     def value_externalized(self, lcd: LedgerCloseData) -> None:
         lcl = self.last_closed_ledger_num()
         if self.state == LedgerManagerState.LM_CATCHING_UP_STATE:
@@ -172,6 +174,7 @@ class LedgerManager:
                 self.catchup_trigger(lcd)
 
     # -- the close ----------------------------------------------------------
+    @main_thread_only
     def close_ledger(self, lcd: LedgerCloseData) -> None:
         header_prev = _copy_header_fast(self.lcl_header)
         assert lcd.ledger_seq == header_prev.ledgerSeq + 1, "non-sequential"

@@ -67,12 +67,19 @@ class Application:
         # app.faults (or a direct reference installed below), and an
         # unconfigured injector is a dict miss per check
         import os as _os
-        from ..util.faults import FaultInjector
+        from ..util.faults import KNOWN_SITES, FaultInjector
         self.faults = FaultInjector(
             seed=int(_os.environ.get("SCT_FAULTS_SEED",
                                      config.FAULTS_SEED)),
             metrics=self.metrics, tracer=self.tracer)
         for site, d in config.FAULTS.items():
+            if site not in KNOWN_SITES:
+                # operator-facing like the env spec and the admin
+                # endpoint: a typo'd config table must kill the node at
+                # startup, not soak a chaos run fault-free
+                raise ValueError(
+                    "unknown fault site %r in FAULTS config; known "
+                    "sites: %s" % (site, ", ".join(sorted(KNOWN_SITES))))
             self.faults.configure(
                 site, probability=float(d.get("p", 1.0)),
                 count=d.get("n"), after=int(d.get("after", 0)))

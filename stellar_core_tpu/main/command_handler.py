@@ -49,6 +49,29 @@ def _int_param(params: Dict[str, str], key: str,
     return v
 
 
+def _float_param(params: Dict[str, str], key: str,
+                 default: Optional[float] = None,
+                 minimum: Optional[float] = None,
+                 maximum: Optional[float] = None) -> Optional[float]:
+    raw = params.get(key)
+    if raw is None or raw == "":
+        return default
+    try:
+        v = float(raw)
+    except (TypeError, ValueError):
+        raise CommandParamError(
+            "parameter %r must be a number, got %r" % (key, raw))
+    if v != v:   # NaN compares false against any bound
+        raise CommandParamError("parameter %r must not be NaN" % key)
+    if minimum is not None and v < minimum:
+        raise CommandParamError(
+            "parameter %r must be >= %g, got %g" % (key, minimum, v))
+    if maximum is not None and v > maximum:
+        raise CommandParamError(
+            "parameter %r must be <= %g, got %g" % (key, maximum, v))
+    return v
+
+
 class CommandHandler:
     def __init__(self, app) -> None:
         self.app = app
@@ -175,10 +198,24 @@ class CommandHandler:
             site = params.get("site")
             if not site:
                 return {"error": "missing 'site' param"}
+            from ..util.faults import KNOWN_SITES
+            if site not in KNOWN_SITES:
+                # arming a typo'd site would silently no-op forever:
+                # validate against the F1 registry (docs/robustness.md)
+                raise CommandParamError(
+                    "unknown fault site %r; known sites: %s"
+                    % (site, ", ".join(sorted(KNOWN_SITES))))
+            p = _float_param(params, "p", 1.0, minimum=0.0, maximum=1.0)
+            if p == 0.0:
+                # p=0 would arm a site that can never fire — the same
+                # silent-no-op class the unknown-site 400 prevents
+                raise CommandParamError(
+                    "parameter 'p' must be > 0 (use action=clear to "
+                    "disarm a site)")
             faults.configure(
-                site, probability=float(params.get("p", 1.0)),
-                count=int(params["n"]) if "n" in params else None,
-                after=int(params.get("after", 0)))
+                site, probability=p,
+                count=_int_param(params, "n", None, minimum=1),
+                after=_int_param(params, "after", 0, minimum=0))
             return {"status": "armed", **faults.to_json()}
         if action == "clear":
             faults.clear(params.get("site"))

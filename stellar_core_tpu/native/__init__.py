@@ -23,7 +23,18 @@ from typing import Optional
 import numpy as np
 
 _DIR = os.path.dirname(os.path.abspath(__file__))
-_BUILD = os.path.join(_DIR, "build")
+# SCT_SANITIZE=1 reroutes every native build into build/sanitized/ with
+# -fsanitize=address,undefined: tools/build_native_sanitized.sh compiles
+# all three extensions there, and the `sanitize`-marked differential
+# tests run under them with libasan preloaded (docs/static-analysis.md
+# "Sanitized native builds"). Read at import so one process is wholly
+# sanitized or wholly not — mixing ASan and non-ASan libs in-process is
+# UB.
+SANITIZE = os.environ.get("SCT_SANITIZE") == "1"
+_BUILD = os.path.join(_DIR, "build", "sanitized") if SANITIZE \
+    else os.path.join(_DIR, "build")
+_SANITIZE_FLAGS = ["-fsanitize=address,undefined",
+                   "-fno-omit-frame-pointer", "-g"]
 _LOCK = threading.Lock()
 _LIB: Optional[ctypes.CDLL] = None
 _TRIED = False
@@ -33,15 +44,16 @@ def _cc_build(src_path: str, so_path: str, include_dir: str) -> bool:
     """Try cc/gcc/g++ -O2 -shared -fPIC; atomic-rename into so_path.
     Shared by the prep library and the XDR extension builds."""
     import tempfile
+    extra = _SANITIZE_FLAGS if SANITIZE else []
     for cc in ("cc", "gcc", "g++"):
         tmp = tempfile.NamedTemporaryFile(
             dir=_BUILD, suffix=".so", delete=False)
         tmp.close()
         try:
             r = subprocess.run(
-                [cc, "-O2", "-shared", "-fPIC", "-I", include_dir,
-                 "-o", tmp.name, src_path],
-                capture_output=True, text=True, timeout=120)
+                [cc, "-O2", "-shared", "-fPIC"] + extra +
+                ["-I", include_dir, "-o", tmp.name, src_path],
+                capture_output=True, text=True, timeout=300)
         except (OSError, subprocess.TimeoutExpired):
             os.unlink(tmp.name)
             continue

@@ -27,6 +27,7 @@ from typing import Callable, Deque, Dict, Optional, Tuple
 
 from ..util import rnd
 from ..util.log import get_logger
+from ..util.threads import TrackedLock
 from ..util.timer import VirtualTimer
 
 log = get_logger("Overlay")
@@ -213,7 +214,7 @@ class TCPReactor:
 
     def __init__(self, clock) -> None:
         self.clock = clock
-        self._lock = threading.Lock()
+        self._lock = TrackedLock("overlay.reactor")
         self._transports: Dict[socket.socket, "TCPTransport"] = {}
         self._doors: Dict[socket.socket, Callable] = {}
         self._wake_r, self._wake_w = socket.socketpair()

@@ -9,6 +9,7 @@ from __future__ import annotations
 
 from typing import Dict, List, Optional
 
+from ..util.threads import main_thread_only
 from ..xdr import NodeID, SCPEnvelope, SCPQuorumSet
 from .ballot import BallotProtocol
 from .driver import SCPDriver
@@ -44,10 +45,12 @@ class SCP:
             del self.known_slots[idx]
 
     # -- protocol entry points ----------------------------------------------
+    @main_thread_only
     def receive_envelope(self, envelope: SCPEnvelope) -> int:
         return self.get_slot(
             envelope.statement.slotIndex).process_envelope(envelope)
 
+    @main_thread_only
     def nominate(self, slot_index: int, value: bytes,
                  previous_value: bytes) -> bool:
         assert self.local_node.is_validator
@@ -76,6 +79,7 @@ class SCP:
             return []
         return [e for e in s.get_current_state()]
 
+    @main_thread_only
     def set_state_from_envelope(self, envelope: SCPEnvelope) -> None:
         """Restore persisted state (reference setStateFromEnvelope)."""
         self.get_slot(envelope.statement.slotIndex).set_state_from_envelope(

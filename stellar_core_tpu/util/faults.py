@@ -32,6 +32,23 @@ from .log import get_logger
 
 log = get_logger("Fault")
 
+# The F1 site registry: every `should_fire`/`fire_point` literal in the
+# tree must be listed here, and every entry here must be cataloged in
+# docs/robustness.md — both directions enforced by the F1 static rule
+# (stellar_core_tpu/analysis, tests/test_static_analysis.py). The admin
+# `faults?action=set` endpoint validates against this set, so a typo'd
+# site name is a 400, not a silently-armed no-op.
+KNOWN_SITES = frozenset({
+    "device.dispatch",
+    "overlay.drop",
+    "overlay.delay",
+    "overlay.duplicate",
+    "overlay.reorder",
+    "archive.get-fail",
+    "archive.corrupt",
+    "archive.short-read",
+})
+
 
 class InjectedFault(Exception):
     """Raised by call sites that turn a fired fault point into an
@@ -75,6 +92,13 @@ class FaultInjector:
     # -- configuration -------------------------------------------------------
     def configure(self, name: str, probability: float = 1.0,
                   count: Optional[int] = None, after: int = 0) -> FaultSite:
+        if name not in KNOWN_SITES:
+            # warn, don't raise: tests arm synthetic sites on purpose;
+            # the operator-facing paths (admin endpoint, SCT_FAULTS env
+            # spec) validate strictly before reaching here
+            log.warning("arming fault site %r not in the F1 registry "
+                        "(util.faults.KNOWN_SITES) — no code checks it, "
+                        "so it will never fire", name)
         site = FaultSite(name, probability, count, after, seed=self.seed)
         self._sites[name] = site
         log.info("fault point %s armed: p=%g count=%s after=%d",
@@ -83,12 +107,19 @@ class FaultInjector:
 
     def configure_from_spec(self, spec: str) -> None:
         """Parse `site:p=0.5,n=3,after=2;site2` (missing fields default to
-        p=1, unlimited, no skip) — the SCT_FAULTS env format."""
+        p=1, unlimited, no skip) — the SCT_FAULTS env format. Operator
+        input: unknown site names raise, so a typo'd chaos run dies at
+        startup instead of soaking fault-free."""
         for part in spec.split(";"):
             part = part.strip()
             if not part:
                 continue
             name, _, argstr = part.partition(":")
+            if name.strip() not in KNOWN_SITES:
+                raise ValueError(
+                    "unknown fault site %r in SCT_FAULTS spec; known "
+                    "sites: %s" % (name.strip(),
+                                   ", ".join(sorted(KNOWN_SITES))))
             kwargs: dict = {}
             for kv in argstr.split(","):
                 kv = kv.strip()

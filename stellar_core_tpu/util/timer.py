@@ -25,6 +25,20 @@ class ClockMode(Enum):
     VIRTUAL_TIME = 1
 
 
+def real_monotonic() -> float:
+    """Wall-clock monotonic seconds. The ONE sanctioned escape hatch for
+    code that measures real elapsed time with no app clock injected
+    (breaker defaults, archive backoff defaults): routing through here
+    keeps `time.monotonic` call sites out of subsystem modules, where
+    the D1 static rule (stellar_core_tpu/analysis) would flag them."""
+    return _time.monotonic()
+
+
+def real_perf_counter() -> float:
+    """Wall-clock perf_counter; same contract as real_monotonic."""
+    return _time.perf_counter()
+
+
 class _Event:
     __slots__ = ("when", "seq", "fn", "cancelled")
 

@@ -92,3 +92,17 @@ def _reseed_rng():
     from stellar_core_tpu.util import rnd
     rnd.reseed(0xFEEDFACE)
     yield
+
+
+@pytest.fixture(autouse=True)
+def _thread_discipline():
+    """Arm the runtime thread-discipline checks (util/threads.py) for the
+    whole run: `@main_thread_only` affinity asserts and the lock-order
+    checker are live in every tier-1 test, binding the pytest thread as
+    THE main/consensus thread (it is the thread that cranks every
+    VirtualClock). Re-armed per test so a test that rebinds or disarms
+    can't leak state."""
+    from stellar_core_tpu.util import threads
+    threads.arm()
+    yield
+    threads.disarm()

@@ -81,6 +81,37 @@ def test_numeric_param_validation_returns_400_not_500(app):
     assert st == 200 and body["enabled"] is False
 
 
+def test_faults_set_rejects_unknown_site_with_400(app):
+    """ISSUE 5 satellite: arming a typo'd site would silently no-op
+    forever; `set` validates against the F1 registry
+    (util.faults.KNOWN_SITES, docs/robustness.md site catalog)."""
+    st, body = cmd(app, "faults", action="set", site="device.dispach")
+    assert st == 400
+    assert "unknown fault site" in body["error"]
+    assert "device.dispatch" in body["error"]   # suggests the catalog
+    assert not app.faults.configured()          # nothing got armed
+
+    # malformed schedule params are 400s too, not 500 stack traces
+    # n=0 and p=0 included: a count-0 or probability-0 site would be
+    # armed yet never fire — the same silent-no-op class the
+    # unknown-site 400 exists to prevent
+    for bad in ({"p": "lots"}, {"p": "-0.5"}, {"p": "1.5"}, {"p": "nan"},
+                {"p": "0"}, {"n": "-3"}, {"n": "0"}, {"after": "-1"}):
+        st, body = cmd(app, "faults", action="set",
+                       site="device.dispatch", **bad)
+        assert st == 400 and "parameter" in body["error"], (bad, body)
+    assert not app.faults.configured()
+
+    # a registered site still arms and clears
+    st, body = cmd(app, "faults", action="set", site="device.dispatch",
+                   p="0.5", n="3", after="2")
+    assert st == 200 and body["status"] == "armed"
+    s = body["sites"]["device.dispatch"]
+    assert (s["probability"], s["remaining"], s["skip"]) == (0.5, 3, 2)
+    st, body = cmd(app, "faults", action="clear")
+    assert st == 200 and not app.faults.configured()
+
+
 def test_metrics_prometheus_format_over_http(app):
     """format=prometheus serves text exposition with the 0.0.4 content
     type through the real HTTP server."""

@@ -73,6 +73,11 @@ def test_fault_spec_parsing_and_metrics():
     assert js["sites"]["overlay.drop"]["probability"] == 0.25
     with pytest.raises(ValueError):
         f.configure_from_spec("bad:q=1")
+    # ISSUE 5: operator-facing spec rejects sites outside the F1
+    # registry, so a typo'd SCT_FAULTS dies at startup instead of
+    # soaking fault-free
+    with pytest.raises(ValueError, match="unknown fault site"):
+        f.configure_from_spec("device.dispach:p=1")
 
 
 def test_fault_unconfigured_site_is_silent():
@@ -455,3 +460,14 @@ def test_config_and_env_arm_faults(monkeypatch):
     assert js["seed"] == 9
     assert js["sites"]["overlay.drop"]["probability"] == 0.5
     assert js["sites"]["archive.get-fail"]["remaining"] == 2
+
+
+def test_config_faults_table_rejects_unknown_site():
+    """ISSUE 5: the config-file arming path validates against the F1
+    registry like the env spec and the admin endpoint — a typo'd FAULTS
+    table kills the node at startup instead of soaking fault-free."""
+    from stellar_core_tpu.main.application import Application
+    cfg = Config.test_config(43)
+    cfg.FAULTS = {"device.dispach": {"p": 1.0}}
+    with pytest.raises(ValueError, match="unknown fault site"):
+        Application(VirtualClock(ClockMode.VIRTUAL_TIME), cfg)

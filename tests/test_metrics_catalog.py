@@ -1,42 +1,44 @@
-"""Metric-name drift guard (ISSUE 4 satellite): every metric registered
+"""Metric-name drift guard (ISSUE 4 satellite, generalized into the
+sctlint rule engine as rule M1 in ISSUE 5): every metric registered
 anywhere in `stellar_core_tpu/` must be documented in docs/metrics.md,
-so the catalog can never silently rot. Dynamic names (`"%s"`-formatted)
-are checked by their literal prefix.
+so the catalog can never silently rot. Dynamic names (`"%s"`-formatted
+or f-strings) are checked by their literal prefix.
+
+The scan itself now lives in `stellar_core_tpu.analysis` (AST-based,
+shared with the sctlint CLI and tests/test_static_analysis.py); this
+file keeps the original self-test contract: the scanner must keep
+finding the known core metrics, and the doc check must stay green.
 """
 
-import os
-import re
+import ast
+import dataclasses
 
-REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
-PKG = os.path.join(REPO, "stellar_core_tpu")
-DOC = os.path.join(REPO, "docs", "metrics.md")
-
-# new_meter("name"), including names split onto the following line; the
-# DOTALL window is kept short so we never jump to a different call's
-# string argument
-_CALL_RE = re.compile(
-    r"new_(?:counter|meter|timer|histogram)\(\s*[\"']([^\"']+)[\"']",
-    re.DOTALL)
+from stellar_core_tpu.analysis import default_config, run_analysis
+from stellar_core_tpu.analysis import rules as R
+from stellar_core_tpu.analysis.engine import _py_files
 
 
-def registered_metric_names():
+def _m1_config():
+    # only the M1 rule: this test must not re-pay the T1 call-graph
+    # walk etc. that tests/test_static_analysis.py already runs
+    return dataclasses.replace(default_config(), enabled_rules=("M1",))
+
+
+def _registered_metric_names():
+    cfg = _m1_config()
     names = set()
-    for dirpath, _dirnames, filenames in os.walk(PKG):
-        for fn in filenames:
-            if not fn.endswith(".py"):
-                continue
-            with open(os.path.join(dirpath, fn)) as fh:
-                src = fh.read()
-            for m in _CALL_RE.finditer(src):
-                names.add(m.group(1))
+    for p in _py_files(cfg.package_dir):   # engine's walk: one skip list
+        with open(p, encoding="utf-8") as fh:
+            facts = R.ModuleFacts(p, ast.parse(fh.read()))
+        names.update(n for (_l, n, _q) in facts.metric_literals)
     return names
 
 
 def test_call_site_scan_finds_the_known_core_metrics():
     """The scanner itself must keep working: if a refactor changes the
-    registration idiom and the regex finds nothing, this fails before
-    the doc check silently passes on an empty set."""
-    names = registered_metric_names()
+    registration idiom and the AST collector finds nothing, this fails
+    before the doc check silently passes on an empty set."""
+    names = _registered_metric_names()
     assert len(names) >= 20
     for expected in ("ledger.ledger.close", "scp.envelope.receive",
                      "overlay.message.broadcast",
@@ -45,15 +47,8 @@ def test_call_site_scan_finds_the_known_core_metrics():
 
 
 def test_every_registered_metric_is_documented():
-    with open(DOC) as fh:
-        doc = fh.read()
-    missing = []
-    for name in sorted(registered_metric_names()):
-        # dynamic names ("fault.injected.%s") are documented by their
-        # literal prefix ("fault.injected.<site>" contains it)
-        probe = name.split("%")[0]
-        if probe not in doc:
-            missing.append(name)
+    res = run_analysis(_m1_config())
+    missing = [f.format() for f in res.violations if f.rule == "M1"]
     assert not missing, (
         "metrics registered in code but absent from docs/metrics.md "
         "(add them to the catalog table): %s" % missing)

@@ -1,0 +1,87 @@
+"""Sanitized native builds (ISSUE 5): compile all three C extensions
+(plus the xdrc serializer) with -fsanitize=address,undefined and run the
+native differential-oracle tests under ASan/UBSan in a subprocess.
+
+Marked `slow` + `sanitize`: tier-1 skips it (the sanitized compile alone
+is ~20s, the oracle run minutes); run explicitly with
+
+    python -m pytest tests/test_native_sanitized.py -m sanitize
+
+or via `tools/build_native_sanitized.sh --check` (same machinery).
+"""
+
+import os
+import shutil
+import subprocess
+import sys
+
+import pytest
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+pytestmark = [pytest.mark.slow, pytest.mark.sanitize]
+
+
+def _sanitizer_env():
+    cc = shutil.which("cc")
+    if cc is None:
+        pytest.skip("no C compiler")
+    libasan = subprocess.run(
+        [cc, "-print-file-name=libasan.so"],
+        capture_output=True, text=True).stdout.strip()
+    if not libasan or not os.path.exists(libasan):
+        pytest.skip("cc has no libasan runtime")
+    libstdcpp = subprocess.run(
+        [cc, "-print-file-name=libstdc++.so"],
+        capture_output=True, text=True).stdout.strip()
+    env = dict(os.environ)
+    env.update({
+        "SCT_SANITIZE": "1",
+        # libstdc++ must be resolvable when ASan's interceptors
+        # initialize or the first C++ throw (JAX/XLA) aborts with
+        # "real___cxa_throw != 0"
+        "LD_PRELOAD": "%s %s" % (libasan, libstdcpp),
+        # CPython deliberately leaks at exit; leak reports would bury
+        # the memory-error signal the build exists to catch
+        "ASAN_OPTIONS": "detect_leaks=0",
+        "JAX_PLATFORMS": "cpu",
+    })
+    return env
+
+
+def test_sanitized_build_compiles_all_three_extensions():
+    env = _sanitizer_env()
+    r = subprocess.run(
+        [sys.executable, "-c",
+         "from stellar_core_tpu import native\n"
+         "assert native.SANITIZE and native._BUILD.endswith('sanitized')\n"
+         "assert native.available(), 'prep failed'\n"
+         "assert native.ed25519_native() is not None, 'ed25519c failed'\n"
+         "assert native.apply_engine() is not None, 'applyc failed'\n"
+         "native._compile_xdr_ext()\n"
+         "assert native._XDR_MOD is not None, 'xdrc failed'\n"
+         "print('SANITIZED-BUILD-OK')"],
+        capture_output=True, text=True, cwd=REPO, env=env, timeout=600)
+    assert r.returncode == 0, (r.stdout, r.stderr)
+    assert "SANITIZED-BUILD-OK" in r.stdout
+    # any sanitizer finding prints a report on stderr even when the
+    # process exits 0 (halt_on_error defaults can vary)
+    assert "ERROR: AddressSanitizer" not in r.stderr
+    assert "runtime error:" not in r.stderr
+
+
+def test_native_differential_oracles_pass_under_asan_ubsan():
+    """The acceptance gate: the prep/apply/xdr oracle suites — the tests
+    that compare every native path against its Python twin — run green
+    with the sanitized libraries loaded."""
+    env = _sanitizer_env()
+    r = subprocess.run(
+        [sys.executable, "-m", "pytest",
+         "tests/test_native_prep.py", "tests/test_native_apply.py",
+         "tests/test_native_xdr.py",
+         "-q", "-p", "no:cacheprovider"],
+        capture_output=True, text=True, cwd=REPO, env=env, timeout=1800)
+    tail = (r.stdout or "")[-4000:] + (r.stderr or "")[-4000:]
+    assert r.returncode == 0, tail
+    assert "ERROR: AddressSanitizer" not in r.stderr, r.stderr[-4000:]
+    assert "runtime error:" not in r.stderr, r.stderr[-4000:]

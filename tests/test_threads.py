@@ -1,0 +1,232 @@
+"""Runtime thread-discipline checks (ISSUE 5, util/threads.py): the
+`@main_thread_only` affinity asserts and the lock-order checker — the
+runtime twins of the static T1 rule (stellar_core_tpu/analysis).
+
+The autouse `_thread_discipline` fixture (tests/conftest.py) arms both
+for every tier-1 test, so this file mostly exercises the failure modes;
+the whole rest of the suite exercises the armed-but-quiet path.
+"""
+
+import threading
+import time
+
+import pytest
+
+from stellar_core_tpu.util import threads
+from stellar_core_tpu.util.threads import (
+    LockOrderError, ThreadDisciplineError, TrackedLock, assert_main_thread,
+    main_thread_only,
+)
+
+
+def _run_in_thread(fn):
+    """Run fn on a worker, returning (result, exception)."""
+    box = {"res": None, "exc": None}
+
+    def run():
+        try:
+            box["res"] = fn()
+        except BaseException as e:
+            box["exc"] = e
+
+    t = threading.Thread(target=run)
+    t.start()
+    t.join(timeout=10)
+    assert not t.is_alive()
+    return box["res"], box["exc"]
+
+
+# -- affinity ---------------------------------------------------------------
+
+
+def test_assert_main_thread_passes_on_the_armed_thread():
+    assert threads.is_armed()   # conftest armed us
+    assert_main_thread("test")  # no raise
+
+
+def test_assert_main_thread_fires_from_a_worker():
+    _res, exc = _run_in_thread(lambda: assert_main_thread("the close path"))
+    assert isinstance(exc, ThreadDisciplineError)
+    assert "the close path" in str(exc)
+
+
+def test_disarmed_is_a_noop_everywhere():
+    threads.disarm()
+    try:
+        _res, exc = _run_in_thread(lambda: assert_main_thread("x"))
+        assert exc is None
+    finally:
+        threads.arm()
+
+
+def test_decorator_registers_and_guards():
+    @main_thread_only
+    def touchy():
+        return 42
+
+    assert "touchy" in {q.split(".")[-1]
+                        for q in threads.MAIN_THREAD_REGISTRY}
+    assert touchy() == 42
+    _res, exc = _run_in_thread(touchy)
+    assert isinstance(exc, ThreadDisciplineError)
+    assert "touchy" in str(exc)
+
+
+def test_registry_covers_the_hot_mutation_points():
+    """The static T1 rule and the chaos soak both assume these entry
+    points are marked; a refactor that drops one must fail here."""
+    import stellar_core_tpu.bucket.bucket_manager  # noqa: F401
+    import stellar_core_tpu.herder.herder  # noqa: F401
+    import stellar_core_tpu.herder.tx_queue  # noqa: F401
+    import stellar_core_tpu.ledger.ledger_manager  # noqa: F401
+    import stellar_core_tpu.scp.scp  # noqa: F401
+
+    reg = set(threads.MAIN_THREAD_REGISTRY)
+    for qual in ("Herder.recv_scp_envelope", "Herder.trigger_next_ledger",
+                 "Herder.value_externalized",
+                 "LedgerManager.value_externalized",
+                 "LedgerManager.close_ledger",
+                 "SCP.receive_envelope", "SCP.nominate",
+                 "SCP.set_state_from_envelope",
+                 "BucketManager.add_batch", "TransactionQueue.try_add"):
+        assert qual in reg, "unmarked mutation point: %s" % qual
+
+
+def test_worker_calling_marked_herder_entry_point_raises():
+    """ISSUE 5 satellite: a worker thread touching a marked Herder entry
+    point fires the affinity assert before any state is mutated."""
+    from stellar_core_tpu.main.application import Application
+    from stellar_core_tpu.main.config import Config
+    from stellar_core_tpu.util.timer import ClockMode, VirtualClock
+
+    app = Application(VirtualClock(ClockMode.VIRTUAL_TIME),
+                      Config.test_config(0))
+    app.start()
+    lcl = app.ledger_manager.last_closed_ledger_num()
+
+    _res, exc = _run_in_thread(
+        lambda: app.herder.trigger_next_ledger(lcl + 1))
+    assert isinstance(exc, ThreadDisciplineError)
+    assert "trigger_next_ledger" in str(exc)
+    # and the same call from the main thread is fine
+    app.herder.trigger_next_ledger(lcl + 1)
+
+
+# -- lock order -------------------------------------------------------------
+
+
+def test_lock_order_inversion_raises_with_both_stacks():
+    a = TrackedLock("test.order.a")
+    b = TrackedLock("test.order.b")
+
+    def order_ab():
+        with a:
+            with b:
+                pass
+
+    order_ab()                       # establishes a -> b
+    with pytest.raises(LockOrderError) as ei:
+        with b:
+            with a:                  # b -> a closes the cycle
+                pass
+    msg = str(ei.value)
+    assert "test.order.a" in msg and "test.order.b" in msg
+    # both acquisition stacks: the current one and the recorded one that
+    # created the conflicting edge — each names this test function
+    assert msg.count("order_ab") >= 1
+    assert msg.count("test_lock_order_inversion_raises_with_both_stacks") >= 1
+    assert "--- current acquisition" in msg
+    assert "--- established order" in msg
+    assert "<stack unavailable>" not in msg
+
+
+def test_lock_order_cycle_through_three_locks():
+    a, b, c = (TrackedLock("test.tri.%s" % n) for n in "abc")
+    with a:
+        with b:
+            pass
+    with b:
+        with c:
+            pass
+    with pytest.raises(LockOrderError) as ei:
+        with c:
+            with a:
+                pass
+    msg = str(ei.value)
+    # the transitive path is named, with the recorded stack of EVERY
+    # established hop that closes the cycle (not a made-up direct edge)
+    assert "test.tri.a -> test.tri.b -> test.tri.c" in msg
+    assert msg.count("--- established order") == 2
+    assert "<stack unavailable>" not in msg
+
+
+def test_same_order_repeated_is_fine_and_releases_unwind():
+    a = TrackedLock("test.rep.a")
+    b = TrackedLock("test.rep.b")
+    for _ in range(3):
+        with a:
+            with b:
+                pass
+    # non-LIFO release must not corrupt the held stack
+    a.acquire()
+    b.acquire()
+    a.release()
+    b.release()
+    with a:
+        with b:
+            pass
+
+
+def test_tracked_lock_still_a_real_lock():
+    lk = TrackedLock("test.real")
+    assert lk.acquire()
+    assert lk.locked()
+    assert not lk.acquire(blocking=False)
+    lk.release()
+    assert not lk.locked()
+
+
+def test_disarmed_tracked_lock_overhead_is_negligible():
+    """Same contract as the tracer's overhead guard: disarmed, the
+    tracked lock must cost within ~4x of a raw threading.Lock (one
+    module-global bool check on top)."""
+    threads.disarm()
+    try:
+        raw = threading.Lock()
+        tracked = TrackedLock("test.overhead")
+        n = 20000
+
+        t0 = time.perf_counter()
+        for _ in range(n):
+            with raw:
+                pass
+        raw_cost = time.perf_counter() - t0
+
+        t0 = time.perf_counter()
+        for _ in range(n):
+            with tracked:
+                pass
+        tracked_cost = time.perf_counter() - t0
+    finally:
+        threads.arm()
+    assert tracked_cost < raw_cost * 4 + 0.05, (raw_cost, tracked_cost)
+
+
+def test_armed_run_keeps_production_locks_cycle_free():
+    """Drive a small consensus burst with the checker armed: the
+    production TrackedLocks (verify cache, threaded verifier, reactor)
+    must establish a consistent order — any inversion raises right
+    here."""
+    from stellar_core_tpu.main.application import Application
+    from stellar_core_tpu.main.config import Config
+    from stellar_core_tpu.testing import AppLedgerAdapter
+    from stellar_core_tpu.util.timer import ClockMode, VirtualClock
+
+    app = Application(VirtualClock(ClockMode.VIRTUAL_TIME),
+                      Config.test_config(0))
+    app.start()
+    adapter = AppLedgerAdapter(app)
+    root = adapter.root_account()
+    alice = root.create(10**9)
+    assert alice.pay(root, 10**6)
+    assert app.ledger_manager.last_closed_ledger_num() >= 3

@@ -1,0 +1,56 @@
+#!/usr/bin/env bash
+# Build all three native C extensions (prep / ed25519c / applyc, plus
+# the xdrc serializer) with AddressSanitizer + UndefinedBehaviorSanitizer
+# into stellar_core_tpu/native/build/sanitized/, and print the LD_PRELOAD
+# line needed to run Python against them.
+#
+#   tools/build_native_sanitized.sh          # build
+#   tools/build_native_sanitized.sh --check  # build + run the native
+#                                            # differential oracles under ASan
+#
+# The pytest equivalent of --check is the `sanitize` marker:
+#   python -m pytest tests/test_native_sanitized.py -m sanitize
+set -euo pipefail
+cd "$(dirname "$0")/.."
+
+LIBASAN="$(cc -print-file-name=libasan.so)"
+if [ ! -e "$LIBASAN" ]; then
+    echo "error: cc has no libasan.so — install gcc's sanitizer runtime" >&2
+    exit 2
+fi
+# libstdc++ must be resolvable when ASan's interceptors initialize, or
+# the first C++ throw (JAX/XLA) dies with "real___cxa_throw != 0"
+PRELOAD="$LIBASAN $(cc -print-file-name=libstdc++.so)"
+
+# build phase needs no preload (the compiler links the runtime); loading
+# the resulting .so does, so the import probes run under LD_PRELOAD.
+# detect_leaks=0: CPython intentionally leaks at exit and would drown
+# real reports.
+SCT_SANITIZE=1 LD_PRELOAD="$PRELOAD" ASAN_OPTIONS=detect_leaks=0 \
+python - <<'EOF'
+from stellar_core_tpu import native
+
+built = {
+    "prep (libsctprep)": native.available(),
+    "ed25519c (libscted25519)": native.ed25519_native() is not None,
+    "applyc (_sctapply)": native.apply_engine() is not None,
+    "xdrc (_sctxdr)": (native._compile_xdr_ext() or True) and
+                      native._XDR_MOD is not None,
+}
+for name, ok in built.items():
+    print("%-28s %s" % (name, "OK" if ok else "FAILED"))
+if not all(built.values()):
+    raise SystemExit(1)
+print("sanitized build dir:", native._BUILD)
+EOF
+
+echo
+echo "run the differential oracles under ASan/UBSan with:"
+echo "  SCT_SANITIZE=1 LD_PRELOAD=\"$PRELOAD\" ASAN_OPTIONS=detect_leaks=0 \\"
+echo "    python -m pytest tests/test_native_prep.py tests/test_native_apply.py tests/test_native_xdr.py -q"
+
+if [ "${1:-}" = "--check" ]; then
+    SCT_SANITIZE=1 LD_PRELOAD="$PRELOAD" ASAN_OPTIONS=detect_leaks=0 \
+    python -m pytest tests/test_native_prep.py tests/test_native_apply.py \
+        tests/test_native_xdr.py -q -p no:cacheprovider
+fi
