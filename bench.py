@@ -159,6 +159,28 @@ def device_full_bench(partial_path: str, batch: int = 8192,
     results["compile_warm_s"] = round(time.perf_counter() - t_w, 2)
     flush("warm_compile")
 
+    # stage 2b: cockpit warmup — the same bucket shape through the
+    # verifier's instrumented warmup path (ISSUE 6 satellite), so
+    # compile-cache hit/miss and per-bucket warmup seconds land in the
+    # artifact AND the cached last_device block: warm-restart
+    # time-to-full-rate is recorded from this device run onward.
+    try:
+        from stellar_core_tpu.crypto.batch_verifier import (
+            TpuSigVerifier, VerifierStats)
+        v = TpuSigVerifier()
+        v.BUCKETS = (batch,)   # instance override; class attr untouched
+        v.stats = VerifierStats()
+        jax.clear_caches()     # a fresh process's in-memory state
+        v.warmup(wait=True)
+        w = v.stats.warmup
+        results["warmup_state"] = w["state"]
+        results["warmup_buckets_s"] = {
+            b: info["seconds"] for b, info in w["buckets"].items()}
+        results["compile_cache"] = dict(v.stats.compile_cache)
+    except Exception as e:   # noqa: BLE001 - recorded, not swallowed
+        results["warmup_error"] = repr(e)[:200]
+    flush("cockpit_warmup")
+
     # stage 3: replay, tpu backend (cpu leg runs in a scrubbed child so
     # the ratio's denominator never touches the relay). The stage flushes
     # at each internal phase (publish, each replay attempt) so the
@@ -176,7 +198,7 @@ def device_full_bench(partial_path: str, batch: int = 8192,
 
 def replay_bench(backend: str, n_checkpoints: int = 4,
                  txs_per_ledger: int = 100, sigs_per_tx: int = 20,
-                 progress=None) -> dict:
+                 progress=None, repeats: int | None = None) -> dict:
     """Catchup-replay benchmark: the second north-star metric
     (BASELINE.md: >=5x pubnet replay vs libsodium CPU; reference
     methodology /root/reference/performance-eval/performance-eval.md:52-66).
@@ -209,6 +231,9 @@ def replay_bench(backend: str, n_checkpoints: int = 4,
     # RTT-bound at this scale — and its one-off cold compile overran the
     # stall watchdog, which kills the child and wedges the relay.)
     from stellar_core_tpu.crypto.batch_verifier import TpuSigVerifier
+    old_buckets = TpuSigVerifier.BUCKETS   # restored below: the tiny
+    # --compare leg runs this function IN-PROCESS (tier-1 test), where a
+    # leaked class-attr override would bleed into later tests
     TpuSigVerifier.BUCKETS = (8192,)
     tmp = tempfile.mkdtemp(prefix="sct-replay-")
     try:
@@ -359,7 +384,8 @@ def replay_bench(backend: str, n_checkpoints: int = 4,
                     "crypto_sigs": crypto["sigs"],
                     "phase_breakdown": phase_breakdown}
 
-        repeats = int(os.environ.get("BENCH_REPLAY_REPEATS", "2"))
+        if repeats is None:
+            repeats = int(os.environ.get("BENCH_REPLAY_REPEATS", "2"))
         best = None
         for k in range(max(1, repeats)):
             r = one_replay()
@@ -369,6 +395,7 @@ def replay_bench(backend: str, n_checkpoints: int = 4,
                 best = r
         return best
     finally:
+        TpuSigVerifier.BUCKETS = old_buckets
         shutil.rmtree(tmp, ignore_errors=True)
 
 
@@ -474,6 +501,90 @@ def fleet_bench(n_nodes: int = 3, n_ledgers: int = 12) -> dict:
     }
     sim.stop_all_nodes()
     return out
+
+
+def _bench_compare_mod():
+    """The perf-regression ledger module (tools/bench_compare.py) —
+    stdlib-only, never imports jax."""
+    if _REPO not in sys.path:
+        sys.path.insert(0, _REPO)
+    from tools import bench_compare
+    return bench_compare
+
+
+def _git_commit() -> str | None:
+    try:
+        out = subprocess.run(
+            ["git", "rev-parse", "--short", "HEAD"], cwd=_REPO,
+            capture_output=True, text=True, timeout=10)
+        return out.stdout.strip() or None
+    except Exception:   # noqa: BLE001 - commit stamp is best-effort
+        return None
+
+
+def compare_leg() -> list:
+    """Tiny deterministic CPU replay leg for the regression gate
+    (ISSUE 6): seeded content, cpu backend, one checkpoint — the full
+    bench compressed into seconds. Records key by platform "cpu-tiny" /
+    "openssl-cpu-tiny", so they only ever gate against tiny-leg
+    baselines, never against full-leg or device history. Pure Python
+    (no jax import): safe to run inline and in tier-1."""
+    bc = _bench_compare_mod()
+    src = "bench.py --compare"
+    r = replay_bench("cpu", n_checkpoints=1, txs_per_ledger=4,
+                     sigs_per_tx=2, repeats=1)
+    return [
+        bc.make_record("replay_ledgers_per_sec", "ledgers/s",
+                       r["ledgers_per_sec"], "cpu-tiny", "higher", src),
+        bc.make_record("replay_txs_per_sec", "txs/s",
+                       r["txs_per_sec"], "cpu-tiny", "higher", src),
+        bc.make_record("replay_wall_s", "s", r["wall_s"],
+                       "cpu-tiny", "lower", src),
+        bc.make_record("replay_crypto_s", "s", r["crypto_s"],
+                       "cpu-tiny", "lower", src),
+        bc.make_record("cpu_openssl_baseline_sigs_per_sec", "sigs/s",
+                       round(cpu_baseline_rate(500), 1),
+                       "openssl-cpu-tiny", "higher", src),
+    ]
+
+
+def compare_main(argv) -> int:
+    """`bench.py --compare [--record] [--input FILE] [--history PATH]
+    [--tolerance T]`: diff a current run against the best committed
+    record per (metric, platform) in bench/history.jsonl; exit 1 on any
+    regression beyond tolerance. Without `--input` the tiny CPU replay
+    leg runs inline; with it, an existing bench-output JSON (or a
+    {"records": [...]} blob) is normalized instead. `--record` appends
+    the current records (commit- and time-stamped) to the history."""
+    import argparse
+    bc = _bench_compare_mod()
+    ap = argparse.ArgumentParser(prog="bench.py --compare")
+    ap.add_argument("--compare", action="store_true")
+    ap.add_argument("--record", action="store_true")
+    ap.add_argument("--input")
+    ap.add_argument("--history",
+                    default=os.path.join(_REPO, "bench", "history.jsonl"))
+    ap.add_argument("--tolerance", type=float, default=0.1)
+    args = ap.parse_args(argv)
+    if args.input:
+        with open(args.input) as fh:
+            blob = json.load(fh)
+        current = bc.normalize_any(blob, os.path.basename(args.input))
+    else:
+        current = compare_leg()
+    history = bc.load_history(args.history)
+    report = bc.compare(current, history, tolerance=args.tolerance)
+    if args.record:
+        commit = _git_commit()
+        now = int(time.time())
+        for rec in current:
+            if rec.get("at_unix") is None:
+                rec["at_unix"] = now
+            if rec.get("commit") is None:
+                rec["commit"] = commit
+        report["recorded"] = bc.append_history(args.history, current)
+    print(json.dumps(report, indent=1, sort_keys=True))
+    return 1 if report["regressions"] else 0
 
 
 def _scrubbed_cpu_env() -> dict:
@@ -723,7 +834,8 @@ def main() -> None:
         out["compile_s"] = res["compile_s"]
         if warm_compile_s is not None:
             out["compile_warm_s"] = warm_compile_s
-        for k in ("latency128_p50_ms", "latency128_p99_ms"):
+        for k in ("latency128_p50_ms", "latency128_p99_ms",
+                  "warmup_state", "warmup_buckets_s", "compile_cache"):
             if k in res:
                 out[k] = res[k]
     else:
@@ -806,7 +918,8 @@ def main() -> None:
             "at_unix": int(t_start), "cached": False,
             **{k: out[k] for k in
                ("value", "vs_baseline", "platform", "replay_speedup",
-                "replay_crypto_speedup") if k in out}}
+                "replay_crypto_speedup", "compile_cache",
+                "warmup_buckets_s") if k in out}}
     elif cached_device is not None:
         out["last_device"] = {"cached": True, **cached_device}
 
@@ -834,5 +947,9 @@ if __name__ == "__main__":
         # the `fleet` block (slot-latency p50/p95, externalize skew);
         # does not touch jax or the device relay
         print(json.dumps(fleet_bench()))
+    elif "--compare" in sys.argv:
+        # perf-regression gate against bench/history.jsonl; does not
+        # touch jax or the device relay
+        sys.exit(compare_main(sys.argv[1:]))
     else:
         main()

@@ -25,7 +25,8 @@ _TIME_READS = {"time", "monotonic", "perf_counter", "perf_counter_ns",
 _DATETIME_READS = {"now", "utcnow", "today", "fromtimestamp"}
 # random-module attributes that are NOT the unseeded global stream
 _RANDOM_OK = {"Random", "SystemRandom", "seed"}
-_METRIC_CALLS = {"new_counter", "new_meter", "new_timer", "new_histogram"}
+_METRIC_CALLS = {"new_counter", "new_gauge", "new_meter", "new_timer",
+                 "new_histogram"}
 _FAULT_CALLS = {"should_fire", "fire_point"}
 
 # method names too generic to follow across objects in the T1 walk:

@@ -47,6 +47,12 @@ Clock/threading audit (ISSUE 5 satellite — the 9 touch points):
 9. ResilientBatchVerifier breaker callbacks (_on_trip/_on_recover) —
    run on whichever thread dispatched (worker under tpu-async): they
    touch only metrics/tracer/flight-recorder, which are thread-safe.
+10. VerifierStats (the ISSUE 6 cockpit) — event stamps read the
+    injected app clock (now_fn), compile DURATIONS read
+    util.timer.real_monotonic (sanctioned: an XLA compile takes real
+    time under a frozen virtual clock); recorded from the main loop,
+    the dispatch worker and the warmup thread under its own
+    TrackedLock("crypto.verifier-stats").
 """
 
 from __future__ import annotations
@@ -55,14 +61,250 @@ import threading
 from typing import Callable, List, Optional, Sequence, Tuple
 
 from ..util.log import get_logger
+from ..util.metrics import MetricsRegistry
 from ..util.threads import TrackedLock
 from ..util.timer import real_monotonic
+from ..util.tracing import tracer_instant
 from ..xdr import PublicKey
 from . import keys as _keys
 
 log = get_logger("Perf")
 
 Triple = Tuple[bytes, bytes, bytes]  # (key32, sig, msg)
+
+
+class VerifierStats:
+    """Cockpit aggregation for the batch-verify boundary (ISSUE 6
+    tentpole; docs/observability.md#device-cockpit).
+
+    One instance per make_verifier() stack, shared by every layer —
+    device backend, CPU fallback, resilient wrapper, threaded wrapper —
+    so drains are attributed to the backend that actually SERVED them
+    (a fallback drain while the breaker is open counts against "cpu",
+    never against the device). The same aggregate objects feed three
+    consumers:
+
+    - the admin `verifier` endpoint (`to_json`): per-bucket occupancy /
+      pad-waste histograms, warmup + compile-cache status, queue depth;
+    - the metrics registry (`verifier.*` names) — which makes the whole
+      cockpit scrapeable via `metrics?format=prometheus`;
+    - the tracer: `verifier.warmup.*` instants, so compile/warmup
+      progress appears in Chrome traces and flight dumps.
+
+    Clocks: event STAMPS (`t` fields) read the injected app clock
+    (`now_fn` = clock.now via make_verifier), so chaos soaks under a
+    virtual clock stay deterministic; compile DURATIONS are real
+    elapsed seconds via util.timer.real_monotonic — an XLA compile
+    takes real time even while the app clock is frozen. Recording
+    happens on the main loop, the threaded dispatch worker and the
+    warmup thread; aggregate mutation is under `_lock`, registry
+    metric objects are individually thread-safe."""
+
+    def __init__(self, metrics=None, tracer=None, now_fn=None,
+                 flight_recorder=None) -> None:
+        self._now = now_fn or real_monotonic
+        # a private registry when none is injected keeps direct
+        # constructions (tests, bench children) app-registry-free while
+        # letting every registration below use the new_* idiom the M1
+        # metric-catalog scanner keys on
+        self.metrics = metrics if metrics is not None \
+            else MetricsRegistry(now_fn=self._now)
+        self.tracer = tracer
+        self.flight_recorder = flight_recorder
+        self._lock = TrackedLock("crypto.verifier-stats")
+        self.backends: dict = {}      # name -> {drains, sigs, pad_total}
+        self.buckets: dict = {}       # bucket -> counts + histograms
+        self.queue = {"depth": 0, "inflight": 0,
+                      "wait_last_mean_ms": None, "wait_last_max_ms": None}
+        self.warmup = {"state": "idle", "planned": [], "begun_t": None,
+                       "done_t": None, "error": None, "buckets": {}}
+        self.compile_cache = {"enabled": None, "dir": None, "hits": 0,
+                              "misses": 0, "unknown": 0, "error": None}
+        # fixed-name registry metrics, created eagerly so the Prometheus
+        # export carries the full cockpit shape from the first scrape
+        m = self.metrics
+        self._h_batch = m.new_histogram("verifier.drain.batch-size")
+        self._h_pad = m.new_histogram("verifier.drain.pad-waste")
+        self._h_occ = m.new_histogram("verifier.drain.occupancy-pct")
+        self._h_splits = m.new_histogram("verifier.drain.splits")
+        self._h_wsec = m.new_histogram("verifier.warmup.bucket-seconds")
+        self._t_wait = m.new_timer("verifier.queue.wait")
+        self._g_depth = m.new_gauge("verifier.queue.depth")
+        self._g_inflight = m.new_gauge("verifier.queue.inflight")
+        self._g_wstate = m.new_gauge("verifier.warmup.state")
+        self._g_wdone = m.new_gauge("verifier.warmup.buckets-done")
+        self._g_cc = m.new_gauge("verifier.compile-cache.enabled")
+        self._c_hit = m.new_counter("verifier.compile-cache.hit")
+        self._c_miss = m.new_counter("verifier.compile-cache.miss")
+
+    # -- drains --------------------------------------------------------------
+    def record_drain(self, backend: str, n: int, pad: int = 0,
+                     splits: int = 1) -> None:
+        """One verify_many drain, attributed to the backend that served
+        it. `pad` is the total padding-lane waste (0 on unpadded CPU
+        drains — which still count, so bucket-selection analysis sees
+        ALL traffic, not just the device path)."""
+        occ = 100.0 * n / (n + pad) if (n + pad) else 100.0
+        with self._lock:
+            d = self.backends.setdefault(
+                backend, {"drains": 0, "sigs": 0, "pad_total": 0})
+            d["drains"] += 1
+            d["sigs"] += n
+            d["pad_total"] += pad
+        self._h_batch.update(n)
+        self._h_pad.update(pad)
+        self._h_occ.update(occ)
+        self._h_splits.update(splits)
+        self.metrics.new_meter("verifier.drains.%s" % backend).mark()
+
+    def record_bucket_dispatch(self, bucket: int, n: int,
+                               pad: int) -> None:
+        """One padded device dispatch into a fixed bucket shape (the
+        device path only — buckets come from TpuSigVerifier.BUCKETS, so
+        the dynamic `verifier.bucket.<b>.*` name space stays bounded)."""
+        occ = 100.0 * n / bucket if bucket else 100.0
+        with self._lock:
+            b = self.buckets.get(bucket)
+            if b is None:
+                b = self.buckets[bucket] = {
+                    "drains": 0, "sigs": 0, "pad_total": 0,
+                    "_occ": self.metrics.new_histogram(
+                        "verifier.bucket.%d.occupancy-pct" % bucket),
+                    "_pad": self.metrics.new_histogram(
+                        "verifier.bucket.%d.pad-waste" % bucket),
+                    "_m": self.metrics.new_meter(
+                        "verifier.bucket.%d.drains" % bucket)}
+            b["drains"] += 1
+            b["sigs"] += n
+            b["pad_total"] += pad
+        b["_occ"].update(occ)
+        b["_pad"].update(pad)
+        b["_m"].mark()
+
+    # -- queue ---------------------------------------------------------------
+    def set_queue_depth(self, depth: int) -> None:
+        self.queue["depth"] = depth
+        self._g_depth.set(depth)
+
+    def set_inflight(self, inflight: bool) -> None:
+        self.queue["inflight"] = int(inflight)
+        self._g_inflight.set(int(inflight))
+
+    def record_queue_wait(self, mean_s: float, max_s: float) -> None:
+        self.queue["wait_last_mean_ms"] = round(mean_s * 1e3, 3)
+        self.queue["wait_last_max_ms"] = round(max_s * 1e3, 3)
+        self._t_wait.update(mean_s)
+
+    # -- compile cache + warmup ---------------------------------------------
+    def compile_cache_enabled(self, path: str) -> None:
+        self.compile_cache.update(
+            {"enabled": True, "dir": path, "error": None})
+        self._g_cc.set(1)
+
+    def compile_cache_error(self, err: str) -> None:
+        """The persistent-XLA-cache enable failed: previously a swallowed
+        log.warning — now a meter, a tracer instant and a flight dump,
+        because a node silently paying cold compiles on every restart is
+        exactly the regression the cockpit exists to catch."""
+        self.compile_cache.update({"enabled": False, "error": err})
+        self._g_cc.set(0)
+        self.metrics.new_meter("verifier.compile-cache.unavailable").mark()
+        tracer_instant(self.tracer, "verifier.compile-cache.unavailable",
+                       cat="crypto", error=err)
+        if self.flight_recorder is not None:
+            self.flight_recorder.dump("compile-cache-unavailable",
+                                      extra={"error": err})
+
+    WARMUP_STATE_CODE = {"idle": 0, "running": 1, "done": 2, "failed": 3}
+
+    def warmup_begin(self, buckets) -> None:
+        with self._lock:
+            self.warmup.update({"state": "running", "begun_t": self._now(),
+                                "done_t": None, "error": None,
+                                "planned": list(buckets)})
+        self._g_wstate.set(self.WARMUP_STATE_CODE["running"])
+        tracer_instant(self.tracer, "verifier.warmup.begin", cat="crypto",
+                       buckets=list(buckets))
+
+    def warmup_bucket_done(self, bucket: int, seconds: float,
+                           cache_hit) -> None:
+        """One bucket shape compiled (or loaded). `cache_hit` is
+        True/False from the compile-cache-entry diff, None when the
+        cache dir is unreadable."""
+        cache = ("hit" if cache_hit is True else
+                 "miss" if cache_hit is False else "unknown")
+        with self._lock:
+            self.warmup["buckets"][str(bucket)] = {
+                "seconds": round(seconds, 3), "cache": cache,
+                "t": self._now()}
+            done = len(self.warmup["buckets"])
+            self.compile_cache[
+                {"hit": "hits", "miss": "misses",
+                 "unknown": "unknown"}[cache]] += 1
+        self._h_wsec.update(seconds)
+        self._g_wdone.set(done)
+        if cache_hit is True:
+            self._c_hit.inc()
+        elif cache_hit is False:
+            self._c_miss.inc()
+        tracer_instant(self.tracer, "verifier.warmup.bucket", cat="crypto",
+                       bucket=bucket, seconds=round(seconds, 3),
+                       cache=cache)
+
+    def warmup_done(self) -> None:
+        with self._lock:
+            self.warmup.update({"state": "done", "done_t": self._now()})
+            total = sum(b["seconds"]
+                        for b in self.warmup["buckets"].values())
+            n = len(self.warmup["buckets"])
+        self._g_wstate.set(self.WARMUP_STATE_CODE["done"])
+        tracer_instant(self.tracer, "verifier.warmup.end", cat="crypto",
+                       buckets=n, total_s=round(total, 3))
+
+    def warmup_failed(self, err: str) -> None:
+        with self._lock:
+            self.warmup.update({"state": "failed", "done_t": self._now(),
+                                "error": err})
+        self._g_wstate.set(self.WARMUP_STATE_CODE["failed"])
+        self.metrics.new_meter("verifier.warmup.failure").mark()
+        tracer_instant(self.tracer, "verifier.warmup.failed", cat="crypto",
+                       error=err)
+        if self.flight_recorder is not None:
+            self.flight_recorder.dump(
+                "verify-warmup-failed",
+                extra={"error": err, "warmup": self.warmup_json()})
+
+    # -- export --------------------------------------------------------------
+    def warmup_json(self) -> dict:
+        with self._lock:
+            w = dict(self.warmup)
+            w["buckets"] = {k: dict(v)
+                            for k, v in self.warmup["buckets"].items()}
+        return w
+
+    def to_json(self) -> dict:
+        """The cockpit blob served by the admin `verifier` endpoint."""
+        with self._lock:
+            backends = {k: dict(v) for k, v in self.backends.items()}
+            buckets = {
+                str(b): {"drains": d["drains"], "sigs": d["sigs"],
+                         "pad_waste_total": d["pad_total"],
+                         "occupancy_pct": d["_occ"].snapshot(),
+                         "pad_waste": d["_pad"].snapshot()}
+                for b, d in sorted(self.buckets.items())}
+            queue = dict(self.queue)
+            cc = dict(self.compile_cache)
+        return {
+            "drains": {"by_backend": backends,
+                       "batch_size": self._h_batch.snapshot(),
+                       "pad_waste": self._h_pad.snapshot(),
+                       "occupancy_pct": self._h_occ.snapshot(),
+                       "splits": self._h_splits.snapshot()},
+            "buckets": buckets,
+            "warmup": self.warmup_json(),
+            "compile_cache": cc,
+            "queue": queue,
+        }
 
 
 class VerifyFuture:
@@ -104,12 +346,14 @@ class BatchSigVerifier:
     # ones — TxSetFrame.check_or_trim prewarms the whole set's signatures
     # through verify_many before walking txs (two-phase validation).
     wants_prewarm = False
-    # span tracer (util/tracing.py), metrics registry and fault injector
-    # (util/faults.py), installed by make_verifier; None keeps direct
-    # constructions (tests, native-apply fallback) silent
+    # span tracer (util/tracing.py), metrics registry, fault injector
+    # (util/faults.py) and the shared VerifierStats cockpit, installed
+    # by make_verifier; None keeps direct constructions (tests,
+    # native-apply fallback) silent
     tracer = None
     metrics = None
     faults = None
+    stats = None
 
     def _span(self, name: str, **tags):
         from ..util.tracing import tracer_span
@@ -179,6 +423,8 @@ class BatchSigVerifier:
             f._complete(hit)
             return f
         self._pending.append(((key.key_bytes, sig, msg), f))
+        if self.stats is not None:
+            self.stats.set_queue_depth(len(self._pending))
         if len(self._pending) >= self._max_pending:
             self.flush()
         return f
@@ -187,6 +433,8 @@ class BatchSigVerifier:
         if not self._pending:
             return
         batch, self._pending = self._pending, []
+        if self.stats is not None:
+            self.stats.set_queue_depth(0)
         triples = [t for (t, _f) in batch]
         try:
             results = self.verify_many(triples)
@@ -206,6 +454,10 @@ def _flush_fallback(verifier, triples: Sequence[Triple]) -> List[bool]:
     m = getattr(verifier, "metrics", None)
     if m is not None:
         m.new_meter("crypto.verify.flush-fallback").mark(len(triples))
+    st = getattr(verifier, "stats", None)
+    if st is not None:
+        # the CPU served this drain (the raising backend did not)
+        st.record_drain("cpu", len(triples))
     return _keys.raw_verify_batch(triples)
 
 
@@ -223,9 +475,19 @@ class CpuSigVerifier(BatchSigVerifier):
         pass
 
     def verify_many(self, triples: Sequence[Triple]) -> List[bool]:
+        # CPU drains carry the same batch-shape tags as device drains
+        # (pad_waste is structurally 0: no padding on the synchronous
+        # path) so bucket-selection analysis sees ALL traffic, not just
+        # what happened to reach the device
         with self._span("crypto.verify_many", backend=self.name,
-                        n=len(triples)):
-            return _keys.raw_verify_batch(triples)
+                        n=len(triples), batches=1, pad_waste=0,
+                        occupancy_pct=100.0):
+            out = _keys.raw_verify_batch(triples)
+            # recorded only after the verify returns: a raising drain is
+            # re-run (and counted once) by _flush_fallback instead
+            if self.stats is not None:
+                self.stats.record_drain(self.name, len(triples))
+            return out
 
 
 class TpuSigVerifier(BatchSigVerifier):
@@ -239,6 +501,12 @@ class TpuSigVerifier(BatchSigVerifier):
     name = "tpu"
     wants_prewarm = True
     BUCKETS = (128, 512, 2048, 8192)
+    # minimum compile duration the persistent cache stores (mirrors the
+    # jax_persistent_cache_min_compile_time_secs value set below): a
+    # compile faster than this writes no entry, so "no new cache file"
+    # proves nothing about it — warmup classifies those "unknown",
+    # never "hit"
+    CACHE_PERSIST_MIN_S = 0.5
 
     # batches below this size stay on one device: sharding a handful of
     # sigs over a pod slice buys nothing and costs a sharded compile
@@ -252,6 +520,7 @@ class TpuSigVerifier(BatchSigVerifier):
         self.batches_dispatched = 0
         self.sigs_verified = 0
         self._compile_cache_dir = compile_cache_dir
+        self._cache_path: Optional[str] = None  # resolved on enable
         self._warmed = False
         self._warmup_thread: Optional[threading.Thread] = None
         self._sharded_fn = None  # lazy; multi-device dp dispatch
@@ -286,9 +555,33 @@ class TpuSigVerifier(BatchSigVerifier):
             os.makedirs(path, exist_ok=True)
             jax.config.update("jax_compilation_cache_dir", path)
             jax.config.update("jax_persistent_cache_min_compile_time_secs",
-                              0.5)
+                              self.CACHE_PERSIST_MIN_S)
+            self._cache_path = path
+            if self.stats is not None:
+                self.stats.compile_cache_enabled(path)
         except Exception as e:  # cache is an optimization, never fatal
             log.warning("compile cache unavailable: %s", e)
+            if self.stats is not None:
+                # ...but an operator must be able to SEE it (tracer
+                # instant + meter + flight dump), or every restart
+                # silently pays cold compiles
+                self.stats.compile_cache_error(repr(e))
+
+    def _cache_entry_count(self) -> int:
+        """Files under the persistent XLA cache dir (-1 = unknown).
+        Warmup diffs this around each bucket compile: no new entry means
+        the executable came from the cache (a warm restart), a new entry
+        means a cold compile just got paid."""
+        import os
+        if self._cache_path is None:
+            return -1
+        try:
+            n = 0
+            for _dir, _sub, files in os.walk(self._cache_path):
+                n += len(files)
+            return n
+        except OSError:
+            return -1
 
     def warmup(self, wait: bool = False) -> None:
         """AOT-compile every bucket shape off the consensus path (startup
@@ -303,26 +596,55 @@ class TpuSigVerifier(BatchSigVerifier):
         if wait:
             self._warmup_thread.join()
 
+    def _compile_bucket(self, b: int) -> None:
+        """AOT-compile (or cache-load) one bucket shape."""
+        import numpy as np
+        import jax.numpy as jnp
+        fn, ndev = self._device_fn(b)
+        b = -(-b // ndev) * ndev
+        args = (jnp.zeros((b, 20), jnp.int32),
+                jnp.zeros((b,), jnp.int32),
+                jnp.zeros((b, 20), jnp.int32),
+                jnp.zeros((b,), jnp.int32),
+                jnp.zeros((b, 64), jnp.int32),
+                jnp.zeros((b, 64), jnp.int32))
+        np.asarray(fn(*args))
+
     def _warmup_impl(self) -> None:
+        st = self.stats
         try:
             self._enable_compile_cache()
-            import numpy as np
-            import jax.numpy as jnp
+            if st is not None:
+                st.warmup_begin(self.BUCKETS)
             for b in self.BUCKETS:
-                fn, ndev = self._device_fn(b)
-                b = -(-b // ndev) * ndev
-                args = (jnp.zeros((b, 20), jnp.int32),
-                        jnp.zeros((b,), jnp.int32),
-                        jnp.zeros((b, 20), jnp.int32),
-                        jnp.zeros((b,), jnp.int32),
-                        jnp.zeros((b, 64), jnp.int32),
-                        jnp.zeros((b, 64), jnp.int32))
-                np.asarray(fn(*args))
+                before = self._cache_entry_count()
+                t0 = real_monotonic()
+                self._compile_bucket(b)
+                dt = real_monotonic() - t0
+                after = self._cache_entry_count()
+                if before < 0 or after < 0:
+                    hit = None            # cache dir unreadable
+                elif after > before:
+                    hit = False           # a cold compile just persisted
+                elif dt >= self.CACHE_PERSIST_MIN_S:
+                    hit = True            # long compile, no new entry:
+                    # the executable came from the cache
+                else:
+                    # fast compile below the persistence threshold
+                    # writes no entry either way — unclassifiable, and
+                    # nothing worth caching was at stake
+                    hit = None
+                if st is not None:
+                    st.warmup_bucket_done(b, dt, hit)
             self._warmed = True
+            if st is not None:
+                st.warmup_done()
             log.info("verify kernel warmup complete (%s buckets)",
                      len(self.BUCKETS))
         except Exception as e:
             log.warning("verify kernel warmup failed: %s", e)
+            if st is not None:
+                st.warmup_failed(repr(e))
 
     def enqueue(self, key: PublicKey, sig: bytes, msg: bytes) -> VerifyFuture:
         return self._batch_enqueue(key, sig, msg)
@@ -379,9 +701,18 @@ class TpuSigVerifier(BatchSigVerifier):
                 self.sigs_verified += n
                 batches += 1
                 pad_waste += b - n
+                if self.stats is not None:
+                    self.stats.record_bucket_dispatch(b, n, b - n)
                 i += n
             sp.set_tag("batches", batches)
             sp.set_tag("pad_waste", pad_waste)
+            total = len(triples)
+            sp.set_tag("occupancy_pct", round(
+                100.0 * total / (total + pad_waste), 1)
+                if total + pad_waste else 100.0)
+            if self.stats is not None:
+                self.stats.record_drain(self.name, total, pad=pad_waste,
+                                        splits=batches)
         return out
 
 
@@ -564,7 +895,11 @@ class ResilientBatchVerifier(BatchSigVerifier):
             # or the breaker is open — the "completed on fallback" signal
             # the chaos soak asserts on
             self.metrics.new_meter("crypto.verify.fallback-drain").mark()
+        # served_by names the backend that actually ran the drain — the
+        # fallback's own verify_many records the drain stats under its
+        # name, so cockpit attribution follows the server, not the wrapper
         with self._span("crypto.verify_fallback", backend=self.name,
+                        served_by=self.fallback.name,
                         n=len(triples), breaker=self.breaker.state):
             return self.fallback.verify_many(triples)
 
@@ -632,6 +967,9 @@ class ThreadedBatchVerifier(BatchSigVerifier):
         with self._lock:
             self._pending.append(
                 ((key.key_bytes, sig, msg), f, self._clock.now()))
+            depth = len(self._pending)
+        if self.stats is not None:
+            self.stats.set_queue_depth(depth)
         return f
 
     def pending(self) -> int:
@@ -644,6 +982,10 @@ class ThreadedBatchVerifier(BatchSigVerifier):
                 return
             batch, self._pending = self._pending, []
             self._inflight = True
+        st = self.stats
+        if st is not None:
+            st.set_queue_depth(0)
+            st.set_inflight(True)
 
         def work() -> None:
             triples = [t for (t, _f, _t0) in batch]
@@ -651,6 +993,8 @@ class ThreadedBatchVerifier(BatchSigVerifier):
             # time is the span's own duration (inner verify_many nests)
             t_disp = self._clock.now()
             waits = [t_disp - t0 for (_t, _f, t0) in batch]
+            if st is not None:
+                st.record_queue_wait(sum(waits) / len(waits), max(waits))
             with self._span("crypto.batch_dispatch",
                             backend="threaded:%s" % self._inner.name,
                             n=len(batch),
@@ -680,6 +1024,8 @@ class ThreadedBatchVerifier(BatchSigVerifier):
                 with self._lock:
                     self._inflight = False
                     more = bool(self._pending)
+                if st is not None:
+                    st.set_inflight(False)
                 if more:
                     # verifies enqueued while the batch was in flight form
                     # the next batch immediately
@@ -705,14 +1051,24 @@ def make_verifier(backend: str = "cpu", clock=None,
     Device backends ("tpu", "tpu-async") are always wrapped in a
     ResilientBatchVerifier with a CPU fallback; "cpu-resilient" wraps the
     CPU backend in the same breaker machinery so chaos runs exercise the
-    device failure domain on device-less containers."""
+    device failure domain on device-less containers.
+
+    Every layer of the stack shares ONE VerifierStats cockpit
+    (`<verifier>.stats`), so fallback drains are attributed to the
+    backend that served them and the admin `verifier` endpoint sees the
+    whole boundary regardless of wrapping."""
     now_fn = clock.now if clock is not None else None
+    stats = VerifierStats(metrics=metrics, tracer=tracer, now_fn=now_fn,
+                          flight_recorder=flight_recorder)
 
     def resilient(primary: BatchSigVerifier) -> ResilientBatchVerifier:
         primary.tracer = tracer
         primary.metrics = metrics
+        primary.stats = stats
         fb = CpuSigVerifier()
         fb.tracer = tracer
+        fb.metrics = metrics
+        fb.stats = stats
         r = ResilientBatchVerifier(
             primary, fb,
             CircuitBreaker(threshold=breaker_threshold,
@@ -720,6 +1076,7 @@ def make_verifier(backend: str = "cpu", clock=None,
             max_pending=max_pending)
         r.tracer = tracer
         r.flight_recorder = flight_recorder
+        r.stats = stats
         return r
 
     if backend == "cpu":
@@ -741,4 +1098,5 @@ def make_verifier(backend: str = "cpu", clock=None,
     v.tracer = tracer
     v.metrics = metrics
     v.faults = faults
+    v.stats = stats
     return v

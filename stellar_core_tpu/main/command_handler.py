@@ -146,6 +146,38 @@ class CommandHandler:
             return render_prometheus(out)
         return out
 
+    def cmd_verifier(self, params) -> dict:
+        """Device cockpit (ISSUE 6 tentpole;
+        docs/observability.md#device-cockpit): the batch-verify
+        boundary's operational state in one JSON blob — per-bucket
+        occupancy/pad-waste histograms, drain attribution by serving
+        backend, compile-cache + per-bucket warmup status (app-clock
+        stamped), queue depth/inflight/queue-wait, breaker state, and
+        the verify-cache counters. The same data is scrapeable as
+        `sct_verifier_*` series via `metrics?format=prometheus`."""
+        v = getattr(self.app, "sig_verifier", None)
+        if v is None:
+            return {"error": "no signature verifier wired"}
+        out: dict = {
+            "configured_backend": self.app.config.SIG_VERIFY_BACKEND,
+            "verifier": v.name,
+        }
+        stats = getattr(v, "stats", None)
+        if stats is not None:
+            out.update(stats.to_json())
+        breaker = getattr(v, "breaker", None)
+        if breaker is not None:
+            out["breaker"] = breaker.to_json()
+        inner = getattr(v, "inner", v)
+        out["counters"] = {
+            "batches_dispatched": getattr(inner, "batches_dispatched", 0),
+            "sigs_verified": getattr(inner, "sigs_verified", 0),
+            "pending": v.pending(),
+        }
+        from ..crypto import keys as _keys
+        out["cache"] = _keys.verify_cache_stats()
+        return out
+
     def cmd_trace(self, params) -> dict:
         """Span-tracer control + export (ISSUE 2 tentpole):
         `trace?action=status|start|stop|clear|dump|flight`.

@@ -32,6 +32,25 @@ class Counter:
         return {"type": "counter", "count": self.count}
 
 
+class Gauge:
+    """Point-in-time value (queue depth, warmup state, occupancy): `set`
+    overwrites; there is no history. Exported as a Prometheus gauge —
+    the natural shape for the verifier-cockpit instants
+    (docs/metrics.md#device-cockpit-gauges)."""
+
+    def __init__(self) -> None:
+        self.value: float = 0.0
+
+    def set(self, v: float) -> None:
+        self.value = v
+
+    def inc(self, n: float = 1) -> None:
+        self.value += n
+
+    def to_json(self) -> dict:
+        return {"type": "gauge", "value": self.value}
+
+
 class Meter:
     """Event-rate meter. Events aggregate into per-second buckets held in
     a deque, so mark() is O(1) amortized and memory is bounded by the
@@ -183,16 +202,28 @@ class MetricsRegistry:
         # control durations); with no injection they keep perf_counter
         self._timer_now = now_fn
         self._metrics: Dict[str, object] = {}
+        # first-use registration can happen on worker threads (threaded
+        # verify dispatch registering a per-backend/per-bucket cockpit
+        # series) while the admin HTTP path iterates the registry for a
+        # scrape — inserts and the export snapshot synchronize here; the
+        # hot already-registered path stays a lock-free dict get
+        self._reg_lock = threading.Lock()
 
     def _get(self, name: str, factory):
         m = self._metrics.get(name)
         if m is None:
-            m = factory()
-            self._metrics[name] = m
+            with self._reg_lock:
+                m = self._metrics.get(name)
+                if m is None:
+                    m = factory()
+                    self._metrics[name] = m
         return m
 
     def new_counter(self, name: str) -> Counter:
         return self._get(name, Counter)
+
+    def new_gauge(self, name: str) -> Gauge:
+        return self._get(name, Gauge)
 
     def new_meter(self, name: str) -> Meter:
         return self._get(name, lambda: Meter(self._now))
@@ -207,8 +238,10 @@ class MetricsRegistry:
         """Export the registry; with `prefix`, serialize only metrics
         whose name starts with it (the admin `metrics?filter=` path —
         operators fetching `crypto.` must not pay for `ledger.*`)."""
+        with self._reg_lock:
+            items = list(self._metrics.items())
         return {name: m.to_json()
-                for name, m in sorted(self._metrics.items())
+                for name, m in sorted(items)
                 if prefix is None or name.startswith(prefix)}
 
 
@@ -244,7 +277,7 @@ def render_prometheus(metrics_json: Dict[str, dict],
                       prefix: str = "sct_") -> str:
     """Registry JSON -> exposition text. Mapping:
 
-    - counter              -> gauge (medida counters can be set/decremented)
+    - counter / gauge      -> gauge (medida counters can be set/decremented)
     - meter                -> `<n>_total` counter + `<n>_rate{window="1m|5m|15m"}` gauges
     - timer / histogram    -> summary (`quantile` labels, `_sum`, `_count`)
                               + `<n>_min` / `<n>_max` gauges
@@ -299,6 +332,9 @@ def render_prometheus(metrics_json: Dict[str, dict],
             for k in ("min", "max"):
                 lines.append("# TYPE %s_%s gauge" % (base, k))
                 lines.append("%s_%s %s" % (base, k, _num(m.get(k, 0.0))))
+        elif t == "gauge":
+            lines.append("# TYPE %s gauge" % base)
+            lines.append("%s %s" % (base, _num(m.get("value", 0.0))))
         elif "count" in m:   # counter or merged bare-count extra
             lines.append("# TYPE %s gauge" % base)
             lines.append("%s %s" % (base, _num(m["count"])))

@@ -112,6 +112,22 @@ def test_faults_set_rejects_unknown_site_with_400(app):
     assert st == 200 and not app.faults.configured()
 
 
+def test_verifier_endpoint_on_plain_cpu_backend(app):
+    """ISSUE 6: the cockpit endpoint works for every backend, including
+    the breaker-less plain CPU verifier (the resilient/threaded shapes
+    are covered in tests/test_verifier_cockpit.py)."""
+    st, body = cmd(app, "verifier")
+    assert st == 200
+    assert body["configured_backend"] == "cpu"
+    assert body["verifier"] == "cpu"
+    assert "breaker" not in body            # plain cpu has no breaker
+    assert body["queue"]["depth"] == 0
+    assert body["warmup"]["state"] == "idle"
+    assert "compile_cache" in body and "buckets" in body
+    assert body["counters"]["pending"] == 0
+    assert "verifier" in app.command_handler.command_names()
+
+
 def test_metrics_prometheus_format_over_http(app):
     """format=prometheus serves text exposition with the 0.0.4 content
     type through the real HTTP server."""

@@ -1,0 +1,261 @@
+"""Perf-regression ledger tests (ISSUE 6): schema validation of every
+committed bench artifact, deterministic ingest into bench/history.jsonl,
+the direction-aware comparator, and the `bench.py --compare` gate driven
+end to end with a tiny deterministic CPU replay leg against synthetic
+baselines (the acceptance criterion: nonzero on an injected regression,
+zero on a clean run).
+"""
+
+import copy
+import json
+import os
+import subprocess
+import sys
+
+import pytest
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+if REPO not in sys.path:
+    sys.path.insert(0, REPO)
+
+from tools import bench_compare as bc          # noqa: E402
+
+HISTORY = os.path.join(REPO, "bench", "history.jsonl")
+
+
+# ------------------------------------------------------------ committed set
+
+def test_committed_artifacts_pass_schema_check():
+    """tools/bench_compare.py --check over every committed BENCH_*.json,
+    MULTICHIP_*.json and bench/history.jsonl: malformed bench artifacts
+    must fail fast instead of silently dropping out of the trajectory."""
+    paths = bc.default_artifacts()
+    assert len(paths) >= 11, paths          # 6 BENCH + 5 MULTICHIP
+    errors = []
+    for p in paths + [HISTORY]:
+        errors.extend(bc.check_artifact(p))
+    assert not errors, errors
+    # the CLI agrees (the tier-1 invocation named in ISSUE 6)
+    assert bc.main(["--check"]) == 0
+
+
+def test_history_matches_fresh_reingest():
+    """bench/history.jsonl is exactly what ingest produces from the
+    committed artifacts — the committed ledger can never drift from its
+    sources."""
+    fresh = bc.ingest(bc.default_artifacts())
+    committed = bc.load_history(HISTORY)
+    assert fresh == committed
+
+
+def test_history_covers_the_headline_metrics():
+    best = bc.best_baselines(bc.load_history(HISTORY))
+    # device verify headline (129k sigs/s, BENCH_r05 cached block)
+    dev = best[("ed25519_verifies_per_sec_per_chip", "tpu")]
+    assert dev["value"] > 100_000
+    assert best[("replay_ledgers_per_sec", "tpu")]["value"] > 0
+    assert best[("native_apply_speedup", "cpu")]["value"] > 4
+    assert best[("multichip_devices", "axon")]["value"] >= 8
+    # direction-aware best: the lowest committed warm-compile time wins
+    warm = best[("device_compile_warm_s", "tpu")]
+    assert warm["direction"] == "lower"
+
+
+def test_malformed_artifacts_fail_check(tmp_path):
+    bad_json = tmp_path / "BENCH_r99.json"
+    bad_json.write_text("{not json")
+    assert bc.check_artifact(str(bad_json))
+
+    bad_payload = tmp_path / "BENCH_r98.json"
+    bad_payload.write_text(json.dumps(
+        {"metric": 5, "unit": "sigs/s", "value": "fast"}))
+    errs = bc.check_artifact(str(bad_payload))
+    assert any("'metric'" in e for e in errs)
+    assert any("'value'" in e for e in errs)
+
+    bad_multichip = tmp_path / "MULTICHIP_r99.json"
+    bad_multichip.write_text(json.dumps({"n_devices": "eight", "rc": 0,
+                                         "ok": True, "skipped": False}))
+    assert any("n_devices" in e
+               for e in bc.check_artifact(str(bad_multichip)))
+
+    # rc=0 wrapper with no parsed payload is malformed; rc!=0 is a
+    # valid record of a failed run
+    wrapper = {"n": 1, "cmd": "x", "rc": 0, "tail": ""}
+    w = tmp_path / "BENCH_r97.json"
+    w.write_text(json.dumps(wrapper))
+    assert bc.check_artifact(str(w))
+    wrapper["rc"] = 124
+    w.write_text(json.dumps(wrapper))
+    assert not bc.check_artifact(str(w))
+
+    bad_hist = tmp_path / "history.jsonl"
+    bad_hist.write_text(json.dumps({"metric": "m", "unit": "u",
+                                    "value": 1.0, "platform": "p",
+                                    "direction": "sideways",
+                                    "source": "s"}) + "\n{oops\n")
+    errs = bc.check_artifact(str(bad_hist))
+    assert any("direction" in e for e in errs)
+    assert any("bad JSON" in e for e in errs)
+
+
+# ------------------------------------------------------------ comparator
+
+def _rec(metric, value, platform="p", direction="higher", **kw):
+    return bc.make_record(metric, "u", value, platform, direction,
+                          "test", **kw)
+
+
+def test_compare_is_direction_aware():
+    history = [_rec("rate", 100.0), _rec("rate", 80.0),
+               _rec("lat", 10.0, direction="lower"),
+               _rec("lat", 25.0, direction="lower")]
+    # best = rate 100 (higher), lat 10 (lower)
+    current = [_rec("rate", 95.0), _rec("lat", 10.5)]
+    current[1]["direction"] = "lower"
+    report = bc.compare(current, history, tolerance=0.1)
+    assert not report["regressions"]
+    assert len(report["ok"]) == 2
+
+    report = bc.compare([_rec("rate", 89.0)], history, tolerance=0.1)
+    assert len(report["regressions"]) == 1
+    assert report["regressions"][0]["best"] == 100.0
+
+    bad_lat = _rec("lat", 11.5, direction="lower")
+    report = bc.compare([bad_lat], history, tolerance=0.1)
+    assert len(report["regressions"]) == 1
+
+    # a better-than-best run is an improvement, never a regression
+    report = bc.compare([_rec("rate", 140.0)], history, tolerance=0.1)
+    assert report["improvements"] and not report["regressions"]
+
+    # unknown (metric, platform) pairs never gate
+    report = bc.compare([_rec("rate", 1.0, platform="other")], history)
+    assert report["new"] and not report["regressions"]
+
+
+def test_compare_platform_keys_baselines_apart():
+    history = [_rec("replay_ledgers_per_sec", 3.34, platform="tpu")]
+    tiny = _rec("replay_ledgers_per_sec", 90.0, platform="cpu-tiny")
+    report = bc.compare([tiny], history)
+    assert report["new"] and not report["regressions"]
+
+
+# --------------------------------------------- end-to-end gate (acceptance)
+
+@pytest.fixture(scope="module")
+def tiny_leg_records():
+    """ONE tiny deterministic CPU replay leg, shared by the gate tests
+    below (seeded content; seconds, not minutes)."""
+    import bench
+    return bench.compare_leg()
+
+
+def test_tiny_leg_records_validate(tiny_leg_records):
+    assert len(tiny_leg_records) == 5
+    for rec in tiny_leg_records:
+        assert not bc.validate_record(rec), rec
+    assert {r["platform"] for r in tiny_leg_records} == \
+        {"cpu-tiny", "openssl-cpu-tiny"}
+    by_metric = {r["metric"]: r for r in tiny_leg_records}
+    assert by_metric["replay_ledgers_per_sec"]["value"] > 0
+    assert by_metric["replay_wall_s"]["direction"] == "lower"
+
+
+def _write_history(path, records):
+    with open(path, "w") as fh:
+        for rec in records:
+            fh.write(json.dumps(rec, sort_keys=True) + "\n")
+
+
+def _synthetic_baseline(records, regress=False):
+    """Baselines from the measured tiny-leg values: equal to current for
+    a clean run; absurdly better than current (x100 / /100) to inject a
+    synthetic regression no real container could beat."""
+    base = copy.deepcopy(records)
+    for rec in base:
+        rec["source"] = "synthetic-baseline"
+        if regress:
+            rec["value"] = (rec["value"] * 100.0
+                            if rec["direction"] == "higher"
+                            else rec["value"] / 100.0)
+    return base
+
+
+def test_compare_gate_clean_and_regressed_inprocess(
+        tiny_leg_records, tmp_path, capsys):
+    import bench
+    cur = tmp_path / "current.json"
+    cur.write_text(json.dumps({"records": tiny_leg_records}))
+
+    clean = tmp_path / "clean.jsonl"
+    _write_history(str(clean), _synthetic_baseline(tiny_leg_records))
+    rc = bench.compare_main(["--compare", "--input", str(cur),
+                             "--history", str(clean)])
+    report = json.loads(capsys.readouterr().out)
+    assert rc == 0, report
+    assert not report["regressions"]
+    assert len(report["ok"]) + len(report["improvements"]) == 5
+
+    regressed = tmp_path / "regressed.jsonl"
+    _write_history(str(regressed),
+                   _synthetic_baseline(tiny_leg_records, regress=True))
+    rc = bench.compare_main(["--compare", "--input", str(cur),
+                             "--history", str(regressed)])
+    report = json.loads(capsys.readouterr().out)
+    assert rc == 1
+    assert len(report["regressions"]) == 5
+    # every regression names the synthetic best it lost to
+    assert all(r["best_source"] == "synthetic-baseline"
+               for r in report["regressions"])
+
+
+def test_compare_gate_record_appends_stamped_records(
+        tiny_leg_records, tmp_path, capsys):
+    import bench
+    cur = tmp_path / "current.json"
+    cur.write_text(json.dumps({"records": tiny_leg_records}))
+    hist = tmp_path / "history.jsonl"
+    _write_history(str(hist), _synthetic_baseline(tiny_leg_records))
+    rc = bench.compare_main(["--compare", "--record",
+                             "--input", str(cur),
+                             "--history", str(hist)])
+    capsys.readouterr()
+    assert rc == 0
+    recs = bc.load_history(str(hist))
+    assert len(recs) == 10
+    appended = recs[5:]
+    for rec in appended:
+        assert not bc.validate_record(rec), rec
+        assert rec["at_unix"] is not None
+    # the recorded run is now the baseline the next run gates against
+    best = bc.best_baselines(recs)
+    assert best[("replay_ledgers_per_sec", "cpu-tiny")]["value"] == \
+        next(r["value"] for r in tiny_leg_records
+             if r["metric"] == "replay_ledgers_per_sec")
+
+
+def test_compare_gate_cli_exit_codes(tiny_leg_records, tmp_path):
+    """The real `bench.py --compare` CLI exits 0 on a clean run and
+    nonzero on an injected synthetic regression (acceptance
+    criterion), via actual subprocess exit codes."""
+    cur = tmp_path / "current.json"
+    cur.write_text(json.dumps({"records": tiny_leg_records}))
+    clean = tmp_path / "clean.jsonl"
+    _write_history(str(clean), _synthetic_baseline(tiny_leg_records))
+    regressed = tmp_path / "regressed.jsonl"
+    _write_history(str(regressed),
+                   _synthetic_baseline(tiny_leg_records, regress=True))
+    env = {**os.environ, "JAX_PLATFORMS": "cpu"}
+    for hist, want_rc in ((clean, 0), (regressed, 1)):
+        proc = subprocess.run(
+            [sys.executable, os.path.join(REPO, "bench.py"), "--compare",
+             "--input", str(cur), "--history", str(hist)],
+            cwd=REPO, env=env, capture_output=True, text=True,
+            timeout=240)
+        assert proc.returncode == want_rc, \
+            (hist, proc.returncode, proc.stdout[-500:],
+             proc.stderr[-500:])
+        report = json.loads(proc.stdout)
+        assert ("regressions" in report and
+                bool(report["regressions"]) == bool(want_rc))

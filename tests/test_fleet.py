@@ -256,6 +256,9 @@ def test_prometheus_endpoint_serves_whole_registry(fleet_sim):
                        for lbl, v in samples[base + "_total"]), name
         elif m.get("type") in ("timer", "histogram"):
             assert samples[base + "_count"][0][1] == float(m["count"])
+        elif m.get("type") == "gauge":
+            # ISSUE 6: gauges (verifier cockpit) expose their value
+            assert samples[base][0][1] == float(m["value"]), name
         else:
             assert samples[base][0][1] == float(m["count"]), name
     # filter + format compose

@@ -42,7 +42,12 @@ def test_call_site_scan_finds_the_known_core_metrics():
     assert len(names) >= 20
     for expected in ("ledger.ledger.close", "scp.envelope.receive",
                      "overlay.message.broadcast",
-                     "crypto.verify.latency", "fault.injected.%s"):
+                     "crypto.verify.latency", "fault.injected.%s",
+                     # ISSUE 6 cockpit: a gauge registration (new_gauge
+                     # joined the scanned idioms) and a dynamic
+                     # per-bucket name
+                     "verifier.queue.depth",
+                     "verifier.bucket.%d.drains"):
         assert expected in names
 
 

@@ -1,0 +1,2 @@
+# Makes `import tools.bench_compare` work from the repo root (bench.py,
+# tests); the scripts themselves also run directly.

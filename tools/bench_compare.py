@@ -1,0 +1,502 @@
+#!/usr/bin/env python3
+"""Perf-regression ledger (ISSUE 6): normalize bench artifacts into
+`bench/history.jsonl`, validate their schemas, and gate runs against
+the best committed record per (metric, platform).
+
+The six BENCH_r*.json and five MULTICHIP_r*.json snapshots each use one
+of three shapes (raw bench output, driver wrapper with a `parsed` blob,
+multichip driver record); this module flattens all of them into one
+normalized record per measurement:
+
+    {"metric": "replay_ledgers_per_sec", "unit": "ledgers/s",
+     "value": 3.34, "platform": "tpu", "direction": "higher",
+     "source": "BENCH_r05.json", "round": 5,
+     "at_unix": 1785466800, "commit": null}
+
+`direction` says which way is better — the comparator is direction-
+aware, so a latency metric regresses UP while a throughput metric
+regresses DOWN. `platform` keys baselines apart: a tiny CPU compare leg
+("cpu-tiny") never gates against full-leg or device history.
+
+CLI (also driven by `bench.py --compare [--record]`):
+
+    tools/bench_compare.py ingest [--out bench/history.jsonl] [files...]
+    tools/bench_compare.py check  [files...]      (alias: --check)
+    tools/bench_compare.py compare --current FILE
+        [--history bench/history.jsonl] [--tolerance 0.1]
+
+`check` exits 1 on any malformed committed artifact — a bench snapshot
+that silently drops out of the trajectory is itself a regression.
+`compare` exits 1 on any regression beyond tolerance.
+"""
+
+from __future__ import annotations
+
+import argparse
+import glob
+import json
+import math
+import os
+import re
+import sys
+from typing import Dict, List, Optional, Tuple
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+DEFAULT_HISTORY = os.path.join("bench", "history.jsonl")
+
+DIRECTIONS = ("higher", "lower")
+REQUIRED_FIELDS = ("metric", "unit", "value", "platform", "direction",
+                   "source")
+
+# device platforms whose compile/latency numbers are meaningful
+_DEVICE_PLATFORMS = ("tpu", "axon")
+
+
+# --------------------------------------------------------------------------
+# record construction + validation
+
+def make_record(metric: str, unit: str, value, platform: str,
+                direction: str, source: str,
+                round_no: Optional[int] = None,
+                at_unix: Optional[int] = None,
+                commit: Optional[str] = None) -> dict:
+    return {"metric": metric, "unit": unit, "value": value,
+            "platform": platform, "direction": direction,
+            "source": source, "round": round_no,
+            "at_unix": at_unix, "commit": commit}
+
+
+def validate_record(rec, where: str = "") -> List[str]:
+    errs: List[str] = []
+    if not isinstance(rec, dict):
+        return ["%s: record is not an object: %r" % (where, rec)]
+    for k in REQUIRED_FIELDS:
+        if k not in rec:
+            errs.append("%s: missing field %r" % (where, k))
+    for k in ("metric", "unit", "platform", "source"):
+        if k in rec and not isinstance(rec[k], str):
+            errs.append("%s: field %r must be a string, got %r"
+                        % (where, k, rec[k]))
+    v = rec.get("value")
+    if "value" in rec and (isinstance(v, bool) or
+                           not isinstance(v, (int, float)) or
+                           not math.isfinite(v)):
+        errs.append("%s: field 'value' must be a finite number, got %r"
+                    % (where, v))
+    if "direction" in rec and rec["direction"] not in DIRECTIONS:
+        errs.append("%s: field 'direction' must be one of %s, got %r"
+                    % (where, "/".join(DIRECTIONS), rec.get("direction")))
+    for k in ("round", "at_unix"):
+        if rec.get(k) is not None and not isinstance(rec[k], int):
+            errs.append("%s: field %r must be an int or null, got %r"
+                        % (where, k, rec[k]))
+    if rec.get("commit") is not None and not isinstance(rec["commit"], str):
+        errs.append("%s: field 'commit' must be a string or null"
+                    % where)
+    return errs
+
+
+def _round_of(source: str) -> Optional[int]:
+    m = re.search(r"_r(\d+)", os.path.basename(source))
+    return int(m.group(1)) if m else None
+
+
+# --------------------------------------------------------------------------
+# artifact normalization
+
+def _is_wrapper(blob: dict) -> bool:
+    """Driver wrapper: {"n": .., "cmd": .., "rc": .., "tail": ..,
+    "parsed": {...}} around the raw bench line."""
+    return isinstance(blob, dict) and "tail" in blob and "rc" in blob \
+        and "metric" not in blob and "n_devices" not in blob
+
+
+def _is_multichip(blob: dict) -> bool:
+    return isinstance(blob, dict) and "n_devices" in blob
+
+
+def _num(p: dict, key: str):
+    v = p.get(key)
+    if isinstance(v, bool) or not isinstance(v, (int, float)) or \
+            not math.isfinite(v):
+        return None
+    return v
+
+
+def _replay_leg_records(leg: dict, platform: str, source: str,
+                        round_no, at_unix) -> List[dict]:
+    out = []
+    for key, metric, unit, direction in (
+            ("ledgers_per_sec", "replay_ledgers_per_sec", "ledgers/s",
+             "higher"),
+            ("txs_per_sec", "replay_txs_per_sec", "txs/s", "higher"),
+            ("crypto_s", "replay_crypto_s", "s", "lower"),
+            ("apply_s", "replay_apply_s", "s", "lower")):
+        v = _num(leg, key)
+        if v is not None:
+            out.append(make_record(metric, unit, v, platform, direction,
+                                   source, round_no, at_unix))
+    return out
+
+
+def _payload_records(p: dict, source: str, round_no,
+                     at_unix=None) -> List[dict]:
+    """Normalize one bench-output payload (the raw `bench.py` JSON line,
+    or a nested last_device / last_real_device_result block)."""
+    out: List[dict] = []
+    at_unix = p.get("at_unix", at_unix)
+    if not isinstance(at_unix, int):
+        at_unix = None
+    platform = p.get("platform") or "unknown"
+
+    def rec(metric, unit, value, plat, direction):
+        out.append(make_record(metric, unit, value, plat, direction,
+                               source, round_no, at_unix))
+
+    if isinstance(p.get("metric"), str) and _num(p, "value") is not None \
+            and isinstance(p.get("unit"), str):
+        rec(p["metric"], p["unit"], p["value"], platform, "higher")
+    v = _num(p, "cpu_openssl_baseline_sigs_per_sec")
+    if v is not None:
+        rec("cpu_openssl_baseline_sigs_per_sec", "sigs/s", v,
+            "openssl-cpu", "higher")
+    if platform in _DEVICE_PLATFORMS:
+        for key, metric in (("compile_s", "device_compile_cold_s"),
+                            ("compile_warm_s", "device_compile_warm_s"),
+                            ("init_s", "device_init_s"),
+                            ("latency128_p50_ms", "verify_latency128_p50_ms"),
+                            ("latency128_p99_ms", "verify_latency128_p99_ms")):
+            v = _num(p, key)
+            if v is not None:
+                rec(metric, "ms" if metric.endswith("_ms") else "s", v,
+                    platform, "lower")
+        # warm-restart trajectory (recorded from ISSUE 6 on): per-bucket
+        # AOT warmup seconds through the verifier's cockpit
+        wb = p.get("warmup_buckets_s")
+        if isinstance(wb, dict) and wb:
+            total = 0.0
+            for b, secs in sorted(wb.items()):
+                if _num({"v": secs}, "v") is None:
+                    continue
+                rec("warmup_bucket_%s_s" % b, "s", secs, platform, "lower")
+                total += secs
+            rec("warmup_total_s", "s", round(total, 3), platform, "lower")
+    rep = p.get("replay")
+    if isinstance(rep, dict):
+        for leg_name in ("cpu", "tpu"):
+            leg = rep.get(leg_name)
+            if isinstance(leg, dict):
+                out.extend(_replay_leg_records(
+                    leg, leg.get("backend", leg_name), source, round_no,
+                    at_unix))
+    for key, metric, plat in (
+            ("replay_speedup", "replay_speedup", "tpu-vs-cpu"),
+            ("replay_crypto_speedup", "replay_crypto_speedup",
+             "tpu-vs-cpu")):
+        v = _num(p, key)
+        if v is not None:
+            rec(metric, "x", v, plat, "higher")
+    ra = p.get("replay_apply")
+    if isinstance(ra, dict):
+        for leg_name in ("native", "python"):
+            leg = ra.get(leg_name)
+            if isinstance(leg, dict):
+                out.extend(_replay_leg_records(
+                    leg, "cpu-apply-%s" % leg_name, source, round_no,
+                    at_unix))
+        v = _num(ra, "apply_speedup")
+        if v is not None:
+            rec("native_apply_speedup", "x", v, "cpu", "higher")
+    # device history survives device-less rounds via the cached block
+    for nest in (p.get("last_device"),
+                 (p.get("errors") or {}).get("last_real_device_result")):
+        if isinstance(nest, dict):
+            out.extend(_payload_records(nest, source, round_no, at_unix))
+    return out
+
+
+def records_from_bench(blob: dict, source: str) -> List[dict]:
+    round_no = _round_of(source)
+    payload = blob.get("parsed") if _is_wrapper(blob) else blob
+    if not isinstance(payload, dict):
+        return []
+    return _payload_records(payload, source, round_no)
+
+
+def records_from_multichip(blob: dict, source: str) -> List[dict]:
+    if not blob.get("ok"):
+        return []      # a failed run leaves no trajectory point
+    return [make_record("multichip_devices", "devices",
+                        blob.get("n_devices", 0), "axon", "higher",
+                        source, _round_of(source))]
+
+
+def normalize_any(blob, source: str) -> List[dict]:
+    """Records from any supported blob shape: an explicit
+    {"records": [...]} list (bench.py --compare output), a multichip
+    driver record, or a bench payload/wrapper."""
+    if isinstance(blob, dict) and isinstance(blob.get("records"), list):
+        return list(blob["records"])
+    if _is_multichip(blob):
+        return records_from_multichip(blob, source)
+    return records_from_bench(blob, source)
+
+
+# --------------------------------------------------------------------------
+# schema checks
+
+def check_artifact(path: str) -> List[str]:
+    name = os.path.basename(path)
+    try:
+        with open(path, encoding="utf-8") as fh:
+            text = fh.read()
+    except OSError as e:
+        return ["%s: unreadable: %s" % (name, e)]
+    if name.endswith(".jsonl"):
+        errs: List[str] = []
+        records = []
+        for i, line in enumerate(text.splitlines(), 1):
+            if not line.strip():
+                continue
+            try:
+                rec = json.loads(line)
+            except ValueError as e:
+                errs.append("%s:%d: bad JSON: %s" % (name, i, e))
+                continue
+            errs.extend(validate_record(rec, "%s:%d" % (name, i)))
+            records.append(rec)
+        errs.extend(_check_direction_consistency(records, name))
+        return errs
+    try:
+        blob = json.loads(text)
+    except ValueError as e:
+        return ["%s: bad JSON: %s" % (name, e)]
+    if _is_multichip(blob):
+        errs = []
+        for key, typ in (("n_devices", int), ("rc", int), ("ok", bool),
+                         ("skipped", bool)):
+            if not isinstance(blob.get(key), typ) or \
+                    (typ is int and isinstance(blob.get(key), bool)):
+                errs.append("%s: multichip field %r must be %s, got %r"
+                            % (name, key, typ.__name__, blob.get(key)))
+        return errs
+    if _is_wrapper(blob):
+        if not isinstance(blob.get("rc"), int):
+            return ["%s: wrapper field 'rc' must be an int" % name]
+        payload = blob.get("parsed")
+        if payload is None:
+            # a crashed driver run with no parsed line is a valid
+            # *failure* artifact only when it says so
+            return [] if blob["rc"] != 0 else \
+                ["%s: rc=0 wrapper without a 'parsed' payload" % name]
+    else:
+        payload = blob
+    errs = []
+    if not isinstance(payload, dict):
+        return ["%s: payload is not an object" % name]
+    if not isinstance(payload.get("metric"), str):
+        errs.append("%s: payload field 'metric' must be a string" % name)
+    if not isinstance(payload.get("unit"), str):
+        errs.append("%s: payload field 'unit' must be a string" % name)
+    v = payload.get("value")
+    if isinstance(v, bool) or not isinstance(v, (int, float)) or \
+            not math.isfinite(v):
+        errs.append("%s: payload field 'value' must be a finite number, "
+                    "got %r" % (name, v))
+    # every record the normalizer derives must itself validate
+    for rec in records_from_bench(blob, name):
+        errs.extend(validate_record(rec, name))
+    return errs
+
+
+def _check_direction_consistency(records, name: str) -> List[str]:
+    seen: Dict[str, str] = {}
+    errs = []
+    for rec in records:
+        if not isinstance(rec, dict):
+            continue
+        m, d = rec.get("metric"), rec.get("direction")
+        if not isinstance(m, str) or d not in DIRECTIONS:
+            continue
+        if m in seen and seen[m] != d:
+            errs.append("%s: metric %r has conflicting directions %s/%s"
+                        % (name, m, seen[m], d))
+        seen.setdefault(m, d)
+    return errs
+
+
+# --------------------------------------------------------------------------
+# history + comparison
+
+def load_history(path: str) -> List[dict]:
+    out: List[dict] = []
+    if not os.path.exists(path):
+        return out
+    with open(path, encoding="utf-8") as fh:
+        for line in fh:
+            if line.strip():
+                out.append(json.loads(line))
+    return out
+
+
+def best_baselines(history) -> Dict[Tuple[str, str], dict]:
+    """Best committed record per (metric, platform), direction-aware."""
+    best: Dict[Tuple[str, str], dict] = {}
+    for rec in history:
+        errs = validate_record(rec, "history")
+        if errs:
+            continue
+        key = (rec["metric"], rec["platform"])
+        cur = best.get(key)
+        if cur is None:
+            best[key] = rec
+        elif rec["direction"] == "higher" and rec["value"] > cur["value"]:
+            best[key] = rec
+        elif rec["direction"] == "lower" and rec["value"] < cur["value"]:
+            best[key] = rec
+    return best
+
+
+def compare(current, history, tolerance: float = 0.1) -> dict:
+    """Diff `current` records against the best committed baseline per
+    (metric, platform). A record regresses when it is worse than the
+    best baseline by more than `tolerance` (fractional); records with
+    no baseline land in `new` and never gate."""
+    base = best_baselines(history)
+    report = {"tolerance": tolerance, "regressions": [],
+              "improvements": [], "ok": [], "new": []}
+    for c in current:
+        errs = validate_record(c, "current")
+        if errs:
+            report["regressions"].append(
+                {"metric": c.get("metric"), "error": "; ".join(errs)})
+            continue
+        key = (c["metric"], c["platform"])
+        b = base.get(key)
+        if b is None:
+            report["new"].append({"metric": c["metric"],
+                                  "platform": c["platform"],
+                                  "value": c["value"]})
+            continue
+        entry = {"metric": c["metric"], "platform": c["platform"],
+                 "current": c["value"], "best": b["value"],
+                 "best_source": b.get("source"),
+                 "direction": c["direction"]}
+        if b["value"]:
+            delta = (c["value"] - b["value"]) / abs(b["value"])
+            entry["delta_pct"] = round(100.0 * delta, 2)
+        if c["direction"] == "higher":
+            regressed = c["value"] < b["value"] * (1.0 - tolerance)
+            improved = c["value"] > b["value"]
+        else:
+            regressed = c["value"] > b["value"] * (1.0 + tolerance)
+            improved = c["value"] < b["value"]
+        (report["regressions"] if regressed else
+         report["improvements"] if improved else
+         report["ok"]).append(entry)
+    return report
+
+
+def append_history(path: str, records) -> int:
+    os.makedirs(os.path.dirname(path) or ".", exist_ok=True)
+    n = 0
+    with open(path, "a", encoding="utf-8") as fh:
+        for rec in records:
+            fh.write(json.dumps(rec, sort_keys=True) + "\n")
+            n += 1
+    return n
+
+
+# --------------------------------------------------------------------------
+# ingest
+
+def default_artifacts(root: str = REPO) -> List[str]:
+    return sorted(glob.glob(os.path.join(root, "BENCH_*.json")) +
+                  glob.glob(os.path.join(root, "MULTICHIP_*.json")))
+
+
+def ingest(paths, out_path: Optional[str] = None) -> List[dict]:
+    """Normalize every artifact into records, deduplicated (cached
+    last_device blocks repeat verbatim across rounds) and
+    deterministically ordered; optionally write them as JSONL."""
+    records: List[dict] = []
+    seen = set()
+    for path in paths:
+        with open(path, encoding="utf-8") as fh:
+            blob = json.load(fh)
+        for rec in normalize_any(blob, os.path.basename(path)):
+            key = (rec["metric"], rec["platform"], rec["value"],
+                   rec.get("at_unix"))
+            if key in seen:
+                continue
+            seen.add(key)
+            records.append(rec)
+    records.sort(key=lambda r: (r.get("round") if r.get("round")
+                                is not None else -1,
+                                r["source"], r["metric"], r["platform"]))
+    if out_path:
+        os.makedirs(os.path.dirname(out_path) or ".", exist_ok=True)
+        with open(out_path, "w", encoding="utf-8") as fh:
+            for rec in records:
+                fh.write(json.dumps(rec, sort_keys=True) + "\n")
+    return records
+
+
+# --------------------------------------------------------------------------
+# CLI
+
+def main(argv=None) -> int:
+    argv = list(sys.argv[1:] if argv is None else argv)
+    # `--check` alias: the tier-1 invocation in ISSUE 6 reads
+    # `tools/bench_compare.py --check`
+    if argv and argv[0] == "--check":
+        argv[0] = "check"
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    sub = ap.add_subparsers(dest="cmd", required=True)
+    p_in = sub.add_parser("ingest", help="normalize artifacts to JSONL")
+    p_in.add_argument("files", nargs="*")
+    p_in.add_argument("--out", default=os.path.join(REPO, DEFAULT_HISTORY))
+    p_ck = sub.add_parser("check", help="validate artifact schemas")
+    p_ck.add_argument("files", nargs="*")
+    p_cp = sub.add_parser("compare", help="gate a run against history")
+    p_cp.add_argument("--current", required=True)
+    p_cp.add_argument("--history",
+                      default=os.path.join(REPO, DEFAULT_HISTORY))
+    p_cp.add_argument("--tolerance", type=float, default=0.1)
+    args = ap.parse_args(argv)
+
+    if args.cmd == "ingest":
+        paths = args.files or default_artifacts()
+        records = ingest(paths, args.out)
+        print("ingested %d records from %d artifacts -> %s"
+              % (len(records), len(paths), args.out))
+        return 0
+
+    if args.cmd == "check":
+        paths = args.files or default_artifacts()
+        hist = os.path.join(REPO, DEFAULT_HISTORY)
+        if not args.files and os.path.exists(hist):
+            paths = paths + [hist]
+        errors: List[str] = []
+        for p in paths:
+            errors.extend(check_artifact(p))
+        for e in errors:
+            print("MALFORMED %s" % e)
+        print("%s: %d artifacts checked, %d errors"
+              % ("FAIL" if errors else "OK", len(paths), len(errors)))
+        return 1 if errors else 0
+
+    if args.cmd == "compare":
+        with open(args.current, encoding="utf-8") as fh:
+            blob = json.load(fh)
+        current = normalize_any(blob, os.path.basename(args.current))
+        history = load_history(args.history)
+        report = compare(current, history, tolerance=args.tolerance)
+        print(json.dumps(report, indent=1, sort_keys=True))
+        return 1 if report["regressions"] else 0
+
+    return 2
+
+
+if __name__ == "__main__":
+    sys.exit(main())
