@@ -587,6 +587,56 @@ def compare_main(argv) -> int:
     return 1 if report["regressions"] else 0
 
 
+def scenario_main(argv) -> int:
+    """`bench.py --scenario NAME [--seed N] [--scale tier1|soak]
+    [--record] [--history PATH] [--tolerance T] [--out FILE]`: run one
+    scenario from the scenario lab (stellar_core_tpu/testing/scenarios.py
+    — churn / flood / partition / surge, or `suite` for all) and emit its
+    fleet bench block. The block's normalized `records` (platform keys
+    `scenario-<name>`) are gated against bench/history.jsonl exactly like
+    perf records: exit 1 on any regression beyond tolerance (default 0.5
+    — slot latencies are wall-clock and jittery; the virtual-clock
+    recovery times are tight). `--record` appends the records to the
+    history. Pure Python (no jax import): safe to run inline."""
+    import argparse
+    bc = _bench_compare_mod()
+    ap = argparse.ArgumentParser(prog="bench.py --scenario")
+    ap.add_argument("--scenario", required=True,
+                    help="churn|flood|partition|surge|suite")
+    ap.add_argument("--seed", type=int, default=1)
+    ap.add_argument("--scale", choices=("tier1", "soak"), default="tier1")
+    ap.add_argument("--record", action="store_true")
+    ap.add_argument("--history",
+                    default=os.path.join(_REPO, "bench", "history.jsonl"))
+    ap.add_argument("--tolerance", type=float, default=0.5)
+    ap.add_argument("--out", help="also write the block to this file")
+    args = ap.parse_args(argv)
+    from stellar_core_tpu.testing.scenarios import run_scenario, run_suite
+    if args.scenario == "suite":
+        block = run_suite(seed=args.seed, scale=args.scale)
+    else:
+        block = run_scenario(args.scenario, seed=args.seed,
+                             scale=args.scale)
+    current = list(block["records"])
+    history = bc.load_history(args.history)
+    report = bc.compare(current, history, tolerance=args.tolerance)
+    if args.record:
+        commit = _git_commit()
+        now = int(time.time())
+        for rec in current:
+            if rec.get("at_unix") is None:
+                rec["at_unix"] = now
+            if rec.get("commit") is None:
+                rec["commit"] = commit
+        report["recorded"] = bc.append_history(args.history, current)
+    block["compare"] = report
+    if args.out:
+        with open(args.out, "w") as fh:
+            json.dump(block, fh, indent=1, sort_keys=True)
+    print(json.dumps(block, indent=1, sort_keys=True))
+    return 1 if report["regressions"] else 0
+
+
 def _scrubbed_cpu_env() -> dict:
     # single source of truth for the axon-env scrub lives in __graft_entry__
     from __graft_entry__ import _scrubbed_env
@@ -947,6 +997,11 @@ if __name__ == "__main__":
         # the `fleet` block (slot-latency p50/p95, externalize skew);
         # does not touch jax or the device relay
         print(json.dumps(fleet_bench()))
+    elif "--scenario" in sys.argv:
+        # scenario lab (ISSUE 8): churn / flood / partition / surge
+        # robustness scenarios emitting fleet bench blocks gated against
+        # bench/history.jsonl; does not touch jax or the device relay
+        sys.exit(scenario_main(sys.argv[1:]))
     elif "--compare" in sys.argv:
         # perf-regression gate against bench/history.jsonl; does not
         # touch jax or the device relay

@@ -73,6 +73,11 @@ class CatchupManager:
     def buffered_count(self) -> int:
         return len(self._buffered)
 
+    def max_buffered_seq(self) -> Optional[int]:
+        """Highest externalized ledger buffered — one of the recovery
+        path's network-tracked-slot signals (Herder.network_tracked_slot)."""
+        return max(self._buffered) if self._buffered else None
+
     def catchup_running(self) -> bool:
         return self._work is not None and not self._work.is_done()
 

@@ -26,7 +26,8 @@ from ..util.threads import main_thread_only
 from ..util.timer import VirtualTimer
 from ..xdr import (
     EnvelopeType, LedgerCloseValueSignature, LedgerUpgrade, SCPEnvelope,
-    SCPQuorumSet, StellarValue, StellarValueExt, Uint32, Uint64, Packer,
+    SCPQuorumSet, SCPStatementType, StellarValue, StellarValueExt, Uint32,
+    Uint64, Packer,
 )
 from ..ledger.ledger_manager import LedgerCloseData
 from .pending_envelopes import PendingEnvelopes, statement_qset_hash
@@ -251,7 +252,13 @@ class HerderSCPDriver(SCPDriver):
 
 class Herder:
     # how far ahead of the current slot envelopes are accepted
+    # (overridable via Config.LEDGER_VALIDITY_BRACKET)
     LEDGER_VALIDITY_BRACKET = 100
+    # cadence of the self-healing poll while out of sync (app-clock
+    # seconds; virtual in tests/simulation)
+    OUT_OF_SYNC_RECOVERY_INTERVAL = 2.0
+    # newest out-of-bracket externalize-hint slots retained while syncing
+    MAX_EXT_HINT_SLOTS = 32
 
     def __init__(self, app) -> None:
         self.app = app
@@ -264,13 +271,23 @@ class Herder:
         self.tx_queue = TransactionQueue(
             app.ledger_manager, cfg.TRANSACTION_QUEUE_PENDING_DEPTH,
             cfg.TRANSACTION_QUEUE_BAN_DEPTH, cfg.POOL_LEDGER_MULTIPLIER,
-            self.verifier)
+            self.verifier, metrics=getattr(app, "metrics", None))
         self.upgrades = Upgrades()
         self.state = HerderState.HERDER_SYNCING_STATE
         self.tracking_slot: Optional[int] = None
         self._scp_timers: Dict[Tuple[int, int], VirtualTimer] = {}
         self.trigger_timer = VirtualTimer(app.clock)
         self.stuck_timer = VirtualTimer(app.clock)
+        # self-healing recovery (out_of_sync_recovery): poll timer,
+        # episode start stamp (None = not recovering), episode counter,
+        # and the buffer of externalize statements seen for slots beyond
+        # the validity bracket — the evidence of where the network is
+        self.LEDGER_VALIDITY_BRACKET = getattr(
+            cfg, "LEDGER_VALIDITY_BRACKET", self.LEDGER_VALIDITY_BRACKET)
+        self.out_of_sync_timer = VirtualTimer(app.clock)
+        self.recovery_started_at: Optional[float] = None
+        self.recoveries = 0
+        self._ext_hints: Dict[int, set] = {}
         self.ledger_close_meta = None
         # register own qset
         q = cfg.QUORUM_SET
@@ -316,8 +333,26 @@ class Herder:
             sm.remove_status_message(StatusCategory.REQUIRES_UPGRADES)
 
     def set_tracking(self, slot: int) -> None:
+        was_recovering = self.recovery_started_at is not None
         self.state = HerderState.HERDER_TRACKING_STATE
         self.tracking_slot = slot
+        if was_recovering:
+            # a recovery episode ends the moment consensus tracks again:
+            # stop the poll, stamp time-to-tracking (the scenario suite's
+            # headline recovery number), and journal the moment
+            dt = max(0.0, self.app.clock.now() - self.recovery_started_at)
+            self.recovery_started_at = None
+            self.out_of_sync_timer.cancel()
+            m = self._metrics()
+            if m is not None:
+                m.new_meter("herder.recovery.resumed").mark()
+                m.new_timer("herder.recovery.time-to-tracking").update(dt)
+            tl = getattr(self.app, "slot_timeline", None)
+            if tl is not None:
+                tl.record(slot, "recovery.tracked", dedupe=True,
+                          time_to_tracking_s=round(dt, 6))
+            log.info("consensus sync recovered at slot %d after %.3fs",
+                     slot, dt)
         self.track_heartbeat()
 
     def track_heartbeat(self) -> None:
@@ -328,6 +363,9 @@ class Herder:
 
     def _lost_sync(self) -> None:
         log.warning("lost consensus sync (stuck timer fired)")
+        m = self._metrics()
+        if m is not None:
+            m.new_meter("herder.recovery.lost-sync").mark()
         # SCP-stall flight dump: the spans/metrics leading into the stall
         # are the evidence that outlives the wedge (ISSUE 2: a stalled
         # relay went unexplained for a round)
@@ -337,9 +375,162 @@ class Herder:
                           extra={"tracking_slot": self.tracking_slot,
                                  "state": "syncing"})
         self.state = HerderState.HERDER_SYNCING_STATE
+        tl = getattr(self.app, "slot_timeline", None)
+        if tl is not None:
+            tl.record(self.current_slot(), "recovery.lost-sync",
+                      dedupe=True)
+        # an app-installed hook still overrides (test/operator hook
+        # contract); the default is the real self-healing path below
         hook = getattr(self.app, "out_of_sync_recovery", None)
         if hook is not None:
             hook()
+        else:
+            self.out_of_sync_recovery()
+
+    # -- self-healing recovery (ISSUE 8) -------------------------------------
+    def _note_externalize_hint(self, envelope: SCPEnvelope) -> None:
+        """Remember EXTERNALIZE statements for slots beyond the validity
+        bracket instead of dropping them blind: they are the evidence of
+        where the network is when we are far behind. Only statements from
+        transitive-quorum nodes WITH a valid envelope signature count —
+        hints steer catchup and the recovery loop, so one forged envelope
+        claiming an absurd slot under a quorum member's id must not
+        poison network_tracked_slot — and the buffer holds the newest
+        MAX_EXT_HINT_SLOTS slots."""
+        st = envelope.statement
+        if st.pledges.disc != SCPStatementType.SCP_ST_EXTERNALIZE:
+            return
+        if not self.quorum_tracker.is_node_definitely_in_quorum(st.nodeID):
+            return
+        slot, node_key = st.slotIndex, st.nodeID.key_bytes
+        if node_key in self._ext_hints.get(slot, ()):
+            return   # already counted: no repeat verify work
+        fut = self.verifier.enqueue(
+            st.nodeID, envelope.signature,
+            self.scp_driver._envelope_sign_bytes(st))
+
+        def done(ok: bool) -> None:
+            if not ok:
+                log.debug("bad signature on externalize hint for slot %d",
+                          slot)
+                return
+            self._ext_hints.setdefault(slot, set()).add(node_key)
+            while len(self._ext_hints) > self.MAX_EXT_HINT_SLOTS:
+                del self._ext_hints[min(self._ext_hints)]
+
+        if fut.done():
+            done(fut.result())
+        else:
+            fut.add_done_callback(done)
+
+    def network_tracked_slot(self) -> Optional[int]:
+        """Best estimate of the slot the network currently externalizes:
+        max over (a) buffered out-of-bracket externalize hints, (b)
+        EXTERNALIZE statements sitting in live SCP slots, (c) ledgers the
+        catchup manager has buffered. None = no evidence."""
+        best: Optional[int] = None
+        if self._ext_hints:
+            best = max(self._ext_hints)
+        for idx in sorted(self.scp.known_slots, reverse=True):
+            if best is not None and idx <= best:
+                break
+            for env in self.scp.known_slots[idx].get_current_state():
+                if env.statement.pledges.disc == \
+                        SCPStatementType.SCP_ST_EXTERNALIZE:
+                    best = idx if best is None else max(best, idx)
+                    break
+        cm = getattr(self.app, "catchup_manager", None)
+        if cm is not None:
+            mb = cm.max_buffered_seq()
+            if mb is not None:
+                best = mb if best is None else max(best, mb)
+        return best
+
+    @main_thread_only
+    def out_of_sync_recovery(self) -> None:
+        """The self-healing path (reference HerderImpl::outOfSyncRecovery
+        + getMoreSCPState): on each poll while not tracking, shed SCP
+        state for slots that can no longer close, locate the network's
+        tracked slot from buffered externalize evidence, solicit fresh
+        SCP state from a few peers, and — when the gap needs history —
+        trigger catchup through the CatchupWork/ArchivePool machinery.
+        Tracking resumes via set_tracking when a slot externalizes."""
+        if self.state == HerderState.HERDER_TRACKING_STATE:
+            return
+        m = self._metrics()
+        clock = self.app.clock
+        first = self.recovery_started_at is None
+        if first:
+            self.recovery_started_at = clock.now()
+            self.recoveries += 1
+        if m is not None:
+            m.new_meter("herder.recovery.attempt").mark()
+        cur = self.current_slot()
+        net_slot = self.network_tracked_slot()
+
+        # 1. shed stale SCP slots: anything below the open slot can never
+        # close anymore, and dropping it speeds envelope processing
+        stale = [s for s in self.scp.known_slots if s < max(1, cur - 1)]
+        if stale:
+            keep_from = max(1, cur - 1)
+            self.scp.purge_slots(keep_from)
+            self.pending.erase_below(keep_from)
+            if m is not None:
+                m.new_counter("herder.recovery.purged-slots").inc(
+                    len(stale))
+
+        # 2. solicit current SCP state from a few random peers (reference
+        # getMoreSCPState): a partitioned-and-healed node re-learns the
+        # live slots without waiting for the next natural flood
+        overlay = getattr(self.app, "overlay_manager", None)
+        asked = 0
+        if overlay is not None and \
+                hasattr(overlay, "random_authenticated_peers"):
+            from ..xdr import MessageType, StellarMessage
+            for peer in overlay.random_authenticated_peers(3):
+                peer.send_message(StellarMessage(
+                    MessageType.GET_SCP_STATE, max(0, cur - 1)))
+                asked += 1
+        if m is not None and asked:
+            m.new_meter("herder.recovery.scp-state-request").mark(asked)
+
+        # 3. the ledger gap needs history: run catchup via the existing
+        # CatchupWork/ArchivePool machinery (multi-archive failover and
+        # all — docs/robustness.md#archive-domain)
+        cm = getattr(self.app, "catchup_manager", None)
+        hm = getattr(self.app, "history_manager", None)
+        triggered = False
+        if net_slot is not None and net_slot > cur and cm is not None \
+                and not cm.catchup_running() and hm is not None \
+                and hm.readable_archive() is not None:
+            if cm.start_catchup() is not None:
+                triggered = True
+                if m is not None:
+                    m.new_meter("herder.recovery.catchup-triggered").mark()
+
+        tl = getattr(self.app, "slot_timeline", None)
+        if tl is not None:
+            tl.record(cur, "recovery.attempt",
+                      net_slot=net_slot, catchup=triggered)
+        if first:
+            recorder = getattr(self.app, "flight_recorder", None)
+            if recorder is not None:
+                # recovery-correlated dump: the state of the node at the
+                # moment self-healing started (rate-limited per reason)
+                recorder.dump("out-of-sync-recovery",
+                              extra={"net_slot": net_slot,
+                                     "current_slot": cur,
+                                     "catchup_triggered": triggered,
+                                     "ext_hint_slots":
+                                         sorted(self._ext_hints)[-8:]})
+        log.info("out-of-sync recovery: slot %d, network at %s, "
+                 "purged %d stale slots, asked %d peers, catchup=%s",
+                 cur, net_slot, len(stale), asked, triggered)
+
+        # 4. keep polling until tracking resumes
+        self.out_of_sync_timer.expires_from_now(
+            self.OUT_OF_SYNC_RECOVERY_INTERVAL)
+        self.out_of_sync_timer.async_wait(self.out_of_sync_recovery)
 
     # -- signed close values (v11+) ------------------------------------------
     def _stellar_value_sign_bytes(self, sv: StellarValue) -> bytes:
@@ -403,6 +594,11 @@ class Herder:
         cur = self.current_slot()
         if slot < max(1, cur - 1) or \
                 slot > cur + self.LEDGER_VALIDITY_BRACKET:
+            if slot > cur:
+                # too far ahead to process, but not to learn from: an
+                # externalize statement up there is recovery's evidence
+                # of where the network is (out_of_sync_recovery)
+                self._note_externalize_hint(envelope)
             return SCP.EnvelopeState.INVALID
         # in-quorum filtering: envelopes from nodes outside the local
         # TRANSITIVE quorum are discarded — they can't affect consensus
@@ -704,6 +900,9 @@ class Herder:
                         self.app.config.MAX_SLOTS_TO_REMEMBER + 1)
         self.scp.purge_slots(keep_from)
         self.pending.erase_below(keep_from)
+        # externalize hints at-or-below the closed slot are consumed
+        self._ext_hints = {s: v for s, v in self._ext_hints.items()
+                           if s > slot_index}
         overlay = getattr(self.app, "overlay_manager", None)
         if overlay is not None and hasattr(overlay, "ledger_closed"):
             overlay.ledger_closed(slot_index)
@@ -800,6 +999,11 @@ class Herder:
                       HerderState.HERDER_TRACKING_STATE else "syncing"),
             "slot": self.tracking_slot,
             "queue_ops": self.tx_queue.size_ops(),
+            "recovery": {
+                "recovering": self.recovery_started_at is not None,
+                "recoveries": self.recoveries,
+                "network_tracked_slot": self.network_tracked_slot(),
+            },
             "scp": self.scp.get_json_info(),
             "transitive": {
                 "node_count": len(self.quorum_tracker.get_quorum()),

@@ -34,13 +34,14 @@ class TransactionQueue:
 
     def __init__(self, ledger_access, pending_depth: int = 4,
                  ban_depth: int = 10, pool_ledger_multiplier: int = 2,
-                 verifier=None) -> None:
+                 verifier=None, metrics=None) -> None:
         """ledger_access: object exposing .ltx_root() and .header()."""
         self._ledger = ledger_access
         self.pending_depth = pending_depth
         self.ban_depth = ban_depth
         self.pool_multiplier = pool_ledger_multiplier
         self.verifier = verifier
+        self.metrics = metrics
         # account -> list[frame] sorted by seq; ages are PER ACCOUNT
         # (reference AccountState.mAge: ledgers since the account last
         # had a tx applied — the whole chain expires together)
@@ -83,9 +84,6 @@ class TransactionQueue:
             return TxQueueResult.ADD_STATUS_DUPLICATE
         if self.is_banned(h):
             return TxQueueResult.ADD_STATUS_TRY_AGAIN_LATER
-        if self.size_ops() + frame.num_operations() > self.pool_cap_ops():
-            return TxQueueResult.ADD_STATUS_TRY_AGAIN_LATER
-
         acc = frame.seq_account_id().key_bytes
         chain = self._pending.get(acc, [])
         # replace-by-fee: same seqnum present?
@@ -101,6 +99,18 @@ class TransactionQueue:
         if replace_idx is None and \
                 frame.seq_num != cur_seq + 1 + len(chain):
             return TxQueueResult.ADD_STATUS_ERROR
+
+        # pool-cap check with surge eviction: a replacement frees its own
+        # ops, so it must not count them twice. Victims are only SELECTED
+        # here (a hopeless low bid bounces before costing any signature
+        # verifies); the eviction COMMITS after the frame proves valid —
+        # an invalid tx must never flush honest pending txs for free
+        need = self.size_ops() + frame.num_operations() - self.pool_cap_ops()
+        if replace_idx is not None:
+            need -= chain[replace_idx].num_operations()
+        victims = self._surge_victims(frame, need) if need > 0 else []
+        if victims is None:
+            return TxQueueResult.ADD_STATUS_TRY_AGAIN_LATER
 
         # full validity check against current ledger — hot verify site
         ltx = LedgerTxn(self._ledger.ltx_root())
@@ -141,6 +151,8 @@ class TransactionQueue:
         finally:
             ltx.rollback()
 
+        if victims:
+            self._surge_evict(victims, frame)
         if replace_idx is not None:
             old = chain[replace_idx]
             del self._known_hashes[old.full_hash()]
@@ -157,6 +169,64 @@ class TransactionQueue:
         self._known_hashes[h] = acc
         self._note_add(frame)
         return TxQueueResult.ADD_STATUS_PENDING
+
+    def _surge_victims(self, frame, need):
+        """Pool saturated: pick the lowest-fee-rate pending txs whose
+        eviction would admit a strictly better bid (reference
+        TransactionQueue::canFitWithEviction role; ISSUE 8 surge
+        scenario). Only chain TAILS are eligible — an inner eviction
+        would break the account's sequence continuity — and a victim
+        qualifies only when the incoming fee-per-op strictly beats its
+        own. Selection does NOT mutate the pool: None means the incoming
+        bid cannot fit even with eviction (nothing is shed for a tx that
+        bounces anyway); a list means evicting exactly those tails frees
+        `need` ops."""
+        rate_in = frame.fee_bid / max(1, frame.num_operations())
+        own = frame.seq_account_id().key_bytes
+        # per-account count of not-yet-selected tail positions: one chain
+        # can donate several tails, deepest-first
+        tails = {acc: len(chain) for acc, chain in self._pending.items()}
+        victims = []
+        while need > 0:
+            victim_acc = None
+            victim_rate = rate_in
+            victim_tail = None
+            for acc, chain in self._pending.items():
+                if acc == own or tails[acc] == 0:
+                    continue
+                tail = chain[tails[acc] - 1]
+                r = tail.fee_bid / max(1, tail.num_operations())
+                if r < victim_rate:
+                    victim_acc, victim_rate, victim_tail = acc, r, tail
+            if victim_acc is None:
+                return None
+            tails[victim_acc] -= 1
+            victims.append((victim_acc, victim_tail))
+            need -= victim_tail.num_operations()
+        return victims
+
+    def _surge_evict(self, victims, frame) -> None:
+        """Commit a `_surge_victims` selection: runs only after the
+        incoming frame passed full validation, so an invalid tx can never
+        flush honest pending txs. Evicted txs are NOT banned: they may be
+        resubmitted once the surge clears."""
+        m = self.metrics
+        rate_in = frame.fee_bid / max(1, frame.num_operations())
+        for acc, tail in victims:
+            chain = self._pending[acc]
+            popped = chain.pop()
+            assert popped is tail, "pool mutated between select and evict"
+            self._known_hashes.pop(popped.full_hash(), None)
+            self._note_remove(popped)
+            if m is not None:
+                m.new_meter("herder.tx-queue.surge-evicted").mark()
+            log.debug("surge-evicted tx %s (fee rate %.1f < %.1f)",
+                      popped.full_hash().hex()[:8],
+                      popped.fee_bid / max(1, popped.num_operations()),
+                      rate_in)
+            if not chain:
+                self._pending.pop(acc, None)
+                self._ages.pop(acc, None)
 
     def _account_seq(self, acc: bytes) -> int:
         from ..xdr import LedgerKey, PublicKey

@@ -413,14 +413,45 @@ class CommandHandler:
                 return {"status": "dropped"}
         return {"error": "peer not found"}
 
+    def _parse_node_param(self, node: str):
+        """A `node` param as hex-XDR PublicKey or strkey (G...); raises
+        CommandParamError (-> 400) on anything else."""
+        from ..xdr import PublicKey
+        if not node:
+            raise CommandParamError("missing 'node' param")
+        try:
+            if node.startswith("G"):
+                from ..crypto import strkey
+                return PublicKey.ed25519(strkey.decode_public_key(node))
+            return PublicKey.from_xdr(bytes.fromhex(node))
+        except Exception:
+            raise CommandParamError(
+                "parameter 'node' must be a hex-encoded PublicKey XDR "
+                "or a G... strkey, got %r" % node)
+
     def cmd_bans(self, params) -> dict:
-        return {"bans": self.app.overlay_manager.ban_manager.banned()}
+        """BanManager operator surface (ISSUE 8 satellite):
+        `bans[?action=list|unban|unban_all]` — list the banned node ids
+        (flood-control escalation and `droppeer?ban=1` feed this set),
+        lift one ban (`action=unban&node=<hex-or-strkey>`), or clear
+        them all. Bad params are 400s via CommandParamError."""
+        bm = self.app.overlay_manager.ban_manager
+        action = params.get("action", "list")
+        if action == "list":
+            return {"bans": bm.banned()}
+        if action == "unban":
+            bm.unban_node(self._parse_node_param(params.get("node", "")))
+            return {"status": "ok", "bans": bm.banned()}
+        if action == "unban_all":
+            n = bm.unban_all()
+            return {"status": "ok", "unbanned": n, "bans": bm.banned()}
+        raise CommandParamError(
+            "parameter 'action' must be list|unban|unban_all, got %r"
+            % action)
 
     def cmd_unban(self, params) -> dict:
-        from ..xdr import PublicKey
-        node = params.get("node", "")
         bm = self.app.overlay_manager.ban_manager
-        bm.unban_node(PublicKey.from_xdr(bytes.fromhex(node)))
+        bm.unban_node(self._parse_node_param(params.get("node", "")))
         return {"status": "ok"}
 
     # -- survey / load -------------------------------------------------------

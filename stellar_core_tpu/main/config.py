@@ -74,11 +74,24 @@ class Config:
         self.MAX_BATCH_WRITE_BYTES = 1024 * 1024
         # queued-but-unsent cap per peer; overflowing drops the connection
         self.PEER_SEND_QUEUE_LIMIT_BYTES = 32 * 1024 * 1024
+        # per-peer flood-rate defense (overlay/flood_control.py,
+        # docs/robustness.md#flood-control): token bucket of
+        # FLOOD_RATE_BURST messages refilling at
+        # FLOOD_RATE_LIMIT_PER_PEER msgs/s on the app clock; <= 0
+        # disables. A message over the limit is dropped unprocessed and
+        # scores one ban point; FLOOD_BAN_SCORE_THRESHOLD points (scores
+        # halve per ledger close) ban the peer via BanManager.
+        self.FLOOD_RATE_LIMIT_PER_PEER = 500.0
+        self.FLOOD_RATE_BURST = 5000
+        self.FLOOD_BAN_SCORE_THRESHOLD = 500
 
         # herder
         self.EXPECTED_LEDGER_CLOSE_TIME = 5.0
         self.MAX_SLOTS_TO_REMEMBER = 12
         self.CONSENSUS_STUCK_TIMEOUT_SECONDS = 35.0
+        # how far ahead of the current slot SCP envelopes are accepted;
+        # beyond it only externalize hints are buffered (recovery path)
+        self.LEDGER_VALIDITY_BRACKET = 100
         self.TRANSACTION_QUEUE_PENDING_DEPTH = 4
         self.TRANSACTION_QUEUE_BAN_DEPTH = 10
         self.POOL_LEDGER_MULTIPLIER = 2
@@ -188,6 +201,7 @@ class Config:
             "PREFERRED_PEERS_ONLY", "PREFERRED_PEER_KEYS",
             "TARGET_PEER_CONNECTIONS", "UNSAFE_QUORUM", "FAILURE_SAFETY",
             "EXPECTED_LEDGER_CLOSE_TIME", "MAX_SLOTS_TO_REMEMBER",
+            "CONSENSUS_STUCK_TIMEOUT_SECONDS", "LEDGER_VALIDITY_BRACKET",
             "INVARIANT_CHECKS", "WORKER_THREADS",
             "MAX_CONCURRENT_SUBPROCESSES", "SIG_VERIFY_BACKEND",
             "SIG_VERIFY_MAX_BATCH", "TRACE_ENABLED", "TRACE_CAPACITY",
@@ -197,6 +211,8 @@ class Config:
             "PEER_TIMEOUT", "PEER_STRAGGLER_TIMEOUT",
             "MAX_BATCH_WRITE_COUNT", "MAX_BATCH_WRITE_BYTES",
             "PEER_SEND_QUEUE_LIMIT_BYTES", "METADATA_OUTPUT_STREAM",
+            "FLOOD_RATE_LIMIT_PER_PEER", "FLOOD_RATE_BURST",
+            "FLOOD_BAN_SCORE_THRESHOLD",
             "SIG_VERIFY_BREAKER_THRESHOLD", "SIG_VERIFY_BREAKER_COOLDOWN",
             "FAULTS_SEED",
         ]

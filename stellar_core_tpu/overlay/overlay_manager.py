@@ -36,6 +36,8 @@ class OverlayManager:
         self.peer_manager = PeerManager(app)
         self.ban_manager = BanManager(app)
         self.floodgate = Floodgate()
+        from .flood_control import FloodControl
+        self.flood_control = FloodControl(app)
         # hash-keyed peer registry: id_key (nodeid xdr) -> Peer
         self.pending_peers: List[Peer] = []
         self.authenticated_peers: Dict[bytes, Peer] = {}
@@ -180,6 +182,10 @@ class OverlayManager:
         t.max_batch_write_count = cfg.MAX_BATCH_WRITE_COUNT
         t.max_batch_write_bytes = cfg.MAX_BATCH_WRITE_BYTES
         t.send_queue_limit_bytes = cfg.PEER_SEND_QUEUE_LIMIT_BYTES
+        # overflow drops are counted, and the overlay.send-overflow
+        # fault site can force them deterministically
+        t.metrics = getattr(self.app, "metrics", None)
+        t.faults = getattr(self.app, "faults", None)
 
     def _on_inbound_connection(self, transport, addr) -> None:
         if self.num_connections() >= \
@@ -309,6 +315,7 @@ class OverlayManager:
             if self.authenticated_peers.get(key) is peer:
                 del self.authenticated_peers[key]
                 self.load_manager.forget(key)
+                self.flood_control.forget(key)
 
     # -- registry views ------------------------------------------------------
     def authenticated_peer_ids(self) -> List[bytes]:
@@ -329,6 +336,13 @@ class OverlayManager:
     def _current_ledger_seq(self) -> int:
         return self.app.ledger_manager.last_closed_ledger_num()
 
+    def flood_rate_limited(self, peer: Peer) -> bool:
+        """Token-bucket admission for one flooded message from `peer`
+        (overlay/flood_control.py): True = drop it before any processing
+        or relay. Escalation (ban score → BanManager + peer drop) happens
+        inside the flood controller."""
+        return self.flood_control.limited(peer)
+
     def recv_flooded_msg(self, msg: StellarMessage, peer: Peer) -> bool:
         """Returns False if this flooded message was seen before."""
         return self.floodgate.add_record(msg, peer.peer_id.to_xdr(),
@@ -348,6 +362,7 @@ class OverlayManager:
 
     def ledger_closed(self, ledger_seq: int) -> None:
         self.floodgate.clear_below(ledger_seq)
+        self.flood_control.ledger_closed()
         self.tx_set_fetcher.stop_fetching_below(ledger_seq)
         self.qset_fetcher.stop_fetching_below(ledger_seq)
 
@@ -372,4 +387,6 @@ class OverlayManager:
             "pending_count": len(self.pending_peers),
             "authenticated": [one(p)
                               for p in self.authenticated_peers.values()],
+            # per-peer flood-defense state (token levels, ban scores)
+            "flood": self.flood_control.to_json(),
         }

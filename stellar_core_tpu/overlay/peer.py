@@ -235,6 +235,10 @@ class Peer:
             herder.recv_tx_set(h, frame)
             self.overlay.item_fetched_txset(h)
         elif t == MessageType.TRANSACTION:
+            if self.overlay.flood_rate_limited(self):
+                # over the per-peer flood rate: dropped before any
+                # validation or relay (docs/robustness.md#flood-control)
+                return
             self.overlay.recv_flooded_msg(msg, self)
             from ..transactions.transaction_frame import TransactionFrame
             frame = TransactionFrame.make_from_wire(
@@ -253,6 +257,8 @@ class Peer:
             herder.recv_scp_quorum_set(h, msg.value)
             self.overlay.item_fetched_qset(h)
         elif t == MessageType.SCP_MESSAGE:
+            if self.overlay.flood_rate_limited(self):
+                return
             self.overlay.recv_flooded_msg(msg, self)
             # only relay envelopes that verified (reference Peer.cpp
             # rebroadcasts unless the herder discarded the envelope); with

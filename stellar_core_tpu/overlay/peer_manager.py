@@ -199,6 +199,17 @@ class BanManager:
             db.execute("DELETE FROM bans WHERE nodeid = ?", (key,))
             db.commit()
 
+    def unban_all(self) -> int:
+        """Lift every ban (admin `bans?action=unban_all`); returns how
+        many were lifted."""
+        n = len(self._banned)
+        self._banned.clear()
+        db = getattr(self.app, "database", None)
+        if db is not None:
+            db.execute("DELETE FROM bans")
+            db.commit()
+        return n
+
     def is_banned(self, node_id: PublicKey) -> bool:
         return node_id.to_xdr().hex() in self._banned
 

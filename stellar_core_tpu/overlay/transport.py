@@ -136,6 +136,10 @@ class ChaosTransport(Transport):
     # delay applied to frames the `overlay.delay` site selects; virtual
     # seconds in simulations
     delay_s = 0.25
+    # deterministic geographic base delay applied to EVERY outbound frame
+    # (seconds on the sender's clock) — fed by the simulation's seeded
+    # per-link latency matrix (simulation/geography.py); 0 = co-located
+    link_delay_s = 0.0
 
     def __init__(self, inner: Transport, clock, faults=None,
                  site_prefix: str = "overlay") -> None:
@@ -171,10 +175,13 @@ class ChaosTransport(Transport):
             frames.append(self._reorder_held)
             self._reorder_held = None
         for f in frames:
+            wait = self.link_delay_s
             if self._fire("delay"):
                 self.delayed += 1
+                wait += self.delay_s
+            if wait > 0:
                 t = VirtualTimer(self.clock)
-                t.expires_from_now(self.delay_s)
+                t.expires_from_now(wait)
                 t.async_wait(lambda f=f: self._send_now(f))
             else:
                 self._send_now(f)
@@ -324,6 +331,10 @@ class TCPTransport(Transport):
     # queue lets a stuck reader consume all memory)
     send_queue_limit_bytes = 32 * 1024 * 1024
     connect_timeout = 5.0
+    # observability/fault wiring, installed by the overlay manager
+    # (_apply_transport_limits); both optional — raw transports work bare
+    metrics = None
+    faults = None
 
     def __init__(self, reactor: TCPReactor, sock: socket.socket) -> None:
         self.reactor = reactor
@@ -407,6 +418,7 @@ class TCPTransport(Transport):
         return 0.0
 
     def send_frame(self, raw: bytes) -> None:
+        from ..util.faults import check_faults
         framed = struct.pack(">I", len(raw) | _LAST_FRAG) + raw
         with self._wlock:
             # closed/_failed must be read under the lock: a frame racing
@@ -417,9 +429,17 @@ class TCPTransport(Transport):
             self._wqueue.append((framed, time.monotonic()))
             self._wqueue_bytes += len(framed)
             overflow = self._wqueue_bytes > self.send_queue_limit_bytes
+        # fault site: force the overflow path without queuing 32 MB
+        # (docs/robustness.md#fault-points)
+        if not overflow and check_faults(self, "overlay.send-overflow"):
+            overflow = True
         if overflow:
-            log.debug("send queue overflow (> %d bytes), dropping peer",
-                      self.send_queue_limit_bytes)
+            # a stalled reader must not pin send_queue_limit_bytes per
+            # peer indefinitely: count it and drop the connection
+            log.warning("send queue overflow (> %d bytes), dropping peer",
+                        self.send_queue_limit_bytes)
+            if self.metrics is not None:
+                self.metrics.new_meter("overlay.send-queue.overflow").mark()
             self._fail()
             return
         self.reactor.wake()

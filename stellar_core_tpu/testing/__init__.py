@@ -9,11 +9,11 @@ from __future__ import annotations
 
 from typing import List, Optional
 
-from .crypto.hashing import sha256
-from .crypto.keys import SecretKey
-from .ledger.ledgertxn import InMemoryLedgerTxnRoot, LedgerTxn
-from .transactions.transaction_frame import TransactionFrame
-from .xdr import (
+from ..crypto.hashing import sha256
+from ..crypto.keys import SecretKey
+from ..ledger.ledgertxn import InMemoryLedgerTxnRoot, LedgerTxn
+from ..transactions.transaction_frame import TransactionFrame
+from ..xdr import (
     Asset, LedgerHeader, LedgerKey, Memo, MuxedAccount, Operation,
     OperationBody, OperationType, Price, PublicKey, StellarValue,
     StellarValueExt, TimeBounds, Transaction, TransactionEnvelope, _Ext,
@@ -65,7 +65,7 @@ class TestLedger:
             genesis_header(ledger_version=ledger_version))
         self.verifier = verifier
         root_sk = root_secret_key(network_id)
-        from .transactions.account_helpers import make_account_entry
+        from ..transactions.account_helpers import make_account_entry
         ltx = LedgerTxn(self.root)
         ltx.create(make_account_entry(
             root_sk.public_key, GENESIS_TOTAL_COINS,
@@ -169,7 +169,7 @@ class AppLedgerAdapter:
         if status != 0:
             return False
         self.app.manual_close()
-        from .xdr import TransactionResultCode
+        from ..xdr import TransactionResultCode
         return frame.result.code == TransactionResultCode.txSUCCESS
 
     def root_account(self) -> "TestAccount":
@@ -207,21 +207,21 @@ class TestAccount:
             body=body)
 
     def op_create_account(self, dest: PublicKey, balance: int) -> Operation:
-        from .xdr import CreateAccountOp
+        from ..xdr import CreateAccountOp
         return self.op(OperationBody(
             OperationType.CREATE_ACCOUNT,
             CreateAccountOp(destination=dest, startingBalance=balance)))
 
     def op_payment(self, dest: PublicKey, amount: int,
                    asset: Optional[Asset] = None) -> Operation:
-        from .xdr import PaymentOp
+        from ..xdr import PaymentOp
         return self.op(OperationBody(
             OperationType.PAYMENT,
             PaymentOp(destination=MuxedAccount.from_account_id(dest),
                       asset=asset or Asset.native(), amount=amount)))
 
     def op_change_trust(self, asset: Asset, limit: int) -> Operation:
-        from .xdr import ChangeTrustOp
+        from ..xdr import ChangeTrustOp
         return self.op(OperationBody(
             OperationType.CHANGE_TRUST,
             ChangeTrustOp(line=asset, limit=limit)))
@@ -229,7 +229,7 @@ class TestAccount:
     def op_manage_sell_offer(self, selling: Asset, buying: Asset,
                              amount: int, n: int, d: int,
                              offer_id: int = 0) -> Operation:
-        from .xdr import ManageSellOfferOp
+        from ..xdr import ManageSellOfferOp
         return self.op(OperationBody(
             OperationType.MANAGE_SELL_OFFER,
             ManageSellOfferOp(selling=selling, buying=buying, amount=amount,
@@ -238,7 +238,7 @@ class TestAccount:
     def op_manage_buy_offer(self, selling: Asset, buying: Asset,
                             buy_amount: int, n: int, d: int,
                             offer_id: int = 0) -> Operation:
-        from .xdr import ManageBuyOfferOp
+        from ..xdr import ManageBuyOfferOp
         return self.op(OperationBody(
             OperationType.MANAGE_BUY_OFFER,
             ManageBuyOfferOp(selling=selling, buying=buying,
@@ -248,7 +248,7 @@ class TestAccount:
     def op_create_passive_sell_offer(self, selling: Asset, buying: Asset,
                                      amount: int, n: int, d: int
                                      ) -> Operation:
-        from .xdr import CreatePassiveSellOfferOp
+        from ..xdr import CreatePassiveSellOfferOp
         return self.op(OperationBody(
             OperationType.CREATE_PASSIVE_SELL_OFFER,
             CreatePassiveSellOfferOp(selling=selling, buying=buying,
@@ -258,7 +258,7 @@ class TestAccount:
                        set_flags=None, master_weight=None, low=None,
                        med=None, high=None, home_domain=None,
                        signer=None) -> Operation:
-        from .xdr import SetOptionsOp
+        from ..xdr import SetOptionsOp
         return self.op(OperationBody(
             OperationType.SET_OPTIONS,
             SetOptionsOp(inflationDest=inflation_dest,
@@ -268,13 +268,13 @@ class TestAccount:
                          homeDomain=home_domain, signer=signer)))
 
     def op_add_signer(self, key_bytes32: bytes, weight: int = 1) -> Operation:
-        from .xdr import Signer, SignerKey
+        from ..xdr import Signer, SignerKey
         return self.op_set_options(
             signer=Signer(key=SignerKey.ed25519(key_bytes32), weight=weight))
 
     def op_allow_trust(self, trustor: PublicKey, code: bytes = b"USD\x00",
                        authorize: int = 1) -> Operation:
-        from .xdr import AllowTrustAsset, AllowTrustOp
+        from ..xdr import AllowTrustAsset, AllowTrustOp
         return self.op(OperationBody(
             OperationType.ALLOW_TRUST,
             AllowTrustOp(trustor=trustor, asset=AllowTrustAsset(1, code),
@@ -282,7 +282,7 @@ class TestAccount:
 
     def op_manage_data(self, name: str,
                        value: Optional[bytes]) -> Operation:
-        from .xdr import ManageDataOp
+        from ..xdr import ManageDataOp
         return self.op(OperationBody(
             OperationType.MANAGE_DATA,
             ManageDataOp(dataName=name, dataValue=value)))

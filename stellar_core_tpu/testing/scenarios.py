@@ -1,0 +1,729 @@
+"""Scenario lab (ISSUE 8): declarative robustness scenarios.
+
+Each scenario composes what PRs 3-6 built — the seeded fault injector,
+ChaosTransport, the fleet observability stack — with this PR's node
+lifecycle (Simulation.stop_node / restart_node / add_late_node), the
+Herder's self-healing out-of-sync recovery, the overlay flood defense,
+and the tx-queue surge eviction, into one deterministic, asserted run
+that emits a **fleet bench block**: slot latency p50/p95, externalize
+skew, and scenario-specific numbers (recovery time-to-tracking, flood
+latency ratio, surge evictions) plus normalized `records` for
+`bench/history.jsonl` under scenario-specific platform keys
+(`scenario-churn`, `scenario-flood`, ...) — scenario regressions gate
+exactly like perf regressions (`bench.py --scenario NAME`,
+tools/bench_compare.py).
+
+Every schedule runs on seeded RNG streams and virtual app clocks only
+(no wall clock, no unseeded randomness — the sctlint D1/D2 contract),
+so one (scenario, seed, scale) triple replays identically.
+
+Catalog: docs/robustness.md#scenario-catalog. Tier-1 runs the small
+seeded variants (tests/test_scenarios.py); full soaks ride the `slow`
+marker.
+"""
+
+from __future__ import annotations
+
+import os
+import shutil
+import tempfile
+from typing import Callable, Dict, List, Optional
+
+from ..crypto.hashing import sha256
+from ..crypto.keys import SecretKey
+from ..history.archive import HistoryArchive
+from ..main.config import Config
+from ..simulation.geography import LatencyMatrix
+from ..simulation.simulation import Simulation
+from ..util import rnd
+from ..util.log import get_logger
+from ..xdr import (
+    Memo, MessageType, MuxedAccount, SCPQuorumSet, StellarMessage,
+    Transaction, TransactionEnvelope, _Ext,
+)
+from . import AppLedgerAdapter, TestAccount
+
+log = get_logger("LoadGen")
+
+
+# --------------------------------------------------------------------------
+# shared plumbing
+
+def _record(metric: str, unit: str, value: float, platform: str,
+            direction: str, source: str) -> dict:
+    """One normalized bench record (tools/bench_compare.py schema)."""
+    return {"metric": metric, "unit": unit, "value": value,
+            "platform": platform, "direction": direction, "source": source,
+            "round": None, "at_unix": None, "commit": None}
+
+
+def _keys(n: int, tag: bytes, seed: int) -> List[SecretKey]:
+    return [SecretKey.from_seed(sha256(tag + b"-%d-" % seed + bytes([i])))
+            for i in range(n)]
+
+
+def _clear_verify_cache() -> None:
+    from ..crypto import keys as _keys_mod
+    _keys_mod.flush_verify_cache()
+
+
+def _header_hashes(app) -> Dict[int, str]:
+    rows = app.database.execute(
+        "SELECT ledgerseq, ledgerhash FROM ledgerheaders").fetchall()
+    return dict(rows)
+
+
+def _assert_header_equality(apps: List, min_common: int = 2) -> int:
+    """Per-height header-hash equality across every app's DB; returns the
+    number of common heights compared."""
+    maps = [_header_hashes(a) for a in apps]
+    common = set.intersection(*(set(m) for m in maps))
+    assert len(common) >= min_common, \
+        "too few common heights: %d" % len(common)
+    for seq in sorted(common):
+        hashes = {m[seq] for m in maps}
+        assert len(hashes) == 1, "fork at ledger %d: %r" % (seq, hashes)
+    return len(common)
+
+
+def _fleet_block(agg) -> dict:
+    """The fleet summary sub-block every scenario emits."""
+    summary = agg.fleet_stats()["summary"]
+    return {
+        "slot_count": summary["slot_count"],
+        "slot_latency_p50_ms": round(
+            summary["slot_latency_p50_s"] * 1e3, 3),
+        "slot_latency_p95_ms": round(
+            summary["slot_latency_p95_s"] * 1e3, 3),
+        "externalize_skew_p50_ms": round(
+            summary["externalize_skew_p50_s"] * 1e3, 3),
+        "externalize_skew_max_ms": round(
+            summary["externalize_skew_max_s"] * 1e3, 3),
+        "stragglers": summary["stragglers"],
+    }
+
+
+def _crank_until(sim: Simulation, pred: Callable[[], bool],
+                 max_rounds: int, what: str) -> None:
+    assert sim.crank_until(pred, max_rounds), \
+        "scenario stalled waiting for %s: %r" % (
+            what, {n: v.app.ledger_manager.last_closed_ledger_num()
+                   for n, v in sim.nodes.items()})
+
+
+def _common_records(name: str, fleet: dict, source: str) -> List[dict]:
+    plat = "scenario-%s" % name
+    return [
+        _record("scenario_slot_latency_p95", "ms",
+                fleet["slot_latency_p95_ms"], plat, "lower", source),
+        _record("scenario_externalize_skew_max", "ms",
+                fleet["externalize_skew_max_ms"], plat, "lower", source),
+    ]
+
+
+# --------------------------------------------------------------------------
+# churn: kill / restart under load, rejoin via recovery + archive catchup
+
+def run_churn(seed: int, scale: str, workdir: str) -> dict:
+    """Churn soak: a 4-node fleet closes ledgers under payment load and
+    publishes checkpoints; one tracking node is killed mid-run, the
+    survivors advance past the victim's validity bracket, the victim
+    restarts over its persisted DB/buckets, loses sync (stuck timer),
+    and self-heals: externalize hints locate the network, recovery
+    triggers CatchupWork against the archive, and tracking resumes —
+    asserted per-height header-hash-equal with the survivors."""
+    freq = 4
+    bracket = 12
+    cycles = 1 if scale == "tier1" else 2
+    archive_root = os.path.join(workdir, "archive")
+    os.makedirs(archive_root, exist_ok=True)
+
+    def tweak_for(i: int):
+        def tweak(cfg: Config) -> None:
+            cfg.DATABASE = "sqlite3://%s" % os.path.join(
+                workdir, "node%d.db" % i)
+            cfg.BUCKET_DIR_PATH = os.path.join(workdir, "buckets-%d" % i)
+            cfg.CHECKPOINT_FREQUENCY = freq
+            cfg.LEDGER_VALIDITY_BRACKET = bracket
+            cfg.CONSENSUS_STUCK_TIMEOUT_SECONDS = 2.0
+            cfg.CATCHUP_COMPLETE = True   # replay every height: the
+            # hash-equality assertion covers the victim's whole gap
+            arch = HistoryArchive.local_dir("lab", archive_root)
+            d = {"get": arch.get_tmpl, "mkdir": arch.mkdir_tmpl}
+            if i == 0:
+                d["put"] = arch.put_tmpl
+            cfg.HISTORY = {"lab": d}
+        return tweak
+
+    sim = Simulation(Simulation.OVER_LOOPBACK)
+    keys = _keys(4, b"churn", seed)
+    qset = SCPQuorumSet(threshold=3,
+                        validators=[k.public_key for k in keys],
+                        innerSets=[])
+    names = []
+    for i, k in enumerate(keys):
+        node = sim.add_node(k, qset, name="n%d" % i,
+                            cfg_tweak=tweak_for(i))
+        node.app.enable_buckets()
+        names.append(node.name)
+    for i in range(4):
+        for j in range(i + 1, 4):
+            sim.connect(names[i], names[j])
+    sim.apply_latency_matrix(LatencyMatrix(names, "single-dc", seed))
+    sim.start_all_nodes()
+    victim_name = names[-1]
+    n0 = sim.nodes[names[0]].app
+
+    _crank_until(sim, lambda: sim.have_all_externalized(3), 40000,
+                 "initial convergence")
+    # payment load: a couple of funded accounts ping-ponging
+    adapter = AppLedgerAdapter(n0)
+    root = adapter.root_account()
+    accounts = _keys(2, b"churn-acct", seed)
+    n0.submit_transaction(root.tx(
+        [root.op_create_account(k.public_key, 10**10) for k in accounts]))
+    payers = [TestAccount(adapter, k) for k in accounts]
+    pay_seq: Dict[bytes, int] = {}
+    pump_state = {"lcl": 0}
+
+    def pump_load(n_txs: int = 2) -> None:
+        # throttled to one burst per closed ledger: steady load, not a
+        # per-crank firehose
+        lcl = n0.ledger_manager.last_closed_ledger_num()
+        if lcl == pump_state["lcl"]:
+            return
+        pump_state["lcl"] = lcl
+        for i in range(n_txs):
+            p = payers[i % len(payers)]
+            seqk = p.sk.seed
+            try:
+                seq = pay_seq.get(seqk) or p.next_seq()
+                st = n0.submit_transaction(p.tx(
+                    [p.op_payment(root.account_id, 100 + i)], seq=seq))
+                if st == 0:
+                    pay_seq[seqk] = seq + 1
+                else:
+                    pay_seq.pop(seqk, None)  # resync from the ledger
+            except AssertionError:
+                pay_seq.pop(seqk, None)   # account not yet created
+
+    recovery_times: List[float] = []
+    for cycle in range(cycles):
+        victim = sim.nodes[victim_name]
+        lcl_at_kill = victim.app.ledger_manager.last_closed_ledger_num()
+        sim.stop_node(victim_name)
+        # survivors advance past the victim's validity bracket AND past
+        # the next checkpoint boundaries, pumping load the whole way
+        down_target = lcl_at_kill + bracket + 2 * freq
+
+        def survivors_ahead() -> bool:
+            pump_load()
+            return sim.have_all_externalized(down_target)
+        _crank_until(sim, survivors_ahead, 120000,
+                     "survivors past the bracket")
+        # drain the publish queue so the archive covers the gap
+        _crank_until(
+            sim, lambda: n0.history_manager.publish_queue() == [],
+            60000, "publish queue drain")
+
+        sim.restart_node(victim_name)
+        victim = sim.nodes[victim_name]
+        h = victim.app.herder
+        from ..herder.herder import HerderState
+
+        def victim_recovered() -> bool:
+            pump_load(1)
+            return (h.recoveries >= 1 and
+                    h.state == HerderState.HERDER_TRACKING_STATE and
+                    victim.app.ledger_manager.last_closed_ledger_num() >=
+                    down_target)
+        _crank_until(sim, victim_recovered, 200000,
+                     "victim recovery to TRACKING")
+        mjson = victim.app.metrics.to_json()
+        assert mjson["herder.recovery.lost-sync"]["count"] >= 1
+        assert mjson["herder.recovery.attempt"]["count"] >= 1
+        assert mjson["herder.recovery.catchup-triggered"]["count"] >= 1, \
+            "recovery never routed through CatchupWork"
+        ttt = mjson["herder.recovery.time-to-tracking"]
+        assert ttt["count"] >= 1
+        recovery_times.append(ttt["mean"])
+
+    # everyone advances together after the final heal
+    tip = max(v.app.ledger_manager.last_closed_ledger_num()
+              for v in sim.nodes.values())
+    _crank_until(sim, lambda: sim.have_all_externalized(tip + 2), 60000,
+                 "post-recovery convergence")
+    common = _assert_header_equality(
+        [v.app for v in sim.nodes.values()], min_common=8)
+    fleet = _fleet_block(sim.fleet())
+    sim.stop_all_nodes()
+
+    source = "bench.py --scenario churn"
+    ttt_s = round(max(recovery_times), 6)
+    records = _common_records("churn", fleet, source)
+    records.append(_record("scenario_recovery_time_to_tracking", "s",
+                           ttt_s, "scenario-churn", "lower", source))
+    return {
+        "metric": "scenario_churn", "unit": "ms",
+        "value": fleet["slot_latency_p95_ms"],
+        "platform": "scenario-churn",
+        "scenario": "churn", "seed": seed, "scale": scale,
+        "topology": {"nodes": 4, "threshold": 3, "mode": "loopback",
+                     "profile": "single-dc",
+                     "checkpoint_frequency": freq, "bracket": bracket},
+        "fault_schedule": ["kill %s x%d, restart after bracket+2*freq "
+                           "slots" % (victim_name, cycles)],
+        "assertions": {
+            "recovery_cycles": cycles,
+            "recovery_time_to_tracking_s": ttt_s,
+            "common_heights_hash_equal": common,
+        },
+        "fleet": fleet,
+        "records": records,
+    }
+
+
+# --------------------------------------------------------------------------
+# flood: adversarial envelope/tx flood vs the per-peer rate limiter
+
+def _junk_tx_message(network_id: bytes, i: int) -> StellarMessage:
+    """Distinct, cheap-to-reject flood payload: an unsigned payment from
+    a nonexistent account (every honest node drops it at checkValid)."""
+    from ..xdr import Asset, Operation, OperationBody, OperationType, \
+        PaymentOp
+    sk = SecretKey.from_seed(sha256(b"flood-src" + network_id))
+    dst = SecretKey.from_seed(sha256(b"flood-dst" + network_id))
+    op = Operation(sourceAccount=None, body=OperationBody(
+        OperationType.PAYMENT,
+        PaymentOp(destination=MuxedAccount.from_account_id(dst.public_key),
+                  asset=Asset.native(), amount=1 + i)))
+    t = Transaction(
+        sourceAccount=MuxedAccount.from_account_id(sk.public_key),
+        fee=100, seqNum=i + 1, timeBounds=None, memo=Memo.none(),
+        operations=[op], ext=_Ext.v0())
+    return StellarMessage(MessageType.TRANSACTION,
+                          TransactionEnvelope.for_tx(t))
+
+
+def run_flood(seed: int, scale: str, workdir: str) -> dict:
+    """Adversarial flood: 3 honest validators plus one flooder peer over
+    the real overlay stack. The baseline leg closes ledgers clean; the
+    flood leg has the flooder spray distinct junk transactions until the
+    per-peer token bucket caps it and ban-score escalation bans + drops
+    it — honest slot latency p95 must stay within tolerance of the
+    baseline."""
+    slots = 6 if scale == "tier1" else 20
+    burst_msgs = 60 if scale == "tier1" else 200
+
+    def leg(flood_on: bool) -> dict:
+        rnd.reseed(seed)
+        _clear_verify_cache()
+        sim = Simulation(Simulation.OVER_PEERS)
+        hkeys = _keys(3, b"flood-honest", seed)
+        fkey = _keys(1, b"flood-adversary", seed)[0]
+        qset = SCPQuorumSet(threshold=2,
+                            validators=[k.public_key for k in hkeys],
+                            innerSets=[])
+
+        def tweak(cfg: Config) -> None:
+            cfg.DATABASE = "sqlite3://:memory:"
+            # tight defense so the scenario caps within a short run:
+            # ~burst tokens, slow refill, quick ban escalation
+            cfg.FLOOD_RATE_LIMIT_PER_PEER = 50.0
+            cfg.FLOOD_RATE_BURST = 30
+            cfg.FLOOD_BAN_SCORE_THRESHOLD = 40
+            # real-cadence virtual slots (1 s apart): honest per-slot SCP
+            # traffic stays under the refill rate, while the flooder's
+            # burst lands inside one instant and caps — accelerated
+            # closes would make EVERY peer look like a flooder
+            cfg.ARTIFICIALLY_ACCELERATE_TIME_FOR_TESTING = False
+            cfg.EXPECTED_LEDGER_CLOSE_TIME = 1.0
+        honest = [sim.add_node(k, qset, name="h%d" % i, cfg_tweak=tweak)
+                  for i, k in enumerate(hkeys)]
+        flooder = sim.add_node(fkey, qset, name="adv", cfg_tweak=tweak)
+        for i in range(3):
+            for j in range(i + 1, 3):
+                sim.connect_peers(honest[i].name, honest[j].name)
+        for h in honest:
+            sim.connect_peers(flooder.name, h.name)
+        sim.start_all_nodes()
+        honest_apps = [n.app for n in honest]
+
+        def honest_at(seq: int) -> bool:
+            return all(a.ledger_manager.last_closed_ledger_num() >= seq
+                       for a in honest_apps)
+        _crank_until(sim, lambda: honest_at(2), 60000, "flood-leg start")
+        base = max(a.ledger_manager.last_closed_ledger_num()
+                   for a in honest_apps)
+
+        flood_stats = {}
+        if flood_on:
+            net = flooder.app.config.network_id
+            sent = 0
+            adv_key = flooder.app.config.node_id().to_xdr()
+
+            def flooder_banned() -> bool:
+                return any(adv_key not in a.overlay_manager
+                           .authenticated_peers and
+                           a.overlay_manager.ban_manager.is_banned(
+                               flooder.app.config.node_id())
+                           for a in honest_apps)
+            for _ in range(40):
+                if flooder_banned():
+                    break
+                for _ in range(burst_msgs):
+                    flooder.app.overlay_manager.broadcast_message(
+                        _junk_tx_message(net, sent), False)
+                    sent += 1
+                sim.crank_all_nodes(4)
+            assert flooder_banned(), \
+                "flood never escalated into a BanManager ban"
+            m0 = honest_apps[0].metrics.to_json()
+            limited = m0.get("overlay.flood.rate-limited",
+                             {}).get("count", 0)
+            bans = sum(a.metrics.to_json().get("overlay.flood.ban",
+                                               {}).get("count", 0)
+                       for a in honest_apps)
+            assert limited > 0, "rate limiter never capped the flooder"
+            assert bans >= 1
+            flood_stats = {"junk_sent": sent, "limited_at_h0": limited,
+                           "bans": bans}
+
+        _crank_until(sim, lambda: honest_at(base + slots), 200000,
+                     "honest liveness%s" % (" under flood"
+                                            if flood_on else ""))
+        _assert_header_equality(honest_apps, min_common=2)
+        from ..util.fleet import FleetAggregator
+        agg = FleetAggregator()
+        for n in honest:
+            agg.add_app(n.name, n.app)
+        fleet = _fleet_block(agg)
+        sim.stop_all_nodes()
+        return {"fleet": fleet, "flood": flood_stats}
+
+    off = leg(False)
+    on = leg(True)
+    p95_off = max(off["fleet"]["slot_latency_p95_ms"], 0.001)
+    ratio = round(on["fleet"]["slot_latency_p95_ms"] / p95_off, 3)
+    source = "bench.py --scenario flood"
+    records = _common_records("flood", on["fleet"], source)
+    records.append(_record("scenario_flood_latency_ratio", "x", ratio,
+                           "scenario-flood", "lower", source))
+    return {
+        "metric": "scenario_flood", "unit": "ms",
+        "value": on["fleet"]["slot_latency_p95_ms"],
+        "platform": "scenario-flood",
+        "scenario": "flood", "seed": seed, "scale": scale,
+        "topology": {"nodes": 3, "threshold": 2, "mode": "peers",
+                     "adversaries": 1},
+        "fault_schedule": ["flooder sprays %d-msg junk-tx bursts until "
+                           "banned" % (60 if scale == "tier1" else 200)],
+        "assertions": {
+            "flooder_banned": True,
+            "limited_at_h0": on["flood"]["limited_at_h0"],
+            "bans": on["flood"]["bans"],
+            "junk_sent": on["flood"]["junk_sent"],
+            "p95_ratio_on_vs_off": ratio,
+        },
+        "fleet": on["fleet"],
+        "baseline_fleet": off["fleet"],
+        "records": records,
+    }
+
+
+# --------------------------------------------------------------------------
+# partition: region severed and healed; minority self-heals via SCP state
+
+def run_partition(seed: int, scale: str, workdir: str) -> dict:
+    """Partitioned-region heal: 4 validators across a three-region
+    latency matrix over chaos links; one region (1 node) is severed, the
+    majority keeps externalizing, the minority's stuck timer fires and
+    recovery polls; after heal, the recovery path re-learns the live
+    slots via GET_SCP_STATE solicitation (no archive needed inside the
+    remember window) and tracking resumes hash-equal."""
+    part_slots = 4 if scale == "tier1" else 8
+
+    def tweak(cfg: Config) -> None:
+        cfg.DATABASE = "sqlite3://:memory:"
+        # cross-region slots take several virtual seconds (latency +
+        # nomination rounds): 10 s only fires for the genuinely severed
+        # node, not for a slow-but-alive majority
+        cfg.CONSENSUS_STUCK_TIMEOUT_SECONDS = 10.0
+        # the partitioned node's virtual clock jumps ahead on its own
+        # timers; idle/straggler drops would disconnect it permanently
+        # (sim links have no redial) — the scenario tests SCP recovery,
+        # not the peer book, so park the peer-liveness timeouts
+        cfg.PEER_TIMEOUT = 10**6
+        cfg.PEER_STRAGGLER_TIMEOUT = 10**6
+
+    sim = Simulation(Simulation.OVER_PEERS)
+    keys = _keys(4, b"partition", seed)
+    qset = SCPQuorumSet(threshold=3,
+                        validators=[k.public_key for k in keys],
+                        innerSets=[])
+    names = [sim.add_node(k, qset, name="p%d" % i, cfg_tweak=tweak).name
+             for i, k in enumerate(keys)]
+    sim.apply_latency_matrix(LatencyMatrix(names, "three-region", seed))
+    for i in range(4):
+        for j in range(i + 1, 4):
+            sim.connect_peers(names[i], names[j], chaos=True)
+    sim.start_all_nodes()
+    _crank_until(sim, lambda: sim.have_all_externalized(3), 80000,
+                 "pre-partition convergence")
+
+    minority = names[3]
+    majority = names[:3]
+    for other in majority:
+        sim.set_partition(minority, other, True)
+    maj_apps = [sim.nodes[n].app for n in majority]
+    min_app = sim.nodes[minority].app
+    base = max(a.ledger_manager.last_closed_ledger_num() for a in maj_apps)
+
+    def majority_ahead() -> bool:
+        return all(a.ledger_manager.last_closed_ledger_num() >=
+                   base + part_slots for a in maj_apps)
+    _crank_until(sim, majority_ahead, 200000, "majority under partition")
+    for other in majority:
+        sim.heal_partition(minority, other)
+        # the frames the partition ate advanced the senders' HMAC
+        # sequences: the healed link is cryptographically dead, like a
+        # real partition killing TCP — reconnect with a fresh handshake
+        sim.reconnect_peers(minority, other, chaos=True)
+
+    h = min_app.herder
+    from ..herder.herder import HerderState
+
+    def minority_healed() -> bool:
+        return (h.recoveries >= 1 and
+                h.state == HerderState.HERDER_TRACKING_STATE and
+                min_app.ledger_manager.last_closed_ledger_num() >=
+                base + part_slots)
+    _crank_until(sim, minority_healed, 200000, "minority heal")
+    mjson = min_app.metrics.to_json()
+    assert mjson["herder.recovery.lost-sync"]["count"] >= 1
+    assert mjson["herder.recovery.scp-state-request"]["count"] >= 1, \
+        "recovery never solicited SCP state"
+    ttt = mjson["herder.recovery.time-to-tracking"]
+    assert ttt["count"] >= 1
+    tip = max(v.app.ledger_manager.last_closed_ledger_num()
+              for v in sim.nodes.values())
+    _crank_until(sim, lambda: sim.have_all_externalized(tip + 2), 80000,
+                 "post-heal convergence")
+    common = _assert_header_equality([v.app for v in sim.nodes.values()],
+                                     min_common=4)
+    fleet = _fleet_block(sim.fleet())
+    matrix = sim.latency_matrix.to_json()
+    sim.stop_all_nodes()
+
+    source = "bench.py --scenario partition"
+    heal_s = round(ttt["mean"], 6)
+    records = _common_records("partition", fleet, source)
+    records.append(_record("scenario_recovery_time_to_tracking", "s",
+                           heal_s, "scenario-partition", "lower", source))
+    return {
+        "metric": "scenario_partition", "unit": "ms",
+        "value": fleet["slot_latency_p95_ms"],
+        "platform": "scenario-partition",
+        "scenario": "partition", "seed": seed, "scale": scale,
+        "topology": {"nodes": 4, "threshold": 3, "mode": "peers",
+                     "profile": "three-region",
+                     "regions": matrix["regions"]},
+        "fault_schedule": ["sever %s from all for %d slots, then heal"
+                           % (minority, part_slots)],
+        "assertions": {
+            "recovery_time_to_tracking_s": heal_s,
+            "scp_state_requests":
+                mjson["herder.recovery.scp-state-request"]["count"],
+            "common_heights_hash_equal": common,
+        },
+        "fleet": fleet,
+        "records": records,
+    }
+
+
+# --------------------------------------------------------------------------
+# surge: pool saturation with hot-account contention + fee-bid eviction
+
+def run_surge(seed: int, scale: str, workdir: str) -> dict:
+    """Surge: a 3-node fleet with a deliberately small tx pool is hit
+    with 3 rounds of low-fee payments (every round pays the SAME hot
+    destination) until the pool saturates, then a burst of high-fee
+    bids — each admission must evict a lowest-fee-rate chain tail
+    (`herder.tx-queue.surge-evicted`), the pool stays bounded, and
+    consensus keeps closing hash-equal."""
+    n_low = 10 if scale == "tier1" else 20
+    n_high = 5 if scale == "tier1" else 10
+    cap_ops = 3 * n_low
+
+    def tweak(cfg: Config) -> None:
+        cfg.DATABASE = "sqlite3://:memory:"
+        cfg.TESTING_UPGRADE_MAX_TX_SET_SIZE = cap_ops
+        cfg.POOL_LEDGER_MULTIPLIER = 1
+
+    sim = Simulation(Simulation.OVER_LOOPBACK)
+    keys = _keys(3, b"surge", seed)
+    qset = SCPQuorumSet(threshold=2,
+                        validators=[k.public_key for k in keys],
+                        innerSets=[])
+    names = [sim.add_node(k, qset, name="s%d" % i, cfg_tweak=tweak).name
+             for i, k in enumerate(keys)]
+    for i in range(3):
+        for j in range(i + 1, 3):
+            sim.connect(names[i], names[j])
+    sim.start_all_nodes()
+    n0 = sim.nodes[names[0]].app
+    _crank_until(sim, lambda: sim.have_all_externalized(2), 40000,
+                 "surge start")
+
+    adapter = AppLedgerAdapter(n0)
+    root = adapter.root_account()
+    low_keys = _keys(n_low, b"surge-low", seed)
+    high_keys = _keys(n_high, b"surge-high", seed)
+    n0.submit_transaction(root.tx(
+        [root.op_create_account(k.public_key, 10**10)
+         for k in low_keys + high_keys]))
+    hot = root.account_id   # every payment hits ONE hot destination
+
+    def accounts_exist() -> bool:
+        return adapter.account_exists(low_keys[0].public_key) and \
+            adapter.account_exists(high_keys[-1].public_key)
+    _crank_until(sim, accounts_exist, 40000, "surge accounts")
+
+    # saturate: 3 rounds of low-fee chains, no cranking in between so the
+    # pool actually fills instead of draining into txsets
+    lows = [TestAccount(adapter, k) for k in low_keys]
+    for rnd_i in range(3):
+        for acc in lows:
+            seq = acc.next_seq() + rnd_i
+            st = n0.submit_transaction(acc.tx(
+                [acc.op_payment(hot, 50 + rnd_i)], seq=seq, fee=100))
+            assert st == 0, "low-fee fill rejected (round %d)" % rnd_i
+    q = n0.herder.tx_queue
+    assert q.size_ops() == cap_ops, (q.size_ops(), cap_ops)
+
+    # the pool is full: every further same-rate bid must bounce...
+    bounced = n0.submit_transaction(
+        lows[0].tx([lows[0].op_payment(hot, 999)],
+                   seq=lows[0].next_seq() + 3, fee=100))
+    assert bounced != 0, "same-rate bid admitted into a full pool"
+    # ...while strictly-better bids evict lowest-rate tails
+    highs = [TestAccount(adapter, k) for k in high_keys]
+    for acc in highs:
+        st = n0.submit_transaction(acc.tx(
+            [acc.op_payment(hot, 77)], seq=acc.next_seq(), fee=2000))
+        assert st == 0, "high-fee bid rejected despite eviction room"
+    assert q.size_ops() <= cap_ops
+    evicted = n0.metrics.to_json()[
+        "herder.tx-queue.surge-evicted"]["count"]
+    assert evicted >= n_high, (evicted, n_high)
+
+    # remember the high bids' hashes before consensus consumes them
+    high_hashes = {f.contents_hash().hex()
+                   for chain in q._pending.values()
+                   for f in chain if f.fee_bid >= 2000}
+    assert len(high_hashes) == n_high
+    tip = n0.ledger_manager.last_closed_ledger_num()
+    _crank_until(sim, lambda: sim.have_all_externalized(tip + 4), 80000,
+                 "surge drain")
+    # the high bids actually made it into closed ledgers
+    applied = {row[0] for row in n0.database.execute(
+        "SELECT txid FROM txhistory").fetchall()}
+    assert high_hashes <= applied, \
+        "surge-admitted high-fee txs never applied"
+    assert q.size_ops() <= cap_ops
+    common = _assert_header_equality([v.app for v in sim.nodes.values()],
+                                     min_common=4)
+    fleet = _fleet_block(sim.fleet())
+    sim.stop_all_nodes()
+
+    source = "bench.py --scenario surge"
+    records = _common_records("surge", fleet, source)
+    return {
+        "metric": "scenario_surge", "unit": "ms",
+        "value": fleet["slot_latency_p95_ms"],
+        "platform": "scenario-surge",
+        "scenario": "surge", "seed": seed, "scale": scale,
+        "topology": {"nodes": 3, "threshold": 2, "mode": "loopback",
+                     "pool_cap_ops": cap_ops},
+        "fault_schedule": ["%d low-fee chains x3 rounds to a hot "
+                           "destination, then %d high-fee bids"
+                           % (n_low, n_high)],
+        "assertions": {
+            "surge_evicted": evicted,
+            "pool_bounded": True,
+            "applied_tx_rows": len(applied),
+            "common_heights_hash_equal": common,
+        },
+        "fleet": fleet,
+        "records": records,
+    }
+
+
+# --------------------------------------------------------------------------
+# registry + runner
+
+SCENARIOS: Dict[str, dict] = {
+    "churn": {
+        "fn": run_churn,
+        "description": "kill/restart a tracking node under load + "
+                       "archive failover; self-healing recovery to "
+                       "TRACKING (time-to-tracking gated)",
+    },
+    "flood": {
+        "fn": run_flood,
+        "description": "adversarial junk-tx flood vs the per-peer token "
+                       "bucket + ban-score escalation; honest p95 vs "
+                       "no-flood baseline",
+    },
+    "partition": {
+        "fn": run_partition,
+        "description": "three-region latency matrix, one region severed "
+                       "and healed; minority self-heals via solicited "
+                       "SCP state",
+    },
+    "surge": {
+        "fn": run_surge,
+        "description": "tx-pool saturation with hot-account contention; "
+                       "fee-bid surge eviction keeps the pool bounded",
+    },
+}
+
+
+def run_scenario(name: str, seed: int = 1, scale: str = "tier1",
+                 workdir: Optional[str] = None) -> dict:
+    """Run one scenario deterministically; returns its fleet bench block
+    (see module docstring). Raises AssertionError when a scenario
+    invariant does not hold."""
+    if name not in SCENARIOS:
+        raise ValueError("unknown scenario %r; known: %s"
+                         % (name, ", ".join(sorted(SCENARIOS))))
+    assert scale in ("tier1", "soak"), scale
+    rnd.reseed(seed)
+    _clear_verify_cache()
+    own_dir = workdir is None
+    if own_dir:
+        workdir = tempfile.mkdtemp(prefix="sct-scenario-%s-" % name)
+    try:
+        block = SCENARIOS[name]["fn"](seed, scale, workdir)
+    finally:
+        if own_dir:
+            shutil.rmtree(workdir, ignore_errors=True)
+    block["description"] = SCENARIOS[name]["description"]
+    return block
+
+
+def run_suite(seed: int = 1, scale: str = "tier1") -> dict:
+    """All scenarios, one artifact: the shape committed as
+    BENCH_r*_scenarios.json and ingested into bench/history.jsonl."""
+    blocks = {name: run_scenario(name, seed=seed, scale=scale)
+              for name in sorted(SCENARIOS)}
+    records: List[dict] = []
+    for b in blocks.values():
+        records.extend(b["records"])
+    return {
+        "metric": "scenario_suite", "unit": "scenarios",
+        "value": len(blocks), "platform": "scenario-suite",
+        "seed": seed, "scale": scale,
+        "scenarios": blocks,
+        "records": records,
+    }

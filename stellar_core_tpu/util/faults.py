@@ -44,6 +44,8 @@ KNOWN_SITES = frozenset({
     "overlay.delay",
     "overlay.duplicate",
     "overlay.reorder",
+    "overlay.flood-limit",
+    "overlay.send-overflow",
     "archive.get-fail",
     "archive.corrupt",
     "archive.short-read",

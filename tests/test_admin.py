@@ -396,3 +396,43 @@ def test_testacc_and_testtx_endpoints(app):
     app.config.ARTIFICIALLY_GENERATE_LOAD_FOR_TESTING = False
     st, out = app.command_handler.handle_command("testacc", {"name": "bob"})
     assert "error" in out
+
+
+# ----------------------------------------------- bans operator surface
+
+def test_bans_list_unban_unban_all(app):
+    """ISSUE 8 satellite: `bans?action=list|unban|unban_all` with 400s
+    on bad params via the CommandParamError path."""
+    from stellar_core_tpu.crypto import strkey
+    from stellar_core_tpu.crypto.keys import SecretKey
+    from stellar_core_tpu.crypto.hashing import sha256 as _sha
+    bm = app.overlay_manager.ban_manager
+    ids = [SecretKey.from_seed(_sha(b"ban%d" % i)).public_key
+           for i in range(3)]
+    for pk in ids:
+        bm.ban_node(pk)
+    st, body = cmd(app, "bans")
+    assert st == 200 and len(body["bans"]) == 3
+    st, body = cmd(app, "bans", action="list")
+    assert st == 200 and len(body["bans"]) == 3
+    # unban by hex-XDR
+    st, body = cmd(app, "bans", action="unban",
+                   node=ids[0].to_xdr().hex())
+    assert st == 200 and len(body["bans"]) == 2
+    assert not bm.is_banned(ids[0])
+    # unban by strkey
+    st, body = cmd(app, "bans", action="unban",
+                   node=strkey.encode_public_key(ids[1].key_bytes))
+    assert st == 200 and len(body["bans"]) == 1
+    # bad params are 400s, not 500s
+    st, body = cmd(app, "bans", action="unban", node="not-a-key")
+    assert st == 400 and "node" in body["error"]
+    st, body = cmd(app, "bans", action="unban")
+    assert st == 400
+    st, body = cmd(app, "bans", action="frobnicate")
+    assert st == 400 and "action" in body["error"]
+    # unban_all clears the set (and the DB table)
+    st, body = cmd(app, "bans", action="unban_all")
+    assert st == 200 and body["unbanned"] == 1 and body["bans"] == []
+    assert app.database.execute(
+        "SELECT COUNT(*) FROM bans").fetchone()[0] == 0

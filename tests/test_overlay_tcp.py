@@ -475,3 +475,38 @@ def test_inbound_preferred_peer_matches_listening_port():
         assert stranger.overlay_manager.get_authenticated_peers_count() == 0
     finally:
         _shutdown(apps)
+
+
+def test_send_overflow_fault_site_forces_drop_and_meter():
+    """ISSUE 8 satellite: the `overlay.send-overflow` fault site forces
+    the queue-overflow drop path deterministically (no 32 MiB needed),
+    and the drop marks the `overlay.send-queue.overflow` meter."""
+    import socket as _socket
+    from stellar_core_tpu.overlay.transport import TCPTransport
+    from stellar_core_tpu.util.faults import FaultInjector
+    from stellar_core_tpu.util.metrics import MetricsRegistry
+    clock, reactor = _reactor()
+    srv = _socket.socket()
+    srv.bind(("127.0.0.1", 0))
+    srv.listen(1)
+    try:
+        t = TCPTransport.connect(reactor, *srv.getsockname())
+        metrics = MetricsRegistry()
+        faults = FaultInjector(seed=3, metrics=metrics)
+        faults.configure("overlay.send-overflow", count=1)
+        t.metrics = metrics
+        t.faults = faults
+        closed = []
+        t.on_closed = lambda: closed.append(1)
+        t.send_frame(b"tiny")
+        deadline = time.time() + 10
+        while not closed and time.time() < deadline:
+            clock.crank(False)
+            time.sleep(0.002)
+        assert closed, "forced overflow never dropped the transport"
+        m = metrics.to_json()
+        assert m["overlay.send-queue.overflow"]["count"] == 1
+        assert m["fault.injected.overlay.send-overflow"]["count"] == 1
+    finally:
+        reactor.stop()
+        srv.close()

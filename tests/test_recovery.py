@@ -1,0 +1,238 @@
+"""Herder self-healing recovery (ISSUE 8 tentpole, unit level):
+externalize-hint buffering beyond the validity bracket, network-tracked-
+slot estimation, the out_of_sync_recovery poll loop (purge / solicit /
+catchup trigger), time-to-tracking accounting on resume, and the legacy
+app-hook override. The end-to-end paths (restart + catchup, partition +
+SCP-state solicitation) live in tests/test_scenarios.py."""
+
+import pytest
+
+import stellar_core_tpu.xdr as X
+from stellar_core_tpu.crypto.hashing import sha256
+from stellar_core_tpu.crypto.keys import SecretKey
+from stellar_core_tpu.herder.herder import HerderState
+from stellar_core_tpu.main.application import Application
+from stellar_core_tpu.main.config import Config
+from stellar_core_tpu.util.timer import ClockMode, VirtualClock
+
+
+def _mk_app(n=0, bracket=8, tweak=None):
+    cfg = Config.test_config(n)
+    cfg.LEDGER_VALIDITY_BRACKET = bracket
+    # a second validator in the quorum so foreign envelopes pass the
+    # in-quorum filter
+    other = SecretKey.from_seed(sha256(b"recovery-other"))
+    cfg.QUORUM_SET = X.SCPQuorumSet(
+        threshold=1,
+        validators=[cfg.NODE_SEED.public_key, other.public_key],
+        innerSets=[])
+    if tweak:
+        tweak(cfg)
+    app = Application(VirtualClock(ClockMode.VIRTUAL_TIME), cfg)
+    app.start()
+    return app, other
+
+
+def _externalize_env(app, sk, slot):
+    qh = sha256(app.config.QUORUM_SET.to_xdr())
+    st = X.SCPStatement(
+        nodeID=sk.public_key, slotIndex=slot,
+        pledges=X.SCPPledges(
+            X.SCPStatementType.SCP_ST_EXTERNALIZE,
+            X.SCPExternalize(commit=X.SCPBallot(counter=1, value=b"v"),
+                             nH=1, commitQuorumSetHash=qh)))
+    env = X.SCPEnvelope(statement=st, signature=b"")
+    p = X.Packer()
+    p.put(app.config.network_id)
+    X.Uint32.pack(p, X.EnvelopeType.ENVELOPE_TYPE_SCP)
+    p.put(st.to_xdr())
+    env.signature = sk.sign(sha256(p.bytes()))
+    return env
+
+
+def test_out_of_bracket_externalize_becomes_a_hint():
+    app, other = _mk_app()
+    h = app.herder
+    cur = h.current_slot()
+    far = cur + h.LEDGER_VALIDITY_BRACKET + 5
+    from stellar_core_tpu.scp.scp import SCP
+    st = h.recv_scp_envelope(_externalize_env(app, other, far))
+    assert st == SCP.EnvelopeState.INVALID   # not processed...
+    assert far in h._ext_hints               # ...but remembered
+    assert h.network_tracked_slot() == far
+
+
+def test_hints_require_quorum_membership_and_externalize():
+    app, other = _mk_app()
+    h = app.herder
+    cur = h.current_slot()
+    far = cur + h.LEDGER_VALIDITY_BRACKET + 5
+    outsider = SecretKey.from_seed(sha256(b"recovery-outsider"))
+    h.recv_scp_envelope(_externalize_env(app, outsider, far))
+    assert far not in h._ext_hints
+    # nomination statements that far ahead are not evidence either
+    qh = sha256(app.config.QUORUM_SET.to_xdr())
+    st = X.SCPStatement(
+        nodeID=other.public_key, slotIndex=far,
+        pledges=X.SCPPledges(
+            X.SCPStatementType.SCP_ST_NOMINATE,
+            X.SCPNomination(quorumSetHash=qh, votes=[b"x"], accepted=[])))
+    env = X.SCPEnvelope(statement=st, signature=b"\x00" * 64)
+    h.recv_scp_envelope(env)
+    assert far not in h._ext_hints
+
+
+def test_hints_require_a_valid_signature():
+    """One forged envelope claiming an absurd slot under a quorum
+    member's id must not poison network_tracked_slot (it steers the
+    recovery loop's catchup trigger and /info forever)."""
+    app, other = _mk_app()
+    h = app.herder
+    far = h.current_slot() + h.LEDGER_VALIDITY_BRACKET + 10**6
+    env = _externalize_env(app, other, far)
+    env.signature = b"\x00" * 64   # forged: right node id, wrong sig
+    h.recv_scp_envelope(env)
+    assert far not in h._ext_hints
+    assert h.network_tracked_slot() is None
+
+
+def test_hint_buffer_is_bounded_and_consumed_on_externalize():
+    app, other = _mk_app()
+    h = app.herder
+    base = h.current_slot() + h.LEDGER_VALIDITY_BRACKET + 1
+    for k in range(h.MAX_EXT_HINT_SLOTS + 10):
+        h.recv_scp_envelope(_externalize_env(app, other, base + k))
+    assert len(h._ext_hints) == h.MAX_EXT_HINT_SLOTS
+    assert min(h._ext_hints) == base + 10    # oldest evicted
+    # a close consumes hints at-or-below the closed slot
+    app.manual_close()
+    assert min(h._ext_hints) > \
+        app.ledger_manager.last_closed_ledger_num()
+
+
+def test_lost_sync_runs_recovery_and_rearms_poll():
+    app, other = _mk_app()
+    h = app.herder
+    assert h.state == HerderState.HERDER_TRACKING_STATE
+    h._lost_sync()
+    assert h.state == HerderState.HERDER_SYNCING_STATE
+    assert h.recoveries == 1
+    assert h.recovery_started_at is not None
+    assert h.out_of_sync_timer.seated      # the poll loop is armed
+    m = app.metrics.to_json()
+    assert m["herder.recovery.lost-sync"]["count"] == 1
+    assert m["herder.recovery.attempt"]["count"] == 1
+    # cranking past the poll interval fires another attempt
+    app.clock.set_virtual_time(
+        app.clock.now() + h.OUT_OF_SYNC_RECOVERY_INTERVAL + 0.1)
+    app.crank(False)
+    assert app.metrics.to_json()["herder.recovery.attempt"]["count"] >= 2
+
+
+def test_resume_tracking_stops_poll_and_records_time():
+    app, other = _mk_app()
+    h = app.herder
+    h._lost_sync()
+    t0 = app.clock.now()
+    app.clock.set_virtual_time(t0 + 3.5)
+    h.set_tracking(h.current_slot())
+    assert h.state == HerderState.HERDER_TRACKING_STATE
+    assert h.recovery_started_at is None
+    assert not h.out_of_sync_timer.seated
+    m = app.metrics.to_json()
+    assert m["herder.recovery.resumed"]["count"] == 1
+    ttt = m["herder.recovery.time-to-tracking"]
+    assert ttt["count"] == 1
+    assert ttt["mean"] == pytest.approx(3.5, abs=0.01)
+    # the journal carries the recovery milestones
+    tl = app.slot_timeline
+    slot = h.current_slot()
+    events = {ev["event"] for evs in
+              (tl.events(s) for s in tl.slots()) for ev in evs}
+    assert "recovery.lost-sync" in events
+    assert "recovery.tracked" in events
+
+
+def test_recovery_purges_stale_scp_slots():
+    app, other = _mk_app()
+    h = app.herder
+    for _ in range(3):
+        app.manual_close()
+    # park stale state several slots below the open one
+    h.scp.get_slot(1, create=True)
+    cur = h.current_slot()
+    assert 1 < cur - 1
+    h.state = HerderState.HERDER_SYNCING_STATE
+    h.out_of_sync_recovery()
+    assert 1 not in h.scp.known_slots
+    m = app.metrics.to_json()
+    assert m["herder.recovery.purged-slots"]["count"] >= 1
+
+
+def test_recovery_triggers_catchup_when_behind(tmp_path):
+    """With a readable archive configured and externalize evidence ahead
+    of the bracket, the recovery poll routes through
+    CatchupManager.start_catchup."""
+    import os
+    from stellar_core_tpu.history.archive import HistoryArchive
+    root = tmp_path / "archive"
+    os.makedirs(root, exist_ok=True)
+
+    # publisher seeds the archive
+    pcfg = Config.test_config(50)
+    pcfg.DATABASE = "sqlite3://:memory:"
+    pcfg.CHECKPOINT_FREQUENCY = 4
+    arch = HistoryArchive.local_dir("r", str(root))
+    pcfg.HISTORY = {"r": {"get": arch.get_tmpl, "mkdir": arch.mkdir_tmpl,
+                          "put": arch.put_tmpl}}
+    pub = Application(VirtualClock(ClockMode.VIRTUAL_TIME), pcfg)
+    pub.enable_buckets(str(tmp_path / "pub-buckets"))
+    pub.start()
+    while pub.ledger_manager.last_closed_ledger_num() < 6:
+        pub.manual_close()
+    pub.crank_until(lambda: pub.history_manager.publish_queue() == [],
+                    max_cranks=20000)
+
+    def tweak(cfg):
+        cfg.DATABASE = "sqlite3://:memory:"
+        cfg.CHECKPOINT_FREQUENCY = 4
+        cfg.HISTORY = {"r": {"get": arch.get_tmpl,
+                             "mkdir": arch.mkdir_tmpl}}
+        # publisher and recoverer share one genesis (test_config(50))
+        cfg.NODE_SEED = pcfg.NODE_SEED
+        cfg.NETWORK_PASSPHRASE = pcfg.NETWORK_PASSPHRASE
+    app, other = _mk_app(51, tweak=tweak)
+    app.enable_buckets(str(tmp_path / "rec-buckets"))
+    h = app.herder
+    far = h.current_slot() + h.LEDGER_VALIDITY_BRACKET + 2
+    h.recv_scp_envelope(_externalize_env(app, other, far))
+    h._lost_sync()
+    assert app.catchup_manager.catchup_running()
+    m = app.metrics.to_json()
+    assert m["herder.recovery.catchup-triggered"]["count"] == 1
+    # the catchup completes against the published archive
+    work = app.catchup_manager._work
+    for _ in range(200000):
+        if work.is_done():
+            break
+        app.crank(False)
+    from stellar_core_tpu.work.basic_work import State
+    assert work.state == State.SUCCESS
+    assert app.ledger_manager.last_closed_ledger_num() >= 3
+
+
+def test_app_hook_still_overrides_the_default_recovery():
+    app, other = _mk_app()
+    called = []
+    app.out_of_sync_recovery = lambda: called.append(True)
+    app.herder._lost_sync()
+    assert called == [True]
+    assert app.herder.recoveries == 0     # default path did not run
+
+
+def test_recovery_in_quorum_json():
+    app, other = _mk_app()
+    info = app.herder.get_json_info()
+    assert info["recovery"] == {
+        "recovering": False, "recoveries": 0,
+        "network_tracked_slot": None}

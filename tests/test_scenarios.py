@@ -1,0 +1,170 @@
+"""Scenario lab (ISSUE 8 capstone): tier-1 runs the small seeded
+variants of every scenario (churn / flood / partition / surge), full
+soaks ride the `slow` marker, and `bench.py --scenario` is driven end to
+end with its bench block schema checked by tools/bench_compare.py.
+
+Each scenario is internally asserted (the run raises on any violated
+invariant — liveness, hash equality, recovery-path metrics, ban
+escalation, pool bounds); the tests here additionally pin the block's
+schema and the acceptance-criteria numbers.
+"""
+
+import json
+import os
+import subprocess
+import sys
+
+import pytest
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+if REPO not in sys.path:
+    sys.path.insert(0, REPO)
+
+from stellar_core_tpu.testing.scenarios import (  # noqa: E402
+    SCENARIOS, run_scenario,
+)
+from tools import bench_compare as bc             # noqa: E402
+
+
+def _check_block_schema(block):
+    """Every scenario block is a valid bench artifact: headline
+    metric/unit/value plus normalized records."""
+    assert isinstance(block["metric"], str)
+    assert isinstance(block["unit"], str)
+    assert isinstance(block["value"], (int, float))
+    assert block["records"], "scenario emitted no bench records"
+    for rec in block["records"]:
+        errs = bc.validate_record(rec, block["scenario"])
+        assert not errs, errs
+        assert rec["platform"].startswith("scenario-")
+    fleet = block["fleet"]
+    for key in ("slot_count", "slot_latency_p50_ms", "slot_latency_p95_ms",
+                "externalize_skew_p50_ms", "externalize_skew_max_ms"):
+        assert key in fleet, key
+
+
+# ------------------------------------------------------- tier-1 variants
+
+@pytest.mark.scenario
+def test_churn_scenario_recovers_via_recovery_path(tmp_path):
+    """Acceptance: a seeded scenario kills a tracking node mid-run,
+    restarts it, and it returns to TRACKING via the new recovery path
+    with per-height header-hash equality against the survivors;
+    recovery time-to-tracking appears in the fleet bench block."""
+    block = run_scenario("churn", seed=1, workdir=str(tmp_path))
+    _check_block_schema(block)
+    a = block["assertions"]
+    assert a["recovery_cycles"] >= 1
+    assert a["recovery_time_to_tracking_s"] > 0
+    assert a["common_heights_hash_equal"] >= 8
+    assert any(r["metric"] == "scenario_recovery_time_to_tracking"
+               for r in block["records"])
+
+
+@pytest.mark.scenario
+def test_flood_scenario_caps_and_bans_the_flooder(tmp_path):
+    """Acceptance: the rate limiter caps a misbehaving peer (meter +
+    ban-score escalation) while honest-slot latency p95 stays within
+    tolerance of the no-flood baseline."""
+    block = run_scenario("flood", seed=1, workdir=str(tmp_path))
+    _check_block_schema(block)
+    a = block["assertions"]
+    assert a["flooder_banned"] is True
+    assert a["limited_at_h0"] > 0
+    assert a["bans"] >= 1
+    # wall-clock latencies jitter; "within tolerance" = same order of
+    # magnitude, not a tight perf gate (the gate lives in bench history)
+    assert a["p95_ratio_on_vs_off"] < 10.0
+
+
+@pytest.mark.scenario
+def test_partition_scenario_heals_via_scp_state(tmp_path):
+    block = run_scenario("partition", seed=1, workdir=str(tmp_path))
+    _check_block_schema(block)
+    a = block["assertions"]
+    assert a["scp_state_requests"] >= 1
+    assert a["recovery_time_to_tracking_s"] > 0
+    assert a["common_heights_hash_equal"] >= 4
+
+
+@pytest.mark.scenario
+def test_surge_scenario_evicts_by_fee_bid(tmp_path):
+    block = run_scenario("surge", seed=1, workdir=str(tmp_path))
+    _check_block_schema(block)
+    a = block["assertions"]
+    assert a["surge_evicted"] >= 5
+    assert a["pool_bounded"] is True
+
+
+# ------------------------------------------------- bench.py --scenario
+
+@pytest.mark.scenario
+def test_bench_scenario_end_to_end_and_schema(tmp_path):
+    """`bench.py --scenario surge` as a real subprocess: exits 0 against
+    an empty history (new records never gate), writes a block whose
+    schema passes `tools/bench_compare.py --check`, and `--record`
+    appends gateable records."""
+    hist = tmp_path / "history.jsonl"
+    out = tmp_path / "block.json"
+    env = dict(os.environ)
+    env.setdefault("JAX_PLATFORMS", "cpu")
+    proc = subprocess.run(
+        [sys.executable, os.path.join(REPO, "bench.py"), "--scenario",
+         "surge", "--seed", "1", "--history", str(hist), "--record",
+         "--out", str(out)],
+        cwd=REPO, env=env, capture_output=True, text=True, timeout=300)
+    assert proc.returncode == 0, proc.stdout[-2000:] + proc.stderr[-2000:]
+    block = json.loads(proc.stdout)
+    assert block["scenario"] == "surge"
+    assert block["compare"]["recorded"] == len(block["records"])
+    # the emitted artifact passes the committed schema checker
+    assert bc.check_artifact(str(out)) == []
+    # …and the recorded history is valid + re-gateable: a second compare
+    # against the fresh baseline must not regress (same-run values)
+    recs = bc.load_history(str(hist))
+    assert len(recs) == len(block["records"])
+    report = bc.compare(recs, recs, tolerance=0.5)
+    assert report["regressions"] == []
+
+
+@pytest.mark.scenario
+def test_bench_scenario_gate_fails_on_regression(tmp_path):
+    """An artificially-better committed baseline makes the same records
+    regress: the comparator (the scenario gate's engine) exits nonzero
+    territory — regressions listed."""
+    rec = {"metric": "scenario_recovery_time_to_tracking", "unit": "s",
+           "value": 1.0, "platform": "scenario-churn",
+           "direction": "lower", "source": "t", "round": None,
+           "at_unix": None, "commit": None}
+    better = dict(rec, value=0.1)
+    report = bc.compare([rec], [better], tolerance=0.5)
+    assert report["regressions"], report
+
+
+def test_scenario_registry_is_cataloged():
+    """Every scenario in the registry is named in the docs catalog
+    (docs/robustness.md#scenario-catalog) and vice versa — the F1-style
+    drift guard for scenarios."""
+    with open(os.path.join(REPO, "docs", "robustness.md")) as fh:
+        docs = fh.read()
+    assert "## Scenario catalog" in docs
+    for name in SCENARIOS:
+        assert "`%s`" % name in docs, \
+            "scenario %r missing from docs/robustness.md" % name
+
+
+def test_unknown_scenario_raises():
+    with pytest.raises(ValueError):
+        run_scenario("nope")
+
+
+# ------------------------------------------------------------- full soaks
+
+@pytest.mark.scenario
+@pytest.mark.slow
+@pytest.mark.parametrize("name", sorted(SCENARIOS))
+@pytest.mark.parametrize("seed", [1, 2])
+def test_scenario_soak(name, seed, tmp_path):
+    block = run_scenario(name, seed=seed, scale="soak",
+                         workdir=str(tmp_path))
+    _check_block_schema(block)

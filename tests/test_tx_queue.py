@@ -240,3 +240,143 @@ def test_queue_caps_total_fees_per_fee_source():
     head = c.tx([c.op_payment(b.account_id, 2)], seq=c.next_seq(),
                 fee=1000)
     assert q.try_add(head) == PENDING
+
+
+# --- surge eviction by fee bid (ISSUE 8) ------------------------------------
+
+class _Meters:
+    """Minimal metrics facade recording meter marks."""
+
+    def __init__(self):
+        self.marks = {}
+
+    def new_meter(self, name):
+        meters = self.marks
+
+        class _M:
+            def mark(self, n=1, _name=name):
+                meters[_name] = meters.get(_name, 0) + n
+        return _M()
+
+
+def test_surge_eviction_admits_strictly_better_bids(env):
+    led, root, a, b, q = env
+    q.metrics = _Meters()
+    led.header().maxTxSetSize = 2   # cap = 2 * 2 = 4 ops
+    f1 = _pay(a, root)
+    f2 = _pay(a, root, seq=f1.seq_num + 1)
+    g1 = _pay(b, root)
+    g2 = _pay(b, root, seq=g1.seq_num + 1)
+    for f in (f1, f2, g1, g2):
+        assert q.try_add(f) == PENDING
+    # same fee rate: no eviction, the pool stays as-is
+    c = root.create(10**10)
+    assert q.try_add(_pay(c, root)) == LATER
+    assert q.size_ops() == 4
+    # a strictly better bid evicts the lowest-rate chain TAIL
+    high = _pay(c, root, fee=1000)
+    assert q.try_add(high) == PENDING
+    assert q.size_ops() == 4
+    assert q.metrics.marks["herder.tx-queue.surge-evicted"] == 1
+    # one of the two tails (f2 or g2) was shed; heads survive
+    assert q._known_hashes.get(f1.full_hash()) is not None
+    assert q._known_hashes.get(g1.full_hash()) is not None
+    assert (q._known_hashes.get(f2.full_hash()) is None) != \
+        (q._known_hashes.get(g2.full_hash()) is None)
+    # evicted txs are NOT banned: resubmission after a drain is allowed
+    evicted = f2 if q._known_hashes.get(f2.full_hash()) is None else g2
+    assert not q.is_banned(evicted.full_hash())
+
+
+def test_surge_eviction_never_breaks_own_chain(env):
+    led, root, a, b, q = env
+    led.header().maxTxSetSize = 1   # cap = 2 ops
+    f1 = _pay(a, root)
+    f2 = _pay(a, root, seq=f1.seq_num + 1)
+    assert q.try_add(f1) == PENDING
+    assert q.try_add(f2) == PENDING
+    # a high bid from the SAME account cannot evict its own tail (that
+    # would orphan the new tx's sequence position): rejected instead
+    f3 = _pay(a, root, seq=f1.seq_num + 2, fee=5000)
+    assert q.try_add(f3) == LATER
+    assert q.size_ops() == 2
+
+
+def test_surge_eviction_frees_multiple_ops_for_multi_op_bid(env):
+    led, root, a, b, q = env
+    led.header().maxTxSetSize = 1   # cap = 2 ops
+    f1 = _pay(a, root)
+    g1 = _pay(b, root)
+    assert q.try_add(f1) == PENDING
+    assert q.try_add(g1) == PENDING
+    c = root.create(10**10)
+    two_ops = c.tx([c.op_payment(root.account_id, 1),
+                    c.op_payment(root.account_id, 2)], fee=4000)
+    assert q.try_add(two_ops) == PENDING
+    # both single-op chains were shed to fit the 2-op high bid
+    assert q.size_ops() == 2
+    assert q._known_hashes.get(two_ops.full_hash()) is not None
+
+
+def test_invalid_bid_cannot_evict(env):
+    """An invalid tx must never flush honest pending txs: eviction
+    commits only after the incoming frame passes full validation, so a
+    huge fee bid from an account that cannot pay it costs nothing to
+    anyone else (a free queue-flush DoS otherwise)."""
+    led, root, a, b, q = env
+    q.metrics = _Meters()
+    led.header().maxTxSetSize = 1   # cap = 2 ops
+    f1 = _pay(a, root)
+    g1 = _pay(b, root)
+    assert q.try_add(f1) == PENDING
+    assert q.try_add(g1) == PENDING
+    # funded to exist, but with only 1000 stroops above the reserve —
+    # nowhere near the 5000 fee bid
+    reserve = 2 * led.header().baseReserve
+    poor = root.create(reserve + 1000)
+    assert q.try_add(_pay(poor, root, fee=5000)) == ERR
+    assert q.size_ops() == 2
+    assert q._known_hashes.get(f1.full_hash()) is not None
+    assert q._known_hashes.get(g1.full_hash()) is not None
+    assert "herder.tx-queue.surge-evicted" not in q.metrics.marks
+
+
+def test_insufficient_eviction_room_sheds_nothing(env):
+    """Selection is all-or-nothing: when evicting every eligible tail
+    still cannot fit the incoming bid, the pool is left untouched (no
+    victims lost to a tx that bounces anyway)."""
+    led, root, a, b, q = env
+    q.metrics = _Meters()
+    led.header().maxTxSetSize = 1   # cap = 2 ops
+    f1 = _pay(a, root)
+    g1 = _pay(b, root)
+    assert q.try_add(f1) == PENDING
+    assert q.try_add(g1) == PENDING
+    c = root.create(10**10)
+    three_ops = c.tx([c.op_payment(root.account_id, i)
+                      for i in (1, 2, 3)], fee=9000)
+    assert q.try_add(three_ops) == LATER   # needs 3 ops, only 2 exist
+    assert q.size_ops() == 2
+    assert q._known_hashes.get(f1.full_hash()) is not None
+    assert q._known_hashes.get(g1.full_hash()) is not None
+    assert "herder.tx-queue.surge-evicted" not in q.metrics.marks
+
+
+def test_replacement_into_full_pool_evicts_nothing(env):
+    """Replace-by-fee frees the ops of the tx it replaces: a replacement
+    into a full pool nets zero new ops and must not evict a third
+    party's pending tx."""
+    led, root, a, b, q = env
+    q.metrics = _Meters()
+    led.header().maxTxSetSize = 1   # cap = 2 ops
+    base = led.header().baseFee
+    f1 = _pay(a, root, fee=base)
+    g1 = _pay(b, root)
+    assert q.try_add(f1) == PENDING
+    assert q.try_add(g1) == PENDING
+    hi = _pay(a, root, seq=f1.seq_num, fee=base * 10)
+    assert q.try_add(hi) == PENDING
+    assert q.size_ops() == 2
+    assert q._known_hashes.get(g1.full_hash()) is not None
+    assert q._known_hashes.get(hi.full_hash()) is not None
+    assert "herder.tx-queue.surge-evicted" not in q.metrics.marks
