@@ -374,6 +374,11 @@ def replay_bench(backend: str, n_checkpoints: int = 4,
             # drains key by configured backend AND actual platform, so a
             # fallback leg can never masquerade as device time
             phase_breakdown = app.tracer.phase_breakdown(wall_s=wall)
+            # close-cockpit apply attribution (ISSUE 9): per-op ms +
+            # bail reasons + state-read stats; per_op_ms + other_ms sum
+            # to apply_wall_s by construction (ledger/apply_stats.py)
+            apply_breakdown = \
+                app.ledger_manager.apply_stats.apply_breakdown()
             return {"backend": backend, "ledgers": n_ledgers,
                     "dense_ledgers": dense, "wall_s": round(wall, 3),
                     "ledgers_per_sec": round(n_ledgers / wall, 2),
@@ -382,7 +387,8 @@ def replay_bench(backend: str, n_checkpoints: int = 4,
                     "sigs_per_tx": sigs_per_tx,
                     "crypto_s": round(crypto["s"], 3),
                     "crypto_sigs": crypto["sigs"],
-                    "phase_breakdown": phase_breakdown}
+                    "phase_breakdown": phase_breakdown,
+                    "apply_breakdown": apply_breakdown}
 
         if repeats is None:
             repeats = int(os.environ.get("BENCH_REPLAY_REPEATS", "2"))
@@ -533,7 +539,7 @@ def compare_leg() -> list:
     src = "bench.py --compare"
     r = replay_bench("cpu", n_checkpoints=1, txs_per_ledger=4,
                      sigs_per_tx=2, repeats=1)
-    return [
+    recs = [
         bc.make_record("replay_ledgers_per_sec", "ledgers/s",
                        r["ledgers_per_sec"], "cpu-tiny", "higher", src),
         bc.make_record("replay_txs_per_sec", "txs/s",
@@ -546,6 +552,10 @@ def compare_leg() -> list:
                        round(cpu_baseline_rate(500), 1),
                        "openssl-cpu-tiny", "higher", src),
     ]
+    # per-op apply costs gate under the same tiny platform key (ISSUE 9)
+    recs.extend(bc.apply_breakdown_records(
+        r.get("apply_breakdown"), "cpu-tiny", src))
+    return recs
 
 
 def compare_main(argv) -> int:

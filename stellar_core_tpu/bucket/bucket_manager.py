@@ -55,7 +55,7 @@ def calculate_skip_values(header) -> None:
 class BucketManager:
     def __init__(self, bucket_dir: Optional[str] = None,
                  background_merges: bool = True,
-                 num_workers: int = 2) -> None:
+                 num_workers: int = 2, stats=None) -> None:
         self.bucket_dir = bucket_dir
         if bucket_dir:
             os.makedirs(bucket_dir, exist_ok=True)
@@ -64,7 +64,11 @@ class BucketManager:
         self._executor = (ThreadPoolExecutor(
             max_workers=num_workers,
             thread_name_prefix="bucket-merge") if background_merges else None)
-        self.bucket_list = BucketList(self._executor, adopt=self.adopt_bucket)
+        # close cockpit (ledger/apply_stats.py): per-level sizes recorded
+        # at every snapshot, merge durations from the worker pool
+        self._stats = stats
+        self.bucket_list = BucketList(self._executor, adopt=self.adopt_bucket,
+                                      stats=stats)
 
     # -- store ---------------------------------------------------------------
     def bucket_filename(self, hash_: bytes) -> Optional[str]:
@@ -120,6 +124,12 @@ class BucketManager:
         its skipList (reference BucketManagerImpl::snapshotLedger)."""
         header.bucketListHash = self.get_hash()
         calculate_skip_values(header)
+        if self._stats is not None:
+            # per-level curr+snap entry counts — the close cockpit's
+            # bucket-size view (bounded: K_NUM_LEVELS gauges)
+            self._stats.record_level_sizes(
+                (lev.level, len(lev.curr) + len(lev.snap))
+                for lev in self.bucket_list.levels)
 
     def get_referenced_hashes(self) -> List[bytes]:
         refs: List[bytes] = []
@@ -201,11 +211,15 @@ class BucketManager:
                 lev.next = FutureBucket.resolved(payload)
             else:
                 mc, ms, sh = payload
+                on_done = None
+                if self._stats is not None:
+                    on_done = (lambda secs, n, _s=self._stats, _l=i:
+                               _s.record_merge(_l, secs, n))
                 lev.next = FutureBucket.start(
                     self._executor, mc, ms, sh,
                     keep_dead=keep_dead_entries(i),
                     max_protocol_version=max_protocol_version,
-                    adopt=self.adopt_bucket)
+                    adopt=self.adopt_bucket, on_done=on_done)
         self.bucket_list.restart_merges(curr_ledger)
 
     def shutdown(self) -> None:

@@ -66,10 +66,21 @@ class LedgerManager:
         self.app = app
         self.state = LedgerManagerState.LM_BOOTING_STATE
         cfg = app.config
+        # close cockpit (ISSUE 9): ONE aggregation shared by the native
+        # engine, the Python op loop, the SQL root and the bucket layer;
+        # constructed before the root so state-read telemetry is wired
+        # from the first lookup (docs/observability.md#close-cockpit)
+        from ..ledger.apply_stats import ApplyStats
+        clock = getattr(app, "clock", None)
+        self.apply_stats = ApplyStats(
+            metrics=getattr(app, "metrics", None),
+            tracer=getattr(app, "tracer", None),
+            now_fn=clock.now if clock is not None else None)
         if cfg.DATABASE == "in-memory":
             self.root = InMemoryLedgerTxnRoot()
         else:
-            self.root = LedgerTxnRoot(app.database)
+            self.root = LedgerTxnRoot(app.database,
+                                      stats=self.apply_stats)
         self.lcl_hash: bytes = b"\x00" * 32
         self.catchup_trigger = None  # set by CatchupManager wiring
         # True between a bucket-apply's state wipe and its successful LCL
@@ -234,6 +245,10 @@ class LedgerManager:
         except BaseException as e:
             if ltx._open:
                 ltx.rollback()   # drop children too: no dangling state
+            # seal the close-cockpit window (path "failed") so per-op
+            # seconds already recorded for this close can't outgrow the
+            # cumulative apply wall (apply_stats.abort_close docstring)
+            self.apply_stats.abort_close()
             # black box for the postmortem: spans + metrics at the moment
             # of a failed close (KeyboardInterrupt/SystemExit excluded —
             # an operator ^C is not a crash)
@@ -254,6 +269,26 @@ class LedgerManager:
             frames = lcd.tx_set.sort_for_apply()
             base_fee = lcd.tx_set.base_fee(header)
 
+        # close cockpit: open the per-close stats window, classify the
+        # tx mix (fee-bump / muxed counted distinctly), and bulk-warm the
+        # root entry cache with the txset's statically-knowable keys so
+        # apply-path reads are cache hits with measured coverage
+        # (reference prefetchTransactionData; ledger/apply_stats.py)
+        from ..ledger.apply_stats import frame_traits, txset_prefetch_keys
+        from ..util.timer import real_perf_counter
+        stats = self.apply_stats
+        stats.begin_close(lcd.ledger_seq)
+        fee_bumps = muxeds = 0
+        for f in frames:
+            fee_bump, muxed = frame_traits(f)
+            fee_bumps += fee_bump
+            muxeds += muxed
+        stats.record_tx_counts(len(frames), fee_bumps, muxeds)
+        if frames and hasattr(self.root, "prefetch"):
+            with app_span(self.app, "close.prefetch", cat="ledger") as psp:
+                psp.set_tag("cached",
+                            self.root.prefetch(txset_prefetch_keys(frames)))
+
         # fast path: the native engine runs BOTH phases in one C call and
         # installs per-frame results/meta + the close-level delta; any
         # ineligibility falls through to the Python phases with no state
@@ -262,10 +297,11 @@ class LedgerManager:
         from ..ledger.native_apply import native_apply_txset
         with app_span(self.app, "close.apply", cat="ledger",
                       txs=len(frames)) as apply_sp:
+            t_apply = real_perf_counter()
             if native_apply_txset(self, ltx, frames, base_fee, verifier):
-                apply_sp.set_tag("apply_path", "native")
+                apply_path = "native"
             else:
-                apply_sp.set_tag("apply_path", "python")
+                apply_path = "python"
                 # phase 1: fees + seq nums for every tx, each in a nested
                 # txn so the per-tx fee-processing changes become
                 # txfeehistory meta (reference saves these
@@ -281,8 +317,12 @@ class LedgerManager:
                             fee_ltx.rollback()
                         raise
                 # phase 2: apply, collecting results (+ invariant checks)
+                # with per-op latency attribution (the cockpit's
+                # Python-path histograms)
                 for f in frames:
-                    f.apply(ltx, verifier)
+                    f.apply(ltx, verifier, stats=stats)
+            apply_wall_s = real_perf_counter() - t_apply
+            apply_sp.set_tag("apply_path", apply_path)
         # result hash in apply order, assembled from wire bytes:
         # TransactionResultSet XDR is count ‖ pairs, and each frame holds
         # (or lazily serializes) its own pair bytes — on the native fast
@@ -399,6 +439,24 @@ class LedgerManager:
                 self._store_upgrade_history(lcd.ledger_seq, up, changes,
                                             index)
             self._store_local_has()
+
+        # seal the close-cockpit window only now that the close is
+        # DURABLE (LCL advanced, SQL stored) — a failure anywhere above
+        # reaches abort_close() instead, so closes.{native|python} never
+        # counts a close that didn't commit. Tagging the apply span this
+        # late still works: the span OBJECT is already recorded in the
+        # tracer ring (spans are recorded by reference at exit), so the
+        # op mix / read-set stats land in exported traces and flight
+        # dumps regardless.
+        close_blob = stats.end_close(apply_path, apply_wall_s,
+                                     write_set=len(delta))
+        if close_blob is not None:
+            apply_sp.set_tag("op_mix", {
+                n: d["count"] for n, d in close_blob["ops"].items()})
+            apply_sp.set_tag("reads", close_blob["reads"])
+            if close_blob.get("bail"):
+                apply_sp.set_tag("native_bail", close_blob["bail"])
+
         self._emit_close_meta(lcd, frames, applied_upgrades)
         hm = getattr(self.app, "history_manager", None)
         if hm is not None:
@@ -497,7 +555,8 @@ class LedgerManager:
             # or running on wrong state (catchup heals)
             from ..bucket.bucket_list import BucketList
             bm.bucket_list = BucketList(bm._executor,
-                                        adopt=bm.adopt_bucket)
+                                        adopt=bm.adopt_bucket,
+                                        stats=bm._stats)
             log.warning("bucket-list restore failed: %s", e)
 
     def _store_upgrade_history(self, ledger_seq: int, up, changes,

@@ -565,18 +565,39 @@ class InMemoryLedgerTxnRoot(AbstractLedgerTxnParent):
         self._entries.clear()
 
 
+_ENTRY_TYPE_NAMES = {
+    LedgerEntryType.ACCOUNT: "account",
+    LedgerEntryType.TRUSTLINE: "trustline",
+    LedgerEntryType.OFFER: "offer",
+    LedgerEntryType.DATA: "data",
+}
+
+
 class LedgerTxnRoot(AbstractLedgerTxnParent):
     """SQL-backed root with an entry cache and per-type bulk writers
-    (reference LedgerTxnRoot + LedgerTxn{Account,Offer,TrustLine,Data}SQL)."""
+    (reference LedgerTxnRoot + LedgerTxn{Account,Offer,TrustLine,Data}SQL).
+
+    `stats` (ledger/apply_stats.py ApplyStats) is the close cockpit's
+    state-read telemetry: per-type SQL point lookups, entry-cache
+    hit/miss, prefetch coverage and hit-rate (reference
+    getPrefetchHitRate parity), bulk-scan row counts. Every hook is a
+    no-op when no stats object is wired (tests, standalone tools)."""
 
     ENTRY_CACHE_SIZE = 4096
 
     def __init__(self, db: Database,
-                 header: Optional[LedgerHeader] = None) -> None:
+                 header: Optional[LedgerHeader] = None,
+                 stats=None) -> None:
         self._db = db
         self._header = header
         self._cache: RandomEvictionCache = RandomEvictionCache(
             self.ENTRY_CACHE_SIZE)
+        self._stats = stats
+        # keys warmed by prefetch(): a later cache-hit on one counts as a
+        # prefetch hit, a SQL fetch counts as a prefetch miss (reference
+        # LedgerTxnRoot::getPrefetchHitRate). Bounded: cleared when it
+        # outgrows the cache it describes several times over.
+        self._prefetched: set = set()
 
     def set_header(self, header: LedgerHeader) -> None:
         self._header = header
@@ -586,14 +607,25 @@ class LedgerTxnRoot(AbstractLedgerTxnParent):
         return self._header
 
     # -- reads --------------------------------------------------------------
+    def _note_prefetched(self, kb: bytes) -> None:
+        if len(self._prefetched) > 4 * self.ENTRY_CACHE_SIZE:
+            self._prefetched.clear()
+        self._prefetched.add(kb)
+
     def get_entry(self, key: LedgerKey) -> Optional[LedgerEntry]:
         kb = _kb(key)
         hit = self._cache.maybe_get(kb)
+        st = self._stats
         if hit is not None:
             blob = hit
+            if st is not None:
+                st.record_read(True, kb in self._prefetched)
         else:
             blob = self._select_blob(key)
             self._cache.put(kb, blob if blob is not None else b"")
+            if st is not None:
+                st.record_read(False, False,
+                               _ENTRY_TYPE_NAMES.get(key.disc, "unknown"))
         if not blob:
             return None
         return LedgerEntry.from_xdr(blob)
@@ -602,10 +634,17 @@ class LedgerTxnRoot(AbstractLedgerTxnParent):
         """Raw LedgerEntry XDR by key XDR, through the entry cache — the
         native apply engine's lookup callback."""
         hit = self._cache.maybe_get(kb)
+        st = self._stats
         if hit is not None:
+            if st is not None:
+                st.record_read(True, kb in self._prefetched)
             return hit or None
-        blob = self._select_blob(LedgerKey.from_xdr(kb))
+        key = LedgerKey.from_xdr(kb)
+        blob = self._select_blob(key)
         self._cache.put(kb, blob if blob is not None else b"")
+        if st is not None:
+            st.record_read(False, False,
+                           _ENTRY_TYPE_NAMES.get(key.disc, "unknown"))
         return blob
 
     def _select_blob(self, key: LedgerKey) -> Optional[bytes]:
@@ -631,12 +670,17 @@ class LedgerTxnRoot(AbstractLedgerTxnParent):
         row = cur.fetchone()
         return row[0] if row else None
 
+    def _record_scan(self, rows) -> list:
+        if self._stats is not None:
+            self._stats.record_bulk_scan(len(rows))
+        return rows
+
     def _all_offers_for_book(self, selling, buying):
         out: Dict[bytes, LedgerEntry] = {}
         cur = self._db.execute(
             "SELECT entry FROM offers WHERE selling=? AND buying=?",
             (_asset_str(selling), _asset_str(buying)))
-        for (blob,) in cur.fetchall():
+        for (blob,) in self._record_scan(cur.fetchall()):
             e = LedgerEntry.from_xdr(blob)
             out[_kb(ledger_entry_key(e))] = e
         return out
@@ -646,23 +690,23 @@ class LedgerTxnRoot(AbstractLedgerTxnParent):
         cur = self._db.execute(
             "SELECT entry FROM offers WHERE sellerid=?",
             (_acc_str(account_id),))
-        for (blob,) in cur.fetchall():
+        for (blob,) in self._record_scan(cur.fetchall()):
             e = LedgerEntry.from_xdr(blob)
             out[_kb(ledger_entry_key(e))] = e
         return out
 
     def _all_offers(self):
         out: Dict[bytes, LedgerEntry] = {}
-        for (blob,) in self._db.execute(
-                "SELECT entry FROM offers").fetchall():
+        for (blob,) in self._record_scan(self._db.execute(
+                "SELECT entry FROM offers").fetchall()):
             e = LedgerEntry.from_xdr(blob)
             out[_kb(ledger_entry_key(e))] = e
         return out
 
     def _all_accounts(self):
         out: Dict[bytes, LedgerEntry] = {}
-        for (blob,) in self._db.execute(
-                "SELECT entry FROM accounts").fetchall():
+        for (blob,) in self._record_scan(self._db.execute(
+                "SELECT entry FROM accounts").fetchall()):
             e = LedgerEntry.from_xdr(blob)
             out[_kb(ledger_entry_key(e))] = e
         return out
@@ -670,19 +714,38 @@ class LedgerTxnRoot(AbstractLedgerTxnParent):
     def prefetch(self, keys) -> int:
         """Bulk-warm the entry cache for `keys`; returns how many were
         actually cached (reference LedgerTxnRoot::prefetch,
-        LedgerTxn.cpp — stops when the cache is half full so prefetch
-        can't evict the working set)."""
+        LedgerTxn.cpp — stops loading when the cache is half full so
+        prefetch can't evict the working set). Coverage — keys resident
+        afterwards (already warm + newly loaded) over keys requested —
+        feeds `ledger.apply.prefetch.coverage-pct`; later root reads of
+        prefetched keys count into the getPrefetchHitRate-parity
+        hit/miss meters."""
         budget = self._cache._max // 2
         n = 0
+        requested = 0
+        covered = 0
+        note = self._stats is not None
+        loads: Dict[str, int] = {}
         for key in keys:
-            if len(self._cache) >= budget:
-                break
+            requested += 1
             kb = _kb(key)
             if self._cache.maybe_get(kb) is not None:
+                covered += 1
+                if note:
+                    self._note_prefetched(kb)
                 continue
+            if len(self._cache) >= budget:
+                continue   # over budget: keep counting coverage only
             blob = self._select_blob(key)
             self._cache.put(kb, blob if blob is not None else b"")
+            if note:
+                self._note_prefetched(kb)
+                name = _ENTRY_TYPE_NAMES.get(key.disc, "unknown")
+                loads[name] = loads.get(name, 0) + 1
             n += 1
+            covered += 1
+        if self._stats is not None:
+            self._stats.record_prefetch(requested, covered, loads)
         return n
 
     def clear_entries(self) -> None:

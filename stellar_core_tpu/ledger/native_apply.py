@@ -9,9 +9,13 @@ everything downstream of the apply loop — result hash, bucket-list delta,
 tx/fee history rows, close meta, invariants — runs unchanged Python over
 identical state.
 
-The engine returns None for ANY input outside its subset before mutating
-shared state, so the Python apply path (the differential-test oracle,
-tests/test_native_apply.py) remains the single source of semantics.
+The engine returns {"bail": reason} (or None) for ANY input outside its
+subset before mutating shared state, so the Python apply path (the
+differential-test oracle, tests/test_native_apply.py) remains the single
+source of semantics. Every ineligibility/bailout — decided here or
+inside the engine — classifies to a reason metered as
+`ledger.apply.native-bail.<reason>` (ISSUE 9 forensics: the op-coverage
+order of ROADMAP item 2 follows observed traffic, not the alphabet).
 
 Gate: SCT_NATIVE_APPLY=0 disables (mirroring SCT_NATIVE_XDR); an absent
 compiler disables silently.
@@ -22,31 +26,54 @@ from __future__ import annotations
 from typing import List, Optional
 
 
+def _classify_engine_bail(reason: str) -> str:
+    """Engine reason string -> metric-safe reason. `op-<n>` carries the
+    numeric wire type; name it (`op-manage-sell-offer`) so operators
+    read traffic, not enum values."""
+    if reason.startswith("op-"):
+        try:
+            from .apply_stats import op_type_name
+            return "op-" + op_type_name(int(reason[3:]))
+        except ValueError:
+            return reason
+    return reason
+
+
+def _bail(stats, reason: str) -> bool:
+    """Record one classified ineligibility/bailout; returns False so
+    call sites read `return _bail(stats, "...")`."""
+    if stats is not None:
+        stats.record_bail(reason)
+    return False
+
+
 def native_apply_txset(lm, ltx, frames, base_fee: Optional[int],
                        verifier) -> bool:
     """Run the whole txset's fee+apply phases natively. Returns False on
     any ineligibility/bailout with NO state mutated (the caller then runs
     the Python phases); True means ltx, the header fee pool, and every
     frame's result/meta are populated exactly as the Python path would
-    have."""
+    have. Per-op attribution and bail classification land in
+    `lm.apply_stats` (ledger/apply_stats.py)."""
+    stats = getattr(lm, "apply_stats", None)
     if not getattr(lm, "use_native_apply", True):
-        return False
+        return _bail(stats, "disabled")
     from ..native import apply_engine
     eng = apply_engine()
     if eng is None:
-        return False
+        return _bail(stats, "no-engine")
     from ..transactions.transaction_frame import TransactionFrame
     if ltx._changes:
-        return False  # engine reads close-start state from the root
+        return _bail(stats, "open-changes")
     header = ltx.load_header()
     if header.ledgerVersion < 10:
-        return False
+        return _bail(stats, "protocol-pre10")
     for f in frames:
         if type(f) is not TransactionFrame:
-            return False  # fee bumps: Python path
+            return _bail(stats, "fee-bump")  # fee bumps: Python path
     get_blob = getattr(lm.root, "get_entry_blob", None)
     if get_blob is None:
-        return False
+        return _bail(stats, "no-blob-lookup")
     if verifier is None:
         from ..crypto.batch_verifier import CpuSigVerifier
         verifier = CpuSigVerifier()
@@ -64,10 +91,14 @@ def native_apply_txset(lm, ltx, frames, base_fee: Optional[int],
     out = eng.apply_close(params, envs, hashes, get_blob,
                           verifier.prewarm_many)
     if out is None:
-        return False
+        return _bail(stats, "engine-ineligible")
+    if "bail" in out:
+        return _bail(stats, _classify_engine_bail(out["bail"]))
     header.feePool = out["feePool"]
     ltx.inject_native_changes(out["changes"])
     for f, rb, fcb, mb in zip(frames, out["results"], out["fee_changes"],
                               out["meta"]):
         f.set_native_apply_output(rb, fcb, mb)
+    if stats is not None and out.get("op_stats"):
+        stats.record_native_op_table(out["op_stats"])
     return True

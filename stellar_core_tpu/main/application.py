@@ -237,7 +237,8 @@ class Application:
     def enable_buckets(self, bucket_dir: Optional[str] = None) -> None:
         from ..bucket.bucket_manager import BucketManager
         self.bucket_manager = BucketManager(
-            bucket_dir or self.config.BUCKET_DIR_PATH)
+            bucket_dir or self.config.BUCKET_DIR_PATH,
+            stats=self.ledger_manager.apply_stats)
 
     # -- info ----------------------------------------------------------------
     def get_info(self) -> dict:

@@ -178,6 +178,29 @@ class CommandHandler:
         out["cache"] = _keys.verify_cache_stats()
         return out
 
+    def cmd_applystats(self, params) -> dict:
+        """Close cockpit (ISSUE 9 tentpole;
+        docs/observability.md#close-cockpit): the apply path's
+        operational state in one JSON blob — per-op-type counts and
+        attributed milliseconds (native engine table + Python-path
+        timings), native-bail forensics by classified reason, state-read
+        telemetry (per-type point lookups, entry-cache hit/miss,
+        prefetch coverage + getPrefetchHitRate parity, bulk-scan rows),
+        bucket per-level sizes and merge durations, and the last close's
+        blob. `applystats?action=reset` zeroes the cumulative aggregates
+        (registry metrics keep their monotonic histories). The same data
+        is scrapeable as `sct_ledger_apply_*` / `sct_bucket_*` series
+        via `metrics?format=prometheus`."""
+        stats = self.app.ledger_manager.apply_stats
+        action = params.get("action", "status")
+        if action == "reset":
+            stats.reset()
+            return {"status": "reset", **stats.to_json()}
+        if action != "status":
+            raise CommandParamError(
+                "parameter 'action' must be status|reset, got %r" % action)
+        return stats.to_json()
+
     def cmd_trace(self, params) -> dict:
         """Span-tracer control + export (ISSUE 2 tentpole):
         `trace?action=status|start|stop|clear|dump|flight`.

@@ -21,6 +21,13 @@
  *     hash; lookup(key_xdr)->entry_xdr|None reads close-start state;
  *     verify([(key32,sig,msg)])->[bool] is the batch crypto boundary
  *     (BatchSigVerifier.prewarm_many — cache-aware, one device batch).
+ *     A successful close's dict carries "op_stats": {op_type: (count,
+ *     ns)} — the close cockpit's per-op attribution (ISSUE 9). An
+ *     unsupported input returns {"bail": "<reason>"} (classified:
+ *     "op-<n>" names the first unsupported op type, "muxed-account",
+ *     "multisig-shape", "signer-key-type", "entry-kind", ...) so
+ *     ledger/native_apply.py can meter ledger.apply.native-bail.<reason>;
+ *     None is kept for protocol-version ineligibility.
  *
  * State model: an overlay of parsed entries keyed by LedgerKey bytes.
  * Only balance/seqNum/existence ever mutate under the supported ops, so
@@ -32,7 +39,9 @@
 #define PY_SSIZE_T_CLEAN
 #include <Python.h>
 #include <stdint.h>
+#include <stdio.h>
 #include <string.h>
+#include <time.h>
 
 #define LET_ACCOUNT 0
 #define LET_TRUSTLINE 1
@@ -95,6 +104,7 @@
 #define NBUCKETS 1024
 #define MAX_SIGNERS 20
 #define MAX_SIGS 20
+#define MAX_OPTYPES 16 /* wire op types are 0..13; table rounded up */
 
 typedef struct {
     char *data;
@@ -294,7 +304,31 @@ typedef struct {
     int64_t baseFee, baseReserve, effBase;
     int bail;  /* unsupported input: fall back to the Python path */
     int pyerr; /* a Python exception is set: propagate */
+    /* bail forensics (ISSUE 9): first classified reason wins — the
+       caller (ledger/native_apply.py) turns it into a
+       ledger.apply.native-bail.<reason> meter + span tag so op-coverage
+       work (ROADMAP item 2) is ordered by observed traffic */
+    const char *bailmsg;
+    char bailbuf[48];
+    /* per-op-type attribution for the close: apply-loop count and
+       CLOCK_MONOTONIC nanoseconds per wire op type, returned as the
+       "op_stats" table so native closes attribute like Python ones */
+    int64_t op_cnt[MAX_OPTYPES];
+    int64_t op_ns[MAX_OPTYPES];
 } Ctx;
+
+static void set_bail_reason(Ctx *c, const char *msg)
+{
+    if (!c->bailmsg)
+        c->bailmsg = msg;
+}
+
+static int64_t now_ns(void)
+{
+    struct timespec ts;
+    clock_gettime(CLOCK_MONOTONIC, &ts);
+    return (int64_t)ts.tv_sec * 1000000000LL + (int64_t)ts.tv_nsec;
+}
 
 static uint32_t fnv1a(const uint8_t *p, int n)
 {
@@ -370,12 +404,20 @@ static int parse_account(Ctx *c, Entry *e, const uint8_t *blob, int len)
     if (!th)
         return -1;
     memcpy(e->st.thresholds, th, 4);
-    if (rd_u32(&r, &n) < 0 || n > MAX_SIGNERS)
+    if (rd_u32(&r, &n) < 0)
         return -1;
+    if (n > MAX_SIGNERS) {
+        set_bail_reason(c, "multisig-shape");
+        return -1;
+    }
     e->st.nsigners = (int)n;
     for (i = 0; i < e->st.nsigners; i++) {
-        if (rd_u32(&r, &ktype) < 0 || ktype != 0)
-            return -1; /* pre-auth-tx / hash-x signers: Python path */
+        if (rd_u32(&r, &ktype) < 0)
+            return -1;
+        if (ktype != 0) { /* pre-auth-tx / hash-x signers: Python path */
+            set_bail_reason(c, "signer-key-type");
+            return -1;
+        }
         const uint8_t *sk = rd_take(&r, 32);
         if (!sk)
             return -1;
@@ -514,6 +556,7 @@ static Entry *get_entry(Ctx *c, const uint8_t *keyb, int keylen)
                            ? parse_trustline(c, e, e->base, e->baselen)
                            : -1;
         if (rc < 0) {
+            set_bail_reason(c, "entry-kind");
             c->bail = 1;
             PyMem_Free(e->keyb);
             PyMem_Free(e->base);
@@ -522,6 +565,7 @@ static Entry *get_entry(Ctx *c, const uint8_t *keyb, int keylen)
             return NULL;
         }
     } else {
+        set_bail_reason(c, "lookup-type");
         c->bail = 1;
         PyMem_Free(e->keyb);
         PyMem_Free(e);
@@ -764,9 +808,10 @@ static PyObject *delta_changes_blob(Ctx *c, int lv)
     }
 fail:
     PyMem_Free(b.data);
-    if (!PyErr_Occurred())
+    if (!PyErr_Occurred()) {
+        set_bail_reason(c, "delta");
         c->bail = 1;
-    else
+    } else
         c->pyerr = 1;
     return NULL;
 }
@@ -817,11 +862,16 @@ typedef struct {
 } Tx;
 
 /* MuxedAccount, ed25519 arm only (muxed sub-ids: Python path) */
-static int rd_muxed(Rd *r, uint8_t *out32)
+static int rd_muxed(Ctx *c, Rd *r, uint8_t *out32)
 {
     uint32_t kt;
-    if (rd_u32(r, &kt) < 0 || kt != 0)
+    if (rd_u32(r, &kt) < 0)
         return -1;
+    if (kt != 0) {
+        if (kt == 0x100) /* KEY_TYPE_MUXED_ED25519 */
+            set_bail_reason(c, "muxed-account");
+        return -1;
+    }
     const uint8_t *p = rd_take(r, 32);
     if (!p)
         return -1;
@@ -862,9 +912,13 @@ static int parse_envelope(Ctx *c, const uint8_t *blob, Py_ssize_t len,
     Rd r = {blob, len, 0};
     uint32_t u, n;
     int i;
-    if (rd_u32(&r, &u) < 0 || u != 2) /* ENVELOPE_TYPE_TX */
+    if (rd_u32(&r, &u) < 0)
         return -1;
-    if (rd_muxed(&r, t->src) < 0)
+    if (u != 2) { /* ENVELOPE_TYPE_TX (fee bumps etc.: Python path) */
+        set_bail_reason(c, u == 5 ? "fee-bump" : "envelope-type");
+        return -1;
+    }
+    if (rd_muxed(c, &r, t->src) < 0)
         return -1;
     if (rd_u32(&r, &t->fee) < 0 || rd_i64(&r, &t->seqNum) < 0)
         return -1;
@@ -911,7 +965,7 @@ static int parse_envelope(Ctx *c, const uint8_t *blob, Py_ssize_t len,
         if (rd_u32(&r, &u) < 0 || u > 1)
             return -1;
         op->has_src = (int)u;
-        if (op->has_src && rd_muxed(&r, op->src) < 0)
+        if (op->has_src && rd_muxed(c, &r, op->src) < 0)
             return -1;
         if (rd_u32(&r, &u) < 0)
             return -1;
@@ -927,7 +981,7 @@ static int parse_envelope(Ctx *c, const uint8_t *blob, Py_ssize_t len,
             if (rd_i64(&r, &op->amount) < 0)
                 return -1;
         } else if (op->optype == OP_PAYMENT) {
-            if (rd_muxed(&r, op->dest) < 0)
+            if (rd_muxed(c, &r, op->dest) < 0)
                 return -1;
             if (rd_asset(&r, op) < 0)
                 return -1;
@@ -970,8 +1024,10 @@ static int parse_envelope(Ctx *c, const uint8_t *blob, Py_ssize_t len,
             if ((op->so_has_mw && op->so_mw > 255) ||
                 (op->so_has_lt && op->so_lt > 255) ||
                 (op->so_has_mt && op->so_mt > 255) ||
-                (op->so_has_ht && op->so_ht > 255))
+                (op->so_has_ht && op->so_ht > 255)) {
+                set_bail_reason(c, "threshold-range");
                 return -1;
+            }
             /* homeDomain: optional string32 */
             if (rd_u32(&r, &u) < 0 || u > 1)
                 return -1;
@@ -994,20 +1050,34 @@ static int parse_envelope(Ctx *c, const uint8_t *blob, Py_ssize_t len,
             op->so_has_signer = (int)u;
             if (u) {
                 const uint8_t *p;
-                if (rd_u32(&r, &kt) < 0 || kt != 0 ||
-                    !(p = rd_take(&r, 32)))
+                if (rd_u32(&r, &kt) < 0)
+                    return -1;
+                if (kt != 0) {
+                    set_bail_reason(c, "signer-key-type");
+                    return -1;
+                }
+                if (!(p = rd_take(&r, 32)))
                     return -1;
                 memcpy(op->so_signer_key, p, 32);
                 if (rd_u32(&r, &op->so_signer_w) < 0)
                     return -1;
             }
-        } else
-            return -1; /* other op types: Python path */
+        } else {
+            /* other op types: Python path — record WHICH one, so the
+               op-coverage order of ROADMAP item 2 follows traffic */
+            snprintf(c->bailbuf, sizeof(c->bailbuf), "op-%d", op->optype);
+            set_bail_reason(c, c->bailbuf);
+            return -1;
+        }
     }
     if (rd_u32(&r, &u) < 0 || u != 0) /* tx ext */
         return -1;
-    if (rd_u32(&r, &n) < 0 || n > MAX_SIGS)
+    if (rd_u32(&r, &n) < 0)
         return -1;
+    if (n > MAX_SIGS) {
+        set_bail_reason(c, "multisig-shape");
+        return -1;
+    }
     t->nsigs = (int)n;
     for (i = 0; i < t->nsigs; i++) {
         const uint8_t *h = rd_take(&r, 4);
@@ -1170,6 +1240,7 @@ static int vset_verify(Ctx *c, VSet *vs, Tx *t)
     }
     if (PySequence_Fast_GET_SIZE(seq) != vs->n) {
         Py_DECREF(seq);
+        set_bail_reason(c, "verify-shape");
         c->bail = 1;
         return -1;
     }
@@ -1677,9 +1748,10 @@ static PyObject *apply_close(PyObject *self, PyObject *args)
             PyBytes_GET_SIZE(h) != 32) {
             Py_XDECREF(env);
             Py_XDECREF(h);
-            if (!PyErr_Occurred())
+            if (!PyErr_Occurred()) {
+                set_bail_reason(&c, "input-shape");
                 c.bail = 1;
-            else
+            } else
                 c.pyerr = 1;
             goto done;
         }
@@ -1692,8 +1764,10 @@ static PyObject *apply_close(PyObject *self, PyObject *args)
         Py_DECREF(env);
         Py_DECREF(h);
         if (rc < 0) {
-            if (!c.pyerr)
+            if (!c.pyerr) {
+                set_bail_reason(&c, "envelope");
                 c.bail = 1;
+            }
             goto done;
         }
     }
@@ -1717,6 +1791,7 @@ static PyObject *apply_close(PyObject *self, PyObject *args)
         if (!src)
             goto done;
         if (!src->exists) {
+            set_bail_reason(&c, "fee-source-missing");
             c.bail = 1; /* Python asserts here; let it */
             goto done;
         }
@@ -1847,6 +1922,7 @@ static PyObject *apply_close(PyObject *self, PyObject *args)
                     level = 2;
                 if (!check_sig(t, &vs, oa->exists ? oa : NULL, osrc,
                                level)) {
+                    set_bail_reason(&c, "op-auth");
                     c.bail = 1;
                     goto txfail;
                 }
@@ -1899,6 +1975,10 @@ static PyObject *apply_close(PyObject *self, PyObject *args)
         for (i = 0; i < t->nops; i++) {
             Op *op = &t->ops[i];
             const uint8_t *osrc = op->has_src ? op->src : t->src;
+            /* per-op attribution: the whole op handling (state loads,
+               apply, delta serialization, savepoint commit/rollback)
+               charges to the op's wire type */
+            int64_t t_op = now_ns();
             Entry *oa = get_account(&c, osrc);
             if (!oa)
                 goto txfail;
@@ -1928,6 +2008,10 @@ static PyObject *apply_close(PyObject *self, PyObject *args)
             } else {
                 rollback_level(&c, 3);
                 ok = 0;
+            }
+            if (op->optype >= 0 && op->optype < MAX_OPTYPES) {
+                c.op_cnt[op->optype]++;
+                c.op_ns[op->optype] += now_ns() - t_op;
             }
         }
         if (ok) {
@@ -2027,12 +2111,38 @@ static PyObject *apply_close(PyObject *self, PyObject *args)
         Py_DECREF(tup);
     }
 
-    out = Py_BuildValue("{s:L,s:O,s:O,s:O,s:O}", "feePool",
-                        (long long)c.feePool, "changes", changes,
-                        "results", results, "fee_changes", fee_changes,
-                        "meta", metas);
-    if (!out)
-        c.pyerr = 1;
+    {
+        /* per-op-type attribution table: {op_type: (count, ns)} — the
+           close cockpit's native-path per-op breakdown (ISSUE 9) */
+        PyObject *op_stats = PyDict_New();
+        if (!op_stats) {
+            c.pyerr = 1;
+            goto done;
+        }
+        for (i = 0; i < MAX_OPTYPES; i++) {
+            if (!c.op_cnt[i])
+                continue;
+            PyObject *k = PyLong_FromLong(i);
+            PyObject *v2 = Py_BuildValue(
+                "(LL)", (long long)c.op_cnt[i], (long long)c.op_ns[i]);
+            if (!k || !v2 || PyDict_SetItem(op_stats, k, v2) < 0) {
+                Py_XDECREF(k);
+                Py_XDECREF(v2);
+                Py_DECREF(op_stats);
+                c.pyerr = 1;
+                goto done;
+            }
+            Py_DECREF(k);
+            Py_DECREF(v2);
+        }
+        out = Py_BuildValue("{s:L,s:O,s:O,s:O,s:O,s:O}", "feePool",
+                            (long long)c.feePool, "changes", changes,
+                            "results", results, "fee_changes", fee_changes,
+                            "meta", metas, "op_stats", op_stats);
+        Py_DECREF(op_stats);
+        if (!out)
+            c.pyerr = 1;
+    }
 
 done:
     bailing = c.bail && !c.pyerr;
@@ -2049,7 +2159,13 @@ done:
     ctx_free(&c);
     if (c.pyerr)
         return NULL;
-    if (bailing || !out)
+    if (bailing)
+        /* classified bail: the caller marks
+           ledger.apply.native-bail.<reason> and falls back to Python
+           (c.bailbuf lives in the stack Ctx — still valid here) */
+        return Py_BuildValue("{s:s}", "bail",
+                             c.bailmsg ? c.bailmsg : "unsupported");
+    if (!out)
         Py_RETURN_NONE;
     return out;
 }

@@ -1,0 +1,473 @@
+"""Close cockpit (ISSUE 9): per-op apply attribution, native-bail
+forensics, state-read telemetry, and the surfaces they feed.
+
+- apply_breakdown per-op ms + residual sum to the measured apply wall on
+  BOTH the native and the Python path (the bench block's contract);
+- a forced-bail txset (an offer op) classifies to the right
+  `ledger.apply.native-bail.<reason>` meter and span tag;
+- fee-bump and muxed traffic are counted distinctly;
+- `applystats` admin endpoint round-trips (status + reset + 400s) and
+  the `sct_ledger_apply_*` series appear in `metrics?format=prometheus`;
+- LedgerTxnRoot state-read telemetry: per-type lookups, cache hit/miss,
+  prefetch coverage and getPrefetchHitRate parity;
+- bucket layer: per-level sizes + merge durations.
+"""
+
+import pytest
+
+from stellar_core_tpu.herder.txset import TxSetFrame
+from stellar_core_tpu.ledger.apply_stats import (
+    ApplyStats, frame_traits, op_type_name, txset_prefetch_keys,
+)
+from stellar_core_tpu.ledger.ledger_manager import (
+    LedgerCloseData, LedgerManager,
+)
+from stellar_core_tpu.main.application import Application
+from stellar_core_tpu.main.config import Config
+from stellar_core_tpu.native import apply_engine
+from stellar_core_tpu.testing import (
+    TESTING_NETWORK_ID, TestAccount, root_secret_key,
+)
+from stellar_core_tpu.util.timer import ClockMode, VirtualClock
+from stellar_core_tpu.xdr import (
+    Asset, CryptoKeyType, MuxedAccount, OperationType, StellarValue,
+    StellarValueExt,
+)
+
+
+# ---------------------------------------------------------------- harness
+
+class _StubConfig:
+    DATABASE = "in-memory"
+    LEDGER_PROTOCOL_VERSION = 13
+    GENESIS_TOTAL_COINS = 10 ** 17
+    TESTING_UPGRADE_DESIRED_FEE = 100
+    TESTING_UPGRADE_RESERVE = 5_000_000
+    TESTING_UPGRADE_MAX_TX_SET_SIZE = 1000
+    network_id = TESTING_NETWORK_ID
+
+
+class _StubApp:
+    config = _StubConfig()
+
+    def network_root_key(self):
+        return root_secret_key()
+
+
+class _Shim:
+    def __init__(self, lm):
+        self.lm = lm
+        self.network_id = TESTING_NETWORK_ID
+
+    def header(self):
+        return self.lm.root.get_header()
+
+    def seq_num(self, account_id):
+        from stellar_core_tpu.xdr import LedgerKey
+        e = self.lm.root.get_entry(LedgerKey.account(account_id))
+        return e.data.value.seqNum if e is not None else 0
+
+
+class CloseHarness:
+    """One LedgerManager closing real LedgerCloseData through
+    close_ledger — the same path consensus and catchup replay use."""
+
+    def __init__(self, native: bool):
+        self.lm = LedgerManager(_StubApp())
+        self.lm.start_new_ledger()
+        self.lm.use_native_apply = native
+        self.shim = _Shim(self.lm)
+
+    def account(self, sk):
+        return TestAccount(self.shim, sk)
+
+    def close(self, frames):
+        lm = self.lm
+        header = lm.root.get_header()
+        ts = TxSetFrame(TESTING_NETWORK_ID, lm.lcl_hash, frames)
+        value = StellarValue(
+            txSetHash=ts.get_contents_hash(),
+            closeTime=header.scpValue.closeTime + 5,
+            upgrades=[], ext=StellarValueExt(0, None))
+        lm.close_ledger(LedgerCloseData(header.ledgerSeq + 1, ts, value))
+
+
+def _payment_frames(h, n=4):
+    from stellar_core_tpu.crypto.keys import SecretKey
+    root = h.account(root_secret_key())
+    sks = [SecretKey.from_seed(bytes([50 + i]) * 32) for i in range(n)]
+    h.close([root.tx([root.op_create_account(sk.public_key, 10 ** 10)
+                      for sk in sks])])
+    accs = [h.account(sk) for sk in sks]
+    return [a.tx([a.op_payment(root.account_id, 1000 + i)])
+            for i, a in enumerate(accs)]
+
+
+def _breakdown_sums(ab):
+    total_ms = sum(ab["per_op_ms"].values()) + ab["other_ms"]
+    wall_ms = ab["apply_wall_s"] * 1e3
+    # per-op values are rounded to 1 µs; generous absolute slack
+    assert abs(total_ms - wall_ms) < max(0.5, 1e-3 * wall_ms), \
+        (total_ms, wall_ms)
+    assert ab["other_ms"] >= 0.0
+
+
+# ------------------------------------------------- breakdown sums to wall
+
+def test_python_path_breakdown_sums_to_wall():
+    h = CloseHarness(native=False)
+    frames = _payment_frames(h)
+    h.close(frames)
+    stats = h.lm.apply_stats
+    ab = stats.apply_breakdown()
+    assert stats.closes["python"] == 2 and stats.closes.get("native", 0) == 0
+    assert ab["op_counts"]["payment"] == 4
+    assert ab["op_counts"]["create-account"] == 4
+    assert ab["per_op_ms"]["payment"] > 0
+    _breakdown_sums(ab)
+    # the Python path also feeds the per-op latency histograms
+    hist = stats.metrics.to_json().get("ledger.apply.op.payment.seconds")
+    assert hist and hist["count"] == 4
+    # every close bailed with a classified reason (the gate is off)
+    assert ab["bails"].get("disabled") == 2
+
+
+@pytest.mark.skipif(apply_engine() is None,
+                    reason="native apply engine unavailable")
+def test_native_path_breakdown_sums_to_wall():
+    h = CloseHarness(native=True)
+    frames = _payment_frames(h)
+    h.close(frames)
+    stats = h.lm.apply_stats
+    ab = stats.apply_breakdown()
+    assert stats.closes["native"] == 2
+    # the native engine's (count, ns) table attributes per op type
+    assert ab["op_counts"]["payment"] == 4
+    assert ab["op_counts"]["create-account"] == 4
+    assert ab["per_op_ms"]["payment"] > 0
+    _breakdown_sums(ab)
+    assert ab["bails"] == {}
+
+
+# ------------------------------------------------- native-bail forensics
+
+@pytest.mark.skipif(apply_engine() is None,
+                    reason="native apply engine unavailable")
+def test_forced_bail_offer_op_classifies():
+    h = CloseHarness(native=True)
+    root = h.account(root_secret_key())
+    usd = Asset.credit("USD", root.account_id)
+    f = root.tx([root.op_manage_sell_offer(Asset.native(), usd, 10, 1, 1)])
+    h.close([f])
+    stats = h.lm.apply_stats
+    # the engine named the unsupported op type; the close fell back to
+    # Python and still closed the ledger
+    assert stats.bails == {"op-manage-sell-offer": 1}
+    m = stats.metrics.to_json().get(
+        "ledger.apply.native-bail.op-manage-sell-offer")
+    assert m and m["count"] == 1
+    assert stats.closes["python"] == 1
+    assert stats.last_close["bail"] == "op-manage-sell-offer"
+    assert op_type_name(OperationType.MANAGE_SELL_OFFER) == \
+        "manage-sell-offer"
+
+
+@pytest.mark.skipif(apply_engine() is None,
+                    reason="native apply engine unavailable")
+def test_fee_bump_bails_and_counts_distinctly():
+    h = CloseHarness(native=True)
+    root = h.account(root_secret_key())
+    from stellar_core_tpu.crypto.keys import SecretKey
+    sk = SecretKey.from_seed(bytes([77]) * 32)
+    h.close([root.tx([root.op_create_account(sk.public_key, 10 ** 10)])])
+    a = h.account(sk)
+    inner = a.tx([a.op_payment(root.account_id, 1)], fee=200)
+    from stellar_core_tpu.transactions.transaction_frame import (
+        FeeBumpTransactionFrame,
+    )
+    from stellar_core_tpu.xdr import (
+        EnvelopeType, FeeBumpTransaction, FeeBumpTransactionEnvelope,
+        TransactionEnvelope, _Ext,
+    )
+    from stellar_core_tpu.xdr.transaction import _InnerTxEnvelope
+    fb = FeeBumpTransaction(
+        feeSource=root.muxed, fee=1000,
+        innerTx=_InnerTxEnvelope(EnvelopeType.ENVELOPE_TYPE_TX,
+                                 inner.envelope.value),
+        ext=_Ext.v0())
+    env = TransactionEnvelope(
+        EnvelopeType.ENVELOPE_TYPE_TX_FEE_BUMP,
+        FeeBumpTransactionEnvelope(tx=fb, signatures=[]))
+    frame = FeeBumpTransactionFrame(TESTING_NETWORK_ID, env)
+    frame.add_signature(root.sk)
+    h.close([frame])
+    stats = h.lm.apply_stats
+    assert stats.bails.get("fee-bump") == 1
+    assert stats.tx["fee_bump"] == 1
+    assert stats.closes["python"] == 1
+
+
+def test_failed_close_seals_window_and_sum_contract_survives():
+    """A close that RAISES mid-apply still seals the cockpit window
+    (path "failed", via close_ledger's exception handler → abort_close):
+    the per-op seconds recorded for the doomed close join a matching
+    apply wall, so other_ms stays >= 0 and the breakdown keeps adding
+    up."""
+    h = CloseHarness(native=False)
+    frames = _payment_frames(h)
+
+    orig_apply = type(frames[1]).apply
+
+    def exploding_apply(self, ltx, verifier=None, stats=None):
+        raise RuntimeError("injected mid-apply failure")
+
+    # frame 0 applies (and records its op) before frame 1 detonates
+    frames[1].apply = exploding_apply.__get__(frames[1])
+    with pytest.raises(RuntimeError, match="injected mid-apply"):
+        h.close(frames)
+    frames[1].apply = orig_apply.__get__(frames[1])
+
+    stats = h.lm.apply_stats
+    assert stats.closes.get("failed") == 1
+    assert stats._close is None          # window sealed, not leaked
+    assert stats.last_close["path"] == "failed"
+    # frame 0's payment was recorded; its seconds cannot outgrow the wall
+    assert stats.ops["payment"]["count"] >= 1
+    _breakdown_sums(stats.apply_breakdown())
+    # a later close opens a fresh window and attributes normally
+    h.close([frames[0]])
+    assert stats.closes["python"] == 2   # setup close + this one
+    _breakdown_sums(stats.apply_breakdown())
+
+    # failure AFTER apply but before the close is durable (here: the
+    # tx-history store) must also classify "failed" — the window is
+    # sealed only once the close commits, so closes.{native|python}
+    # never counts a close that didn't
+    def boom(*a, **k):
+        raise RuntimeError("post-apply failure")
+    h.lm._store_txs = boom
+    with pytest.raises(RuntimeError, match="post-apply"):
+        h.close([frames[2]])
+    del h.lm._store_txs
+    assert stats.closes["failed"] == 2
+    assert stats.closes["python"] == 2   # unchanged
+    _breakdown_sums(stats.apply_breakdown())
+
+
+def test_frame_traits_muxed_detection():
+    h = CloseHarness(native=False)
+    root = h.account(root_secret_key())
+    plain = root.tx([root.op_payment(root.account_id, 1)])
+    assert frame_traits(plain) == (False, False)
+    muxed_dest = MuxedAccount(
+        CryptoKeyType.KEY_TYPE_MUXED_ED25519, None)
+    from stellar_core_tpu.xdr.basic import MuxedAccountMed25519
+    muxed_dest.value = MuxedAccountMed25519(
+        id=7, ed25519=root.account_id.key_bytes)
+    f = root.tx([root.op_payment(root.account_id, 1)])
+    f.tx.operations[0].body.value.destination = muxed_dest
+    assert frame_traits(f) == (False, True)
+
+
+# ------------------------------------------------- prefetch key collection
+
+def test_txset_prefetch_keys_cover_sources_and_destinations():
+    h = CloseHarness(native=False)
+    root = h.account(root_secret_key())
+    from stellar_core_tpu.crypto.keys import SecretKey
+    sk = SecretKey.from_seed(bytes([60]) * 32)
+    usd = Asset.credit("USD", root.account_id)
+    f1 = root.tx([root.op_create_account(sk.public_key, 10 ** 9)])
+    f2 = root.tx([root.op_payment(sk.public_key, 5, asset=usd)])
+    keys = txset_prefetch_keys([f1, f2])
+    kinds = [k.disc for k in keys]
+    from stellar_core_tpu.xdr import LedgerEntryType
+    assert kinds.count(LedgerEntryType.ACCOUNT) == 2   # root + dest, deduped
+    assert kinds.count(LedgerEntryType.TRUSTLINE) == 2  # src + dest USD lines
+
+
+# ------------------------------------ full app: endpoint, prometheus, reads
+
+@pytest.fixture
+def app():
+    cfg = Config.test_config(0)
+    cfg.DATABASE = "sqlite3://:memory:"
+    a = Application(VirtualClock(ClockMode.VIRTUAL_TIME), cfg)
+    a.start()
+    yield a
+    a.stop()
+
+
+def _drive_closes(app, n_payments=6):
+    from stellar_core_tpu.simulation.load_generator import LoadGenerator
+    lg = LoadGenerator(app)
+    lg.generate_accounts(4)
+    app.manual_close()
+    lg.generate_payments(n_payments)
+    app.clock.set_virtual_time(app.clock.now() + 1.0)
+    app.manual_close()
+
+
+def test_applystats_endpoint_roundtrip(app):
+    _drive_closes(app)
+    st, body = app.command_handler.handle_command("applystats", {})
+    assert st == 200
+    assert body["closes"]["native"] + body["closes"]["python"] >= 2
+    assert body["ops"]  # per-op table populated
+    assert body["last_close"]["reads"]["write_set"] > 0
+    assert "prefetch" in body["state_reads"]
+    # reset zeroes the aggregates but keeps the endpoint shape
+    st, body = app.command_handler.handle_command(
+        "applystats", {"action": "reset"})
+    assert st == 200 and body["status"] == "reset"
+    st, body = app.command_handler.handle_command("applystats", {})
+    assert st == 200
+    assert body["closes"] == {"native": 0, "python": 0}
+    assert body["ops"] == {}
+    # malformed action is a 400, not a 500
+    st, body = app.command_handler.handle_command(
+        "applystats", {"action": "bogus"})
+    assert st == 400 and "action" in body["error"]
+
+
+def test_prometheus_series_roundtrip(app):
+    _drive_closes(app)
+    st, text = app.command_handler.handle_command(
+        "metrics", {"format": "prometheus"})
+    assert st == 200 and isinstance(text, str)
+    lines = text.splitlines()
+    # fixed cockpit series are present from the first scrape
+    for needle in ("sct_ledger_apply_wall", "sct_ledger_apply_read_set",
+                   "sct_ledger_apply_prefetch_coverage_pct",
+                   "sct_ledger_apply_state_cache_hit_total"):
+        assert any(line.startswith(needle) for line in lines), needle
+    # dynamic per-op series carry real counts
+    tot = next(line for line in lines if line.startswith(
+        "sct_ledger_apply_op_payment_count_total"))
+    assert float(tot.split()[-1]) > 0
+
+
+def test_state_read_telemetry_and_prefetch(app):
+    _drive_closes(app)
+    stats = app.ledger_manager.apply_stats
+    r = stats.to_json()["state_reads"]
+    assert r["prefetch"]["calls"] >= 2
+    assert r["prefetch"]["requested"] > 0
+    assert r["prefetch"]["cached"] <= r["prefetch"]["requested"]
+    assert 0.0 <= stats.prefetch_hit_rate() <= 1.0
+    # per-type lookup meters registered under the documented prefix
+    mj = app.metrics.to_json(prefix="ledger.apply.state.lookup.")
+    assert mj  # at least one entry type was looked up in SQL
+    cov = app.metrics.to_json().get("ledger.apply.prefetch.coverage-pct")
+    assert cov and cov["count"] >= 2
+
+
+def test_close_span_tagged_with_op_mix(app):
+    app.tracer.enable()
+    _drive_closes(app)
+    spans = [s for s in app.tracer.spans() if s.name == "close.apply"]
+    assert spans
+    tagged = [s for s in spans if s.tags and "op_mix" in s.tags]
+    assert tagged, "close.apply spans must carry op-mix tags"
+    last = tagged[-1]
+    assert last.tags["apply_path"] in ("native", "python")
+    assert "reads" in last.tags
+    assert isinstance(last.tags["op_mix"], dict)
+
+
+def test_bucket_merge_and_level_telemetry(tmp_path):
+    cfg = Config.test_config(0)
+    cfg.DATABASE = "sqlite3://:memory:"
+    app = Application(VirtualClock(ClockMode.VIRTUAL_TIME), cfg)
+    app.enable_buckets(str(tmp_path / "buckets"))
+    app.start()
+    try:
+        for _ in range(3):
+            _drive_closes(app, n_payments=2)
+        stats = app.ledger_manager.apply_stats
+        b = stats.to_json()["buckets"]
+        assert b["merges"] > 0
+        assert b["merge_seconds"] >= 0.0
+        assert b["levels"]  # per-level sizes recorded at snapshot
+        g = app.metrics.to_json().get("bucket.level.0.entries")
+        assert g is not None
+        hist = app.metrics.to_json().get("bucket.merge.seconds")
+        assert hist and hist["count"] > 0
+    finally:
+        app.stop()
+
+
+# -------------------------------------------------- traced replay contract
+
+def test_traced_replay_breakdown_both_paths(tmp_path):
+    """The bench contract end to end on a REAL catchup replay: publish a
+    small history once, replay it twice (native on / pinned to Python),
+    and assert each leg's apply_breakdown sums to its apply wall."""
+    import os
+    from stellar_core_tpu.catchup.catchup_work import CatchupConfiguration
+    from stellar_core_tpu.history.archive import HistoryArchive
+    from stellar_core_tpu.work.basic_work import State
+
+    archive_root = str(tmp_path / "archive")
+    os.makedirs(archive_root, exist_ok=True)
+
+    def make_app(n, writable):
+        cfg = Config.test_config(n)
+        cfg.DATABASE = "sqlite3://:memory:"
+        cfg.CHECKPOINT_FREQUENCY = 8
+        arch = HistoryArchive.local_dir("bench", archive_root)
+        d = {"get": arch.get_tmpl, "mkdir": arch.mkdir_tmpl}
+        if writable:
+            d["put"] = arch.put_tmpl
+        cfg.HISTORY = {"bench": d}
+        a = Application(VirtualClock(ClockMode.VIRTUAL_TIME), cfg)
+        a.start()
+        return a
+
+    pub = make_app(0, True)
+    from stellar_core_tpu.testing import AppLedgerAdapter
+    ad = AppLedgerAdapter(pub)
+    root = ad.root_account()
+    from stellar_core_tpu.crypto.keys import SecretKey
+    sks = [SecretKey.from_seed(bytes([90 + i]) * 32) for i in range(3)]
+    pub.submit_transaction(root.tx(
+        [root.op_create_account(sk.public_key, 10 ** 10) for sk in sks]))
+    pub.manual_close()
+    senders = [TestAccount(ad, sk) for sk in sks]
+    pub.clock.set_virtual_time(pub.clock.now() + 10.0)
+    target = pub.history_manager.published_checkpoints + 1
+    while pub.history_manager.published_checkpoints < target:
+        for s in senders:
+            pub.submit_transaction(
+                s.tx([s.op_payment(root.account_id, 100)]))
+        pub.clock.set_virtual_time(pub.clock.now() + 1.0)
+        pub.manual_close()
+        pub.crank_until(
+            lambda: pub.history_manager.publish_queue() == [], 20000)
+
+    for native in (True, False):
+        if native and apply_engine() is None:
+            continue
+        app = make_app(1, False)
+        app.tracer.enable(capacity=65536)
+        app.ledger_manager.use_native_apply = native
+        app.clock.set_virtual_time(pub.clock.now() + 10.0)
+        work = app.catchup_manager.start_catchup(
+            CatchupConfiguration.complete())
+        for _ in range(10 ** 6):
+            if work.is_done():
+                break
+            app.crank(False)
+        assert work.state == State.SUCCESS
+        ab = app.ledger_manager.apply_stats.apply_breakdown()
+        path = "native" if native else "python"
+        assert ab["closes"][path] > 0, ab["closes"]
+        assert ab["per_op_ms"].get("payment", 0) > 0
+        _breakdown_sums(ab)
+        # the replayed closes' spans carry the cockpit tags
+        spans = [s for s in app.tracer.spans()
+                 if s.name == "close.apply" and s.tags
+                 and "op_mix" in s.tags]
+        assert spans
+        app.stop()
+    pub.stop()

@@ -152,7 +152,9 @@ def tiny_leg_records():
 
 
 def test_tiny_leg_records_validate(tiny_leg_records):
-    assert len(tiny_leg_records) == 5
+    # 5 classic records + the close-cockpit apply records (ISSUE 9):
+    # apply_wall_s, one apply_op_<type>_ms per op type seen, apply_other_ms
+    assert len(tiny_leg_records) >= 8
     for rec in tiny_leg_records:
         assert not bc.validate_record(rec), rec
     assert {r["platform"] for r in tiny_leg_records} == \
@@ -160,6 +162,9 @@ def test_tiny_leg_records_validate(tiny_leg_records):
     by_metric = {r["metric"]: r for r in tiny_leg_records}
     assert by_metric["replay_ledgers_per_sec"]["value"] > 0
     assert by_metric["replay_wall_s"]["direction"] == "lower"
+    assert by_metric["apply_wall_s"]["direction"] == "lower"
+    assert by_metric["apply_op_payment_ms"]["value"] > 0
+    assert by_metric["apply_other_ms"]["platform"] == "cpu-tiny"
 
 
 def _write_history(path, records):
@@ -188,6 +193,7 @@ def test_compare_gate_clean_and_regressed_inprocess(
     cur = tmp_path / "current.json"
     cur.write_text(json.dumps({"records": tiny_leg_records}))
 
+    n = len(tiny_leg_records)
     clean = tmp_path / "clean.jsonl"
     _write_history(str(clean), _synthetic_baseline(tiny_leg_records))
     rc = bench.compare_main(["--compare", "--input", str(cur),
@@ -195,7 +201,7 @@ def test_compare_gate_clean_and_regressed_inprocess(
     report = json.loads(capsys.readouterr().out)
     assert rc == 0, report
     assert not report["regressions"]
-    assert len(report["ok"]) + len(report["improvements"]) == 5
+    assert len(report["ok"]) + len(report["improvements"]) == n
 
     regressed = tmp_path / "regressed.jsonl"
     _write_history(str(regressed),
@@ -204,7 +210,10 @@ def test_compare_gate_clean_and_regressed_inprocess(
                              "--history", str(regressed)])
     report = json.loads(capsys.readouterr().out)
     assert rc == 1
-    assert len(report["regressions"]) == 5
+    # every nonzero-valued record loses to its absurd synthetic best
+    # (a zero-valued per-op total cannot regress against base 0)
+    want = sum(1 for r in tiny_leg_records if r["value"] > 0)
+    assert len(report["regressions"]) == want
     # every regression names the synthetic best it lost to
     assert all(r["best_source"] == "synthetic-baseline"
                for r in report["regressions"])
@@ -222,9 +231,10 @@ def test_compare_gate_record_appends_stamped_records(
                              "--history", str(hist)])
     capsys.readouterr()
     assert rc == 0
+    n = len(tiny_leg_records)
     recs = bc.load_history(str(hist))
-    assert len(recs) == 10
-    appended = recs[5:]
+    assert len(recs) == 2 * n
+    appended = recs[n:]
     for rec in appended:
         assert not bc.validate_record(rec), rec
         assert rec["at_unix"] is not None

@@ -47,7 +47,20 @@ def test_call_site_scan_finds_the_known_core_metrics():
                      # joined the scanned idioms) and a dynamic
                      # per-bucket name
                      "verifier.queue.depth",
-                     "verifier.bucket.%d.drains"):
+                     "verifier.bucket.%d.drains",
+                     # ISSUE 9 close cockpit: the dynamic ledger.apply.*
+                     # prefixes (per-op attribution, native-bail
+                     # forensics, per-type state reads) and the bucket
+                     # layer's per-level telemetry must stay under the
+                     # drift guard
+                     "ledger.apply.op.%s.count",
+                     "ledger.apply.op.%s.seconds",
+                     "ledger.apply.native-bail.%s",
+                     "ledger.apply.state.lookup.%s",
+                     "ledger.apply.wall",
+                     "ledger.apply.prefetch.coverage-pct",
+                     "bucket.merge.level.%d",
+                     "bucket.level.%d.entries"):
         assert expected in names
 
 

@@ -123,6 +123,78 @@ def _num(p: dict, key: str):
     return v
 
 
+def apply_breakdown_records(ab: dict, platform: str, source: str,
+                            round_no=None, at_unix=None) -> List[dict]:
+    """Normalize an `apply_breakdown` block (ISSUE 9: the close
+    cockpit's per-op attribution) into direction-aware per-op records —
+    per-op cost regressions gate against bench/history.jsonl exactly
+    like every other metric."""
+    out: List[dict] = []
+    if not isinstance(ab, dict):
+        return out
+    v = _num(ab, "apply_wall_s")
+    if v is not None:
+        out.append(make_record("apply_wall_s", "s", v, platform, "lower",
+                               source, round_no, at_unix))
+    per_op = ab.get("per_op_ms")
+    if isinstance(per_op, dict):
+        for op, ms in sorted(per_op.items()):
+            if _num({"v": ms}, "v") is None:
+                continue
+            out.append(make_record("apply_op_%s_ms" % op, "ms", ms,
+                                   platform, "lower", source, round_no,
+                                   at_unix))
+    v = _num(ab, "other_ms")
+    if v is not None:
+        out.append(make_record("apply_other_ms", "ms", v, platform,
+                               "lower", source, round_no, at_unix))
+    return out
+
+
+def validate_apply_breakdown(ab, where: str = "") -> List[str]:
+    """Schema check for one `apply_breakdown` block (`check`/`--check`):
+    the per-op components + residual must exist, be finite, and sum to
+    the measured apply wall — a breakdown that silently stops adding up
+    is itself a regression."""
+    errs: List[str] = []
+    if not isinstance(ab, dict):
+        return ["%s: apply_breakdown is not an object: %r" % (where, ab)]
+    wall = _num(ab, "apply_wall_s")
+    if wall is None or wall < 0:
+        errs.append("%s: apply_breakdown.apply_wall_s must be a finite "
+                    "number >= 0, got %r" % (where, ab.get("apply_wall_s")))
+    per_op = ab.get("per_op_ms")
+    if not isinstance(per_op, dict):
+        errs.append("%s: apply_breakdown.per_op_ms must be an object"
+                    % where)
+        per_op = {}
+    for op, ms in per_op.items():
+        if not isinstance(op, str) or _num({"v": ms}, "v") is None:
+            errs.append("%s: apply_breakdown.per_op_ms[%r] must be a "
+                        "finite number, got %r" % (where, op, ms))
+    other = _num(ab, "other_ms")
+    if other is None:
+        errs.append("%s: apply_breakdown.other_ms must be a finite number"
+                    % where)
+    for key in ("closes", "bails", "state_reads"):
+        if not isinstance(ab.get(key), dict):
+            errs.append("%s: apply_breakdown.%s must be an object"
+                        % (where, key))
+    if wall is not None and other is not None and not errs:
+        total_ms = sum(v for v in per_op.values()
+                       if isinstance(v, (int, float))) + other
+        # per-op values are rounded to 1 µs in the artifact; allow the
+        # accumulated rounding slack plus a 0.1% relative band
+        tol = max(1.0, 1e-3 * wall * 1e3)
+        if abs(total_ms - wall * 1e3) > tol:
+            errs.append(
+                "%s: apply_breakdown parts sum to %.3f ms but "
+                "apply_wall_s is %.3f ms — the breakdown no longer "
+                "accounts for the measured wall" % (where, total_ms,
+                                                    wall * 1e3))
+    return errs
+
+
 def _replay_leg_records(leg: dict, platform: str, source: str,
                         round_no, at_unix) -> List[dict]:
     out = []
@@ -136,6 +208,8 @@ def _replay_leg_records(leg: dict, platform: str, source: str,
         if v is not None:
             out.append(make_record(metric, unit, v, platform, direction,
                                    source, round_no, at_unix))
+    out.extend(apply_breakdown_records(leg.get("apply_breakdown"),
+                                       platform, source, round_no, at_unix))
     return out
 
 
@@ -303,10 +377,31 @@ def check_artifact(path: str) -> List[str]:
             not math.isfinite(v):
         errs.append("%s: payload field 'value' must be a finite number, "
                     "got %r" % (name, v))
+    # every apply_breakdown anywhere in the payload (replay legs,
+    # replay_apply legs, nested last_device blocks) must schema-validate
+    # and sum to its measured apply wall
+    _walk_breakdowns(payload, name, errs)
     # every record the normalizer derives must itself validate
     for rec in records_from_bench(blob, name):
         errs.extend(validate_record(rec, name))
     return errs
+
+
+def _walk_breakdowns(blob, name: str, errs: List[str],
+                     depth: int = 0) -> None:
+    if depth > 6:
+        return
+    if isinstance(blob, list):
+        for v in blob:
+            _walk_breakdowns(v, name, errs, depth + 1)
+        return
+    if not isinstance(blob, dict):
+        return
+    if "apply_breakdown" in blob:
+        errs.extend(validate_apply_breakdown(blob["apply_breakdown"], name))
+    for v in blob.values():
+        if isinstance(v, (dict, list)):
+            _walk_breakdowns(v, name, errs, depth + 1)
 
 
 def _check_direction_consistency(records, name: str) -> List[str]:
