@@ -462,29 +462,51 @@ def chaos_smoke(n_ledgers: int = 30, txs_per_ledger: int = 10) -> dict:
 
 def fleet_bench(n_nodes: int = 3, n_ledgers: int = 12) -> dict:
     """`bench.py --fleet`: the multi-node leg (ISSUE 4;
-    docs/observability.md#fleet-view). Runs an n-node loopback
-    simulation with per-node tracing on, closes >= n_ledgers ledgers,
+    docs/observability.md#fleet-view). Runs an n-node simulation over
+    the REAL overlay stack (Peer handshake/HMAC/flood — the wire
+    cockpit needs actual frames to account, ISSUE 10) with per-node
+    tracing on, closes >= n_ledgers ledgers under a light payment load,
     and reports the fleet aggregate — slot-latency p50/p95, externalize
-    skew across nodes, straggler counts — from the merged slot
-    timelines. Pure Python (no jax import): safe to run inline."""
+    skew, per-slot bandwidth totals, flood duplication ratio and
+    tx-latency p50/p95 — from the merged slot timelines + overlay
+    exports. Pure Python (no jax import): safe to run inline."""
     from stellar_core_tpu.simulation import topologies
+    from stellar_core_tpu.simulation.simulation import Simulation
+    from stellar_core_tpu.testing import AppLedgerAdapter
     from stellar_core_tpu.util import rnd
 
     rnd.reseed(0xF1EE7)
     sim = topologies.core(
         n_nodes, max(2, (n_nodes * 2 + 1) // 3),
-        cfg_tweak=lambda c: setattr(c, "TRACE_ENABLED", True))
+        mode=Simulation.OVER_PEERS,
+        cfg_tweak=lambda c: (setattr(c, "TRACE_ENABLED", True),
+                             setattr(c, "DATABASE", "sqlite3://:memory:")))
     sim.start_all_nodes()
+    first = next(iter(sim.nodes.values())).app
+    sim.crank_until(lambda: sim.have_all_externalized(2), 60000)
+    # payment load through the real overlay: the tx-lifecycle funnel
+    # measures submit→applied end to end
+    ad = AppLedgerAdapter(first)
+    root = ad.root_account()
+    base_seq = ad.seq_num(root.account_id)
+    for i in range(4):
+        first.submit_transaction(root.tx(
+            [root.op_payment(root.account_id, 1 + i)],
+            seq=base_seq + 1 + i))
     target = 1 + n_ledgers   # genesis is seq 1; n_ledgers consensus closes
     ok = sim.crank_until(lambda: sim.have_all_externalized(target),
                          200000)
     agg = sim.fleet()     # one aggregation feeds both views
     stats = agg.fleet_stats()
     trace = agg.merged_chrome_trace()
+    overlay = agg.overlay_breakdown()
     summary = stats["summary"]
     out = {
         "metric": "fleet_slot_latency",
         "unit": "ms",
+        # stable gating key for records derived from this payload (the
+        # overlay_breakdown normalizer keys per metric+platform)
+        "platform": "fleet-sim",
         "nodes": n_nodes,
         "ledgers_closed": min(
             n.app.ledger_manager.last_closed_ledger_num()
@@ -505,6 +527,19 @@ def fleet_bench(n_nodes: int = 3, n_ledgers: int = 12) -> dict:
             "dropped_spans": trace["dropped_spans"],
         },
     }
+    # wire cockpit (ISSUE 10): fleet bandwidth totals + tx-latency
+    # percentiles ride in the fleet block, the full overlay_breakdown
+    # is schema-validated by tools/bench_compare.py
+    if overlay is not None:
+        out["overlay_breakdown"] = overlay
+        out["fleet"]["recv_bytes_total"] = overlay["recv_bytes"]
+        out["fleet"]["send_bytes_total"] = overlay["send_bytes"]
+        out["fleet"]["flood_duplication_ratio"] = \
+            overlay["flood"]["duplication_ratio"]
+        out["fleet"]["tx_latency_p50_ms"] = \
+            overlay["tx_latency_ms"]["p50"]
+        out["fleet"]["tx_latency_p95_ms"] = \
+            overlay["tx_latency_ms"]["p95"]
     sim.stop_all_nodes()
     return out
 

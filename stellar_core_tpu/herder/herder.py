@@ -268,10 +268,20 @@ class Herder:
         self.scp = SCP(self.scp_driver, cfg.node_id(),
                        cfg.NODE_IS_VALIDATOR, cfg.QUORUM_SET)
         self.pending = PendingEnvelopes(self)
+        # tx-lifecycle cockpit (ISSUE 10): submit → queue → include →
+        # externalize → apply latency attribution on the app clock,
+        # wired before the queue so eviction/expiry outcomes land in the
+        # same funnel (docs/observability.md#overlay-cockpit)
+        from .tx_lifecycle import TxLifecycle
+        self.tx_lifecycle = TxLifecycle(
+            metrics=getattr(app, "metrics", None),
+            tracer=getattr(app, "tracer", None),
+            now_fn=app.clock.now)
         self.tx_queue = TransactionQueue(
             app.ledger_manager, cfg.TRANSACTION_QUEUE_PENDING_DEPTH,
             cfg.TRANSACTION_QUEUE_BAN_DEPTH, cfg.POOL_LEDGER_MULTIPLIER,
-            self.verifier, metrics=getattr(app, "metrics", None))
+            self.verifier, metrics=getattr(app, "metrics", None),
+            lifecycle=self.tx_lifecycle)
         self.upgrades = Upgrades()
         self.state = HerderState.HERDER_SYNCING_STATE
         self.tracking_slot: Optional[int] = None
@@ -571,7 +581,16 @@ class Herder:
         m = self._metrics()
         if m is not None:
             m.new_meter("herder.tx.received").mark()
+        # lifecycle stamp: submit at entry, queue on admission — the
+        # submit→queue stage is the admission (signature-check) cost. A
+        # re-flooded duplicate must not clobber the original's stamps.
+        h = frame.full_hash()
+        fresh = self.tx_lifecycle.submit(h)
         status = self.tx_queue.try_add(frame)
+        if status == TxQueueResult.ADD_STATUS_PENDING:
+            self.tx_lifecycle.queued(h)
+        elif fresh and status != TxQueueResult.ADD_STATUS_DUPLICATE:
+            self.tx_lifecycle.outcome(h, "rejected")
         if m is not None and status == 0:
             m.new_meter("herder.tx.accepted").mark()
         return status
@@ -613,6 +632,13 @@ class Herder:
         if not self.pending.begin_verify(envelope, eh):
             # duplicate (processed / discarded / already verifying)
             return SCP.EnvelopeState.INVALID
+        # envelope pipeline latency (ISSUE 10): receive → verify →
+        # herder process, app-clock stamped, attributed to the verify
+        # backend — the envelope-verify cost ROADMAP item 3's BLS
+        # tradeoff study needs on the same axis as bandwidth
+        ostats = getattr(getattr(self.app, "overlay_manager", None),
+                         "stats", None)
+        t_recv = self.app.clock.now()
         fut = self.verifier.enqueue(
             st.nodeID, envelope.signature,
             self.scp_driver._envelope_sign_bytes(st))
@@ -620,7 +646,13 @@ class Herder:
         def done(ok: bool) -> None:
             if not ok:
                 log.debug("bad envelope signature")
+            t_verified = self.app.clock.now()
             self.pending.finish_verify(envelope, ok, eh)
+            if ostats is not None:
+                ostats.record_envelope(
+                    t_verified - t_recv,
+                    self.app.clock.now() - t_verified,
+                    getattr(self.verifier, "name", "none"), ok)
             if on_verified is not None:
                 on_verified(ok)
 
@@ -808,6 +840,10 @@ class Herder:
             tsp.set_tag("txs", len(txset.frames))
             h = txset.get_contents_hash()
             self.pending.add_tx_set(h, txset)
+            # lifecycle stamp: txset inclusion at nomination (the slot's
+            # externalized set may differ; missed stages backfill)
+            self.tx_lifecycle.included(
+                [f.full_hash() for f in txset.frames])
 
         close_time = max(self.app.clock.system_now(),
                          lcl.scpValue.closeTime + 1)
@@ -875,9 +911,21 @@ class Herder:
         self.persist_latest_scp_state(slot_index)
         self.save_scp_history(slot_index)
 
+        # lifecycle stamps around the close: externalize before, apply
+        # after the ledger manager returns — externalize→apply is the
+        # local close cost the funnel separates from consensus latency
+        tx_hashes = [f.full_hash() for f in txset.frames]
+        self.tx_lifecycle.externalized(tx_hashes)
         lm = self.app.ledger_manager
         lcd = LedgerCloseData(slot_index, txset, sv)
         lm.value_externalized(lcd)
+        if lm.last_closed_ledger_num() >= slot_index:
+            self.tx_lifecycle.applied(tx_hashes, slot_index)
+        else:
+            # buffered into a catchup gap: the close happens later via
+            # replay — don't fabricate an apply stamp now
+            for h in tx_hashes:
+                self.tx_lifecycle.outcome(h, "deferred")
 
         # disarm upgrade parameters that just externalized or whose
         # scheduled time expired (reference HerderImpl::valueExternalized →

@@ -34,7 +34,7 @@ class TransactionQueue:
 
     def __init__(self, ledger_access, pending_depth: int = 4,
                  ban_depth: int = 10, pool_ledger_multiplier: int = 2,
-                 verifier=None, metrics=None) -> None:
+                 verifier=None, metrics=None, lifecycle=None) -> None:
         """ledger_access: object exposing .ltx_root() and .header()."""
         self._ledger = ledger_access
         self.pending_depth = pending_depth
@@ -42,6 +42,9 @@ class TransactionQueue:
         self.pool_multiplier = pool_ledger_multiplier
         self.verifier = verifier
         self.metrics = metrics
+        # tx-lifecycle cockpit (ISSUE 10): evict/expire/ban/replace
+        # outcomes complete the submit→apply funnel
+        self.lifecycle = lifecycle
         # account -> list[frame] sorted by seq; ages are PER ACCOUNT
         # (reference AccountState.mAge: ledgers since the account last
         # had a tx applied — the whole chain expires together)
@@ -56,6 +59,10 @@ class TransactionQueue:
     def _note_add(self, frame) -> None:
         k = frame.fee_account_id().key_bytes
         self._fee_totals[k] = self._fee_totals.get(k, 0) + frame.fee_bid
+
+    def _note_outcome(self, frame, kind: str) -> None:
+        if self.lifecycle is not None:
+            self.lifecycle.outcome(frame.full_hash(), kind)
 
     def _note_remove(self, frame) -> None:
         k = frame.fee_account_id().key_bytes
@@ -160,6 +167,7 @@ class TransactionQueue:
             # tail, but later txs still chain off the replacement
             self._banned[0].add(old.full_hash())
             self._note_remove(old)
+            self._note_outcome(old, "replaced")
             chain[replace_idx] = frame
         else:
             chain.append(frame)
@@ -218,6 +226,7 @@ class TransactionQueue:
             assert popped is tail, "pool mutated between select and evict"
             self._known_hashes.pop(popped.full_hash(), None)
             self._note_remove(popped)
+            self._note_outcome(popped, "evicted")
             if m is not None:
                 m.new_meter("herder.tx-queue.surge-evicted").mark()
             log.debug("surge-evicted tx %s (fee rate %.1f < %.1f)",
@@ -251,6 +260,10 @@ class TransactionQueue:
                     self._note_remove(g)
                     if g.full_hash() != h:
                         self._known_hashes.pop(g.full_hash(), None)
+                        # a chain-mate invalidated by the applied tx's
+                        # seq advance (the applied tx itself finalizes
+                        # via TxLifecycle.applied)
+                        self._note_outcome(g, "dropped")
             if new_chain:
                 self._pending[acc] = new_chain
                 # the account saw a tx applied this ledger: age resets
@@ -272,6 +285,7 @@ class TransactionQueue:
                     self._banned[0].add(f.full_hash())
                     self._known_hashes.pop(f.full_hash(), None)
                     self._note_remove(f)
+                    self._note_outcome(f, "expired")
                 self._pending.pop(acc, None)
                 self._ages.pop(acc, None)
             else:
@@ -301,6 +315,7 @@ class TransactionQueue:
                 self._banned[0].add(f.full_hash())
                 self._known_hashes.pop(f.full_hash(), None)
                 self._note_remove(f)
+                self._note_outcome(f, "banned")
             if cut:
                 self._pending[acc] = chain[:cut]
             else:

@@ -142,8 +142,12 @@ class CommandHandler:
         if prefix:
             out = {k: v2 for k, v2 in out.items() if k.startswith(prefix)}
         if params.get("format") == "prometheus":
-            from ..util.metrics import render_prometheus
-            return render_prometheus(out)
+            # HELP text sourced from the docs/metrics.md catalog (the
+            # M1-guarded one), falling back to the metric name — real
+            # Prometheus/Grafana setups get self-describing scrapes
+            from ..util.metrics import load_help_catalog, render_prometheus
+            return render_prometheus(out,
+                                     help_catalog=load_help_catalog())
         return out
 
     def cmd_verifier(self, params) -> dict:
@@ -200,6 +204,49 @@ class CommandHandler:
             raise CommandParamError(
                 "parameter 'action' must be status|reset, got %r" % action)
         return stats.to_json()
+
+    def cmd_overlaystats(self, params) -> dict:
+        """Wire cockpit (ISSUE 10 tentpole;
+        docs/observability.md#overlay-cockpit): the overlay's
+        operational state in one JSON blob — per-message-type
+        send/recv counters and byte totals, per-peer top-K bandwidth
+        attribution, flood dedup (unique vs duplicate receipts +
+        duplication ratio, the O(n²) flood waste), send-queue pressure,
+        envelope pipeline latency by verify backend, and the
+        tx-lifecycle funnel (submit→queue→include→externalize→apply
+        stage latencies whose stages sum to total by construction,
+        plus per-tx outcomes). `overlaystats?action=reset` zeroes the
+        cumulative aggregates (registry metrics keep their monotonic
+        histories). The same data is scrapeable as `sct_overlay_*` /
+        `sct_herder_tx_*` series via `metrics?format=prometheus`; the
+        `fleet` field is the compact shape util/fleet.py aggregates."""
+        om = self.app.overlay_manager
+        stats = getattr(om, "stats", None) if om is not None else None
+        lc = getattr(self.app.herder, "tx_lifecycle", None)
+        action = params.get("action", "status")
+        if action not in ("status", "reset"):
+            raise CommandParamError(
+                "parameter 'action' must be status|reset, got %r" % action)
+        if action == "reset":
+            if stats is not None:
+                stats.reset()
+            if lc is not None:
+                lc.reset()
+        if stats is not None and om is not None and \
+                hasattr(om, "send_queue_depth"):
+            stats.set_queue_depth(*om.send_queue_depth())
+        out: dict = {
+            "overlay": stats.to_json() if stats is not None else None,
+            "tx_lifecycle": lc.to_json() if lc is not None else None,
+            "fleet": {
+                "overlay": stats.fleet_json()
+                if stats is not None else None,
+                "tx": lc.fleet_json() if lc is not None else None,
+            },
+        }
+        if action == "reset":
+            out["status"] = "reset"
+        return out
 
     def cmd_trace(self, params) -> dict:
         """Span-tracer control + export (ISSUE 2 tentpole):

@@ -18,18 +18,23 @@ log = get_logger("Overlay")
 
 
 class _FloodRecord:
-    __slots__ = ("ledger_seq", "message", "peers_told")
+    __slots__ = ("ledger_seq", "message", "peers_told", "dupes")
 
     def __init__(self, ledger_seq: int, message: StellarMessage) -> None:
         self.ledger_seq = ledger_seq
         self.message = message
         self.peers_told: Set[str] = set()
+        self.dupes = 0        # duplicate receipts (flood-layer waste)
 
 
 class Floodgate:
     def __init__(self) -> None:
         self._map: Dict[bytes, _FloodRecord] = {}
         self._shutting_down = False
+        # wire cockpit (ISSUE 10): dedup accounting — unique vs
+        # duplicate receipts feed the flood duplication ratio, broadcast
+        # fanout feeds its histogram (installed by OverlayManager)
+        self.stats = None
 
     @staticmethod
     def msg_id(msg: StellarMessage) -> bytes:
@@ -47,8 +52,13 @@ class Floodgate:
             rec = _FloodRecord(ledger_seq, msg)
             self._map[h] = rec
             rec.peers_told.add(from_peer_id)
+            if self.stats is not None:
+                self.stats.record_flood(unique=True)
             return True
         rec.peers_told.add(from_peer_id)
+        rec.dupes += 1
+        if self.stats is not None:
+            self.stats.record_flood(unique=False)
         return False
 
     def broadcast(self, msg: StellarMessage, force: bool, peers: Dict,
@@ -69,6 +79,8 @@ class Floodgate:
             peer.send_message(msg)
             rec.peers_told.add(pid)
             n += 1
+        if self.stats is not None:
+            self.stats.record_broadcast(n)
         return n
 
     def forget_record(self, msg: StellarMessage) -> None:

@@ -77,6 +77,26 @@ class LoadManager:
         c.bytes_send += sent
         c.bytes_recv += received
 
+    def record_sent(self, peer_key: bytes, nbytes: int) -> None:
+        """One outbound message to `peer_key` (Peer.send_message) — the
+        send-path twin of the receive accounting, so the cost vector and
+        `_worst_peer_key` see both directions (ISSUE 10 satellite)."""
+        c = self.peer_costs(peer_key)
+        c.bytes_send += nbytes
+        c.msgs_send += 1
+
+    def totals(self) -> dict:
+        """Both-direction byte/message totals across every tracked peer
+        (SurveyManager.get_stats + the fleet aggregate surface these)."""
+        out = {"bytes_send": 0, "bytes_recv": 0,
+               "msgs_send": 0, "msgs_recv": 0}
+        for c in self._costs.values():
+            out["bytes_send"] += c.bytes_send
+            out["bytes_recv"] += c.bytes_recv
+            out["msgs_send"] += c.msgs_send
+            out["msgs_recv"] += c.msgs_recv
+        return out
+
     # -- shedding ------------------------------------------------------------
     def _worst_peer_key(self) -> Optional[bytes]:
         worst, worst_cost = None, -1.0

@@ -35,7 +35,18 @@ class OverlayManager:
         self.peer_auth = PeerAuth(app)
         self.peer_manager = PeerManager(app)
         self.ban_manager = BanManager(app)
+        # wire cockpit (ISSUE 10): ONE aggregation shared by Peer frame
+        # accounting, Floodgate dedup, the Herder's envelope pipeline
+        # and the tick's queue-depth gauge — constructed before any peer
+        # so the first frame is already attributed
+        # (docs/observability.md#overlay-cockpit)
+        from .overlay_stats import OverlayStats
+        self.stats = OverlayStats(
+            metrics=getattr(app, "metrics", None),
+            tracer=getattr(app, "tracer", None),
+            now_fn=app.clock.now)
         self.floodgate = Floodgate()
+        self.floodgate.stats = self.stats
         from .flood_control import FloodControl
         self.flood_control = FloodControl(app)
         # hash-keyed peer registry: id_key (nodeid xdr) -> Peer
@@ -151,10 +162,30 @@ class OverlayManager:
                     continue
                 self.connect_to(rec.host, rec.port)
         self.load_manager.maybe_shed_excess_load(self)
+        # send-queue pressure gauges: total queued-but-unsent bytes and
+        # how many peers have a backlog (TCP transports; loopback pipes
+        # have no queue and report 0)
+        total, backlogged = self.send_queue_depth()
+        self.stats.set_queue_depth(total, backlogged)
         self._arm_tick()
 
     def num_connections(self) -> int:
         return len(self.pending_peers) + len(self.authenticated_peers)
+
+    def send_queue_depth(self) -> tuple:
+        """(total queued-but-unsent bytes, peers with a backlog) across
+        every connection — the cockpit's send-queue pressure signal."""
+        total = 0
+        backlogged = 0
+        for p in list(self.authenticated_peers.values()) + \
+                list(self.pending_peers):
+            t = p.transport
+            qb = getattr(t, "_wqueue_bytes",
+                         getattr(getattr(t, "inner", None),
+                                 "_wqueue_bytes", 0)) or 0
+            total += qb
+            backlogged += qb > 0
+        return total, backlogged
 
     # -- connections ---------------------------------------------------------
     def connect_to(self, host: str, port: int) -> Optional[Peer]:
@@ -361,6 +392,9 @@ class OverlayManager:
         self.floodgate.forget_record(msg)
 
     def ledger_closed(self, ledger_seq: int) -> None:
+        # per-slot bandwidth attribution: bytes moved since the previous
+        # close belong to this slot (fleet view sums them across nodes)
+        self.stats.slot_closed(ledger_seq)
         self.floodgate.clear_below(ledger_seq)
         self.flood_control.ledger_closed()
         self.tx_set_fetcher.stop_fetching_below(ledger_seq)

@@ -66,6 +66,13 @@ class Peer:
         self.connected_at = app.clock.now()
         self.dropped = False
         self.ever_authenticated = False
+        # wire cockpit (ISSUE 10): per-message-type byte accounting on
+        # both directions (docs/observability.md#overlay-cockpit)
+        self._stats = getattr(overlay, "stats", None)
+        # the last authenticated frame, for MAC-layer duplicate
+        # detection (ChaosTransport overlay.duplicate injection)
+        self._last_frame_seq: Optional[int] = None
+        self._last_frame_mac = b""
         transport.on_frame = self._on_frame
         transport.on_closed = self._on_closed
 
@@ -127,6 +134,14 @@ class Peer:
         self.bytes_written += len(raw)
         self.messages_written += 1
         self.last_write = self.app.clock.now()
+        key = self.peer_id.key_bytes if self.peer_id is not None else None
+        if self._stats is not None:
+            self._stats.record_send(t, len(raw), key)
+        if self.peer_id is not None:
+            # sent bytes feed the same per-peer cost vector the receive
+            # path already feeds (reference LoadManager symmetry)
+            self.overlay.load_manager.record_sent(
+                self.peer_id.to_xdr(), len(raw))
         self.transport.send_frame(raw)
 
     def send_hello(self) -> None:
@@ -167,11 +182,19 @@ class Peer:
         try:
             am = AuthenticatedMessage.from_xdr(raw)
         except Exception:
+            if self._stats is not None:
+                self._stats.record_recv(
+                    None, len(raw),
+                    self.peer_id.key_bytes if self.peer_id else None)
             self.drop("malformed frame")
             return
         v0 = am.value
         msg = v0.message
         t = msg.disc
+        if self._stats is not None:
+            self._stats.record_recv(
+                t, len(raw),
+                self.peer_id.key_bytes if self.peer_id else None)
         if t not in (MessageType.HELLO, MessageType.ERROR_MSG):
             if self.state < PeerState.GOT_HELLO:
                 self.drop("message before handshake")
@@ -180,9 +203,25 @@ class Peer:
             data = struct.pack(">Q", v0.sequence) + msg.to_xdr()
             if v0.sequence != self.recv_mac_seq or not hmac_sha256_verify(
                     self.recv_mac_key, data, v0.mac):
+                # a byte-identical replay of the PREVIOUS frame is a
+                # transport-level duplicate (ChaosTransport
+                # overlay.duplicate, or a duplicating network) — count
+                # it into the duplication ratio and drop the FRAME, not
+                # the link (the MAC chain proves it's a copy, not a
+                # forgery)
+                if v0.sequence == self._last_frame_seq and \
+                        v0.mac == self._last_frame_mac and \
+                        hmac_sha256_verify(self.recv_mac_key, data, v0.mac):
+                    if self._stats is not None:
+                        self._stats.record_duplicate_frame(
+                            t, flooded=t in (MessageType.TRANSACTION,
+                                             MessageType.SCP_MESSAGE))
+                    return
                 self.drop("unexpected MAC/sequence",
                           send_error=ErrorCode.ERR_AUTH)
                 return
+            self._last_frame_seq = v0.sequence
+            self._last_frame_mac = v0.mac
             self.recv_mac_seq += 1
         try:
             if self.peer_id is not None:

@@ -252,8 +252,15 @@ class SurveyManager:
         """Compact survey health for the fleet aggregate (util/fleet.py):
         enough to see, across N nodes at once, who surveyed whom and who
         dropped responses — without shipping full topologies."""
-        return {"running": self.running,
-                "surveyed": len(self._surveyed),
-                "results": len(self.results),
-                "backlog": len(self._backlog),
-                "bad_responses": self.bad_responses}
+        out = {"running": self.running,
+               "surveyed": len(self._surveyed),
+               "results": len(self.results),
+               "backlog": len(self._backlog),
+               "bad_responses": self.bad_responses}
+        # both-direction bandwidth totals (LoadManager now accounts the
+        # send path too — ISSUE 10 satellite): the fleet aggregate's
+        # survey block carries who moved how many bytes each way
+        lm = getattr(self.overlay, "load_manager", None)
+        if lm is not None:
+            out.update(lm.totals())
+        return out

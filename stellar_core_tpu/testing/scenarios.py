@@ -121,6 +121,37 @@ def _common_records(name: str, fleet: dict, source: str) -> List[dict]:
     ]
 
 
+def _overlay_records(name: str, ob: Optional[dict],
+                     source: str) -> List[dict]:
+    """Direction-aware records from an `overlay_breakdown` (ISSUE 10):
+    flood duplication ratio (lower = less O(n²) waste) and end-to-end
+    tx latency p50/p95. Delegated to tools/bench_compare.py so the
+    emission rules (skip idle-run zeros) live in one place."""
+    if ob is None:
+        return []
+    return _bench_compare().overlay_breakdown_records(
+        ob, "scenario-%s" % name, source)
+
+
+def _bench_compare():
+    """tools/bench_compare.py as a module WITHOUT touching sys.path
+    (library code must not graft the repo root onto the process-wide
+    import path); loaded once by file location, cached in sys.modules."""
+    import importlib.util
+    import sys
+    mod = sys.modules.get("_sct_tools_bench_compare")
+    if mod is not None:
+        return mod
+    path = os.path.join(os.path.dirname(os.path.dirname(os.path.dirname(
+        os.path.abspath(__file__)))), "tools", "bench_compare.py")
+    spec = importlib.util.spec_from_file_location(
+        "_sct_tools_bench_compare", path)
+    mod = importlib.util.module_from_spec(spec)
+    sys.modules["_sct_tools_bench_compare"] = mod
+    spec.loader.exec_module(mod)
+    return mod
+
+
 # --------------------------------------------------------------------------
 # churn: kill / restart under load, rejoin via recovery + archive catchup
 
@@ -353,6 +384,17 @@ def run_flood(seed: int, scale: str, workdir: str) -> dict:
             return all(a.ledger_manager.last_closed_ledger_num() >= seq
                        for a in honest_apps)
         _crank_until(sim, lambda: honest_at(2), 60000, "flood-leg start")
+        # honest payment traffic through the real overlay: the wire
+        # cockpit's tx-lifecycle funnel measures submit→applied latency
+        # under flood vs baseline (ISSUE 10)
+        ad = AppLedgerAdapter(honest_apps[0])
+        root = ad.root_account()
+        base_seq = ad.seq_num(root.account_id)
+        for i in range(3):
+            st = honest_apps[0].submit_transaction(root.tx(
+                [root.op_payment(root.account_id, 1 + i)],
+                seq=base_seq + 1 + i))
+            assert st == 0, "honest payment rejected at submit"
         base = max(a.ledger_manager.last_closed_ledger_num()
                    for a in honest_apps)
 
@@ -392,14 +434,22 @@ def run_flood(seed: int, scale: str, workdir: str) -> dict:
         _crank_until(sim, lambda: honest_at(base + slots), 200000,
                      "honest liveness%s" % (" under flood"
                                             if flood_on else ""))
+        # the honest payments actually completed the funnel
+        def payments_applied() -> bool:
+            lc = honest_apps[0].herder.tx_lifecycle
+            return lc.fleet_json()["count"] >= 3
+        _crank_until(sim, payments_applied, 60000,
+                     "honest payments applied")
         _assert_header_equality(honest_apps, min_common=2)
         from ..util.fleet import FleetAggregator
         agg = FleetAggregator()
         for n in honest:
             agg.add_app(n.name, n.app)
         fleet = _fleet_block(agg)
+        overlay = agg.overlay_breakdown()
         sim.stop_all_nodes()
-        return {"fleet": fleet, "flood": flood_stats}
+        return {"fleet": fleet, "flood": flood_stats,
+                "overlay_breakdown": overlay}
 
     off = leg(False)
     on = leg(True)
@@ -409,6 +459,13 @@ def run_flood(seed: int, scale: str, workdir: str) -> dict:
     records = _common_records("flood", on["fleet"], source)
     records.append(_record("scenario_flood_latency_ratio", "x", ratio,
                            "scenario-flood", "lower", source))
+    # wire-cockpit gates (ISSUE 10): flood duplication ratio + honest
+    # tx latency under flood
+    records.extend(_overlay_records("flood", on["overlay_breakdown"],
+                                    source))
+    assert on["overlay_breakdown"] is not None
+    assert on["overlay_breakdown"]["flood"]["unique"] > 0
+    assert on["overlay_breakdown"]["tx_latency_ms"]["count"] >= 3
     return {
         "metric": "scenario_flood", "unit": "ms",
         "value": on["fleet"]["slot_latency_p95_ms"],
@@ -427,6 +484,8 @@ def run_flood(seed: int, scale: str, workdir: str) -> dict:
         },
         "fleet": on["fleet"],
         "baseline_fleet": off["fleet"],
+        "overlay_breakdown": on["overlay_breakdown"],
+        "baseline_overlay_breakdown": off["overlay_breakdown"],
         "records": records,
     }
 
@@ -633,11 +692,20 @@ def run_surge(seed: int, scale: str, workdir: str) -> dict:
     assert q.size_ops() <= cap_ops
     common = _assert_header_equality([v.app for v in sim.nodes.values()],
                                      min_common=4)
-    fleet = _fleet_block(sim.fleet())
+    agg = sim.fleet()
+    fleet = _fleet_block(agg)
+    # loopback mode has no wire stats (the overlay shim), but the
+    # tx-lifecycle half still measures the surge's submit→apply funnel
+    # incl. the evictions the fee-market defense performed (ISSUE 10)
+    overlay = agg.overlay_breakdown()
     sim.stop_all_nodes()
 
     source = "bench.py --scenario surge"
     records = _common_records("surge", fleet, source)
+    records.extend(_overlay_records("surge", overlay, source))
+    assert overlay is not None
+    assert overlay["tx_latency_ms"]["count"] > 0
+    assert overlay["outcomes"].get("evicted", 0) >= n_high
     return {
         "metric": "scenario_surge", "unit": "ms",
         "value": fleet["slot_latency_p95_ms"],
@@ -655,6 +723,7 @@ def run_surge(seed: int, scale: str, workdir: str) -> dict:
             "common_heights_hash_equal": common,
         },
         "fleet": fleet,
+        "overlay_breakdown": overlay,
         "records": records,
     }
 

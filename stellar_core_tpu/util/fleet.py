@@ -57,16 +57,29 @@ class FleetAggregator:
     # -- intake --------------------------------------------------------------
     def add_app(self, name: str, app) -> None:
         survey = None
-        sm = getattr(getattr(app, "overlay_manager", None),
-                     "survey_manager", None)
+        om = getattr(app, "overlay_manager", None)
+        sm = getattr(om, "survey_manager", None)
         if sm is not None:
             survey = sm.get_stats()
+        # wire cockpit (ISSUE 10): per-node overlay bandwidth + tx
+        # lifecycle in the same compact shape `overlaystats` serves
+        # under its "fleet" field, so add_http stores identical input
+        ostats = getattr(om, "stats", None)
+        lc = getattr(getattr(app, "herder", None), "tx_lifecycle", None)
+        overlay = None
+        if ostats is not None or lc is not None:
+            overlay = {
+                "overlay": ostats.fleet_json()
+                if ostats is not None else None,
+                "tx": lc.fleet_json() if lc is not None else None,
+            }
         self.nodes.append({
             "name": name,
             "node_id": app.config.node_id().key_bytes.hex(),
             "trace": app.tracer.to_chrome_trace(),
             "timeline": app.slot_timeline.to_json(),
             "survey": survey,
+            "overlay": overlay,
         })
 
     def add_http(self, base_url: str, name: Optional[str] = None,
@@ -97,6 +110,9 @@ class FleetAggregator:
             "trace": get("/trace") or {"traceEvents": []},
             "timeline": tl,
             "survey": survey,
+            # same compact shape as add_app stores (the endpoint carries
+            # it under "fleet" precisely for this intake path)
+            "overlay": (get("/overlaystats") or {}).get("fleet"),
         })
 
     # -- cross-host alignment ------------------------------------------------
@@ -270,6 +286,20 @@ class FleetAggregator:
                 }
             if entry:
                 slots[str(slot)] = entry
+        # per-slot fleet bandwidth: sum each node's per-slot byte deltas
+        # (ISSUE 10 — the measurement ROADMAP item 3's 50-100-node
+        # envelope-cost study reads per slot)
+        for node in self.nodes:
+            ov = (node.get("overlay") or {}).get("overlay") or {}
+            for slot_str, delta in (ov.get("per_slot") or {}).items():
+                entry = slots.get(slot_str)
+                if entry is None:
+                    continue
+                bw = entry.setdefault(
+                    "bandwidth", {"recv_bytes": 0, "send_bytes": 0,
+                                  "recv_msgs": 0, "send_msgs": 0})
+                for k in bw:
+                    bw[k] += delta.get(k, 0)
         out = {
             "nodes": [n["name"] for n in self.nodes],
             "slots": slots,
@@ -290,4 +320,73 @@ class FleetAggregator:
                    if n.get("survey")}
         if surveys:
             out["survey"] = surveys
+        ob = self.overlay_breakdown()
+        if ob is not None:
+            out["summary"]["recv_bytes_total"] = ob["recv_bytes"]
+            out["summary"]["send_bytes_total"] = ob["send_bytes"]
+            out["summary"]["flood_duplication_ratio"] = \
+                ob["flood"]["duplication_ratio"]
+            out["summary"]["tx_latency_p50_ms"] = ob["tx_latency_ms"]["p50"]
+            out["summary"]["tx_latency_p95_ms"] = ob["tx_latency_ms"]["p95"]
         return out
+
+    # -- overlay breakdown (ISSUE 10) ----------------------------------------
+    def overlay_breakdown(self) -> Optional[dict]:
+        """Fleet-wide `overlay_breakdown` block for bench/scenario
+        artifacts (normalized by tools/bench_compare.py): summed
+        bandwidth totals, flood dedup (duplication ratio = duplicate
+        receipts / unique flooded messages — the O(n²) waste), and the
+        tx-lifecycle latency whose stage seconds sum to total_seconds
+        by construction. Tx percentiles are computed over the MERGED
+        per-node total-latency reservoirs, not merged per-node
+        percentiles. None when no node exported overlay data."""
+        totals = {"recv_bytes": 0, "send_bytes": 0,
+                  "recv_msgs": 0, "send_msgs": 0}
+        unique = dupes = 0
+        stage: Dict[str, float] = {}
+        total_s = 0.0
+        count = 0
+        samples: List[float] = []
+        outcomes: Dict[str, int] = {}
+        any_data = False
+        for node in self.nodes:
+            data = node.get("overlay")
+            if not data:
+                continue
+            ov = data.get("overlay")
+            if ov:
+                any_data = True
+                for k in totals:
+                    totals[k] += (ov.get("totals") or {}).get(k, 0)
+                fl = ov.get("flood") or {}
+                unique += fl.get("unique", 0)
+                dupes += fl.get("duplicates", 0)
+            tx = data.get("tx")
+            if tx:
+                any_data = True
+                count += tx.get("count", 0)
+                total_s += tx.get("total_seconds", 0.0)
+                for s, v in (tx.get("stage_seconds") or {}).items():
+                    stage[s] = stage.get(s, 0.0) + v
+                samples.extend(tx.get("samples_ms") or ())
+                for k, v in (tx.get("outcomes") or {}).items():
+                    outcomes[k] = outcomes.get(k, 0) + v
+        if not any_data:
+            return None
+        return {
+            **totals,
+            "flood": {
+                "unique": unique, "duplicates": dupes,
+                "duplication_ratio": round(
+                    dupes / unique if unique else 0.0, 4),
+            },
+            "tx_latency_ms": {
+                "count": count,
+                "p50": round(_percentile(samples, 0.50), 3),
+                "p95": round(_percentile(samples, 0.95), 3),
+            },
+            "stage_seconds": {s: round(v, 9)
+                              for s, v in sorted(stage.items())},
+            "total_seconds": round(total_s, 9),
+            "outcomes": dict(sorted(outcomes.items())),
+        }

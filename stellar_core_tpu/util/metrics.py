@@ -9,10 +9,12 @@ EWMA; percentiles from a bounded reservoir.
 from __future__ import annotations
 
 import bisect
+import os
+import re
 import threading
 import time
 from collections import deque
-from typing import Deque, Callable, Dict, List
+from typing import Deque, Callable, Dict, List, Optional, Tuple
 
 
 class Counter:
@@ -273,8 +275,100 @@ def _num(v) -> str:
     return repr(float(v))
 
 
+# -- HELP catalog (docs/metrics.md -> `# HELP` lines) -------------------------
+#
+# Real Prometheus/Grafana setups expect self-describing scrapes. The
+# HELP text is sourced from the docs/metrics.md catalog tables (the same
+# file the M1 drift guard keeps complete), parsed once per process:
+# exact names map directly, dynamic names (`fault.injected.<site>`) map
+# by the literal prefix before the first `<...>` placeholder.
+
+class HelpCatalog:
+    def __init__(self, exact: Dict[str, str],
+                 prefixes: List[Tuple[str, str, str]]) -> None:
+        self.exact = exact
+        # (prefix, suffix, text), most-specific-first: families that
+        # share a placeholder prefix (`overlay.recv.<type>.count` vs
+        # `.bytes`) are distinguished by the literal after the
+        # placeholder
+        self.prefixes = sorted(
+            prefixes, key=lambda kv: -(len(kv[0]) + len(kv[1])))
+
+    def lookup(self, name: str) -> Optional[str]:
+        t = self.exact.get(name)
+        if t is not None:
+            return t
+        for prefix, suffix, text in self.prefixes:
+            if name.startswith(prefix) and name.endswith(suffix) and \
+                    len(name) > len(prefix) + len(suffix):
+                return text
+        return None
+
+
+_HELP_CATALOG: Optional[HelpCatalog] = None
+
+
+def _strip_markdown(cell: str) -> str:
+    out = cell.replace("\\|", "|").replace("`", "")
+    out = re.sub(r"\[([^\]]*)\]\([^)]*\)", r"\1", out)   # [text](link)
+    return " ".join(out.split())
+
+
+def load_help_catalog(path: Optional[str] = None) -> HelpCatalog:
+    """Parse docs/metrics.md catalog tables into {metric: help-text}.
+    Cached after the first call (the docs ship with the package); a
+    missing or unreadable file degrades to an empty catalog — the
+    exposition then falls back to the metric name itself."""
+    global _HELP_CATALOG
+    if _HELP_CATALOG is not None and path is None:
+        return _HELP_CATALOG
+    cache = path is None
+    if path is None:
+        path = os.path.join(
+            os.path.dirname(os.path.dirname(os.path.dirname(
+                os.path.abspath(__file__)))), "docs", "metrics.md")
+    exact: Dict[str, str] = {}
+    prefixes: List[Tuple[str, str, str]] = []
+    try:
+        with open(path, encoding="utf-8") as fh:
+            text = fh.read()
+    except OSError:
+        text = ""
+    for line in text.splitlines():
+        line = line.strip()
+        if not line.startswith("|"):
+            continue
+        cells = re.split(r"(?<!\\)\|", line.strip("|"))
+        if len(cells) < 3:
+            continue
+        names = re.findall(r"`([^`]+)`", cells[0])
+        meaning = _strip_markdown(cells[-1])
+        if not meaning or meaning.lower() == "meaning":
+            continue
+        for name in names:
+            name = name.strip()
+            if not name or name.startswith((".", "-")):
+                continue   # shorthand continuation like `-miss`
+            if "<" in name:
+                prefix = name.split("<", 1)[0]
+                suffix = name.rsplit(">", 1)[-1] if ">" in name else ""
+                prefixes.append((prefix, suffix, meaning))
+            else:
+                exact[name] = meaning
+    catalog = HelpCatalog(exact, prefixes)
+    if cache:
+        _HELP_CATALOG = catalog
+    return catalog
+
+
+def _help_text(s: str) -> str:
+    # exposition-format escaping for HELP lines
+    return s.replace("\\", "\\\\").replace("\n", "\\n")
+
+
 def render_prometheus(metrics_json: Dict[str, dict],
-                      prefix: str = "sct_") -> str:
+                      prefix: str = "sct_",
+                      help_catalog: Optional[HelpCatalog] = None) -> str:
     """Registry JSON -> exposition text. Mapping:
 
     - counter / gauge      -> gauge (medida counters can be set/decremented)
@@ -286,6 +380,12 @@ def render_prometheus(metrics_json: Dict[str, dict],
     Two source names that mangle to the same series keep only the
     first (sorted source order); the duplicate is emitted as a comment
     so the collision is visible in the scrape body.
+
+    With `help_catalog` (the admin endpoint passes the docs/metrics.md
+    catalog), every `# TYPE` line is preceded by a `# HELP` line whose
+    text comes from the catalog where available, falling back to the
+    source metric name — real Prometheus/Grafana setups then get
+    self-describing scrapes.
     """
     lines: List[str] = []
     emitted: set = set()
@@ -295,6 +395,13 @@ def render_prometheus(metrics_json: Dict[str, dict],
         m = metrics_json[name]
         base = prometheus_name(name, prefix)
         t = m.get("type")
+        help_text = None
+        if help_catalog is not None:
+            help_text = _help_text(help_catalog.lookup(name) or name)
+
+        def _help(series: str) -> None:
+            if help_text is not None:
+                lines.append("# HELP %s %s" % (series, help_text))
         # reserve every series this metric will emit, not just the base:
         # a counter named "foo.total" must not collide with meter "foo"'s
         # generated `foo_total` either
@@ -312,14 +419,17 @@ def render_prometheus(metrics_json: Dict[str, dict],
             continue
         emitted |= series
         if t == "meter":
+            _help(base + "_total")
             lines.append("# TYPE %s_total counter" % base)
             lines.append("%s_total %s" % (base, _num(m["count"])))
+            _help(base + "_rate")
             lines.append("# TYPE %s_rate gauge" % base)
             for w, k in (("1m", "1_min_rate"), ("5m", "5_min_rate"),
                          ("15m", "15_min_rate")):
                 lines.append('%s_rate{window="%s"} %s'
                              % (base, w, _num(m.get(k, 0.0))))
         elif t in ("timer", "histogram"):
+            _help(base)
             lines.append("# TYPE %s summary" % base)
             for q, k in q_map:
                 lines.append('%s{quantile="%s"} %s'
@@ -330,12 +440,15 @@ def render_prometheus(metrics_json: Dict[str, dict],
                 base, _num(m.get("mean", 0.0) * m.get("count", 0))))
             lines.append("%s_count %s" % (base, _num(m.get("count", 0))))
             for k in ("min", "max"):
+                _help("%s_%s" % (base, k))
                 lines.append("# TYPE %s_%s gauge" % (base, k))
                 lines.append("%s_%s %s" % (base, k, _num(m.get(k, 0.0))))
         elif t == "gauge":
+            _help(base)
             lines.append("# TYPE %s gauge" % base)
             lines.append("%s %s" % (base, _num(m.get("value", 0.0))))
         elif "count" in m:   # counter or merged bare-count extra
+            _help(base)
             lines.append("# TYPE %s gauge" % base)
             lines.append("%s %s" % (base, _num(m["count"])))
         # anything else (malformed entry) is skipped silently: the JSON

@@ -99,6 +99,82 @@ def test_malformed_artifacts_fail_check(tmp_path):
     assert any("bad JSON" in e for e in errs)
 
 
+# --------------------------------------------------- overlay_breakdown
+
+def _good_overlay_breakdown():
+    return {
+        "recv_bytes": 1000, "send_bytes": 900,
+        "recv_msgs": 10, "send_msgs": 9,
+        "flood": {"unique": 10, "duplicates": 5,
+                  "duplication_ratio": 0.5},
+        "tx_latency_ms": {"count": 3, "p50": 100.0, "p95": 200.0},
+        "stage_seconds": {"submit-to-queue": 0.1,
+                          "queue-to-include": 0.2,
+                          "include-to-externalize": 0.3,
+                          "externalize-to-apply": 0.4},
+        "total_seconds": 1.0,
+        "outcomes": {"applied": 3},
+    }
+
+
+def test_overlay_breakdown_validates_and_normalizes():
+    ob = _good_overlay_breakdown()
+    assert bc.validate_overlay_breakdown(ob, "t") == []
+    recs = bc.overlay_breakdown_records(ob, "scenario-flood", "src")
+    by = {r["metric"]: r for r in recs}
+    assert by["flood_duplication_ratio"]["value"] == 0.5
+    assert by["flood_duplication_ratio"]["direction"] == "lower"
+    assert by["tx_latency_total_p95_ms"]["value"] == 200.0
+    assert by["tx_latency_total_p95_ms"]["direction"] == "lower"
+    for r in recs:
+        assert bc.validate_record(r, "t") == []
+
+
+def test_overlay_breakdown_idle_run_emits_no_latency_records():
+    """A 0-count run must never commit a 0-valued latency baseline (any
+    later real latency would then gate as a regression forever)."""
+    ob = _good_overlay_breakdown()
+    ob["tx_latency_ms"] = {"count": 0, "p50": 0.0, "p95": 0.0}
+    ob["flood"] = {"unique": 0, "duplicates": 0,
+                   "duplication_ratio": 0.0}
+    assert bc.validate_overlay_breakdown(ob, "t") == []
+    assert bc.overlay_breakdown_records(ob, "p", "src") == []
+
+
+def test_fleet_payload_overlay_breakdown_normalizes():
+    """A `bench.py --fleet` payload carries its overlay_breakdown at
+    the payload level (no embedded records list) — records_from_bench
+    must derive the wire-cockpit records under the payload's stable
+    platform key."""
+    blob = {"metric": "fleet_slot_latency", "unit": "ms",
+            "platform": "fleet-sim", "nodes": 3,
+            "overlay_breakdown": _good_overlay_breakdown()}
+    recs = bc.records_from_bench(blob, "BENCH_r99.json")
+    by = {r["metric"]: r for r in recs}
+    assert by["flood_duplication_ratio"]["platform"] == "fleet-sim"
+    assert by["tx_latency_total_p95_ms"]["platform"] == "fleet-sim"
+    assert all(r["direction"] == "lower" for r in recs)
+
+
+def test_overlay_breakdown_sum_contract_enforced(tmp_path):
+    ob = _good_overlay_breakdown()
+    ob["stage_seconds"]["queue-to-include"] = 5.0    # no longer sums
+    errs = bc.validate_overlay_breakdown(ob, "t")
+    assert any("no longer accounts" in e for e in errs)
+    # ratio inconsistency is caught too
+    ob2 = _good_overlay_breakdown()
+    ob2["flood"]["duplication_ratio"] = 0.9
+    assert any("inconsistent" in e
+               for e in bc.validate_overlay_breakdown(ob2, "t"))
+    # and the walk finds a breakdown nested inside a scenario artifact
+    bad = tmp_path / "BENCH_r96.json"
+    bad.write_text(json.dumps({"metric": "m", "unit": "u", "value": 1.0,
+                               "scenarios": {"flood": {
+                                   "overlay_breakdown": ob}}}))
+    assert any("no longer accounts" in e
+               for e in bc.check_artifact(str(bad)))
+
+
 # ------------------------------------------------------------ comparator
 
 def _rec(metric, value, platform="p", direction="higher", **kw):

@@ -152,8 +152,11 @@ def test_fleet_aggregator_against_live_http_node():
     assert {"2", "3"} <= set(node["timeline"]["slots"])
     # survey stats arrive in the SAME compact shape add_app stores, so
     # fleet_stats()['survey'] consumers work against live nodes too
+    # (+ the both-direction LoadManager bandwidth totals, ISSUE 10)
     assert set(node["survey"]) == {"running", "surveyed", "results",
-                                   "backlog", "bad_responses"}
+                                   "backlog", "bad_responses",
+                                   "bytes_send", "bytes_recv",
+                                   "msgs_send", "msgs_recv"}
     trace = agg.merged_chrome_trace()
     assert any(ev["name"] == "timeline.externalize"
                for ev in trace["traceEvents"])

@@ -60,7 +60,23 @@ def test_call_site_scan_finds_the_known_core_metrics():
                      "ledger.apply.wall",
                      "ledger.apply.prefetch.coverage-pct",
                      "bucket.merge.level.%d",
-                     "bucket.level.%d.entries"):
+                     "bucket.level.%d.entries",
+                     # ISSUE 10 wire cockpit: the dynamic overlay.* /
+                     # herder.tx.* prefixes (per-message-type bandwidth,
+                     # per-backend envelope verify, lifecycle stages and
+                     # funnel outcomes) must stay under the drift guard
+                     "overlay.recv.%s.count",
+                     "overlay.recv.%s.bytes",
+                     "overlay.send.%s.count",
+                     "overlay.send.%s.bytes",
+                     "overlay.envelope.verify-latency.%s",
+                     "overlay.envelope.verify-latency",
+                     "overlay.flood.unique",
+                     "overlay.flood.duplicate",
+                     "overlay.send-queue.depth",
+                     "herder.tx.latency.%s",
+                     "herder.tx.latency.total",
+                     "herder.tx.outcome.%s"):
         assert expected in names
 
 

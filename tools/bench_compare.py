@@ -195,6 +195,100 @@ def validate_apply_breakdown(ab, where: str = "") -> List[str]:
     return errs
 
 
+def overlay_breakdown_records(ob: dict, platform: str, source: str,
+                              round_no=None, at_unix=None) -> List[dict]:
+    """Normalize an `overlay_breakdown` block (ISSUE 10: the wire
+    cockpit's fleet aggregate) into direction-aware records — the flood
+    duplication ratio (the O(n²) flood waste ROADMAP item 3 wants to
+    shrink) and the end-to-end tx latency gate against
+    bench/history.jsonl exactly like every other metric. Latency
+    records are only emitted when the run actually applied tracked
+    transactions: a 0-valued p95 from an idle run must never become the
+    committed best baseline."""
+    out: List[dict] = []
+    if not isinstance(ob, dict):
+        return out
+    fl = ob.get("flood")
+    if isinstance(fl, dict) and _num(fl, "unique") and \
+            _num(fl, "duplication_ratio") is not None:
+        out.append(make_record("flood_duplication_ratio", "x",
+                               fl["duplication_ratio"], platform, "lower",
+                               source, round_no, at_unix))
+    tx = ob.get("tx_latency_ms")
+    if isinstance(tx, dict) and _num(tx, "count"):
+        for q in ("p50", "p95"):
+            v = _num(tx, q)
+            if v is not None:
+                out.append(make_record(
+                    "tx_latency_total_%s_ms" % q, "ms", v, platform,
+                    "lower", source, round_no, at_unix))
+    return out
+
+
+def validate_overlay_breakdown(ob, where: str = "") -> List[str]:
+    """Schema check for one `overlay_breakdown` block (`check`/
+    `--check`): bandwidth totals, flood dedup (ratio consistent with
+    duplicates/unique) and the tx-lifecycle sum contract (stage seconds
+    sum to total_seconds) must all hold — a breakdown that silently
+    stops adding up is itself a regression."""
+    errs: List[str] = []
+    if not isinstance(ob, dict):
+        return ["%s: overlay_breakdown is not an object: %r" % (where, ob)]
+    for key in ("recv_bytes", "send_bytes", "recv_msgs", "send_msgs"):
+        v = _num(ob, key)
+        if v is None or v < 0:
+            errs.append("%s: overlay_breakdown.%s must be a finite "
+                        "number >= 0, got %r" % (where, key, ob.get(key)))
+    fl = ob.get("flood")
+    if not isinstance(fl, dict):
+        errs.append("%s: overlay_breakdown.flood must be an object"
+                    % where)
+    else:
+        u, d = _num(fl, "unique"), _num(fl, "duplicates")
+        r = _num(fl, "duplication_ratio")
+        if u is None or u < 0 or d is None or d < 0 or r is None or r < 0:
+            errs.append("%s: overlay_breakdown.flood needs finite "
+                        "unique/duplicates/duplication_ratio >= 0, got %r"
+                        % (where, fl))
+        elif u and abs(r - d / u) > 1e-3:
+            errs.append("%s: overlay_breakdown.flood duplication_ratio "
+                        "%.4f inconsistent with duplicates/unique %.4f"
+                        % (where, r, d / u))
+    tx = ob.get("tx_latency_ms")
+    if not isinstance(tx, dict) or _num(tx, "count") is None:
+        errs.append("%s: overlay_breakdown.tx_latency_ms must be an "
+                    "object with a finite count" % where)
+    else:
+        p50, p95 = _num(tx, "p50"), _num(tx, "p95")
+        if p50 is None or p95 is None or p50 < 0 or p95 + 1e-9 < p50:
+            errs.append("%s: overlay_breakdown.tx_latency_ms needs "
+                        "finite 0 <= p50 <= p95, got %r" % (where, tx))
+    stage = ob.get("stage_seconds")
+    total = _num(ob, "total_seconds")
+    if not isinstance(stage, dict) or total is None or total < 0:
+        errs.append("%s: overlay_breakdown needs stage_seconds (object) "
+                    "and finite total_seconds >= 0" % where)
+    else:
+        bad = [s for s, v in stage.items()
+               if _num({"v": v}, "v") is None]
+        if bad:
+            errs.append("%s: overlay_breakdown.stage_seconds has "
+                        "non-finite entries %r" % (where, bad))
+        else:
+            # the tx-lifecycle sum contract: per-tx totals are computed
+            # as the sum of the stage durations, so the cumulative
+            # aggregates must agree to rounding slack
+            s = sum(stage.values())
+            tol = max(1e-6, 1e-3 * total)
+            if abs(s - total) > tol:
+                errs.append(
+                    "%s: overlay_breakdown stage_seconds sum to %.6f s "
+                    "but total_seconds is %.6f s — the lifecycle "
+                    "breakdown no longer accounts for the total"
+                    % (where, s, total))
+    return errs
+
+
 def _replay_leg_records(leg: dict, platform: str, source: str,
                         round_no, at_unix) -> List[dict]:
     out = []
@@ -281,6 +375,13 @@ def _payload_records(p: dict, source: str, round_no,
         v = _num(ra, "apply_speedup")
         if v is not None:
             rec("native_apply_speedup", "x", v, "cpu", "higher")
+    # wire-cockpit records from a payload-level overlay_breakdown
+    # (`bench.py --fleet`; scenario artifacts embed theirs in an
+    # explicit `records` list, which normalize_any prefers)
+    ob = p.get("overlay_breakdown")
+    if isinstance(ob, dict):
+        out.extend(overlay_breakdown_records(ob, platform, source,
+                                             round_no, at_unix))
     # device history survives device-less rounds via the cached block
     for nest in (p.get("last_device"),
                  (p.get("errors") or {}).get("last_real_device_result")):
@@ -377,9 +478,10 @@ def check_artifact(path: str) -> List[str]:
             not math.isfinite(v):
         errs.append("%s: payload field 'value' must be a finite number, "
                     "got %r" % (name, v))
-    # every apply_breakdown anywhere in the payload (replay legs,
-    # replay_apply legs, nested last_device blocks) must schema-validate
-    # and sum to its measured apply wall
+    # every apply_breakdown / overlay_breakdown anywhere in the payload
+    # (replay legs, replay_apply legs, scenario blocks, nested
+    # last_device blocks) must schema-validate — breakdown sum
+    # contracts are enforced in committed artifacts
     _walk_breakdowns(payload, name, errs)
     # every record the normalizer derives must itself validate
     for rec in records_from_bench(blob, name):
@@ -399,6 +501,9 @@ def _walk_breakdowns(blob, name: str, errs: List[str],
         return
     if "apply_breakdown" in blob:
         errs.extend(validate_apply_breakdown(blob["apply_breakdown"], name))
+    if "overlay_breakdown" in blob:
+        errs.extend(validate_overlay_breakdown(blob["overlay_breakdown"],
+                                               name))
     for v in blob.values():
         if isinstance(v, (dict, list)):
             _walk_breakdowns(v, name, errs, depth + 1)
