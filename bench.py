@@ -159,21 +159,33 @@ def device_full_bench(partial_path: str, batch: int = 8192,
     results["compile_warm_s"] = round(time.perf_counter() - t_w, 2)
     flush("warm_compile")
 
-    # stage 2b: cockpit warmup — the same bucket shape through the
-    # verifier's instrumented warmup path (ISSUE 6 satellite), so
-    # compile-cache hit/miss and per-bucket warmup seconds land in the
-    # artifact AND the cached last_device block: warm-restart
-    # time-to-full-rate is recorded from this device run onward.
+    # stage 2b: cockpit warmup — the buckets this run MEASURED, chosen
+    # through the histogram-driven selection (ISSUE 11): the traffic
+    # stages 1 dispatched (throughput batch + the 128-latency SLO leg)
+    # is recorded into a VerifierStats, warmup_plan derives the adaptive
+    # set from it, and the plan is persisted beside the XLA cache — so
+    # `warmup_buckets_s` reflects the adaptive set and a warm restart on
+    # this host compiles only the buckets real traffic used.
     try:
         from stellar_core_tpu.crypto.batch_verifier import (
-            TpuSigVerifier, VerifierStats)
+            TpuSigVerifier, VerifierStats, warmup_plan)
         v = TpuSigVerifier()
-        v.BUCKETS = (batch,)   # instance override; class attr untouched
+        v.BUCKETS = (128, batch)   # instance override; class attr untouched
         v.stats = VerifierStats()
+        # replay this run's observed batch mix through the cockpit
+        for _ in range(iters):
+            v.stats.record_bucket_dispatch(batch, batch, 0)
+        if "latency128_p50_ms" in results:
+            for _ in range(50):
+                v.stats.record_bucket_dispatch(128, 128, 0)
+        plan, plan_info = warmup_plan(v.stats, v.BUCKETS)
+        results["warmup_plan"] = {"buckets": plan, **plan_info}
+        results["warmup_plan_path"] = v.save_warmup_plan()
         jax.clear_caches()     # a fresh process's in-memory state
         v.warmup(wait=True)
         w = v.stats.warmup
         results["warmup_state"] = w["state"]
+        results["warmup_source"] = w["source"]
         results["warmup_buckets_s"] = {
             b: info["seconds"] for b, info in w["buckets"].items()}
         results["compile_cache"] = dict(v.stats.compile_cache)
@@ -542,6 +554,172 @@ def fleet_bench(n_nodes: int = 3, n_ledgers: int = 12) -> dict:
             overlay["tx_latency_ms"]["p95"]
     sim.stop_all_nodes()
     return out
+
+
+def fleet_verify_child(chunk: int = 8192, chunks: int = 3,
+                       iters: int = 4) -> dict:
+    """One fleet-verify measurement at the CURRENT process's device
+    count (the orchestrator forces it per child via
+    `--xla_force_host_platform_device_count=N`): a `chunks`-chunk drain
+    through the production TpuSigVerifier — sharded mesh dispatch,
+    double-buffered staging, cockpit-driven warmup — timed end to end.
+
+    warm_restart_s is construction → warmed → first full-rate drain
+    complete, i.e. the time a restarted node pays before verifying at
+    full rate (near-zero compile inside when the persistent XLA cache
+    is warm)."""
+    import jax
+    from stellar_core_tpu.crypto.batch_verifier import (
+        TpuSigVerifier, VerifierStats)
+
+    n_devices = jax.device_count()
+    n = chunk * chunks
+    pubs, sigs, msgs = _example_batch(n, n_keys=64)
+    triples = list(zip(pubs, sigs, msgs))
+
+    t0 = time.perf_counter()
+    v = TpuSigVerifier(shard_threshold=min(chunk, 2048))
+    v.BUCKETS = (chunk,)
+    v.stats = VerifierStats()
+    # cockpit evidence for the adaptive plan: this mix is all `chunk`-
+    # sized buckets, so warmup compiles exactly one shape
+    v.stats.record_bucket_dispatch(chunk, chunk, 0)
+    v.save_warmup_plan()
+    v.warmup(wait=True)
+    first = v.verify_many(triples)
+    warm_restart_s = time.perf_counter() - t0
+    assert all(first), "fleet verify rejected valid signatures"
+
+    best = 0.0
+    for _ in range(iters):
+        t1 = time.perf_counter()
+        ok = v.verify_many(triples)
+        dt = time.perf_counter() - t1
+        assert all(ok)
+        best = max(best, n / dt)
+    j = v.stats.to_json()
+    return {
+        "devices": n_devices,
+        "platform": jax.devices()[0].platform,
+        "chunk": chunk,
+        "drain_sigs": n,
+        "fleet_sigs_per_s": round(best, 1),
+        "per_device_sigs_per_s": round(best / n_devices, 1),
+        "warm_restart_s": round(warm_restart_s, 3),
+        "warmup_source": j["warmup"]["source"],
+        "warmup_buckets_s": {b: info["seconds"] for b, info in
+                             j["warmup"]["buckets"].items()},
+        "staging": j["staging"],
+        "devices_detail": j["devices"],
+    }
+
+
+def _spawn_fleet_child(n_devices: int, chunk: int,
+                       chunks: int) -> subprocess.Popen:
+    env = _scrubbed_cpu_env()
+    env["XLA_FLAGS"] = (env.get("XLA_FLAGS", "") +
+                        " --xla_force_host_platform_device_count=%d"
+                        % n_devices).strip()
+    return _spawn("import bench, json; "
+                  "print('FLEETV_JSON ' + json.dumps("
+                  "bench.fleet_verify_child(chunk=%d, chunks=%d)))"
+                  % (chunk, chunks), env)
+
+
+def fleet_verify_main(argv) -> int:
+    """`bench.py --fleet-verify [--devices 1,2,4] [--chunk 8192]
+    [--record] [--history PATH] [--tolerance T] [--out FILE]`: the
+    multi-device verify leg (ISSUE 11; ROADMAP item 1). One child
+    process per device count, each on a forced virtual-CPU fleet
+    (`--xla_force_host_platform_device_count=N` — the same fake-device
+    contract tier-1 uses), running the SAME batch mix through the
+    production sharded drain. Emits `fleet_sigs_per_s` /
+    `per_device_sigs_per_s` / `warm_restart_s` records under
+    `verify-fleet-cpu<N>` platform keys, gated against
+    bench/history.jsonl; the N_max/N_1 ratio lands as
+    `fleet_verify_speedup`. Never touches the device relay."""
+    import argparse
+    bc = _bench_compare_mod()
+    ap = argparse.ArgumentParser(prog="bench.py --fleet-verify")
+    ap.add_argument("--fleet-verify", action="store_true")
+    ap.add_argument("--devices", default="1,2,4")
+    ap.add_argument("--chunk", type=int, default=8192)
+    ap.add_argument("--chunks", type=int, default=3)
+    ap.add_argument("--record", action="store_true")
+    ap.add_argument("--history",
+                    default=os.path.join(_REPO, "bench", "history.jsonl"))
+    ap.add_argument("--tolerance", type=float, default=0.25)
+    ap.add_argument("--out", help="also write the block to this file")
+    args = ap.parse_args(argv)
+    counts = sorted({int(x) for x in args.devices.split(",") if x.strip()})
+
+    legs = {}
+    errors = {}
+    for nd in counts:
+        proc = _spawn_fleet_child(nd, args.chunk, args.chunks)
+        # budget: one cold kernel compile (~150s on this container) +
+        # the timed drains; stall-kill well past that
+        deadline = time.time() + 900
+        while time.time() < deadline and proc.poll() is None:
+            time.sleep(1.0)
+        if proc.poll() is None:
+            proc.kill()
+            proc.communicate()
+            errors["fleet_cpu%d" % nd] = "killed at deadline"
+            continue
+        got, err = _harvest(proc, "FLEETV_JSON")
+        if err:
+            errors["fleet_cpu%d" % nd] = err
+        else:
+            legs[str(nd)] = got
+
+    out = {
+        "metric": "fleet_verify_sigs_per_s",
+        "unit": "sigs/s",
+        "value": max((leg["fleet_sigs_per_s"] for leg in legs.values()),
+                     default=0.0),
+        "platform": "verify-fleet-cpu",
+        "chunk": args.chunk,
+        "drain_sigs": args.chunk * args.chunks,
+        "fleet_verify": legs,
+    }
+    if "1" in legs and len(legs) > 1:
+        top = str(max(int(k) for k in legs))
+        out["fleet_speedup"] = round(
+            legs[top]["fleet_sigs_per_s"] / legs["1"]["fleet_sigs_per_s"],
+            3)
+        out["fleet_speedup_devices"] = int(top)
+    if errors:
+        out["errors"] = errors
+
+    src = "bench.py --fleet-verify"
+    records = bc.fleet_verify_records(out.get("fleet_verify"), src)
+    if "fleet_speedup" in out:
+        records.append(bc.make_record(
+            "fleet_verify_speedup", "x", out["fleet_speedup"],
+            "verify-fleet-cpu", "higher", src))
+    out["records"] = records
+    history = bc.load_history(args.history)
+    report = bc.compare(records, history, tolerance=args.tolerance)
+    if args.record:
+        commit = _git_commit()
+        now = int(time.time())
+        for rec in records:
+            if rec.get("at_unix") is None:
+                rec["at_unix"] = now
+            if rec.get("commit") is None:
+                rec["commit"] = commit
+        report["recorded"] = bc.append_history(args.history, records)
+    out["compare"] = report
+    if args.out:
+        with open(args.out, "w") as fh:
+            json.dump(out, fh, indent=1, sort_keys=True)
+    print(json.dumps(out, indent=1, sort_keys=True))
+    # a leg that produced no data is a failure, not a green gate — an
+    # all-children-wedged run must never read as "no regressions"
+    if not legs or errors:
+        return 1
+    return 1 if report["regressions"] else 0
 
 
 def _bench_compare_mod():
@@ -1042,6 +1220,11 @@ if __name__ == "__main__":
         # the `fleet` block (slot-latency p50/p95, externalize skew);
         # does not touch jax or the device relay
         print(json.dumps(fleet_bench()))
+    elif "--fleet-verify" in sys.argv:
+        # multi-device verify leg (ISSUE 11): sharded drains on forced
+        # virtual-CPU fleets, gated against bench/history.jsonl; spawns
+        # scrubbed CPU children only — never touches the device relay
+        sys.exit(fleet_verify_main(sys.argv[1:]))
     elif "--scenario" in sys.argv:
         # scenario lab (ISSUE 8): churn / flood / partition / surge
         # robustness scenarios emitting fleet bench blocks gated against

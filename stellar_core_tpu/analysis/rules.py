@@ -259,6 +259,18 @@ class ModuleFacts(ast.NodeVisitor):
         elif callee == "submit" and node.args:
             self._note_thread_entry(node.lineno, node.args[0],
                                     "executor.submit(...)")
+        elif callee == "spawn_worker":
+            # util.threads.spawn_worker(name, target): the audited
+            # worker factory — its target walks exactly like a bare
+            # Thread(target=...) so routing a spawn through the registry
+            # can never weaken the T1 discipline check
+            if len(node.args) >= 2:
+                self._note_thread_entry(node.lineno, node.args[1],
+                                        "spawn_worker(...)")
+            for kw in node.keywords:
+                if kw.arg == "target":
+                    self._note_thread_entry(node.lineno, kw.value,
+                                            "spawn_worker(...)")
 
         # call-graph edge for the enclosing def
         if self._func_stack and callee is not None:

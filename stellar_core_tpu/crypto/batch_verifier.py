@@ -51,8 +51,23 @@ Clock/threading audit (ISSUE 5 satellite — the 9 touch points):
     injected app clock (now_fn), compile DURATIONS read
     util.timer.real_monotonic (sanctioned: an XLA compile takes real
     time under a frozen virtual clock); recorded from the main loop,
-    the dispatch worker and the warmup thread under its own
-    TrackedLock("crypto.verifier-stats").
+    the dispatch worker, the staging worker and the warmup thread under
+    its own TrackedLock("crypto.verifier-stats").
+11. _StagingJob worker ("crypto.verify-staging", ISSUE 11) — packs and
+    device_puts the next drain chunk while the fleet executes the
+    current one; touches only host numpy buffers, JAX transfer APIs and
+    VerifierStats (thread-safe), never ledger/consensus objects.
+    Overlap DURATIONS read util.timer.real_monotonic (sanctioned: the
+    host/device overlap being measured is real elapsed time).
+12. DeviceFleetHealth per-device breakers — same injected app clock as
+    the resilient layer's breaker (make_verifier passes clock.now), so
+    per-chip cooldown/reprobe advance deterministically under a
+    virtual clock; callbacks touch only metrics/tracer/flight-recorder.
+
+All three crypto workers (dispatch, staging, warmup) spawn through
+util.threads.spawn_worker under names registered in
+WORKER_THREAD_REGISTRY; the static T1 rule follows spawn_worker targets
+like any Thread(target=...) site.
 """
 
 from __future__ import annotations
@@ -62,7 +77,7 @@ from typing import Callable, List, Optional, Sequence, Tuple
 
 from ..util.log import get_logger
 from ..util.metrics import MetricsRegistry
-from ..util.threads import TrackedLock
+from ..util.threads import TrackedLock, spawn_worker
 from ..util.timer import real_monotonic
 from ..util.tracing import tracer_instant
 from ..xdr import PublicKey
@@ -114,10 +129,23 @@ class VerifierStats:
         self._lock = TrackedLock("crypto.verifier-stats")
         self.backends: dict = {}      # name -> {drains, sigs, pad_total}
         self.buckets: dict = {}       # bucket -> counts + histograms
+        # per-device fleet attribution (ISSUE 11): device index ->
+        # {drains, sigs, pad_total, inflight} for every padded dispatch
+        # the device participated in
+        self.devices: dict = {}
+        # non-bucketed (CPU-path) drain sizes, power-of-two quantized so
+        # the dict stays bounded: the raw material bucket_traffic() maps
+        # onto the candidate ladder for cockpit-driven warm start
+        self.drain_sizes: dict = {}   # backend -> {quantized_n: drains}
+        # double-buffer staging aggregate (host pack/device_put overlap
+        # with device execution, ISSUE 11 tentpole)
+        self.staging = {"chunks": 0, "staged_s": 0.0, "overlap_s": 0.0,
+                        "last_overlap_pct": None, "stalls": 0}
         self.queue = {"depth": 0, "inflight": 0,
                       "wait_last_mean_ms": None, "wait_last_max_ms": None}
-        self.warmup = {"state": "idle", "planned": [], "begun_t": None,
-                       "done_t": None, "error": None, "buckets": {}}
+        self.warmup = {"state": "idle", "planned": [], "source": None,
+                       "begun_t": None, "done_t": None, "error": None,
+                       "buckets": {}}
         self.compile_cache = {"enabled": None, "dir": None, "hits": 0,
                               "misses": 0, "unknown": 0, "error": None}
         # fixed-name registry metrics, created eagerly so the Prometheus
@@ -131,19 +159,24 @@ class VerifierStats:
         self._t_wait = m.new_timer("verifier.queue.wait")
         self._g_depth = m.new_gauge("verifier.queue.depth")
         self._g_inflight = m.new_gauge("verifier.queue.inflight")
+        self._g_overlap = m.new_gauge("verifier.staging.overlap-pct")
         self._g_wstate = m.new_gauge("verifier.warmup.state")
         self._g_wdone = m.new_gauge("verifier.warmup.buckets-done")
+        self._g_wsource = m.new_gauge("verifier.warmup.source")
         self._g_cc = m.new_gauge("verifier.compile-cache.enabled")
         self._c_hit = m.new_counter("verifier.compile-cache.hit")
         self._c_miss = m.new_counter("verifier.compile-cache.miss")
 
     # -- drains --------------------------------------------------------------
     def record_drain(self, backend: str, n: int, pad: int = 0,
-                     splits: int = 1) -> None:
+                     splits: int = 1, bucketed: bool = False) -> None:
         """One verify_many drain, attributed to the backend that served
         it. `pad` is the total padding-lane waste (0 on unpadded CPU
         drains — which still count, so bucket-selection analysis sees
-        ALL traffic, not just the device path)."""
+        ALL traffic, not just the device path). `bucketed=True` means the
+        drain's traffic already landed in the exact per-bucket dispatch
+        stats (record_bucket_dispatch) — unbucketed drains additionally
+        feed `drain_sizes`, the CPU-side half of bucket_traffic()."""
         occ = 100.0 * n / (n + pad) if (n + pad) else 100.0
         with self._lock:
             d = self.backends.setdefault(
@@ -151,6 +184,10 @@ class VerifierStats:
             d["drains"] += 1
             d["sigs"] += n
             d["pad_total"] += pad
+            if not bucketed and n > 0:
+                q = 1 << (n - 1).bit_length()   # next power of two
+                sizes = self.drain_sizes.setdefault(backend, {})
+                sizes[q] = sizes.get(q, 0) + 1
         self._h_batch.update(n)
         self._h_pad.update(pad)
         self._h_occ.update(occ)
@@ -180,6 +217,109 @@ class VerifierStats:
         b["_occ"].update(occ)
         b["_pad"].update(pad)
         b["_m"].mark()
+
+    # -- fleet: per-device attribution (ISSUE 11) ----------------------------
+    def record_device_dispatch(self, idx: int, n: int, pad: int) -> None:
+        """One device's share of a padded dispatch (its lanes on a
+        sharded mesh drain, or the whole bucket on a single-device
+        dispatch): per-device throughput attribution for the admin
+        `verifier` endpoint's fleet rows."""
+        with self._lock:
+            d = self.devices.setdefault(
+                idx, {"drains": 0, "sigs": 0, "pad_total": 0,
+                      "inflight": 0})
+            d["drains"] += 1
+            d["sigs"] += n
+            d["pad_total"] += pad
+        self.metrics.new_meter("verifier.device.%d.drains" % idx).mark()
+
+    def set_device_inflight(self, idx: int, inflight: bool) -> None:
+        with self._lock:
+            d = self.devices.setdefault(
+                idx, {"drains": 0, "sigs": 0, "pad_total": 0,
+                      "inflight": 0})
+            d["inflight"] = int(inflight)
+        self.metrics.new_gauge(
+            "verifier.device.%d.inflight" % idx).set(int(inflight))
+
+    def set_device_breaker(self, idx: int, code: int) -> None:
+        self.metrics.new_gauge("verifier.device.%d.breaker" % idx).set(code)
+
+    def device_trip(self, idx: int, breaker_json: dict) -> None:
+        self.metrics.new_meter("verifier.device.trip").mark()
+        tracer_instant(self.tracer, "verifier.device.trip", cat="crypto",
+                       device=idx)
+        if self.flight_recorder is not None:
+            self.flight_recorder.dump(
+                "verify-device-trip",
+                extra={"device": idx, "breaker": breaker_json})
+
+    def device_recover(self, idx: int) -> None:
+        self.metrics.new_meter("verifier.device.recover").mark()
+        tracer_instant(self.tracer, "verifier.device.recover",
+                       cat="crypto", device=idx)
+
+    # -- fleet: double-buffer staging ----------------------------------------
+    def record_staging(self, staged_s: float, overlap_s: float,
+                       chunks: int) -> None:
+        """One drain's staging totals: `staged_s` of host pack +
+        host→device transfer ran on the staging worker, `overlap_s` of
+        it concurrent with device execution of the previous chunk. The
+        overlap-pct gauge is the headline: near 100 means the device
+        never idles on host marshalling."""
+        pct = round(100.0 * overlap_s / staged_s, 1) if staged_s > 0 \
+            else 100.0
+        with self._lock:
+            s = self.staging
+            s["chunks"] += chunks
+            s["staged_s"] = round(s["staged_s"] + staged_s, 6)
+            s["overlap_s"] = round(s["overlap_s"] + overlap_s, 6)
+            s["last_overlap_pct"] = pct
+        self._g_overlap.set(pct)
+
+    def record_staging_stall(self) -> None:
+        """The staging worker failed (or the verify.staging-stall fault
+        fired): the chunk re-staged synchronously on the dispatch
+        thread — the drain completed, but the device idled."""
+        with self._lock:
+            self.staging["stalls"] += 1
+        self.metrics.new_meter("verifier.staging.stall").mark()
+        tracer_instant(self.tracer, "verifier.staging.stall", cat="crypto")
+
+    # -- cockpit-driven bucket selection -------------------------------------
+    def bucket_traffic(self, candidates) -> dict:
+        """Observed drain traffic mapped onto a candidate bucket ladder:
+        exact per-bucket device dispatch counts plus every non-bucketed
+        (CPU-path) drain size mapped to the smallest candidate that
+        holds it. This is the evidence warmup_plan() ranks — CPU drains
+        included, so bucket selection sees ALL traffic."""
+        cands = sorted(candidates)
+
+        def fit(n: int) -> int:
+            for c in cands:
+                if n <= c:
+                    return c
+            return cands[-1]
+
+        out: dict = {}
+        with self._lock:
+            for b, d in self.buckets.items():
+                out[fit(b)] = out.get(fit(b), 0) + d["drains"]
+            for sizes in self.drain_sizes.values():
+                for n, drains in sizes.items():
+                    out[fit(n)] = out.get(fit(n), 0) + drains
+        return out
+
+    def bucket_occupancy_p50(self) -> dict:
+        """Median occupancy-% per device bucket (None until sampled) —
+        the pad-waste signal warmup_plan() uses to pre-warm the next
+        smaller shape under a mostly-padding bucket."""
+        out = {}
+        with self._lock:
+            for b, d in self.buckets.items():
+                snap = d["_occ"].snapshot()
+                out[b] = snap["median"] if snap["count"] else None
+        return out
 
     # -- queue ---------------------------------------------------------------
     def set_queue_depth(self, depth: int) -> None:
@@ -216,15 +356,20 @@ class VerifierStats:
                                       extra={"error": err})
 
     WARMUP_STATE_CODE = {"idle": 0, "running": 1, "done": 2, "failed": 3}
+    # where the warm-start bucket set came from: the hardcoded default
+    # ladder, or the cockpit-derived plan persisted beside the XLA cache
+    WARMUP_SOURCE_CODE = {"default": 0, "cockpit": 1}
 
-    def warmup_begin(self, buckets) -> None:
+    def warmup_begin(self, buckets, source: str = "default") -> None:
         with self._lock:
             self.warmup.update({"state": "running", "begun_t": self._now(),
                                 "done_t": None, "error": None,
+                                "source": source,
                                 "planned": list(buckets)})
         self._g_wstate.set(self.WARMUP_STATE_CODE["running"])
+        self._g_wsource.set(self.WARMUP_SOURCE_CODE.get(source, 0))
         tracer_instant(self.tracer, "verifier.warmup.begin", cat="crypto",
-                       buckets=list(buckets))
+                       buckets=list(buckets), source=source)
 
     def warmup_bucket_done(self, bucket: int, seconds: float,
                            cache_hit) -> None:
@@ -292,6 +437,9 @@ class VerifierStats:
                          "occupancy_pct": d["_occ"].snapshot(),
                          "pad_waste": d["_pad"].snapshot()}
                 for b, d in sorted(self.buckets.items())}
+            devices = {str(i): dict(d)
+                       for i, d in sorted(self.devices.items())}
+            staging = dict(self.staging)
             queue = dict(self.queue)
             cc = dict(self.compile_cache)
         return {
@@ -301,10 +449,52 @@ class VerifierStats:
                        "occupancy_pct": self._h_occ.snapshot(),
                        "splits": self._h_splits.snapshot()},
             "buckets": buckets,
+            "devices": devices,
+            "staging": staging,
             "warmup": self.warmup_json(),
             "compile_cache": cc,
             "queue": queue,
         }
+
+
+def warmup_plan(stats, candidates):
+    """Cockpit-driven warm-start bucket selection (ISSUE 11 tentpole):
+    derive the AOT warmup set from the `verifier.bucket.<b>.drains` /
+    `pad-waste` histograms the cockpit aggregates — CPU drains included
+    via `drain_sizes`, so selection sees ALL traffic.
+
+    Rules, in order:
+    - only candidate shapes with observed traffic are warmed, hottest
+      (most drains) first, so the first compile serves the most load;
+    - a device bucket whose median occupancy is below 50% mostly pays
+      padding: the next smaller candidate is appended too, so the
+      dispatcher can split down without a cold compile;
+    - no cockpit evidence at all (fresh node, stats=None) falls back to
+      the full candidate ladder.
+
+    Returns (buckets, info) where info carries `source`
+    ("cockpit"/"default") and the evidence the choice was made from —
+    persisted beside the XLA cache by save_warmup_plan() so a warm
+    restart compiles only the shapes real traffic uses."""
+    cands = sorted(candidates)
+    if stats is None:
+        return list(cands), {"source": "default",
+                             "reason": "no cockpit stats"}
+    traffic = stats.bucket_traffic(cands)
+    if not traffic:
+        return list(cands), {"source": "default",
+                             "reason": "no recorded drains"}
+    chosen = sorted(traffic, key=lambda b: (-traffic[b], b))
+    extra = []
+    for b, occ_p50 in sorted(stats.bucket_occupancy_p50().items()):
+        if occ_p50 is None or occ_p50 >= 50.0 or b not in cands:
+            continue
+        i = cands.index(b)
+        if i > 0 and cands[i - 1] not in chosen and \
+                cands[i - 1] not in extra:
+            extra.append(cands[i - 1])
+    return chosen + extra, {"source": "cockpit", "traffic": traffic,
+                            "low_occupancy_extra": extra}
 
 
 class VerifyFuture:
@@ -491,11 +681,28 @@ class CpuSigVerifier(BatchSigVerifier):
 
 
 class TpuSigVerifier(BatchSigVerifier):
-    """JAX/TPU batched backend.
+    """JAX/TPU batched backend with a device-fleet shard scheduler
+    (ISSUE 11 tentpole).
 
-    Batches are padded up to fixed bucket sizes so the kernel compiles once
-    per bucket; oversized batches are split. Correctness contract: identical
-    accept/reject decisions to CpuSigVerifier (RFC 8032 cofactorless).
+    Batches are padded up to fixed bucket sizes so the kernel compiles
+    once per bucket; oversized batches are split. Correctness contract:
+    identical accept/reject decisions to CpuSigVerifier (RFC 8032
+    cofactorless).
+
+    Fleet dispatch: a drain is split into bucket-shaped sub-batches;
+    sub-batches at or above SHARD_MIN_BATCH shard pure-data-parallel
+    over the healthy devices' mesh (one compiled executable per
+    (bucket, mesh) — XLA's SPMD runtime drives every chip in parallel),
+    while straggler tails keep their own smaller bucket on one device
+    instead of padding the whole mesh up. Host→device staging is
+    double-buffered: while the fleet verifies chunk K, chunk K+1 is
+    packed and device_put on the `crypto.verify-staging` worker, so the
+    device never idles on host marshalling (`verifier.staging.
+    overlap-pct`). Per-device health is a ring of circuit breakers
+    (DeviceFleetHealth): a sick chip drops out of the mesh and the
+    drain continues on N-1 devices — the all-or-nothing CPU fallback is
+    the ResilientBatchVerifier layer above, reserved for whole-backend
+    failures.
     """
 
     name = "tpu"
@@ -512,9 +719,19 @@ class TpuSigVerifier(BatchSigVerifier):
     # sigs over a pod slice buys nothing and costs a sharded compile
     SHARD_MIN_BATCH = 1024
 
+    # device drains between cockpit-plan autosaves (save_warmup_plan)
+    PLAN_AUTOSAVE_DRAINS = 32
+
+    # the kernel's device argument order (prepare_batch dict keys)
+    ARG_KEYS = ("ay", "a_sign", "ry", "r_sign", "s_nibs", "k_nibs")
+
     def __init__(self, max_pending: int = 8192,
                  compile_cache_dir: Optional[str] = None,
-                 shard_threshold: Optional[int] = None) -> None:
+                 shard_threshold: Optional[int] = None,
+                 devices: Optional[Sequence] = None,
+                 now_fn: Optional[Callable[[], float]] = None,
+                 device_breaker_threshold: int = 3,
+                 device_breaker_cooldown: float = 30.0) -> None:
         self._pending: List[Tuple[Triple, VerifyFuture]] = []
         self._max_pending = max_pending
         self.batches_dispatched = 0
@@ -523,33 +740,132 @@ class TpuSigVerifier(BatchSigVerifier):
         self._cache_path: Optional[str] = None  # resolved on enable
         self._warmed = False
         self._warmup_thread: Optional[threading.Thread] = None
-        self._sharded_fn = None  # lazy; multi-device dp dispatch
+        self._sharded_fn = None  # full-mesh dp fn (set on first build)
         self._platform: Optional[str] = None  # actual jax platform, lazy
+        self._devices_override = devices
+        self._devices: Optional[list] = None  # resolved on first jax use
+        self._now = now_fn
+        self._dev_threshold = device_breaker_threshold
+        self._dev_cooldown = device_breaker_cooldown
+        self._fleet_health: Optional[DeviceFleetHealth] = None
+        self._mesh_fns: dict = {}   # tuple(device idxs) -> (fn, mesh)
+        self._drains_since_plan_save = 0
         if shard_threshold is not None:
             self.SHARD_MIN_BATCH = shard_threshold
 
-    def _device_fn(self, batch_size: int):
-        """Single-device jit, or the dp-sharded jit when the process sees
-        more than one chip and the batch is worth sharding (VERDICT r2 #3:
-        the production path must use the mesh, not just the dryrun).
-        Cached after first use."""
-        import jax
-        if jax.device_count() <= 1 or batch_size < self.SHARD_MIN_BATCH:
-            from ..ops.ed25519 import verify_batch_jit
-            return verify_batch_jit, 1
-        if self._sharded_fn is None:
+    # -- fleet topology ------------------------------------------------------
+    def _fleet(self):
+        """(devices, health), resolved lazily on first jax touch."""
+        if self._devices is None:
+            import jax
+            self._devices = list(self._devices_override
+                                 if self._devices_override is not None
+                                 else jax.devices())
+            self._fleet_health = DeviceFleetHealth(
+                len(self._devices), threshold=self._dev_threshold,
+                cooldown_s=self._dev_cooldown, now_fn=self._now,
+                owner=self)
+        return self._devices, self._fleet_health
+
+    @property
+    def fleet_health(self) -> "DeviceFleetHealth":
+        return self._fleet()[1]
+
+    def _mesh_fn(self, idxs: tuple):
+        """dp-sharded verify fn over the devices at `idxs` — one
+        compiled executable per (bucket shape, mesh membership). A mesh
+        rebuild after a breaker trip/recover is a real recompile on new
+        shapes; it is counted so degraded-fleet compile cost is never
+        invisible."""
+        got = self._mesh_fns.get(idxs)
+        if got is None:
             from ..parallel.mesh import make_mesh, sharded_verify_fn
-            self._sharded_fn = sharded_verify_fn(make_mesh())
-        return self._sharded_fn, jax.device_count()
+            devs, _health = self._fleet()
+            mesh = make_mesh([devs[i] for i in idxs])
+            got = (sharded_verify_fn(mesh), mesh)
+            if self._mesh_fns and self.metrics is not None:
+                self.metrics.new_meter("verifier.fleet.mesh-rebuild").mark()
+            self._mesh_fns[idxs] = got
+            if len(idxs) == len(devs):
+                self._sharded_fn = got[0]   # full-mesh alias
+        return got
+
+    def _single_fn(self):
+        from ..ops.ed25519 import verify_batch_jit
+        return verify_batch_jit
+
+    def _route(self, n: int):
+        """(fn, padded bucket, device idxs) for an n-sig sub-batch.
+
+        Mesh membership is the healthy device set at route time; the
+        verify.device-lost fault point simulates losing the first
+        healthy device for this dispatch (its breaker counts the
+        failure, so repeated fires trip it and the fleet degrades to
+        N-1)."""
+        devs, health = self._fleet()
+        idxs = health.healthy() if len(devs) > 1 else [0]
+        if len(idxs) > 1 and self.faults is not None and \
+                self.faults.should_fire("verify.device-lost"):
+            lost = idxs[0]
+            health.record_failure(lost)
+            idxs = [i for i in idxs if i != lost]
+        if not idxs:
+            idxs = list(range(len(devs)))
+        if len(idxs) > 1 and n >= self.SHARD_MIN_BATCH:
+            fn, _mesh = self._mesh_fn(tuple(idxs))
+            ndev = len(idxs)
+        else:
+            # sub-batch bucketing: a straggler tail keeps its own small
+            # bucket on ONE device instead of serializing (and padding)
+            # the whole mesh — the first HEALTHY device, so a tripped
+            # device 0 doesn't keep eating every small live-SCP batch
+            # (the per-device compile a non-default device costs only
+            # happens in that degraded state)
+            fn = self._single_fn()
+            idxs = idxs[:1]
+            ndev = 1
+        b = -(-self._bucket(n) // ndev) * ndev
+        return fn, b, tuple(idxs)
+
+    # -- staging (host pack + host→device transfer) --------------------------
+    def _stage_chunk(self, chunk: Sequence[Triple], route) -> dict:
+        """Pack one sub-batch and move it to its device(s). Runs on the
+        staging worker when double-buffered; the returned blob is
+        everything dispatch needs, so the dispatch thread never touches
+        host marshalling."""
+        from ..ops import ed25519 as _e
+        from ..parallel.mesh import pad_batch_to
+        fn, b, idxs = route
+        prep = _e.prepare_batch(
+            [t[0] for t in chunk], [t[1] for t in chunk],
+            [t[2] for t in chunk])
+        padded = pad_batch_to(prep, b)
+        return {"args": self._device_args(padded, idxs),
+                "pre_ok": prep["pre_ok"], "n": len(chunk), "b": b,
+                "fn": fn, "idxs": idxs}
+
+    def _device_args(self, padded: dict, idxs: tuple) -> tuple:
+        """Explicit host→device placement: sharded over the mesh for a
+        fleet dispatch, committed to the default device otherwise — the
+        transfer happens here (on the staging thread when overlapped),
+        not inside the jit call."""
+        import jax
+        devs, _health = self._fleet()
+        if len(idxs) > 1:
+            from jax.sharding import NamedSharding, PartitionSpec as P
+            _fn, mesh = self._mesh_fns[idxs]
+            target = NamedSharding(mesh, P("dp"))
+        else:
+            target = devs[idxs[0]] if idxs else devs[0]
+        return tuple(jax.device_put(padded[k], target)
+                     for k in self.ARG_KEYS)
 
     def _enable_compile_cache(self) -> None:
         """Persistent XLA compilation cache: a node restart never re-pays
         kernel compilation (VERDICT r1: lazy compile on the consensus path
         stalls a validator for the compile duration)."""
         import os
-        path = self._compile_cache_dir or os.environ.get(
-            "JAX_COMPILATION_CACHE_DIR") or os.path.expanduser(
-            "~/.cache/stellar_core_tpu/jax_cache")
+        path = self._resolve_cache_dir()
         try:
             import jax
             os.makedirs(path, exist_ok=True)
@@ -571,17 +887,86 @@ class TpuSigVerifier(BatchSigVerifier):
         """Files under the persistent XLA cache dir (-1 = unknown).
         Warmup diffs this around each bucket compile: no new entry means
         the executable came from the cache (a warm restart), a new entry
-        means a cold compile just got paid."""
+        means a cold compile just got paid. The persisted warmup plan
+        lives beside the executables and is excluded from the diff."""
         import os
         if self._cache_path is None:
             return -1
         try:
             n = 0
             for _dir, _sub, files in os.walk(self._cache_path):
-                n += len(files)
+                # PLAN_BASENAME and its .tmp write-staging sibling: a
+                # concurrent plan autosave must not make a cache-hit
+                # bucket classify as a cold compile
+                n += sum(1 for f in files
+                         if not f.startswith(self.PLAN_BASENAME))
             return n
         except OSError:
             return -1
+
+    # -- cockpit-driven warm start (ISSUE 11 tentpole) -----------------------
+    PLAN_BASENAME = "warmup_buckets.json"
+
+    def _resolve_cache_dir(self) -> str:
+        import os
+        return self._compile_cache_dir or os.environ.get(
+            "JAX_COMPILATION_CACHE_DIR") or os.path.expanduser(
+            "~/.cache/stellar_core_tpu/jax_cache")
+
+    def warmup_plan_path(self) -> str:
+        """The cockpit-derived bucket plan persists beside the XLA
+        compile cache: the same restart that finds warm executables
+        finds the bucket set real traffic uses."""
+        import os
+        return os.path.join(self._cache_path or self._resolve_cache_dir(),
+                            self.PLAN_BASENAME)
+
+    def _load_warmup_plan(self):
+        """(buckets, source): the persisted cockpit plan when present
+        and still valid against the candidate ladder, else the full
+        default BUCKETS."""
+        import json
+        try:
+            with open(self.warmup_plan_path()) as fh:
+                blob = json.load(fh)
+            buckets = [int(b) for b in blob["buckets"]]
+            if buckets and all(b in self.BUCKETS for b in buckets):
+                return buckets, "cockpit"
+            log.warning("persisted warmup plan %r does not fit the "
+                        "candidate ladder %r; using the default set",
+                        buckets, tuple(self.BUCKETS))
+        except (OSError, ValueError, KeyError, TypeError):
+            pass
+        return list(self.BUCKETS), "default"
+
+    def save_warmup_plan(self) -> Optional[str]:
+        """Persist the cockpit-derived bucket plan (warmup_plan over the
+        shared VerifierStats) beside the XLA cache. No-op until the
+        cockpit has seen traffic — a default plan is not evidence worth
+        persisting. Returns the path written, or None."""
+        if self.stats is None:
+            return None
+        buckets, info = warmup_plan(self.stats, self.BUCKETS)
+        if info.get("source") != "cockpit":
+            return None
+        import json
+        import os
+        path = self.warmup_plan_path()
+        try:
+            os.makedirs(os.path.dirname(path), exist_ok=True)
+            tmp = path + ".tmp"
+            with open(tmp, "w") as fh:
+                json.dump({"version": 1, "buckets": buckets,
+                           "candidates": sorted(self.BUCKETS),
+                           "traffic": {str(k): v for k, v in
+                                       sorted(info["traffic"].items())},
+                           "low_occupancy_extra":
+                               info["low_occupancy_extra"]}, fh)
+            os.replace(tmp, path)
+        except OSError as e:
+            log.warning("could not persist warmup plan: %s", e)
+            return None
+        return path
 
     def warmup(self, wait: bool = False) -> None:
         """AOT-compile every bucket shape off the consensus path (startup
@@ -590,33 +975,35 @@ class TpuSigVerifier(BatchSigVerifier):
         if self._warmed:
             return
         if self._warmup_thread is None:
-            self._warmup_thread = threading.Thread(
-                target=self._warmup_impl, daemon=True)
-            self._warmup_thread.start()
+            self._warmup_thread = spawn_worker(
+                "crypto.verify-warmup", self._warmup_impl)
         if wait:
             self._warmup_thread.join()
 
     def _compile_bucket(self, b: int) -> None:
-        """AOT-compile (or cache-load) one bucket shape."""
+        """AOT-compile (or cache-load) one bucket shape, routed exactly
+        like live traffic (mesh-sharded at or above SHARD_MIN_BATCH) so
+        warmup compiles the executables dispatch will actually use."""
         import numpy as np
-        import jax.numpy as jnp
-        fn, ndev = self._device_fn(b)
-        b = -(-b // ndev) * ndev
-        args = (jnp.zeros((b, 20), jnp.int32),
-                jnp.zeros((b,), jnp.int32),
-                jnp.zeros((b, 20), jnp.int32),
-                jnp.zeros((b,), jnp.int32),
-                jnp.zeros((b, 64), jnp.int32),
-                jnp.zeros((b, 64), jnp.int32))
-        np.asarray(fn(*args))
+        fn, bb, idxs = self._route(b)
+        zeros = {
+            "ay": np.zeros((bb, 20), np.int32),
+            "a_sign": np.zeros((bb,), np.int32),
+            "ry": np.zeros((bb, 20), np.int32),
+            "r_sign": np.zeros((bb,), np.int32),
+            "s_nibs": np.zeros((bb, 64), np.int32),
+            "k_nibs": np.zeros((bb, 64), np.int32),
+        }
+        np.asarray(fn(*self._device_args(zeros, idxs)))
 
     def _warmup_impl(self) -> None:
         st = self.stats
         try:
             self._enable_compile_cache()
+            planned, source = self._load_warmup_plan()
             if st is not None:
-                st.warmup_begin(self.BUCKETS)
-            for b in self.BUCKETS:
+                st.warmup_begin(planned, source=source)
+            for b in planned:
                 before = self._cache_entry_count()
                 t0 = real_monotonic()
                 self._compile_bucket(b)
@@ -639,8 +1026,8 @@ class TpuSigVerifier(BatchSigVerifier):
             self._warmed = True
             if st is not None:
                 st.warmup_done()
-            log.info("verify kernel warmup complete (%s buckets)",
-                     len(self.BUCKETS))
+            log.info("verify kernel warmup complete (%s buckets, "
+                     "%s plan)", len(planned), source)
         except Exception as e:
             log.warning("verify kernel warmup failed: %s", e)
             if st is not None:
@@ -662,58 +1049,252 @@ class TpuSigVerifier(BatchSigVerifier):
         return self.BUCKETS[-1]
 
     def verify_many(self, triples: Sequence[Triple]) -> List[bool]:
-        from ..ops import ed25519 as _e
-        from ..parallel.mesh import pad_batch_to
         import numpy as np
         import jax
-        import jax.numpy as jnp
 
         if self._platform is None:
             # the ACTUAL backing platform ("tpu"/"cpu"/…): a jax-on-CPU
             # run of this verifier is a fallback and must trace as one
             self._platform = jax.devices()[0].platform
         out: List[bool] = []
+        st = self.stats
         with self._span("crypto.verify_many", backend=self.name,
                         platform=self._platform, n=len(triples)) as sp:
+            chunks: List[Sequence[Triple]] = []
             i = 0
+            while i < len(triples):
+                chunks.append(triples[i:i + self.BUCKETS[-1]])
+                i += len(chunks[-1])
             batches = 0
             pad_waste = 0
-            while i < len(triples):
-                chunk = triples[i:i + self.BUCKETS[-1]]
-                n = len(chunk)
-                fn, ndev = self._device_fn(self._bucket(n))
-                b = -(-self._bucket(n) // ndev) * ndev
-                with self._span("crypto.dispatch", backend=self.name,
-                                n=n, bucket=b, pad=b - n):
-                    prep = _e.prepare_batch(
-                        [t[0] for t in chunk], [t[1] for t in chunk],
-                        [t[2] for t in chunk])
-                    padded = pad_batch_to(prep, b)  # pad lanes pre_ok=False
-                    ok = np.asarray(fn(
-                        jnp.asarray(padded["ay"]),
-                        jnp.asarray(padded["a_sign"]),
-                        jnp.asarray(padded["ry"]),
-                        jnp.asarray(padded["r_sign"]),
-                        jnp.asarray(padded["s_nibs"]),
-                        jnp.asarray(padded["k_nibs"])))
-                out.extend((ok[:n] & prep["pre_ok"]).tolist())
+            staged_s = overlap_s = 0.0
+            staged_chunks = 0
+            staged = self._stage_chunk(chunks[0],
+                                       self._route(len(chunks[0]))) \
+                if chunks else None
+            for k in range(len(chunks)):
+                # double buffer: chunk K+1 packs + device_puts on the
+                # staging worker while the device executes chunk K
+                job = _StagingJob(self, chunks[k + 1]) \
+                    if k + 1 < len(chunks) else None
+                n, b, idxs = staged["n"], staged["b"], staged["idxs"]
+                if st is not None:
+                    for di in idxs:
+                        st.set_device_inflight(di, True)
+                try:
+                    with self._span("crypto.dispatch", backend=self.name,
+                                    n=n, bucket=b, pad=b - n,
+                                    devices=len(idxs)):
+                        ok_dev = staged["fn"](*staged["args"])  # async
+                        wait_t0 = real_monotonic()
+                        ok = np.asarray(ok_dev)   # blocks on the fleet
+                        wait_t1 = real_monotonic()
+                except Exception:
+                    # a raising fleet dispatch counts against every
+                    # participating device's breaker (attribution to ONE
+                    # chip needs the fault-injection path); the batch
+                    # itself is completed by the resilient layer above
+                    health = self._fleet_health
+                    if health is not None:
+                        for di in idxs:
+                            health.record_failure(di)
+                    raise
+                finally:
+                    if st is not None:
+                        for di in idxs:
+                            st.set_device_inflight(di, False)
+                # every participant's breaker sees the success — single-
+                # device dispatches included, so transient failures
+                # spread over time never read as consecutive and a
+                # half-open device can recover via small drains too
+                health = self._fleet_health
+                if health is not None:
+                    for di in idxs:
+                        health.record_success(di)
+                out.extend((ok[:n] & staged["pre_ok"]).tolist())
                 self.batches_dispatched += 1
                 self.sigs_verified += n
                 batches += 1
                 pad_waste += b - n
-                if self.stats is not None:
-                    self.stats.record_bucket_dispatch(b, n, b - n)
-                i += n
+                if st is not None:
+                    # keyed by the LADDER shape, not the mesh-rounded
+                    # padded size: a degraded 3-device fleet rounds 8192
+                    # to 8193, and an off-ladder key would both escape
+                    # warmup_plan's pad-waste rule and mint unbounded
+                    # verifier.bucket.<b>.* metric families
+                    st.record_bucket_dispatch(self._bucket(n), n, b - n)
+                    lanes = b // len(idxs)
+                    for j, di in enumerate(idxs):
+                        real = min(max(n - j * lanes, 0), lanes)
+                        st.record_device_dispatch(di, real, lanes - real)
+                if job is not None:
+                    staged, s_s, o_s, stalled = job.result(wait_t0,
+                                                           wait_t1)
+                    if stalled:
+                        # staging stalled: re-stage synchronously so the
+                        # drain still completes (the device idles for
+                        # one chunk; the stall meter says so). The
+                        # failed attempt does NOT count toward the
+                        # overlap headline — a drain that stalled every
+                        # chunk must not report near-100% overlap.
+                        if st is not None:
+                            st.record_staging_stall()
+                        staged = self._stage_chunk(
+                            chunks[k + 1], self._route(len(chunks[k + 1])))
+                    else:
+                        staged_s += s_s
+                        overlap_s += o_s
+                        staged_chunks += 1
             sp.set_tag("batches", batches)
             sp.set_tag("pad_waste", pad_waste)
             total = len(triples)
             sp.set_tag("occupancy_pct", round(
                 100.0 * total / (total + pad_waste), 1)
                 if total + pad_waste else 100.0)
-            if self.stats is not None:
-                self.stats.record_drain(self.name, total, pad=pad_waste,
-                                        splits=batches)
+            if staged_chunks:
+                sp.set_tag("staging_overlap_pct", round(
+                    100.0 * overlap_s / staged_s, 1) if staged_s > 0
+                    else 100.0)
+            if st is not None:
+                if staged_chunks:
+                    st.record_staging(staged_s, overlap_s, staged_chunks)
+                st.record_drain(self.name, total, pad=pad_waste,
+                                splits=batches, bucketed=True)
+            self._drains_since_plan_save += 1
+            if self._drains_since_plan_save >= self.PLAN_AUTOSAVE_DRAINS:
+                self._drains_since_plan_save = 0
+                self.save_warmup_plan()
         return out
+
+
+class _StagingJob:
+    """One double-buffer staging unit: packs + device_puts drain chunk
+    K+1 on the `crypto.verify-staging` worker while the dispatch thread
+    waits on chunk K. Timing uses util.timer.real_monotonic (sanctioned:
+    host/device overlap is real elapsed time even under a frozen virtual
+    clock). A staging failure (including the verify.staging-stall fault
+    point) is reported as `stalled` — the caller re-stages synchronously
+    so the drain always completes."""
+
+    __slots__ = ("v", "chunk", "staged", "error", "t0", "t1", "thread")
+
+    def __init__(self, verifier: "TpuSigVerifier",
+                 chunk: Sequence[Triple]) -> None:
+        self.v = verifier
+        self.chunk = chunk
+        self.staged = None
+        self.error: Optional[Exception] = None
+        self.t0 = self.t1 = 0.0
+        self.thread = spawn_worker("crypto.verify-staging", self._run)
+
+    def _run(self) -> None:
+        self.t0 = real_monotonic()
+        try:
+            if self.v.faults is not None:
+                self.v.faults.fire_point("verify.staging-stall")
+            self.staged = self.v._stage_chunk(
+                self.chunk, self.v._route(len(self.chunk)))
+        except Exception as e:
+            self.error = e
+        self.t1 = real_monotonic()
+
+    def result(self, wait_t0: float, wait_t1: float):
+        """(staged, staged_s, overlap_s, stalled): overlap is the
+        intersection of the staging window with the caller's
+        device-wait window [wait_t0, wait_t1]."""
+        self.thread.join()
+        staged_s = max(0.0, self.t1 - self.t0)
+        overlap_s = max(0.0, min(self.t1, wait_t1) -
+                        max(self.t0, wait_t0))
+        if self.error is not None:
+            log.warning("verify staging stalled (%s); re-staging chunk "
+                        "synchronously", self.error)
+            return None, staged_s, overlap_s, True
+        return self.staged, staged_s, overlap_s, False
+
+
+class DeviceFleetHealth:
+    """Per-device circuit breakers over the verify fleet (ISSUE 11
+    satellite): the ResilientBatchVerifier's single breaker treats the
+    whole backend as one unit; this ring trips and recovers per chip,
+    so one sick device degrades the mesh to N-1 devices instead of
+    dropping every drain to the CPU fallback. State is exported as
+    `verifier.device.<i>.breaker` gauges (0 closed / 1 open / 2
+    half-open) plus trip/recover meters and a flight dump per trip.
+
+    Attribution honesty: a whole-mesh dispatch failure cannot name the
+    guilty chip, so it counts against every participant (and, via the
+    resilient layer, the global breaker); single-chip attribution comes
+    from the verify.device-lost fault point and device-identifiable
+    runtime errors."""
+
+    def __init__(self, n_devices: int, threshold: int = 3,
+                 cooldown_s: float = 30.0,
+                 now_fn: Optional[Callable[[], float]] = None,
+                 owner=None) -> None:
+        self.owner = owner     # verifier; stats read dynamically
+        # the ring is mutated from the dispatch thread AND the staging
+        # worker (_route runs on both): one lock makes allow()/record_*
+        # transitions atomic, so a just-tripped chip can never race its
+        # own cooldown back into the mesh. Lock order: fleet-health ->
+        # verifier-stats (the trip/recover callbacks record telemetry);
+        # nothing acquires them in reverse.
+        self._lock = TrackedLock("crypto.fleet-health")
+        self.breakers: List[CircuitBreaker] = []
+        for i in range(n_devices):
+            self.breakers.append(CircuitBreaker(
+                threshold=threshold, cooldown_s=cooldown_s, now_fn=now_fn,
+                on_trip=(lambda i=i: self._on_trip(i)),
+                on_recover=(lambda i=i: self._on_recover(i))))
+
+    def _stats(self):
+        return getattr(self.owner, "stats", None) \
+            if self.owner is not None else None
+
+    def healthy(self) -> List[int]:
+        """Device indices whose breaker admits a dispatch right now
+        (open breakers past their cooldown flip to half-open here —
+        the next fleet dispatch is their reprobe)."""
+        with self._lock:
+            return [i for i, br in enumerate(self.breakers)
+                    if br.allow()]
+
+    def record_failure(self, idx: int) -> bool:
+        with self._lock:
+            tripped = self.breakers[idx].record_failure()
+        self._sync_gauge(idx)
+        return tripped
+
+    def record_success(self, idx: int) -> None:
+        with self._lock:
+            self.breakers[idx].record_success()
+        self._sync_gauge(idx)
+
+    def _sync_gauge(self, idx: int) -> None:
+        st = self._stats()
+        if st is not None:
+            st.set_device_breaker(idx, self.breakers[idx].state_code())
+
+    def _on_trip(self, idx: int) -> None:
+        log.warning("verify device %d breaker TRIPPED; fleet degrades "
+                    "to %d device(s)", idx,
+                    sum(1 for br in self.breakers
+                        if br.state == CircuitBreaker.CLOSED))
+        st = self._stats()
+        if st is not None:
+            st.device_trip(idx, self.breakers[idx].to_json())
+
+    def _on_recover(self, idx: int) -> None:
+        log.info("verify device %d breaker recovered; fleet back to "
+                 "full mesh", idx)
+        st = self._stats()
+        if st is not None:
+            st.device_recover(idx)
+
+    def to_json(self) -> dict:
+        with self._lock:
+            return {"devices": {str(i): br.to_json()
+                                for i, br in enumerate(self.breakers)}}
 
 
 class CircuitBreaker:
@@ -865,6 +1446,14 @@ class ResilientBatchVerifier(BatchSigVerifier):
         if w is not None:
             w(wait)
 
+    def save_warmup_plan(self):
+        f = getattr(self.primary, "save_warmup_plan", None)
+        return f() if f is not None else None
+
+    @property
+    def fleet_health(self):
+        return getattr(self.primary, "_fleet_health", None)
+
     # -- verify paths --------------------------------------------------------
     def verify_many(self, triples: Sequence[Triple]) -> List[bool]:
         if self.breaker.allow():
@@ -956,6 +1545,14 @@ class ThreadedBatchVerifier(BatchSigVerifier):
         if w is not None:
             w(wait)
 
+    def save_warmup_plan(self):
+        f = getattr(self._inner, "save_warmup_plan", None)
+        return f() if f is not None else None
+
+    @property
+    def fleet_health(self):
+        return getattr(self._inner, "fleet_health", None)
+
     def enqueue(self, key: PublicKey, sig: bytes, msg: bytes) -> VerifyFuture:
         ck = _keys._cache_key(key.key_bytes, sig, msg)
         with _keys._cache_lock:
@@ -1033,7 +1630,7 @@ class ThreadedBatchVerifier(BatchSigVerifier):
 
             self._clock.post_to_main(complete)
 
-        threading.Thread(target=work, daemon=True).start()
+        spawn_worker("crypto.verify-dispatch", work)
 
     def verify_many(self, triples: Sequence[Triple]) -> List[bool]:
         return self._inner.verify_many(triples)
@@ -1065,6 +1662,9 @@ def make_verifier(backend: str = "cpu", clock=None,
         primary.tracer = tracer
         primary.metrics = metrics
         primary.stats = stats
+        primary.faults = faults   # verify.device-lost / .staging-stall
+        # fire inside the device backend's route/staging, not just the
+        # resilient layer's device.dispatch point
         fb = CpuSigVerifier()
         fb.tracer = tracer
         fb.metrics = metrics
@@ -1079,17 +1679,26 @@ def make_verifier(backend: str = "cpu", clock=None,
         r.stats = stats
         return r
 
+    def device() -> TpuSigVerifier:
+        # the per-device breaker ring shares the resilient layer's
+        # threshold/cooldown knobs and the injected app clock, so a
+        # chip's trip/reprobe schedule is as deterministic under a
+        # virtual clock as the whole-backend breaker's
+        return TpuSigVerifier(max_pending=max_pending,
+                              compile_cache_dir=compile_cache_dir,
+                              now_fn=now_fn,
+                              device_breaker_threshold=breaker_threshold,
+                              device_breaker_cooldown=breaker_cooldown)
+
     if backend == "cpu":
         v: BatchSigVerifier = CpuSigVerifier()
     elif backend == "cpu-resilient":
         v = resilient(CpuSigVerifier())
     elif backend == "tpu":
-        v = resilient(TpuSigVerifier(max_pending=max_pending,
-                                     compile_cache_dir=compile_cache_dir))
+        v = resilient(device())
     elif backend == "tpu-async":
         assert clock is not None
-        inner = resilient(TpuSigVerifier(max_pending=max_pending,
-                                         compile_cache_dir=compile_cache_dir))
+        inner = resilient(device())
         inner.metrics = metrics
         inner.faults = faults
         v = ThreadedBatchVerifier(inner, clock, metrics=metrics)

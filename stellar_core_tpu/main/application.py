@@ -203,6 +203,13 @@ class Application:
 
     def stop(self) -> None:
         self.state = AppState.APP_STOPPING
+        # persist the cockpit-derived warmup bucket plan beside the XLA
+        # cache (ISSUE 11): the next start warms only the shapes this
+        # run's real traffic used. Best-effort no-op on CPU backends or
+        # when the cockpit saw no traffic.
+        save_plan = getattr(self.sig_verifier, "save_warmup_plan", None)
+        if save_plan is not None:
+            save_plan()
         # interrupt any background quorum-intersection enumeration first:
         # joining that worker can otherwise take minutes (reference
         # HerderImpl.cpp:140-144)

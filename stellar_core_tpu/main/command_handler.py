@@ -155,10 +155,13 @@ class CommandHandler:
         docs/observability.md#device-cockpit): the batch-verify
         boundary's operational state in one JSON blob — per-bucket
         occupancy/pad-waste histograms, drain attribution by serving
-        backend, compile-cache + per-bucket warmup status (app-clock
-        stamped), queue depth/inflight/queue-wait, breaker state, and
-        the verify-cache counters. The same data is scrapeable as
-        `sct_verifier_*` series via `metrics?format=prometheus`."""
+        backend, per-device fleet rows (drain/sig/pad attribution,
+        inflight, breaker ring), double-buffer staging overlap,
+        compile-cache + per-bucket warmup status (app-clock stamped,
+        with the warm-start plan source), queue depth/inflight/
+        queue-wait, breaker state, and the verify-cache counters. The
+        same data is scrapeable as `sct_verifier_*` series via
+        `metrics?format=prometheus`."""
         v = getattr(self.app, "sig_verifier", None)
         if v is None:
             return {"error": "no signature verifier wired"}
@@ -173,6 +176,13 @@ class CommandHandler:
         if breaker is not None:
             out["breaker"] = breaker.to_json()
         inner = getattr(v, "inner", v)
+        # fleet rows (ISSUE 11): per-device breaker ring of the device
+        # backend, read without forcing a jax device resolve — the
+        # per-device drain/inflight attribution itself rides in
+        # stats.to_json()["devices"] above
+        fleet = getattr(inner, "_fleet_health", None)
+        if fleet is not None:
+            out["fleet"] = fleet.to_json()
         out["counters"] = {
             "batches_dispatched": getattr(inner, "batches_dispatched", 0),
             "sigs_verified": getattr(inner, "sigs_verified", 0),

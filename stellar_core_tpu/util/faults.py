@@ -40,6 +40,8 @@ log = get_logger("Fault")
 # site name is a 400, not a silently-armed no-op.
 KNOWN_SITES = frozenset({
     "device.dispatch",
+    "verify.device-lost",
+    "verify.staging-stall",
     "overlay.drop",
     "overlay.delay",
     "overlay.duplicate",

@@ -26,6 +26,13 @@ production can opt in with SCT_THREAD_CHECKS=1.
   thread acquires B while holding A; an edge that closes a cycle raises
   `LockOrderError` carrying BOTH acquisition stacks (the recorded one
   that created the conflicting edge and the current one).
+- `WORKER_THREAD_REGISTRY` + `spawn_worker(name, target)`: every
+  long-lived worker the framework starts (verify dispatch, verify
+  staging, kernel warmup, quorum-intersection, ...) is spawned through
+  one audited factory under a registered name, so the set of threads
+  that may exist is a reviewable registry instead of grep output — and
+  the static T1 rule follows `spawn_worker` targets exactly like bare
+  `Thread(target=...)` sites (docs/static-analysis.md).
 """
 
 from __future__ import annotations
@@ -46,6 +53,44 @@ _main_thread: Optional[threading.Thread] = None
 # rule and tests/test_threads.py assert this registry covers the hot
 # mutation points
 MAIN_THREAD_REGISTRY: Dict[str, str] = {}
+
+# name -> description of every worker thread the framework may start —
+# long-lived workers (verify dispatch, warmup) and short-lived per-job
+# ones (a staging job per drain chunk) alike. Spawning through
+# `spawn_worker` asserts membership, so a new thread cannot appear
+# without a registry entry (and the matching module-docstring audit
+# line); tests/test_threads.py pins the set.
+WORKER_THREAD_REGISTRY: Dict[str, str] = {
+    "crypto.verify-dispatch":
+        "ThreadedBatchVerifier batch dispatch; completes futures via "
+        "clock.post_to_main only",
+    "crypto.verify-staging":
+        "TpuSigVerifier double-buffer staging: packs + device_puts "
+        "drain chunk K+1 while the device runs chunk K (one short-"
+        "lived job thread per staged chunk — spawn cost is microseconds "
+        "against a multi-second device dispatch)",
+    "crypto.verify-warmup":
+        "TpuSigVerifier AOT bucket warmup; touches JAX state only",
+}
+
+
+def register_worker_thread(name: str, description: str) -> None:
+    """Register an additional worker-thread entry point (subsystems
+    outside crypto add theirs at import time)."""
+    WORKER_THREAD_REGISTRY[name] = description
+
+
+def spawn_worker(name: str, target: Callable[[], None],
+                 daemon: bool = True) -> threading.Thread:
+    """Start a named worker thread; `name` must be registered in
+    WORKER_THREAD_REGISTRY (an unregistered spawn is a programming
+    error, caught in tier-1 — not an operator-facing failure)."""
+    assert name in WORKER_THREAD_REGISTRY, (
+        "worker thread %r is not in util.threads.WORKER_THREAD_REGISTRY "
+        "— register it (with a description) before spawning" % name)
+    t = threading.Thread(target=target, name=name, daemon=daemon)
+    t.start()
+    return t
 
 
 class ThreadDisciplineError(AssertionError):

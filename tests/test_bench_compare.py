@@ -156,6 +156,74 @@ def test_fleet_payload_overlay_breakdown_normalizes():
     assert all(r["direction"] == "lower" for r in recs)
 
 
+# --------------------------------------------------- fleet_verify
+
+def _good_fleet_verify():
+    return {
+        "1": {"devices": 1, "fleet_sigs_per_s": 480.0,
+              "per_device_sigs_per_s": 480.0, "warm_restart_s": 2.5},
+        "4": {"devices": 4, "fleet_sigs_per_s": 1000.0,
+              "per_device_sigs_per_s": 250.0, "warm_restart_s": 3.1},
+    }
+
+
+def test_fleet_verify_validates_and_normalizes():
+    fv = _good_fleet_verify()
+    assert bc.validate_fleet_verify(fv, "t") == []
+    recs = bc.fleet_verify_records(fv, "src")
+    by = {(r["metric"], r["platform"]): r for r in recs}
+    assert by[("fleet_sigs_per_s", "verify-fleet-cpu4")]["value"] == 1000.0
+    assert by[("fleet_sigs_per_s", "verify-fleet-cpu4")]["direction"] == \
+        "higher"
+    assert by[("per_device_sigs_per_s", "verify-fleet-cpu1")]["value"] == \
+        480.0
+    assert by[("warm_restart_s", "verify-fleet-cpu4")]["direction"] == \
+        "lower"
+    assert len(recs) == 6
+    for r in recs:
+        assert bc.validate_record(r, "t") == []
+
+
+def test_fleet_verify_schema_violations_fail_check():
+    fv = _good_fleet_verify()
+    fv["4"]["per_device_sigs_per_s"] = 900.0     # != fleet/devices
+    errs = bc.validate_fleet_verify(fv, "t")
+    assert any("inconsistent" in e for e in errs)
+    fv = _good_fleet_verify()
+    fv["4"]["devices"] = 2                       # key/devices mismatch
+    assert any("matching its key" in e
+               for e in bc.validate_fleet_verify(fv, "t"))
+    fv = _good_fleet_verify()
+    fv["1"]["warm_restart_s"] = -1
+    assert any("warm_restart_s" in e
+               for e in bc.validate_fleet_verify(fv, "t"))
+    fv = _good_fleet_verify()
+    fv["1"]["fleet_sigs_per_s"] = 0
+    assert any("fleet_sigs_per_s" in e
+               for e in bc.validate_fleet_verify(fv, "t"))
+
+
+def test_fleet_verify_payload_normalizes_and_checks(tmp_path):
+    """A `bench.py --fleet-verify` artifact (payload-level fleet_verify
+    block + fleet_speedup) derives per-device-count records through
+    records_from_bench, and check_artifact enforces the block schema."""
+    import json
+    blob = {"metric": "fleet_verify_sigs_per_s", "unit": "sigs/s",
+            "value": 1000.0, "platform": "verify-fleet-cpu",
+            "fleet_speedup": 2.08, "fleet_verify": _good_fleet_verify()}
+    recs = bc.records_from_bench(blob, "BENCH_r99.json")
+    by = {(r["metric"], r["platform"]): r for r in recs}
+    assert ("fleet_sigs_per_s", "verify-fleet-cpu1") in by
+    assert by[("fleet_verify_speedup", "verify-fleet-cpu")]["value"] == \
+        2.08
+    p = tmp_path / "BENCH_r99.json"
+    p.write_text(json.dumps(blob))
+    assert bc.check_artifact(str(p)) == []
+    blob["fleet_verify"]["4"]["fleet_sigs_per_s"] = None
+    p.write_text(json.dumps(blob))
+    assert any("fleet_sigs_per_s" in e for e in bc.check_artifact(str(p)))
+
+
 def test_overlay_breakdown_sum_contract_enforced(tmp_path):
     ob = _good_overlay_breakdown()
     ob["stage_seconds"]["queue-to-include"] = 5.0    # no longer sums

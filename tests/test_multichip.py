@@ -95,12 +95,17 @@ def test_weak_scaling_1_2_4_8_devices():
         mesh = make_mesh(devices[:ndev])
         fn = sharded_verify_fn(mesh)
         args = _device_args(pubs, sigs, msgs)
-        ok = list(map(bool, fn(*args)))
+        # AOT-compile once and execute THAT executable: running fn(*args)
+        # and then lower().compile() separately loads two identical
+        # executables per mesh (~25s each from the persistent cache on
+        # CPU) — one is enough for both the verdicts and the cost model
+        compiled = fn.lower(*args).compile()
+        ok = list(map(bool, compiled(*args)))
         assert ok == [i not in bad for i in range(n)]
         # sample oracle agreement (full oracle over 240 sigs is slow)
         for i in (0, 3, n // 2, n - 1):
             assert ok[i] == verify_oracle(pubs[i], sigs[i], msgs[i])
-        cost = fn.lower(*args).compile().cost_analysis()
+        cost = compiled.cost_analysis()
         if isinstance(cost, (list, tuple)):
             cost = cost[0] if cost else {}
         if cost and "flops" in cost:

@@ -215,6 +215,36 @@ def test_t1_worker_reaching_marked_function(tmp_path):
     assert "relay" in t1[0].message
 
 
+def test_t1_follows_spawn_worker_targets(tmp_path):
+    """Routing a thread spawn through util.threads.spawn_worker (the
+    ISSUE 11 worker registry) must not weaken T1: its target is walked
+    exactly like a bare Thread(target=...) entry point."""
+    cfg = _fixture_repo(tmp_path, {"ledger/lm.py": """
+        from ..util.threads import main_thread_only
+
+        @main_thread_only
+        def apply_ledger_close(lcd):
+            pass
+    """, "crypto/stage.py": """
+        from ..ledger.lm import apply_ledger_close
+        from ..util.threads import spawn_worker
+
+        def start(lcd):
+            spawn_worker("crypto.verify-staging",
+                         lambda: apply_ledger_close(lcd))
+
+        def start_kw(lcd):
+            spawn_worker("crypto.verify-staging",
+                         target=lambda: apply_ledger_close(lcd))
+    """})
+    res = run_analysis(cfg)
+    t1 = [f for f in res.violations if f.rule == "T1"]
+    assert len(t1) == 2
+    assert all(f.path == "fakepkg/crypto/stage.py" for f in t1)
+    assert all("apply_ledger_close" in f.message for f in t1)
+    assert all("spawn_worker" in f.message for f in t1)
+
+
 def test_t1_posting_to_main_is_clean(tmp_path):
     cfg = _fixture_repo(tmp_path, {"mod.py": """
         import threading

@@ -212,6 +212,35 @@ def test_disarmed_tracked_lock_overhead_is_negligible():
     assert tracked_cost < raw_cost * 4 + 0.05, (raw_cost, tracked_cost)
 
 
+def test_worker_thread_registry_and_spawn_worker():
+    """ISSUE 11 satellite: every framework worker spawns through
+    spawn_worker under a registered name — the crypto workers
+    (dispatch, double-buffer staging, warmup) must be in the registry,
+    each with a real description, and an unregistered spawn is a
+    programming error caught here, not a silent extra thread."""
+    reg = threads.WORKER_THREAD_REGISTRY
+    for name in ("crypto.verify-dispatch", "crypto.verify-staging",
+                 "crypto.verify-warmup"):
+        assert name in reg and reg[name].strip()
+
+    ran = threading.Event()
+    t = threads.spawn_worker("crypto.verify-staging", ran.set)
+    t.join(timeout=10)
+    assert ran.is_set()
+    assert t.name == "crypto.verify-staging"
+    assert t.daemon
+
+    with pytest.raises(AssertionError, match="WORKER_THREAD_REGISTRY"):
+        threads.spawn_worker("crypto.unregistered-worker", lambda: None)
+
+    threads.register_worker_thread("test.scratch-worker", "test-only")
+    try:
+        t2 = threads.spawn_worker("test.scratch-worker", lambda: None)
+        t2.join(timeout=10)
+    finally:
+        del threads.WORKER_THREAD_REGISTRY["test.scratch-worker"]
+
+
 def test_armed_run_keeps_production_locks_cycle_free():
     """Drive a small consensus burst with the checker armed: the
     production TrackedLocks (verify cache, threaded verifier, reactor)

@@ -340,6 +340,12 @@ def test_admin_verifier_endpoint_live(app):
     assert body["drains"]["by_backend"]["cpu"]["sigs"] == 6
     assert body["drains"]["occupancy_pct"]["count"] >= 1
     assert body["warmup"]["state"] == "idle"
+    assert body["warmup"]["source"] is None     # warmup never ran
+    # fleet rows (ISSUE 11) ride in the same blob: empty on a CPU-only
+    # stack, but the keys are part of the endpoint contract
+    assert body["devices"] == {}
+    assert body["staging"]["chunks"] == 0
+    assert body["staging"]["stalls"] == 0
     assert "compile_cache" in body
     assert body["queue"]["depth"] == 0
     assert body["breaker"]["state"] == "closed"

@@ -289,6 +289,75 @@ def validate_overlay_breakdown(ob, where: str = "") -> List[str]:
     return errs
 
 
+def fleet_verify_records(fv: dict, source: str, round_no=None,
+                         at_unix=None) -> List[dict]:
+    """Normalize a `fleet_verify` block (ISSUE 11: the multi-device
+    verify leg) into direction-aware records keyed per forced device
+    count — `verify-fleet-cpu<N>` platforms only ever gate against
+    their own device-count history, never against single-chip device
+    numbers."""
+    out: List[dict] = []
+    if not isinstance(fv, dict):
+        return out
+    for nd, leg in sorted(fv.items()):
+        if not isinstance(leg, dict):
+            continue
+        plat = "verify-fleet-cpu%s" % nd
+        for key, metric, unit, direction in (
+                ("fleet_sigs_per_s", "fleet_sigs_per_s", "sigs/s",
+                 "higher"),
+                ("per_device_sigs_per_s", "per_device_sigs_per_s",
+                 "sigs/s", "higher"),
+                ("warm_restart_s", "warm_restart_s", "s", "lower")):
+            v = _num(leg, key)
+            if v is not None:
+                out.append(make_record(metric, unit, v, plat, direction,
+                                       source, round_no, at_unix))
+    return out
+
+
+def validate_fleet_verify(fv, where: str = "") -> List[str]:
+    """Schema check for one `fleet_verify` block (`check`/`--check`):
+    every device-count leg needs finite positive rates whose
+    per-device figure is exactly fleet/devices, a non-negative warm
+    restart, and a device count matching its key — a fleet artifact
+    whose arithmetic stops agreeing is itself a regression."""
+    errs: List[str] = []
+    if not isinstance(fv, dict):
+        return ["%s: fleet_verify is not an object: %r" % (where, fv)]
+    for nd, leg in sorted(fv.items()):
+        lw = "%s: fleet_verify[%s]" % (where, nd)
+        if not isinstance(leg, dict):
+            errs.append("%s must be an object" % lw)
+            continue
+        devices = leg.get("devices")
+        if not isinstance(devices, int) or isinstance(devices, bool) \
+                or devices < 1 or str(devices) != str(nd):
+            errs.append("%s.devices must be a positive int matching its "
+                        "key, got %r" % (lw, devices))
+            continue
+        fleet = _num(leg, "fleet_sigs_per_s")
+        per_dev = _num(leg, "per_device_sigs_per_s")
+        if fleet is None or fleet <= 0:
+            errs.append("%s.fleet_sigs_per_s must be a finite number "
+                        "> 0, got %r" % (lw, leg.get("fleet_sigs_per_s")))
+        if per_dev is None or per_dev <= 0:
+            errs.append("%s.per_device_sigs_per_s must be a finite "
+                        "number > 0, got %r"
+                        % (lw, leg.get("per_device_sigs_per_s")))
+        if fleet is not None and per_dev is not None and fleet > 0:
+            want = fleet / devices
+            if abs(per_dev - want) > max(0.15, 1e-3 * want):
+                errs.append("%s.per_device_sigs_per_s %.1f inconsistent "
+                            "with fleet/devices %.1f" % (lw, per_dev,
+                                                         want))
+        wr = _num(leg, "warm_restart_s")
+        if wr is None or wr < 0:
+            errs.append("%s.warm_restart_s must be a finite number >= 0,"
+                        " got %r" % (lw, leg.get("warm_restart_s")))
+    return errs
+
+
 def _replay_leg_records(leg: dict, platform: str, source: str,
                         round_no, at_unix) -> List[dict]:
     out = []
@@ -382,6 +451,17 @@ def _payload_records(p: dict, source: str, round_no,
     if isinstance(ob, dict):
         out.extend(overlay_breakdown_records(ob, platform, source,
                                              round_no, at_unix))
+    # multi-device verify legs (`bench.py --fleet-verify`; the artifact
+    # also carries an explicit `records` list, which normalize_any
+    # prefers — this path keeps nested/legacy blobs normalizable)
+    fv = p.get("fleet_verify")
+    if isinstance(fv, dict):
+        out.extend(fleet_verify_records(fv, source, round_no, at_unix))
+        v = _num(p, "fleet_speedup")
+        if v is not None:
+            out.append(make_record("fleet_verify_speedup", "x", v,
+                                   "verify-fleet-cpu", "higher", source,
+                                   round_no, at_unix))
     # device history survives device-less rounds via the cached block
     for nest in (p.get("last_device"),
                  (p.get("errors") or {}).get("last_real_device_result")):
@@ -504,6 +584,8 @@ def _walk_breakdowns(blob, name: str, errs: List[str],
     if "overlay_breakdown" in blob:
         errs.extend(validate_overlay_breakdown(blob["overlay_breakdown"],
                                                name))
+    if "fleet_verify" in blob:
+        errs.extend(validate_fleet_verify(blob["fleet_verify"], name))
     for v in blob.values():
         if isinstance(v, (dict, list)):
             _walk_breakdowns(v, name, errs, depth + 1)
