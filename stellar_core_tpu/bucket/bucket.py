@@ -17,7 +17,6 @@ from __future__ import annotations
 import os
 from typing import Iterable, List, Optional, Sequence, Tuple
 
-from ..crypto.hashing import SHA256
 from ..util.xdrstream import XDRInputFileStream, XDROutputFileStream
 from ..xdr import (
     BucketEntry, BucketEntryType, LedgerEntry, LedgerKey, ledger_entry_key,
@@ -106,9 +105,11 @@ class Bucket:
 
     # -- persistence ---------------------------------------------------------
     def write_to(self, path: str) -> None:
+        # the memoized framed records the hash already serialized —
+        # a bucket file write never re-serializes its entries
         with XDROutputFileStream(path) as out:
             for e in self._entries:
-                out.write_one(BucketEntry, e)
+                out.write_record(entry_record(e))
         self.path = path
 
     @classmethod
@@ -279,16 +280,39 @@ def merge_buckets(old_bucket: Bucket, new_bucket: Bucket,
     return out.bucket()
 
 
+def entry_record(e: BucketEntry) -> bytes:
+    """One entry's on-disk framed record (RFC 5531 mark + XDR body),
+    MEMOIZED on the entry object. Bucket entries are immutable
+    snapshots by construction (the ledgertxn layer hands the close
+    delta out as structural copies, and buckets never mutate their
+    entries), so one serialization serves the bucket's identity hash,
+    its file write, AND every later merge that re-hashes the same
+    entry objects into a new bucket — the `bucket add` close-phase
+    win the BENCH_r11 leg gates."""
+    rec = e.__dict__.get("_sct_rec")
+    if rec is None:
+        from ..util.xdrstream import frame_record
+        rec = frame_record(e.to_xdr())
+        e.__dict__["_sct_rec"] = rec
+    return rec
+
+
+def entry_record_chunks(entries: Sequence[BucketEntry]):
+    """The bucket's on-disk byte stream as chunks — the exact bytes
+    XDROutputFileStream writes, so the stream digest IS the file
+    identity."""
+    for e in entries:
+        yield entry_record(e)
+
+
 def _hash_entries(entries: Sequence[BucketEntry]) -> bytes:
     """Hash over the serialized stream exactly as it sits on disk
     (reference hashes the XDR file bytes including record marks via
-    SHA256 in XDROutputFileStream::writeOne)."""
+    SHA256 in XDROutputFileStream::writeOne). Routed through the
+    bounded-join stream digest (ISSUE 12): one C-level hashlib update
+    per ~1 MiB group, over memoized per-entry records — registry-free
+    (merge worker threads call this)."""
     if not entries:
         return b"\x00" * 32
-    import struct
-    h = SHA256()
-    for e in entries:
-        b = e.to_xdr()
-        h.add(struct.pack(">I", len(b) | 0x80000000))
-        h.add(b)
-    return h.finish()
+    from ..crypto.batch_hasher import stream_digest
+    return stream_digest(entry_record_chunks(entries))

@@ -241,12 +241,22 @@ class BucketLevel:
         self.curr = Bucket()
         self.snap = Bucket()
         self.next = FutureBucket()
+        # (curr_hash, snap_hash) -> level hash: most levels change only
+        # at their spill boundaries, so a close re-hashes O(changed
+        # levels), not all 11 (ISSUE 12 — the incremental half of the
+        # state commitment, applied to the consensus hash chain too)
+        self._hash_cache: tuple = ()
 
     def get_hash(self) -> bytes:
+        key = (self.curr.get_hash(), self.snap.get_hash())
+        if len(self._hash_cache) == 2 and self._hash_cache[0] == key:
+            return self._hash_cache[1]
         h = SHA256()
-        h.add(self.curr.get_hash())
-        h.add(self.snap.get_hash())
-        return h.finish()
+        h.add(key[0])
+        h.add(key[1])
+        out = h.finish()
+        self._hash_cache = (key, out)
+        return out
 
     def commit(self) -> None:
         """Promote a live next merge into curr (BucketList.cpp:80-89)."""

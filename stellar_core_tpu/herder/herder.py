@@ -782,7 +782,8 @@ class Herder:
             checker.interrupted = True
 
     def recv_tx_set(self, h: bytes, txset: TxSetFrame) -> bool:
-        if txset.get_contents_hash() != h:
+        if txset.get_contents_hash(
+                hasher=getattr(self.app, "batch_hasher", None)) != h:
             return False
         tl = getattr(self.app, "slot_timeline", None)
         if tl is not None and txset.previous_ledger_hash == \
@@ -838,7 +839,8 @@ class Herder:
                 self.tx_queue.ban([f.full_hash() for f in removed])
             txset.surge_pricing_filter(lcl)
             tsp.set_tag("txs", len(txset.frames))
-            h = txset.get_contents_hash()
+            h = txset.get_contents_hash(
+                hasher=getattr(self.app, "batch_hasher", None))
             self.pending.add_tx_set(h, txset)
             # lifecycle stamp: txset inclusion at nomination (the slot's
             # externalized set may differ; missed stages backfill)

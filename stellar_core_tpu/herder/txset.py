@@ -16,7 +16,6 @@ from __future__ import annotations
 
 from typing import Dict, List, Optional, Tuple
 
-from ..crypto.hashing import SHA256, sha256
 from ..ledger.ledgertxn import LedgerTxn
 from ..transactions.transaction_frame import (
     FeeBumpTransactionFrame, TransactionFrame,
@@ -302,11 +301,23 @@ class TxSetFrame:
         return ok
 
     # -- hashing ------------------------------------------------------------
-    def get_contents_hash(self) -> bytes:
+    def get_contents_hash(self, hasher=None) -> bytes:
+        """SHA256(previousLedgerHash ‖ sorted envelopes), streamed as
+        one whole-txset digest through the bounded-join stream path
+        (crypto/batch_hasher.stream_digest, ISSUE 12) — identical bytes
+        to the incremental-context path, one C-level update per ~1 MiB
+        of envelopes instead of one Python call per tx. Callers with an
+        app context (herder intake, the close's value check) pass the
+        app's BatchHasher so the computation lands in the hash cockpit
+        under the `txset` site; cache hits never re-attribute."""
         if self._hash is None:
-            h = SHA256()
-            h.add(self.previous_ledger_hash)
-            for f in self.sorted_for_hash():
-                h.add(f.envelope_bytes())
-            self._hash = h.finish()
+            from itertools import chain
+            chunks = chain(
+                (self.previous_ledger_hash,),
+                (f.envelope_bytes() for f in self.sorted_for_hash()))
+            if hasher is not None:
+                self._hash = hasher.hash_stream(chunks, site="txset")
+            else:
+                from ..crypto.batch_hasher import stream_digest
+                self._hash = stream_digest(chunks)
         return self._hash

@@ -191,7 +191,8 @@ class LedgerManager:
         assert lcd.ledger_seq == header_prev.ledgerSeq + 1, "non-sequential"
         assert lcd.tx_set.previous_ledger_hash == self.lcl_hash, \
             "txset based on wrong ledger"
-        assert lcd.value.txSetHash == lcd.tx_set.get_contents_hash(), \
+        assert lcd.value.txSetHash == lcd.tx_set.get_contents_hash(
+            hasher=getattr(self.app, "batch_hasher", None)), \
             "value/txset hash mismatch"
 
         verifier = getattr(self.app, "sig_verifier", None)
@@ -327,11 +328,24 @@ class LedgerManager:
         # TransactionResultSet XDR is count ‖ pairs, and each frame holds
         # (or lazily serializes) its own pair bytes — on the native fast
         # path no TransactionResult is ever parsed or re-serialized here
-        # (tests/test_native_apply.py pins this layout against the codec)
+        # (tests/test_native_apply.py pins this layout against the codec).
+        # STREAMED through the hash boundary (ISSUE 12 satellite): the
+        # old path built the full concatenated blob before hashing, so
+        # peak memory grew with the txset — the chunked stream keeps it
+        # flat and identical byte-for-byte (tests/test_batch_hasher.py)
         with app_span(self.app, "close.result_hash", cat="ledger"):
-            header.txSetResultHash = sha256(
-                _be_u32(len(frames)) +
-                b"".join(f.result_pair_xdr() for f in frames))
+            from itertools import chain
+            chunks = chain((_be_u32(len(frames)),),
+                           (f.result_pair_xdr() for f in frames))
+            hasher = getattr(self.app, "batch_hasher", None)
+            if hasher is not None:
+                header.txSetResultHash = hasher.hash_stream(
+                    chunks, site="result-set")
+            else:
+                h = SHA256()
+                for c in chunks:
+                    h.add(c)
+                header.txSetResultHash = h.finish()
 
         # invariants see the TX-phase delta under the pre-upgrade header:
         # the reference hooks invariants per operation only, so upgrade
@@ -427,7 +441,24 @@ class LedgerManager:
         with app_span(self.app, "close.commit", cat="ledger"):
             ltx.commit()
         with app_span(self.app, "close.header_hash", cat="ledger"):
-            self.lcl_hash = sha256(self.root.get_header().to_xdr())
+            hasher = getattr(self.app, "batch_hasher", None)
+            hb = self.root.get_header().to_xdr()
+            self.lcl_hash = (hasher.digest_one(hb, site="header")
+                             if hasher is not None else sha256(hb))
+        # state commitment (ledger/state_commitment.py, ISSUE 12): the
+        # incremental Merkle root over the post-close bucket list, plus
+        # a signed light-client checkpoint on its interval — O(changed
+        # levels) per close via the entry-root cache
+        sce = getattr(self.app, "state_commitment", None)
+        if sce is not None and bl is not None:
+            with app_span(self.app, "close.commitment", cat="ledger",
+                          seq=lcd.ledger_seq) as msp:
+                cp = sce.on_close(bl.bucket_list, lcd.ledger_seq,
+                                  self.lcl_hash)
+                if sce.root is not None:
+                    msp.set_tag("root", sce.root.hex()[:16])
+                if cp is not None:
+                    msp.set_tag("checkpoint_seq", cp.ledger_seq)
         with app_span(self.app, "close.sql_commit", cat="ledger"):
             self._store_header(self.root.get_header())
             self._store_txs(lcd, frames)

@@ -109,6 +109,19 @@ class Application:
             breaker_threshold=config.SIG_VERIFY_BREAKER_THRESHOLD,
             breaker_cooldown=config.SIG_VERIFY_BREAKER_COOLDOWN)
 
+        # batched SHA-256 boundary (crypto/batch_hasher.py, ISSUE 12):
+        # the hashing twin of the verifier — config-gated device
+        # backend behind the same breaker knobs, one HasherStats
+        # cockpit behind the admin `hasher` endpoint
+        from ..crypto.batch_hasher import make_hasher
+        self.batch_hasher = make_hasher(
+            config.HASH_BACKEND, clock=clock,
+            compile_cache_dir=config.SIG_VERIFY_COMPILE_CACHE_DIR,
+            metrics=self.metrics, tracer=self.tracer,
+            faults=self.faults, flight_recorder=self.flight_recorder,
+            breaker_threshold=config.SIG_VERIFY_BREAKER_THRESHOLD,
+            breaker_cooldown=config.SIG_VERIFY_BREAKER_COOLDOWN)
+
         self.invariant_manager = InvariantManager(self.metrics)
         for pattern in config.INVARIANT_CHECKS:
             self.invariant_manager.enable(pattern)
@@ -127,6 +140,12 @@ class Application:
         self.catchup_manager = None
         self.overlay_manager = None  # real OverlayManager unless simulated
         self.ledger_manager = LedgerManager(self)
+
+        # state commitments (ledger/state_commitment.py, ISSUE 12):
+        # incremental Merkle root over the bucket list + signed
+        # light-client checkpoints; active once buckets are enabled
+        from ..ledger.state_commitment import StateCommitmentEngine
+        self.state_commitment = StateCommitmentEngine(self)
 
         from ..herder.herder import Herder
         if config.QUORUM_SET is None:
@@ -166,6 +185,11 @@ class Application:
         if self.config.SIG_VERIFY_WARMUP and \
                 getattr(self.sig_verifier, "wants_prewarm", False):
             self.sig_verifier.warmup(wait=False)
+        # the hash kernel warms beside the verify kernel: same
+        # persistent XLA cache, same no-lazy-compile-on-consensus rule
+        if self.config.SIG_VERIFY_WARMUP and \
+                getattr(self.batch_hasher, "wants_warmup", False):
+            self.batch_hasher.warmup(wait=False)
         lm = self.ledger_manager
         if not lm.load_last_known_ledger():
             lm.start_new_ledger()

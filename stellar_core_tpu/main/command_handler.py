@@ -192,6 +192,89 @@ class CommandHandler:
         out["cache"] = _keys.verify_cache_stats()
         return out
 
+    def cmd_hasher(self, params) -> dict:
+        """Hash cockpit (ISSUE 12 tentpole;
+        docs/observability.md#hash-cockpit): the batch-hash boundary's
+        operational state in one JSON blob — per-drain batch-shape /
+        pad-waste / occupancy histograms, per-(lanes×blocks) bucket
+        dispatch stats, drain attribution by serving backend AND by
+        close-path call site (txset / result-set / header /
+        bucket-entries / …), double-buffer staging overlap,
+        compile-cache + per-shape warmup status, oversize split-outs,
+        and the breaker state. The same data is scrapeable as
+        `sct_hasher_*` series via `metrics?format=prometheus`."""
+        h = getattr(self.app, "batch_hasher", None)
+        if h is None:
+            return {"error": "no batch hasher wired"}
+        out: dict = {
+            "configured_backend": self.app.config.HASH_BACKEND,
+            "hasher": h.name,
+        }
+        stats = getattr(h, "stats", None)
+        if stats is not None:
+            out.update(stats.to_json())
+        breaker = getattr(h, "breaker", None)
+        if breaker is not None:
+            out["breaker"] = breaker.to_json()
+        return out
+
+    def cmd_checkpoint(self, params) -> dict:
+        """State checkpoints (ISSUE 12;
+        docs/observability.md#hash-cockpit): `checkpoint[?seq=N]
+        [&entry=<hex LedgerKey XDR>]`. With no params, the latest
+        signed StateCheckpoint {ledger seq, header hash, Merkle root,
+        node signature}; `seq=N` returns that exact checkpoint from the
+        ring. `entry=` additionally serves a Merkle membership proof
+        for that ledger entry against the current commitment root —
+        `light_client_verify(proof, checkpoint, network_id)` then
+        verifies authenticity with no replay and no ledger DB."""
+        sce = getattr(self.app, "state_commitment", None)
+        bm = getattr(self.app, "bucket_manager", None)
+        if sce is None or bm is None:
+            return {"error": "state commitments require buckets enabled"}
+        seq = _int_param(params, "seq", None, minimum=1)
+        cp = sce.checkpoint(seq)
+        out: dict = {
+            "checkpoint": cp,
+            "root": sce.root.hex() if sce.root is not None else None,
+            "interval": self.app.config.STATE_CHECKPOINT_INTERVAL,
+            "retained": len(sce.checkpoints),
+        }
+        if cp is None:
+            out["error"] = ("no checkpoint for seq %d in the ring" % seq
+                            if seq is not None else
+                            "no checkpoint emitted yet")
+        entry = params.get("entry")
+        if entry:
+            # proofs are built against the LATEST checkpoint's frozen
+            # view — pairing one with an older (or evicted/never-
+            # emitted) ring seq would hand a light client a
+            # (proof, checkpoint) pair that can never verify, so any
+            # non-latest seq+entry combination is a 400, never a
+            # silent trap
+            latest = sce.checkpoint()
+            if seq is not None and (
+                    latest is None or cp is None or
+                    cp["ledger_seq"] != latest["ledger_seq"]):
+                raise CommandParamError(
+                    "entry proofs are served against the latest "
+                    "checkpoint%s; request them without 'seq'"
+                    % ("" if latest is None else
+                       " (seq %d)" % latest["ledger_seq"]))
+            from ..xdr import LedgerKey
+            try:
+                key = LedgerKey.from_xdr(bytes.fromhex(entry))
+            except Exception:
+                raise CommandParamError(
+                    "parameter 'entry' must be a hex-encoded LedgerKey "
+                    "XDR, got %r" % entry)
+            proof = sce.prove_entry(key, bm.bucket_list)
+            out["proof"] = proof
+            if proof is None:
+                out["proof_error"] = \
+                    "entry not live in the bucket list"
+        return out
+
     def cmd_applystats(self, params) -> dict:
         """Close cockpit (ISSUE 9 tentpole;
         docs/observability.md#close-cockpit): the apply path's

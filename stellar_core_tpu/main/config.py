@@ -132,6 +132,19 @@ class Config:
         self.SIG_VERIFY_BREAKER_THRESHOLD = 3
         self.SIG_VERIFY_BREAKER_COOLDOWN = 30.0
 
+        # batched SHA-256 boundary (crypto/batch_hasher.py, ISSUE 12):
+        # "cpu" (default, hashlib), "cpu-resilient" (breaker-wrapped CPU,
+        # for chaos runs on device-less containers), "tpu" (JAX batched
+        # kernel behind the breaker + CPU fallback). The hasher shares
+        # the SIG_VERIFY_BREAKER_* knobs and compile-cache dir — one
+        # device failure domain, one operator surface.
+        self.HASH_BACKEND = "cpu"
+        # signed state-checkpoint cadence (ledger/state_commitment.py):
+        # a StateCheckpoint {seq, header hash, Merkle root, node sig} is
+        # emitted every N closes; <= 0 disables emission (the Merkle
+        # root still updates incrementally for the admin endpoint)
+        self.STATE_CHECKPOINT_INTERVAL = 8
+
         # fault injection (util/faults.py, docs/robustness.md): TOML table
         # of site name -> {p, n, after}; merged with the SCT_FAULTS env
         # spec ("site:p=0.5,n=3;site2") at Application construction.
@@ -214,6 +227,7 @@ class Config:
             "FLOOD_RATE_LIMIT_PER_PEER", "FLOOD_RATE_BURST",
             "FLOOD_BAN_SCORE_THRESHOLD",
             "SIG_VERIFY_BREAKER_THRESHOLD", "SIG_VERIFY_BREAKER_COOLDOWN",
+            "HASH_BACKEND", "STATE_CHECKPOINT_INTERVAL",
             "FAULTS_SEED",
         ]
         for k in simple_keys:

@@ -1,0 +1,187 @@
+"""Batched SHA-256 on the device: the hashing half of the crypto plane.
+
+Design (mirrors ops/ed25519.py's split of labor; ROADMAP item 5 — the
+accelerator-side proof-pipeline direction of ACE Runtime
+(PAPERS.md, 2603.10242) and the batched-hash accelerator of SZKP
+(2408.05890)):
+
+- One LANE per message: the batch rides the TPU lane dimension, each
+  lane runs the standard FIPS 180-4 compression over ITS OWN padded
+  message blocks. All state is uint32; adds wrap mod 2^32 and shifts
+  discard overflow bits natively, so the kernel is pure jnp bitwise/add
+  traffic on the VPU — no MXU, no transcendentals.
+- LAYOUT: device arrays are block-first / batch-last ((max_blocks, 16, B)
+  words) so every word of a block is a full-lane vector; the public
+  `hash_blocks_kernel` takes batch-first arrays (the host/byte layout)
+  and transposes once at the jit boundary, exactly like verify_kernel.
+- Variable lengths inside one fixed shape: the host pads every message
+  to the dispatch's block bucket and passes per-lane true block counts;
+  the block loop masks state updates with `i < n_blocks`, so a lane
+  simply stops absorbing once its own message ends. Identical digests
+  to hashlib for every length, asserted by the oracle tests.
+- Host does the byte work TPUs are bad at: FIPS padding + big-endian
+  word packing, numpy-vectorized per message via frombuffer (C speed).
+
+The pure-hashlib oracle lives alongside; `crypto/batch_hasher.py` wraps
+this kernel in the bucketed-dispatch / circuit-breaker machinery.
+"""
+
+from __future__ import annotations
+
+import hashlib
+from functools import partial
+from typing import List, Sequence, Tuple
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+
+# FIPS 180-4 round constants and initial state
+_K = np.array([
+    0x428a2f98, 0x71374491, 0xb5c0fbcf, 0xe9b5dba5,
+    0x3956c25b, 0x59f111f1, 0x923f82a4, 0xab1c5ed5,
+    0xd807aa98, 0x12835b01, 0x243185be, 0x550c7dc3,
+    0x72be5d74, 0x80deb1fe, 0x9bdc06a7, 0xc19bf174,
+    0xe49b69c1, 0xefbe4786, 0x0fc19dc6, 0x240ca1cc,
+    0x2de92c6f, 0x4a7484aa, 0x5cb0a9dc, 0x76f988da,
+    0x983e5152, 0xa831c66d, 0xb00327c8, 0xbf597fc7,
+    0xc6e00bf3, 0xd5a79147, 0x06ca6351, 0x14292967,
+    0x27b70a85, 0x2e1b2138, 0x4d2c6dfc, 0x53380d13,
+    0x650a7354, 0x766a0abb, 0x81c2c92e, 0x92722c85,
+    0xa2bfe8a1, 0xa81a664b, 0xc24b8b70, 0xc76c51a3,
+    0xd192e819, 0xd6990624, 0xf40e3585, 0x106aa070,
+    0x19a4c116, 0x1e376c08, 0x2748774c, 0x34b0bcb5,
+    0x391c0cb3, 0x4ed8aa4a, 0x5b9cca4f, 0x682e6ff3,
+    0x748f82ee, 0x78a5636f, 0x84c87814, 0x8cc70208,
+    0x90befffa, 0xa4506ceb, 0xbef9a3f7, 0xc67178f2], dtype=np.uint32)
+
+_H0 = np.array([
+    0x6a09e667, 0xbb67ae85, 0x3c6ef372, 0xa54ff53a,
+    0x510e527f, 0x9b05688c, 0x1f83d9ab, 0x5be0cd19], dtype=np.uint32)
+
+
+def blocks_for_len(n: int) -> int:
+    """FIPS padded 64-byte block count for an n-byte message (the 0x80
+    marker plus the 8-byte bit length always fit, so empty = 1 block)."""
+    return (n + 9 + 63) // 64
+
+
+def _rotr(x: jnp.ndarray, n: int) -> jnp.ndarray:
+    return (x >> np.uint32(n)) | (x << np.uint32(32 - n))
+
+
+def _compress(state: tuple, blk: jnp.ndarray) -> tuple:
+    """One compression round over a (16, B) block; state is 8 × (B,)
+    uint32. The 48 schedule extensions and 64 rounds run in fori_loops
+    over small per-step dynamic indexing — the per-step work is a
+    handful of full-lane VPU ops, so the loop carries no reshuffles."""
+    kdev = jnp.asarray(_K)
+    nsteps = 64
+    w0 = jnp.zeros((nsteps,) + blk.shape[1:], jnp.uint32)
+    w0 = jax.lax.dynamic_update_slice_in_dim(w0, blk, 0, axis=0)
+
+    def sched(t, w):
+        wt15 = jax.lax.dynamic_index_in_dim(w, t - 15, 0, keepdims=False)
+        wt2 = jax.lax.dynamic_index_in_dim(w, t - 2, 0, keepdims=False)
+        wt16 = jax.lax.dynamic_index_in_dim(w, t - 16, 0, keepdims=False)
+        wt7 = jax.lax.dynamic_index_in_dim(w, t - 7, 0, keepdims=False)
+        s0 = _rotr(wt15, 7) ^ _rotr(wt15, 18) ^ (wt15 >> np.uint32(3))
+        s1 = _rotr(wt2, 17) ^ _rotr(wt2, 19) ^ (wt2 >> np.uint32(10))
+        wt = wt16 + s0 + wt7 + s1
+        return jax.lax.dynamic_update_index_in_dim(w, wt, t, axis=0)
+
+    w = jax.lax.fori_loop(16, nsteps, sched, w0)
+
+    def round_body(t, carry):
+        a, b, c, d, e, f, g, h = carry
+        wt = jax.lax.dynamic_index_in_dim(w, t, 0, keepdims=False)
+        kt = jax.lax.dynamic_index_in_dim(kdev, t, 0, keepdims=False)
+        s1 = _rotr(e, 6) ^ _rotr(e, 11) ^ _rotr(e, 25)
+        ch = (e & f) ^ (jnp.bitwise_not(e) & g)
+        t1 = h + s1 + ch + kt + wt
+        s0 = _rotr(a, 2) ^ _rotr(a, 13) ^ _rotr(a, 22)
+        maj = (a & b) ^ (a & c) ^ (b & c)
+        t2 = s0 + maj
+        return (t1 + t2, a, b, c, d + t1, e, f, g)
+
+    out = jax.lax.fori_loop(0, nsteps, round_body, state)
+    return tuple(s + o for s, o in zip(state, out))
+
+
+def hash_blocks_kernel(words: jnp.ndarray,
+                       n_blocks: jnp.ndarray) -> jnp.ndarray:
+    """Batched SHA-256 core. `words`: (B, max_blocks, 16) uint32
+    big-endian message words, FIPS-padded per lane; `n_blocks`: (B,)
+    int32 true block counts. Returns (B, 8) uint32 digest words.
+
+    The transpose below is the only layout shuffle in the kernel; block
+    `i` only updates the lanes whose message actually extends to it."""
+    w = jnp.moveaxis(words, 0, -1)                  # (max_blocks, 16, B)
+    batch = w.shape[-1]
+    state = tuple(jnp.full((batch,), _H0[i], jnp.uint32)
+                  for i in range(8))
+
+    def block_body(i, st):
+        blk = jax.lax.dynamic_index_in_dim(w, i, 0, keepdims=False)
+        new = _compress(st, blk)
+        active = i < n_blocks                        # (B,) bool
+        return tuple(jnp.where(active, n, o) for n, o in zip(new, st))
+
+    state = jax.lax.fori_loop(0, w.shape[0], block_body, state)
+    return jnp.stack(state, axis=-1)                # (B, 8)
+
+
+@partial(jax.jit, static_argnames=())
+def hash_blocks_jit(words, n_blocks):
+    return hash_blocks_kernel(words, n_blocks)
+
+
+# --- host-side batch preparation (numpy / C-speed per message) -------------
+
+def pad_messages_np(msgs: Sequence[bytes],
+                    max_blocks: int = 0) -> Tuple[np.ndarray, np.ndarray]:
+    """FIPS-pad a batch into device-ready arrays: (B, max_blocks, 16)
+    uint32 big-endian words + (B,) int32 true block counts. max_blocks=0
+    sizes the array to the longest message; an explicit bucket shape
+    must hold every message (asserted — routing splits oversize lanes
+    out before prep)."""
+    n = len(msgs)
+    counts = np.array([blocks_for_len(len(m)) for m in msgs], np.int32) \
+        if n else np.zeros((0,), np.int32)
+    need = int(counts.max()) if n else 1
+    if max_blocks <= 0:
+        max_blocks = need
+    assert need <= max_blocks, (need, max_blocks)
+    words = np.zeros((n, max_blocks, 16), np.uint32)
+    for i, m in enumerate(msgs):
+        padded = m + b"\x80" + b"\x00" * ((-(len(m) + 9)) % 64) + \
+            (8 * len(m)).to_bytes(8, "big")
+        arr = np.frombuffer(padded, dtype=">u4").astype(np.uint32)
+        words[i, :len(arr) // 16] = arr.reshape(-1, 16)
+    return words, counts
+
+
+def digests_to_bytes(digests: np.ndarray) -> List[bytes]:
+    """(B, 8) uint32 digest words -> 32-byte big-endian digests."""
+    blob = np.ascontiguousarray(np.asarray(digests, np.uint32)) \
+        .astype(">u4").tobytes()
+    return [blob[32 * i:32 * i + 32] for i in range(len(digests))]
+
+
+def sha256_batch_device(msgs: Sequence[bytes],
+                        max_blocks: int = 0) -> List[bytes]:
+    """End-to-end batched hash (host prep + device kernel); the
+    convenience path tests and bench use — production dispatch goes
+    through crypto/batch_hasher.py's bucketed shapes."""
+    if not msgs:
+        return []
+    words, counts = pad_messages_np(msgs, max_blocks)
+    out = np.asarray(hash_blocks_jit(jnp.asarray(words),
+                                     jnp.asarray(counts)))
+    return digests_to_bytes(out)
+
+
+def sha256_batch_host(msgs: Sequence[bytes]) -> List[bytes]:
+    """The hashlib oracle both backends must match byte-for-byte."""
+    return [hashlib.sha256(m).digest() for m in msgs]

@@ -729,6 +729,187 @@ def run_surge(seed: int, scale: str, workdir: str) -> dict:
 
 
 # --------------------------------------------------------------------------
+# checkpoint: one validator serving signed state checkpoints + membership
+# proofs to a fleet of light clients while validating (ISSUE 12)
+
+def run_checkpoint(seed: int, scale: str, workdir: str) -> dict:
+    """Checkpoint-serving: a 3-node fleet closes ledgers under payment
+    load; node 0 maintains the incremental Merkle state commitment
+    (asserted equal to the from-scratch oracle at EVERY close) and
+    emits signed checkpoints on a short interval. After the load phase
+    a fleet of light clients round-robins membership proofs for the
+    touched accounts and verifies each against the served checkpoint
+    with `light_client_verify` — a pure function over proof bytes, no
+    ledger DB, no replay — under the <10 ms acceptance bound; one
+    tampered proof and one forged checkpoint signature must be
+    rejected."""
+    from ..ledger.state_commitment import light_client_verify
+    from ..util.timer import real_perf_counter
+    from ..xdr import LedgerKey
+    slots = 9 if scale == "tier1" else 24
+    n_clients = 50 if scale == "tier1" else 1000
+    interval = 3
+
+    def tweak(cfg: Config) -> None:
+        cfg.DATABASE = "sqlite3://:memory:"
+        cfg.STATE_CHECKPOINT_INTERVAL = interval
+
+    sim = Simulation(Simulation.OVER_LOOPBACK)
+    keys = _keys(3, b"checkpoint", seed)
+    qset = SCPQuorumSet(threshold=2,
+                        validators=[k.public_key for k in keys],
+                        innerSets=[])
+    names = []
+    for i, k in enumerate(keys):
+        node = sim.add_node(k, qset, name="c%d" % i, cfg_tweak=tweak)
+        # every validator runs the bucket list (consensus commits to
+        # bucketListHash); node 0 is additionally the checkpoint SERVER
+        node.app.enable_buckets(os.path.join(workdir,
+                                             "cp-buckets-%d" % i))
+        names.append(node.name)
+    server = sim.nodes[names[0]].app
+    for i in range(3):
+        for j in range(i + 1, 3):
+            sim.connect(names[i], names[j])
+    sim.start_all_nodes()
+    _crank_until(sim, lambda: sim.have_all_externalized(2), 40000,
+                 "checkpoint-scenario start")
+
+    adapter = AppLedgerAdapter(server)
+    root = adapter.root_account()
+    accounts = _keys(6, b"checkpoint-acct", seed)
+    server.submit_transaction(root.tx(
+        [root.op_create_account(k.public_key, 10**10) for k in accounts]))
+    sce = server.state_commitment
+    bl = server.bucket_manager.bucket_list
+    oracle_state = {"lcl": 0, "checked": 0}
+
+    def oracle_each_close() -> None:
+        # the 30-ledger-replay acceptance's live twin: every NEW close
+        # on the serving node must keep incremental == from-scratch
+        lcl = server.ledger_manager.last_closed_ledger_num()
+        if lcl == oracle_state["lcl"] or sce.root is None:
+            return
+        oracle_state["lcl"] = lcl
+        assert sce.root == sce.from_scratch_root(bl), \
+            "incremental Merkle root diverged from oracle at %d" % lcl
+        oracle_state["checked"] += 1
+
+    payers = [TestAccount(adapter, k) for k in accounts]
+    pay_seq: Dict[bytes, int] = {}
+    pump_state = {"lcl": 0}
+
+    def pump_load() -> None:
+        lcl = server.ledger_manager.last_closed_ledger_num()
+        oracle_each_close()
+        if lcl == pump_state["lcl"]:
+            return
+        pump_state["lcl"] = lcl
+        for i, p in enumerate(payers[:3]):
+            seqk = p.sk.seed
+            try:
+                seq = pay_seq.get(seqk) or p.next_seq()
+                st = server.submit_transaction(p.tx(
+                    [p.op_payment(root.account_id, 10 + i)], seq=seq))
+                if st == 0:
+                    pay_seq[seqk] = seq + 1
+                else:
+                    pay_seq.pop(seqk, None)
+            except AssertionError:
+                pay_seq.pop(seqk, None)
+
+    base = server.ledger_manager.last_closed_ledger_num()
+
+    def load_done() -> bool:
+        pump_load()
+        return sim.have_all_externalized(base + slots) and \
+            sce.checkpoint() is not None
+    _crank_until(sim, load_done, 200000, "checkpoint load phase")
+    assert oracle_state["checked"] >= slots - 2, oracle_state
+
+    # --- the serving side: checkpoint + per-client proofs -------------
+    cp = sce.checkpoint()
+    assert cp is not None
+    prove_keys = [LedgerKey.account(root.account_id)] + \
+        [LedgerKey.account(k.public_key) for k in accounts]
+    proofs = []
+    for k in prove_keys:
+        p = sce.prove_entry(k)
+        assert p is not None, "no proof for a live account"
+        proofs.append(p)
+    import json as _json
+    proof_bytes = max(len(_json.dumps(p)) for p in proofs)
+
+    # --- the light-client fleet: verify without replay or DB ----------
+    net = server.config.network_id
+    verify_s: List[float] = []
+    for c in range(n_clients):
+        p = proofs[c % len(proofs)]
+        t0 = real_perf_counter()
+        ok, reason = light_client_verify(p, cp, net)
+        verify_s.append(real_perf_counter() - t0)
+        assert ok, "light client %d rejected a valid proof: %s" % (
+            c, reason)
+    verify_s.sort()
+    p50_ms = round(verify_s[len(verify_s) // 2] * 1e3, 4)
+    p95_ms = round(verify_s[int(len(verify_s) * 0.95)] * 1e3, 4)
+    assert p95_ms < 10.0, "light-client verify p95 %.3f ms over the " \
+        "10 ms acceptance bound" % p95_ms
+
+    # tampering must be caught: a flipped entry byte and a forged
+    # checkpoint signature
+    bad = _json.loads(_json.dumps(proofs[0]))
+    flip = "00" if bad["entry"][-2:] != "00" else "01"
+    bad["entry"] = bad["entry"][:-2] + flip
+    assert not light_client_verify(bad, cp, net)[0], \
+        "tampered entry accepted"
+    forged = dict(cp)
+    forged["signature"] = "00" * 64
+    assert not light_client_verify(proofs[0], forged, net)[0], \
+        "forged checkpoint signature accepted"
+
+    emitted = server.metrics.to_json()[
+        "commitment.checkpoint.emitted"]["count"]
+    assert emitted >= 1
+    common = _assert_header_equality([v.app for v in sim.nodes.values()],
+                                     min_common=4)
+    fleet = _fleet_block(sim.fleet())
+    sim.stop_all_nodes()
+
+    source = "bench.py --scenario checkpoint"
+    records = _common_records("checkpoint", fleet, source)
+    records.append(_record("scenario_checkpoint_verify_p95", "ms",
+                           p95_ms, "scenario-checkpoint", "lower",
+                           source))
+    records.append(_record("checkpoint_proof_bytes", "bytes",
+                           proof_bytes, "scenario-checkpoint", "lower",
+                           source))
+    return {
+        "metric": "scenario_checkpoint", "unit": "ms",
+        "value": fleet["slot_latency_p95_ms"],
+        "platform": "scenario-checkpoint",
+        "scenario": "checkpoint", "seed": seed, "scale": scale,
+        "topology": {"nodes": 3, "threshold": 2, "mode": "loopback",
+                     "checkpoint_interval": interval,
+                     "light_clients": n_clients},
+        "fault_schedule": ["none (proof-integrity scenario: tampered "
+                           "proof + forged signature must be rejected)"],
+        "assertions": {
+            "oracle_checked_closes": oracle_state["checked"],
+            "checkpoints_emitted": emitted,
+            "light_clients": n_clients,
+            "verify_p50_ms": p50_ms,
+            "verify_p95_ms": p95_ms,
+            "proof_bytes": proof_bytes,
+            "tampered_rejected": True,
+            "common_heights_hash_equal": common,
+        },
+        "fleet": fleet,
+        "records": records,
+    }
+
+
+# --------------------------------------------------------------------------
 # registry + runner
 
 SCENARIOS: Dict[str, dict] = {
@@ -754,6 +935,14 @@ SCENARIOS: Dict[str, dict] = {
         "fn": run_surge,
         "description": "tx-pool saturation with hot-account contention; "
                        "fee-bid surge eviction keeps the pool bounded",
+    },
+    "checkpoint": {
+        "fn": run_checkpoint,
+        "description": "one validator maintains the incremental Merkle "
+                       "state commitment under load (oracle-checked "
+                       "every close) and serves signed checkpoints + "
+                       "membership proofs to a light-client fleet that "
+                       "verifies without replay (<10 ms p95 gated)",
     },
 }
 

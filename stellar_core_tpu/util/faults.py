@@ -42,6 +42,9 @@ KNOWN_SITES = frozenset({
     "device.dispatch",
     "verify.device-lost",
     "verify.staging-stall",
+    "hash.device-lost",
+    "hash.dispatch-fail",
+    "commitment.sign-fail",
     "overlay.drop",
     "overlay.delay",
     "overlay.duplicate",

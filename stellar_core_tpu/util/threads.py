@@ -71,6 +71,12 @@ WORKER_THREAD_REGISTRY: Dict[str, str] = {
         "against a multi-second device dispatch)",
     "crypto.verify-warmup":
         "TpuSigVerifier AOT bucket warmup; touches JAX state only",
+    "crypto.hash-staging":
+        "TpuBatchHasher double-buffer staging: FIPS-pads + device_puts "
+        "hash chunk K+1 while the device digests chunk K (one short-"
+        "lived job thread per staged chunk, mirroring verify staging)",
+    "crypto.hash-warmup":
+        "TpuBatchHasher AOT shape warmup; touches JAX state only",
 }
 
 

@@ -15,6 +15,14 @@ _MARK = struct.Struct(">I")
 _LAST_FRAG = 0x80000000
 
 
+def frame_record(body: bytes) -> bytes:
+    """One framed record: RFC 5531 mark + body. THE single definition
+    of the framing rule — the file writer below and the bucket layer's
+    hash/persist path (bucket.entry_record) both call it, so the bucket
+    identity hash can never desynchronize from the read path's framing."""
+    return _MARK.pack(len(body) | _LAST_FRAG) + body
+
+
 class XDROutputFileStream:
     def __init__(self, path: str) -> None:
         self._f = open(path, "wb")
@@ -23,8 +31,15 @@ class XDROutputFileStream:
         from ..xdr.codec import xdr_bytes
         body = xdr_bytes(xdr_type, value) if not hasattr(value, "to_xdr") \
             else value.to_xdr()
-        self._f.write(_MARK.pack(len(body) | _LAST_FRAG))
-        self._f.write(body)
+        self._f.write(frame_record(body))
+
+    def write_record(self, record: bytes) -> None:
+        """Write an already-framed record (RFC 5531 mark + XDR body):
+        the bucket layer hashes and persists the SAME serialized bytes
+        (bucket.entry_record — memoized per immutable entry), so a
+        bucket file write never re-serializes what its hash already
+        paid for."""
+        self._f.write(record)
 
     def close(self) -> None:
         self._f.close()

@@ -96,6 +96,27 @@ def test_surge_scenario_evicts_by_fee_bid(tmp_path):
     assert a["pool_bounded"] is True
 
 
+@pytest.mark.scenario
+def test_checkpoint_scenario_serves_light_clients(tmp_path):
+    """Acceptance (ISSUE 12): one validator maintains the incremental
+    Merkle commitment oracle-checked at every close and serves signed
+    checkpoints + membership proofs; a light-client fleet verifies them
+    in <10 ms p95 with no replay; tampered proofs and forged signatures
+    are rejected."""
+    block = run_scenario("checkpoint", seed=1, workdir=str(tmp_path))
+    _check_block_schema(block)
+    a = block["assertions"]
+    assert a["oracle_checked_closes"] >= 5
+    assert a["checkpoints_emitted"] >= 1
+    assert a["verify_p95_ms"] < 10.0
+    assert a["tampered_rejected"] is True
+    assert a["proof_bytes"] > 0
+    assert any(r["metric"] == "checkpoint_proof_bytes"
+               for r in block["records"])
+    assert any(r["metric"] == "scenario_checkpoint_verify_p95"
+               for r in block["records"])
+
+
 # ------------------------------------------------- bench.py --scenario
 
 @pytest.mark.scenario

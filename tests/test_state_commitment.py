@@ -1,0 +1,237 @@
+"""State-commitment tests (ISSUE 12): Merkle helper algebra, the
+incremental-vs-from-scratch differential oracle under randomized bucket
+churn, the 30-ledger replay acceptance, proof round-trips including
+tamper rejection, checkpoint cadence + the sign-fail fault, and the
+admin `checkpoint` endpoint."""
+
+import json
+from types import SimpleNamespace
+
+import pytest
+
+import stellar_core_tpu.xdr as X
+from stellar_core_tpu.bucket.bucket_list import BucketList
+from stellar_core_tpu.crypto.hashing import sha256
+from stellar_core_tpu.ledger.state_commitment import (
+    StateCommitmentEngine, checkpoint_sign_payload, light_client_verify,
+    merkle_climb, merkle_path, merkle_root,
+)
+from stellar_core_tpu.transactions.account_helpers import make_account_entry
+from stellar_core_tpu.util import rnd
+
+PROTO = 13
+
+
+def acct(i: int) -> X.LedgerEntry:
+    key = X.PublicKey.ed25519(i.to_bytes(32, "big"))
+    return make_account_entry(key, 10 ** 9 + i, 0, 1)
+
+
+def acct_key(i: int) -> X.LedgerKey:
+    return X.LedgerKey.account(X.PublicKey.ed25519(i.to_bytes(32, "big")))
+
+
+def _engine() -> StateCommitmentEngine:
+    return StateCommitmentEngine(SimpleNamespace(metrics=None,
+                                                 config=None))
+
+
+# --- merkle algebra ---------------------------------------------------------
+
+def test_merkle_roundtrip_every_size_and_index():
+    for n in (1, 2, 3, 4, 5, 7, 8, 22, 33):
+        leaves = [sha256(bytes([i, n])) for i in range(n)]
+        root = merkle_root(leaves)
+        for i in range(n):
+            path = merkle_path(leaves, i)
+            assert merkle_climb(leaves[i], path) == root, (n, i)
+            # a wrong sibling breaks the climb
+            if path:
+                bad = [dict(s) for s in path]
+                bad[0]["h"] = sha256(b"evil").hex()
+                assert merkle_climb(leaves[i], bad) != root
+
+
+def test_merkle_empty_commits_to_zero():
+    assert merkle_root([]) == b"\x00" * 32
+
+
+# --- the differential oracle under randomized churn ------------------------
+
+def test_incremental_root_matches_oracle_under_random_churn():
+    """Seeded random init/live/dead batches through the real BucketList
+    spill schedule: after EVERY add_batch the engine's incremental root
+    (cached entry roots, cached leaves) must equal the from-scratch
+    recompute."""
+    rnd.reseed(0x5C7C)
+    bl = BucketList()           # synchronous merges: deterministic
+    eng = _engine()
+    live_ids: set = set()
+    next_id = 1
+    for ledger in range(1, 41):
+        inits, lives, deads = [], [], []
+        batch_ids: set = set()
+        for _ in range(rnd.rand_int(1, 3)):
+            inits.append(acct(next_id))
+            live_ids.add(next_id)
+            batch_ids.add(next_id)
+            next_id += 1
+        for i in sorted(live_ids - batch_ids)[:2]:
+            if rnd.rand_int(0, 1):
+                lives.append(acct(i))
+                batch_ids.add(i)
+        if len(live_ids) > 4 and rnd.rand_int(0, 2) == 0:
+            gone = sorted(live_ids)[0]
+            if gone not in batch_ids:
+                live_ids.discard(gone)
+                deads.append(acct_key(gone))
+        bl.add_batch(ledger, PROTO, inits, lives, deads)
+        bl.resolve_all_futures()
+        for lev in bl.levels:
+            lev.commit()
+        got = eng.update_root(bl)
+        assert got == eng.from_scratch_root(bl), \
+            "divergence at ledger %d" % ledger
+
+
+def test_entry_root_cache_hits_on_unchanged_buckets():
+    bl = BucketList()
+    eng = _engine()
+    bl.add_batch(1, PROTO, [acct(1)], [], [])
+    eng.update_root(bl)
+    misses_before = len(eng._entry_roots)
+    eng.update_root(bl)      # nothing changed: no new cache entries
+    assert len(eng._entry_roots) == misses_before
+
+
+# --- the 30-ledger replay acceptance ---------------------------------------
+
+@pytest.fixture()
+def closing_app(tmp_path):
+    from stellar_core_tpu.main.application import Application
+    from stellar_core_tpu.main.config import Config
+    from stellar_core_tpu.util.timer import ClockMode, VirtualClock
+    cfg = Config.test_config(92)
+    cfg.DATABASE = "sqlite3://:memory:"
+    cfg.STATE_CHECKPOINT_INTERVAL = 5
+    app = Application(VirtualClock(ClockMode.VIRTUAL_TIME), cfg)
+    app.enable_buckets(str(tmp_path / "buckets"))
+    app.start()
+    yield app
+    app.stop()
+
+
+def test_thirty_ledger_replay_oracle_checkpoints_and_proofs(closing_app):
+    """The ISSUE 12 acceptance in one run: 30 closes under load with
+    the incremental root equal to the from-scratch oracle at every
+    close; checkpoints on cadence; a light client verifies a membership
+    proof against the served checkpoint in well under 10 ms without
+    touching the ledger DB; tampered proofs and forged checkpoint
+    signatures are rejected."""
+    from stellar_core_tpu.simulation.load_generator import LoadGenerator
+    from stellar_core_tpu.util.timer import real_perf_counter
+    app = closing_app
+    lg = LoadGenerator(app)
+    lg.generate_accounts(10)
+    app.manual_close()
+    sce = app.state_commitment
+    bl = app.bucket_manager.bucket_list
+    for i in range(30):
+        lg.generate_payments(4)
+        app.clock.set_virtual_time(app.clock.now() + 1.0)
+        app.manual_close()
+        assert sce.root == sce.from_scratch_root(bl), \
+            "incremental root diverged at close %d" % i
+    cp = sce.checkpoint()
+    assert cp is not None
+    assert app.metrics.to_json()[
+        "commitment.checkpoint.emitted"]["count"] >= 5
+    # an exact-seq fetch returns the same blob
+    assert sce.checkpoint(cp["ledger_seq"]) == cp
+
+    key = X.LedgerKey.account(app.network_root_key().public_key)
+    proof = sce.prove_entry(key)
+    assert proof is not None
+    net = app.config.network_id
+    t0 = real_perf_counter()
+    ok, reason = light_client_verify(proof, cp, net)
+    dt_ms = (real_perf_counter() - t0) * 1e3
+    assert ok, reason
+    assert dt_ms < 10.0, "light-client verify took %.3f ms" % dt_ms
+
+    # tampering: entry bytes, merkle path, root, signature
+    bad = json.loads(json.dumps(proof))
+    bad["entry"] = bad["entry"][:-2] + (
+        "00" if bad["entry"][-2:] != "00" else "01")
+    assert light_client_verify(bad, cp, net) == (False,
+                                                 "merkle root mismatch")
+    if proof["entry_path"]:
+        bad2 = json.loads(json.dumps(proof))
+        bad2["entry_path"][0]["h"] = "11" * 32
+        assert not light_client_verify(bad2, cp, net)[0]
+    forged = dict(cp)
+    forged["signature"] = "00" * 64
+    assert light_client_verify(proof, forged, net) == \
+        (False, "checkpoint signature invalid")
+    # wrong network id: the signature payload is network-bound
+    assert not light_client_verify(proof, cp, b"\x42" * 32)[0]
+    # a proof for an absent entry does not exist
+    assert sce.prove_entry(acct_key(999999)) is None
+
+
+def test_sign_fail_fault_skips_the_interval(closing_app):
+    app = closing_app
+    from stellar_core_tpu.simulation.load_generator import LoadGenerator
+    app.faults.configure("commitment.sign-fail", probability=1.0,
+                         count=1)
+    lg = LoadGenerator(app)
+    lg.generate_accounts(3)
+    app.manual_close()
+    sce = app.state_commitment
+    for _ in range(12):
+        lg.generate_payments(2)
+        app.clock.set_virtual_time(app.clock.now() + 1.0)
+        app.manual_close()
+    m = app.metrics.to_json()
+    assert m["commitment.sign-fail"]["count"] == 1
+    assert m["fault.injected.commitment.sign-fail"]["count"] == 1
+    # later intervals recovered: a checkpoint still exists
+    assert sce.checkpoint() is not None
+
+
+def test_checkpoint_admin_endpoint(closing_app):
+    app = closing_app
+    from stellar_core_tpu.simulation.load_generator import LoadGenerator
+    lg = LoadGenerator(app)
+    lg.generate_accounts(3)
+    app.manual_close()
+    for _ in range(6):
+        lg.generate_payments(2)
+        app.clock.set_virtual_time(app.clock.now() + 1.0)
+        app.manual_close()
+    key = X.LedgerKey.account(app.network_root_key().public_key)
+    st, body = app.command_handler.handle_command(
+        "checkpoint", {"entry": key.to_xdr().hex()})
+    assert st == 200
+    assert body["checkpoint"] is not None
+    assert body["proof"] is not None
+    ok, reason = light_client_verify(body["proof"], body["checkpoint"],
+                                     app.config.network_id)
+    assert ok, reason
+    # malformed entry param is a 400, not a 500
+    st, body = app.command_handler.handle_command(
+        "checkpoint", {"entry": "zz"})
+    assert st == 400
+    # proofs pair only with the LATEST checkpoint: an entry proof
+    # requested against an older ring seq is a 400, never a
+    # (proof, checkpoint) pair that cannot verify
+    seqs = sorted(app.state_commitment.checkpoints)
+    if len(seqs) > 1:
+        st, body = app.command_handler.handle_command(
+            "checkpoint", {"seq": str(seqs[0]),
+                           "entry": key.to_xdr().hex()})
+        assert st == 400, body
+    # the signed payload binds domain, network, seq, header, root
+    p = checkpoint_sign_payload(b"n" * 32, 7, b"h" * 32, b"r" * 32)
+    assert p != checkpoint_sign_payload(b"n" * 32, 8, b"h" * 32,
+                                        b"r" * 32)

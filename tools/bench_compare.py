@@ -358,6 +358,107 @@ def validate_fleet_verify(fv, where: str = "") -> List[str]:
     return errs
 
 
+def hash_bench_records(hb: dict, source: str, round_no=None,
+                       at_unix=None) -> List[dict]:
+    """Normalize a `hash_bench` block (ISSUE 12: the batched-SHA-256
+    leg) into direction-aware records — kernel throughput per
+    (lanes × blocks) shape keyed under `hash-<platform>-<shape>`
+    platforms (a jax-on-CPU leg only ever gates against its own CPU
+    history, never against real-device numbers), the host hashlib
+    baseline under `hash-host`, and the checkpoint proof-size /
+    light-client verify-cost headlines under `checkpoint-cpu`."""
+    out: List[dict] = []
+    if not isinstance(hb, dict):
+        return out
+    kernel = hb.get("kernel")
+    if isinstance(kernel, dict):
+        for shape, leg in sorted(kernel.items()):
+            if not isinstance(leg, dict):
+                continue
+            plat = "hash-%s-%s" % (leg.get("platform", "cpu"), shape)
+            for key, unit in (("hash_bytes_per_s", "bytes/s"),
+                              ("hash_msgs_per_s", "msgs/s")):
+                v = _num(leg, key)
+                if v is not None:
+                    out.append(make_record(key, unit, v, plat, "higher",
+                                           source, round_no, at_unix))
+    host = hb.get("host")
+    if isinstance(host, dict):
+        v = _num(host, "hash_bytes_per_s")
+        if v is not None:
+            out.append(make_record("hash_bytes_per_s", "bytes/s", v,
+                                   "hash-host", "higher", source,
+                                   round_no, at_unix))
+    cp = hb.get("checkpoint")
+    if isinstance(cp, dict):
+        for key, metric, unit in (
+                ("proof_bytes", "checkpoint_proof_bytes", "bytes"),
+                ("verify_p95_ms", "checkpoint_verify_ms", "ms"),
+                ("update_p95_ms", "checkpoint_update_ms", "ms")):
+            v = _num(cp, key)
+            if v is not None:
+                out.append(make_record(metric, unit, v, "checkpoint-cpu",
+                                       "lower", source, round_no,
+                                       at_unix))
+    return out
+
+
+def validate_hash_bench(hb, where: str = "") -> List[str]:
+    """Schema check for one `hash_bench` block (`check`/`--check`):
+    every kernel shape leg needs finite positive rates consistent with
+    each other, the checkpoint block needs a positive proof size,
+    ordered verify percentiles and a TRUE oracle-equality flag — a
+    hashing artifact whose own differential oracle failed must never
+    read as a committed baseline."""
+    errs: List[str] = []
+    if not isinstance(hb, dict):
+        return ["%s: hash_bench is not an object: %r" % (where, hb)]
+    kernel = hb.get("kernel")
+    if not isinstance(kernel, dict) or not kernel:
+        errs.append("%s: hash_bench.kernel must be a non-empty object"
+                    % where)
+        kernel = {}
+    for shape, leg in sorted(kernel.items()):
+        lw = "%s: hash_bench.kernel[%s]" % (where, shape)
+        if not isinstance(leg, dict):
+            errs.append("%s must be an object" % lw)
+            continue
+        bps = _num(leg, "hash_bytes_per_s")
+        mps = _num(leg, "hash_msgs_per_s")
+        mb = _num(leg, "msg_bytes")
+        if bps is None or bps <= 0:
+            errs.append("%s.hash_bytes_per_s must be a finite number "
+                        "> 0, got %r" % (lw, leg.get("hash_bytes_per_s")))
+        if mps is None or mps <= 0:
+            errs.append("%s.hash_msgs_per_s must be a finite number "
+                        "> 0, got %r" % (lw, leg.get("hash_msgs_per_s")))
+        if None not in (bps, mps, mb) and mps > 0 and mb > 0:
+            want = mps * mb
+            if abs(bps - want) > max(1.0, 1e-2 * want):
+                errs.append("%s.hash_bytes_per_s %.1f inconsistent with "
+                            "msgs/s * msg_bytes %.1f" % (lw, bps, want))
+    cp = hb.get("checkpoint")
+    if not isinstance(cp, dict):
+        errs.append("%s: hash_bench.checkpoint must be an object" % where)
+    else:
+        pb = _num(cp, "proof_bytes")
+        if pb is None or pb <= 0:
+            errs.append("%s: hash_bench.checkpoint.proof_bytes must be "
+                        "a finite number > 0, got %r"
+                        % (where, cp.get("proof_bytes")))
+        p50, p95 = _num(cp, "verify_p50_ms"), _num(cp, "verify_p95_ms")
+        if p50 is None or p95 is None or p50 < 0 or p95 + 1e-9 < p50:
+            errs.append("%s: hash_bench.checkpoint needs finite "
+                        "0 <= verify_p50_ms <= verify_p95_ms, got %r"
+                        % (where, cp))
+        if cp.get("oracle_equal") is not True:
+            errs.append("%s: hash_bench.checkpoint.oracle_equal must be "
+                        "true — the incremental Merkle root diverged "
+                        "from the from-scratch oracle in this artifact"
+                        % where)
+    return errs
+
+
 def _replay_leg_records(leg: dict, platform: str, source: str,
                         round_no, at_unix) -> List[dict]:
     out = []
@@ -462,6 +563,11 @@ def _payload_records(p: dict, source: str, round_no,
             out.append(make_record("fleet_verify_speedup", "x", v,
                                    "verify-fleet-cpu", "higher", source,
                                    round_no, at_unix))
+    # batched-hash legs (`bench.py --hash`; the artifact also carries
+    # an explicit `records` list, which normalize_any prefers)
+    hb = p.get("hash_bench")
+    if isinstance(hb, dict):
+        out.extend(hash_bench_records(hb, source, round_no, at_unix))
     # device history survives device-less rounds via the cached block
     for nest in (p.get("last_device"),
                  (p.get("errors") or {}).get("last_real_device_result")):
@@ -586,6 +692,8 @@ def _walk_breakdowns(blob, name: str, errs: List[str],
                                                name))
     if "fleet_verify" in blob:
         errs.extend(validate_fleet_verify(blob["fleet_verify"], name))
+    if "hash_bench" in blob:
+        errs.extend(validate_hash_bench(blob["hash_bench"], name))
     for v in blob.values():
         if isinstance(v, (dict, list)):
             _walk_breakdowns(v, name, errs, depth + 1)
