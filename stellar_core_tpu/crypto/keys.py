@@ -79,9 +79,65 @@ def raw_verify(key32: bytes, sig: bytes, msg: bytes) -> bool:
         return False
 
 
+_CPU_VERIFY_THREADS = None
+
+
+def _cpu_verify_threads() -> int:
+    """Shard width for large CPU verify batches (ISSUE 13: the replay
+    pipeline is verify-bound on the sync CPU backend; sharding the
+    native batch call over threads — it drops the GIL — is the only CPU
+    lever left). SCT_VERIFY_CPU_THREADS=1 disables."""
+    global _CPU_VERIFY_THREADS
+    if _CPU_VERIFY_THREADS is None:
+        import os
+        try:
+            n = int(os.environ.get("SCT_VERIFY_CPU_THREADS", "0"))
+        except ValueError:
+            n = 0
+        if n <= 0:
+            n = min(8, os.cpu_count() or 1)
+        _CPU_VERIFY_THREADS = max(1, n)
+    return _CPU_VERIFY_THREADS
+
+
+def _verify_batch_sharded(lib, triples, nthreads: int) -> list:
+    """Split one big batch across ephemeral worker threads, each running
+    the native verify_batch ctypes call (GIL released inside). Pure
+    function of the inputs — shard boundaries cannot change results."""
+    from ..util.threads import spawn_worker
+    n = len(triples)
+    chunk = (n + nthreads - 1) // nthreads
+    bounds = [(i, min(i + chunk, n)) for i in range(0, n, chunk)]
+    results: list = [None] * len(bounds)
+    errors: list = [None] * len(bounds)
+
+    def run(idx, lo, hi):
+        try:
+            results[idx] = lib.verify_batch(triples[lo:hi])
+        except BaseException as e:  # re-raised on the caller below
+            errors[idx] = e
+
+    threads = []
+    for idx, (lo, hi) in enumerate(bounds[1:], start=1):
+        threads.append(spawn_worker(
+            "crypto.cpu-verify-shard",
+            (lambda idx=idx, lo=lo, hi=hi: run(idx, lo, hi))))
+    run(0, bounds[0][0], bounds[0][1])
+    for t in threads:
+        t.join()
+    for e in errors:
+        if e is not None:
+            raise e
+    out: list = []
+    for r in results:
+        out.extend(r)
+    return out
+
+
 def raw_verify_batch(triples) -> list:
     """[(key32, sig, msg)] → [bool], one native call when the C library
-    is available (CpuSigVerifier's whole-batch drain path)."""
+    is available (CpuSigVerifier's whole-batch drain path); batches of
+    256+ shard over worker threads."""
     if _ed is None:
         from ..native import ed25519_native
         lib = ed25519_native()
@@ -89,8 +145,13 @@ def raw_verify_batch(triples) -> list:
             out = [False] * len(triples)
             good = [i for i, (k, s, _m) in enumerate(triples)
                     if len(k) == 32 and len(s) == 64]
-            for i, ok in zip(good,
-                             lib.verify_batch([triples[i] for i in good])):
+            good_triples = [triples[i] for i in good]
+            nthreads = _cpu_verify_threads()
+            if len(good) >= 256 and nthreads > 1:
+                oks = _verify_batch_sharded(lib, good_triples, nthreads)
+            else:
+                oks = lib.verify_batch(good_triples)
+            for i, ok in zip(good, oks):
                 out[i] = ok
             return out
     return [raw_verify(k, s, m) for (k, s, m) in triples]

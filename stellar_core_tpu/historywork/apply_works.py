@@ -124,11 +124,53 @@ def checkpoint_verify_triples(frames, ltx) -> List[Tuple]:
     return frames_sig_triples(ltx, frames)
 
 
+class _PrewarmPipeline:
+    """Pipelined catchup (ISSUE 13): ledger N+1's signature verification
+    overlaps ledger N's apply. The MAIN thread collects the candidate
+    triples (ledger reads stay single-threaded); the worker only runs
+    `verifier.prewarm_many` — pure crypto whose native batch call drops
+    the GIL, so it genuinely runs underneath the (also GIL-free) native
+    apply. A prewarm is cache-warming only: stale or extra triples can
+    never change an accept/reject decision, the apply path re-derives
+    candidates against live state."""
+
+    def __init__(self, verifier) -> None:
+        import queue
+        from ..util.threads import spawn_worker
+        self._verifier = verifier
+        self._q: "queue.Queue" = queue.Queue()
+        self._closed = False
+        self._thread = spawn_worker("catchup.prewarm-pipeline", self._run)
+
+    def submit(self, seq: int, triples) -> None:
+        del seq
+        self._q.put(triples)
+
+    def close(self) -> None:
+        # cancel flag first: queued-but-unstarted batches are stale
+        # work the worker must skip (a reset/abort mid-checkpoint would
+        # otherwise leave it verifying a whole checkpoint for nothing)
+        self._closed = True
+        self._q.put(None)
+
+    def _run(self) -> None:
+        while True:
+            triples = self._q.get()
+            if triples is None or self._closed:
+                return
+            try:
+                self._verifier.prewarm_many(triples)
+            except Exception as e:  # cache warm only: never fail catchup
+                log.warning("pipelined prewarm failed: %s", e)
+
+
 class ApplyCheckpointWork(BasicWork):
     """Replay one checkpoint's ledgers through LedgerManager.close_ledger,
     one ledger per crank (reference ApplyCheckpointWork.cpp:244 →
     ApplyLedgerWork.cpp:22-24). First crank drains the checkpoint's
-    signatures through the batch verifier."""
+    signatures through the batch verifier; on the cpu+native path the
+    checkpoint-wide drain is replaced by the per-ledger prewarm
+    pipeline (ledger N+1 verifies while N applies)."""
 
     def __init__(self, app, download_dir: str, checkpoint: int,
                  first_seq: int, last_seq: int) -> None:
@@ -146,6 +188,7 @@ class ApplyCheckpointWork(BasicWork):
         self._next: int = first_seq
         self._sig_state_dirty = False   # a signer set changed mid-checkpoint
         self._prefetch_summary: Optional[dict] = None
+        self._pipeline: Optional[_PrewarmPipeline] = None
 
     def on_reset(self) -> None:
         self._loaded = False
@@ -155,6 +198,72 @@ class ApplyCheckpointWork(BasicWork):
         self._next = self.first_seq
         self._sig_state_dirty = False
         self._prefetch_summary = None
+        self._close_pipeline()
+
+    def _close_pipeline(self) -> None:
+        if self._pipeline is not None:
+            self._pipeline.close()
+            self._pipeline = None
+
+    def _finish(self, st: State) -> None:
+        self._close_pipeline()
+        super()._finish(st)
+
+    # -- pipelined per-ledger prewarm ---------------------------------------
+    def _pipeline_enabled(self) -> bool:
+        """Per-ledger pipelining replaces the checkpoint-wide drain
+        exactly when that drain is redundant (sync CPU backend + native
+        engine): there the verify cost sits INSIDE each close, and the
+        only way to take it off the replay clock is to overlap it with
+        the previous ledger's apply."""
+        if not self._prewarm_redundant():
+            return False
+        cfg = getattr(self.app, "config", None)
+        if not getattr(cfg, "CATCHUP_PIPELINE", True):
+            return False
+        return getattr(self.app, "sig_verifier", None) is not None
+
+    def _range_triples(self, first: int, last: int):
+        """Candidate triples for a ledger range, collected on the MAIN
+        thread against current state (one ltx + one signer cache for
+        the whole batch)."""
+        frames = []
+        for seq in range(first, last + 1):
+            fr = self._frames.get(seq)
+            if fr is not None:
+                frames.extend(fr.frames)
+        if not frames:
+            return []
+        from ..ledger.ledgertxn import LedgerTxn
+        ltx = LedgerTxn(self.app.ledger_manager.ltx_root())
+        try:
+            return checkpoint_verify_triples(frames, ltx)
+        finally:
+            ltx.rollback()
+
+    def _pipeline_submit(self, first: int, last: int) -> None:
+        """Hand the range's signature verification to the pipeline
+        worker; the closes that follow overlap it. A prewarm is
+        opportunistic — whatever the worker hasn't finished when a
+        close needs it, the engine verifies synchronously (sharded),
+        so there is no join barrier anywhere. The
+        `apply.pipeline-stall` fault degrades to sequential: the
+        collection still happens, the verify runs inline right here."""
+        from ..util.faults import check_faults
+        metrics = getattr(self.app, "metrics", None)
+        triples = self._range_triples(first, last)
+        if not triples:
+            return
+        if check_faults(self.app, "apply.pipeline-stall"):
+            if metrics is not None:
+                metrics.new_meter("catchup.pipeline.stall").mark()
+            self.app.sig_verifier.prewarm_many(triples)
+            return
+        if self._pipeline is None:
+            self._pipeline = _PrewarmPipeline(self.app.sig_verifier)
+        if metrics is not None:
+            metrics.new_meter("catchup.pipeline.prewarm").mark()
+        self._pipeline.submit(first, triples)
 
     def _load(self) -> bool:
         lpath = os.path.join(self.download_dir,
@@ -229,6 +338,10 @@ class ApplyCheckpointWork(BasicWork):
                 frames.extend(fr.frames)
             psp.set_tag("txs", len(frames))
         self._prewarm_frames(frames)
+        if self._pipeline_enabled():
+            # cpu+native: the whole checkpoint's signature verification
+            # rides the pipeline worker underneath the apply loop
+            self._pipeline_submit(self.first_seq, self.last_seq)
         self._prefetch_checkpoint(frames)
         log.debug("prewarmed checkpoint %08x (%d txs)",
                   self.checkpoint, len(frames))
@@ -296,6 +409,10 @@ class ApplyCheckpointWork(BasicWork):
         if not self._sig_state_dirty:
             return
         self._sig_state_dirty = False
+        if self._pipeline_enabled():
+            # re-collect the remaining range against post-mutation state
+            self._pipeline_submit(self._next, self.last_seq)
+            return
         frames = []
         for seq in range(self._next, self.last_seq + 1):
             fr = self._frames.get(seq)

@@ -170,6 +170,15 @@ class ApplyStats:
         self._m_feebump = m.new_meter("ledger.apply.tx.fee-bump")
         self._m_muxed = m.new_meter("ledger.apply.tx.muxed")
         self._h_merge = m.new_histogram("bucket.merge.seconds")
+        # conflict-graph parallel close (ISSUE 13): per-close cluster
+        # shape gauges + parallel/serial path meters
+        self._g_cl_count = m.new_gauge("ledger.apply.cluster.count")
+        self._g_cl_width = m.new_gauge("ledger.apply.cluster.width")
+        self._g_cl_workers = m.new_gauge("ledger.apply.cluster.workers")
+        self._m_cl_parallel = m.new_meter(
+            "ledger.apply.cluster.parallel-close")
+        self._m_cl_serial = m.new_meter("ledger.apply.cluster.serial-close")
+        self._m_cl_degrade = m.new_meter("ledger.apply.cluster.degraded")
         # per-entry-type / per-op-type metrics, resolved once — the hot
         # read and apply loops must not pay a name format + registry
         # lookup per event (both name spaces are small and bounded)
@@ -197,6 +206,10 @@ class ApplyStats:
                              "hits": 0, "misses": 0},
             }
             self.buckets = {"levels": {}, "merges": 0, "merge_seconds": 0.0}
+            self.clusters = {"parallel_closes": 0, "serial_closes": 0,
+                             "degraded": 0, "last_count": 0,
+                             "last_width": 0, "last_workers": 0,
+                             "last_apply_ms": 0.0}
             self.last_close: Optional[dict] = None
             self._close = None
 
@@ -339,6 +352,37 @@ class ApplyStats:
             self._m_feebump.mark(fee_bump)
         if muxed:
             self._m_muxed.mark(muxed)
+
+    def record_clusters(self, count: int, width: int, workers: int,
+                        parallel: bool, apply_ns: int = 0) -> None:
+        """One native close's conflict-graph shape: cluster count, max
+        cluster width (txs), worker count, whether the engine actually
+        ran the clusters concurrently, and the engine's tx-execution
+        wall (the phase the parallelism accelerates — parse/verify/
+        fees/emission excluded)."""
+        self._g_cl_count.set(count)
+        self._g_cl_width.set(width)
+        self._g_cl_workers.set(workers)
+        (self._m_cl_parallel if parallel else self._m_cl_serial).mark()
+        with self._lock:
+            key = "parallel_closes" if parallel else "serial_closes"
+            self.clusters[key] += 1
+            self.clusters["last_count"] = count
+            self.clusters["last_width"] = width
+            self.clusters["last_workers"] = workers
+            self.clusters["last_apply_ms"] = round(apply_ns / 1e6, 3)
+            if self._close is not None:
+                self._close["clusters"] = {
+                    "count": count, "width": width, "workers": workers,
+                    "parallel": parallel,
+                    "apply_ms": round(apply_ns / 1e6, 3)}
+
+    def record_cluster_degrade(self) -> None:
+        """apply.cluster-fail fired: this close runs serial instead of
+        parallel (the fault's graceful-degradation contract)."""
+        self._m_cl_degrade.mark()
+        with self._lock:
+            self.clusters["degraded"] += 1
 
     # -- native-bail forensics -----------------------------------------------
     def record_bail(self, reason: str) -> None:
@@ -494,6 +538,7 @@ class ApplyStats:
                     "levels": {str(k): dict(v) for k, v in sorted(
                         self.buckets["levels"].items())},
                 },
+                "clusters": dict(self.clusters),
                 "last_close": self.last_close,
             }
 
@@ -522,6 +567,7 @@ class ApplyStats:
                 "op_counts": op_counts,
                 "other_ms": round(other, 6),
                 "bails": dict(sorted(self.bails.items())),
+                "clusters": dict(self.clusters),
                 "tx": dict(self.tx),
                 "state_reads": {
                     "lookups": dict(sorted(

@@ -285,10 +285,23 @@ class LedgerManager:
             fee_bumps += fee_bump
             muxeds += muxed
         stats.record_tx_counts(len(frames), fee_bumps, muxeds)
-        if frames and hasattr(self.root, "prefetch"):
+        # the bulk prefetch warms the root cache for the PYTHON apply
+        # path; the native engine loads every static key itself through
+        # get_entry_blob (same cache, same telemetry hooks), so running
+        # both would pay the Python key-build + cache walk twice per
+        # close (ISSUE 13: ~9ms/close on the replay leg). When the
+        # engine is expected to run, the prefetch is DEFERRED, not
+        # dropped: a bailing close still warms the cache before the
+        # Python phases (below).
+        def _bulk_prefetch() -> None:
             with app_span(self.app, "close.prefetch", cat="ledger") as psp:
                 psp.set_tag("cached",
                             self.root.prefetch(txset_prefetch_keys(frames)))
+
+        can_prefetch = bool(frames) and hasattr(self.root, "prefetch")
+        if can_prefetch and not self._native_covers_prefetch():
+            _bulk_prefetch()
+            can_prefetch = False   # done; don't repeat on a native bail
 
         # fast path: the native engine runs BOTH phases in one C call and
         # installs per-frame results/meta + the close-level delta; any
@@ -303,6 +316,10 @@ class LedgerManager:
                 apply_path = "native"
             else:
                 apply_path = "python"
+                if can_prefetch:
+                    # the engine bailed: the deferred bulk prefetch runs
+                    # now so the Python phases see a warm root cache
+                    _bulk_prefetch()
                 # phase 1: fees + seq nums for every tx, each in a nested
                 # txn so the per-tx fee-processing changes become
                 # txfeehistory meta (reference saves these
@@ -535,6 +552,14 @@ class LedgerManager:
                       "disabling stream", lcd.ledger_seq, e)
             stream.close()
             self.app.close_meta_stream = None
+
+    def _native_covers_prefetch(self) -> bool:
+        """True when the native engine will run this close and therefore
+        performs its own static-key loads (ledger/native_apply.py)."""
+        if not getattr(self, "use_native_apply", True):
+            return False
+        from ..native import apply_engine
+        return apply_engine() is not None
 
     def _bucket_manager(self):
         return getattr(self.app, "bucket_manager", None)

@@ -506,6 +506,37 @@ class InMemoryLedgerTxnRoot(AbstractLedgerTxnParent):
         lookup callback (no parse, no copy)."""
         return self._entries.get(kb)
 
+    def offers_for_book_blobs(self, selling_xdr: bytes,
+                              buying_xdr: bytes) -> List[bytes]:
+        """Raw offer-entry blobs for one (selling, buying) book — the
+        native engine's `book` callback. The engine merges its own
+        overlay (created/modified/erased offers) on top; this returns
+        only close-start root state."""
+        out: List[bytes] = []
+        for kb, eb in self._entries.items():
+            if LedgerKey.from_xdr(kb).disc != LedgerEntryType.OFFER:
+                continue
+            e = LedgerEntry.from_xdr(eb)
+            o = e.data.value
+            if o.selling.to_xdr() == selling_xdr and \
+                    o.buying.to_xdr() == buying_xdr:
+                out.append(eb)
+        return out
+
+    def offers_by_account_blobs(self, account_key: bytes) -> List[bytes]:
+        """Raw offer-entry blobs of one seller (ed25519 key bytes) —
+        the native engine's `acct_offers` callback (allow-trust
+        revokes). Root order matches `_offers_by_account`, so the
+        engine's merged iteration order equals the Python path's."""
+        out: List[bytes] = []
+        for kb, eb in self._entries.items():
+            if LedgerKey.from_xdr(kb).disc != LedgerEntryType.OFFER:
+                continue
+            e = LedgerEntry.from_xdr(eb)
+            if e.data.value.sellerID.key_bytes == account_key:
+                out.append(eb)
+        return out
+
     def _all_offers_for_book(self, selling, buying):
         out: Dict[bytes, LedgerEntry] = {}
         sb = (selling.to_xdr(), buying.to_xdr())
@@ -646,6 +677,29 @@ class LedgerTxnRoot(AbstractLedgerTxnParent):
             st.record_read(False, False,
                            _ENTRY_TYPE_NAMES.get(key.disc, "unknown"))
         return blob
+
+    def offers_for_book_blobs(self, selling_xdr: bytes,
+                              buying_xdr: bytes) -> List[bytes]:
+        """Raw offer blobs for one book (native engine `book`
+        callback); same SQL the Python path's `_all_offers_for_book`
+        runs, counted into the same bulk-scan telemetry."""
+        import base64
+        cur = self._db.execute(
+            "SELECT entry FROM offers WHERE selling=? AND buying=?",
+            (base64.b64encode(selling_xdr).decode(),
+             base64.b64encode(buying_xdr).decode()))
+        return [blob for (blob,) in self._record_scan(cur.fetchall())]
+
+    def offers_by_account_blobs(self, account_key: bytes) -> List[bytes]:
+        """Raw offer blobs of one seller (ed25519 key bytes) — native
+        engine `acct_offers` callback. Row order (the seller index →
+        offerid) matches `_offers_by_account`, so the engine's merged
+        iteration order equals the Python path's."""
+        from ..xdr import PublicKey
+        cur = self._db.execute(
+            "SELECT entry FROM offers WHERE sellerid=?",
+            (_acc_str(PublicKey.ed25519(account_key)),))
+        return [blob for (blob,) in self._record_scan(cur.fetchall())]
 
     def _select_blob(self, key: LedgerKey) -> Optional[bytes]:
         t = key.disc

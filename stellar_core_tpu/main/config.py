@@ -115,6 +115,16 @@ class Config:
         self.WORKER_THREADS = 4
         self.MAX_CONCURRENT_SUBPROCESSES = 16
 
+        # conflict-graph parallel close (native/applyc.c, ISSUE 13):
+        # disjoint tx clusters apply on worker threads inside the C
+        # engine. Workers 0 = auto (min(8, cpu_count)); 1 or
+        # NATIVE_PARALLEL_APPLY=False pins the serial native path.
+        self.NATIVE_PARALLEL_APPLY = True
+        self.NATIVE_PARALLEL_WORKERS = 0
+        # pipelined catchup (historywork/apply_works.py): verify ledger
+        # N+1's signatures on a worker while ledger N applies
+        self.CATCHUP_PIPELINE = True
+
         # TPU crypto backend gate (this build's headline knob):
         # "cpu" (default, OpenSSL), "tpu" (JAX batched), "tpu-async"
         self.SIG_VERIFY_BACKEND = "cpu"

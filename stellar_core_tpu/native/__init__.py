@@ -50,8 +50,10 @@ def _cc_build(src_path: str, so_path: str, include_dir: str) -> bool:
             dir=_BUILD, suffix=".so", delete=False)
         tmp.close()
         try:
+            # -pthread: applyc.c's parallel close spawns worker threads;
+            # harmless for the single-threaded extensions
             r = subprocess.run(
-                [cc, "-O2", "-shared", "-fPIC"] + extra +
+                [cc, "-O2", "-shared", "-fPIC", "-pthread"] + extra +
                 ["-I", include_dir, "-o", tmp.name, src_path],
                 capture_output=True, text=True, timeout=300)
         except (OSError, subprocess.TimeoutExpired):

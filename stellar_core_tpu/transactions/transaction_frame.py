@@ -671,6 +671,9 @@ class FeeBumpTransactionFrame:
         inner_env = TransactionEnvelope(
             EnvelopeType.ENVELOPE_TYPE_TX, fb.innerTx.value)
         self.inner = TransactionFrame(network_id, inner_env)
+        self._native_result_b: Optional[bytes] = None
+        self._native_fee_b: Optional[bytes] = None
+        self._native_meta_b: Optional[bytes] = None
         self.result: TransactionResult = _make_result(
             0, TransactionResultCode.txFEE_BUMP_INNER_SUCCESS)
         self._contents_hash: Optional[bytes] = None
@@ -680,17 +683,62 @@ class FeeBumpTransactionFrame:
         self._sig_frozen = False
         self.fee_meta: list = []
 
+    def set_native_apply_output(self, result_b: bytes, fee_changes_b: bytes,
+                                meta_b: bytes) -> None:
+        """Install the native apply engine's per-tx outputs (all XDR
+        bytes) — the fee-bump twin of TransactionFrame's installer. The
+        result wraps the inner pair; the meta is the INNER tx's apply
+        meta (tx_meta delegates to it on the Python path too)."""
+        self._result = None
+        self._native_result_b = result_b
+        self._fee_meta = None
+        self._native_fee_b = fee_changes_b
+        self._native_meta_b = meta_b
+
+    @property
+    def result(self) -> TransactionResult:
+        if self._result is None and self._native_result_b is not None:
+            self._result = TransactionResult.from_xdr(
+                self._native_result_b)
+        return self._result
+
+    @result.setter
+    def result(self, r: TransactionResult) -> None:
+        self._result = r
+        self._native_result_b = None
+
+    @property
+    def fee_meta(self) -> list:
+        if self._fee_meta is None and self._native_fee_b is not None:
+            from ..xdr import LedgerEntryChanges
+            from ..xdr.codec import xdr_from
+            self._fee_meta = xdr_from(LedgerEntryChanges,
+                                      self._native_fee_b)
+        return self._fee_meta
+
+    @fee_meta.setter
+    def fee_meta(self, changes: list) -> None:
+        self._fee_meta = changes
+        self._native_fee_b = None
+
     @property
     def op_metas(self):
         return self.inner.op_metas
 
     def tx_meta(self):
+        from ..xdr import TransactionMeta
+        if self._native_meta_b is not None:
+            return TransactionMeta.from_xdr(self._native_meta_b)
         return self.inner.tx_meta()
 
     def tx_meta_xdr(self) -> bytes:
+        if self._native_meta_b is not None:
+            return self._native_meta_b
         return self.inner.tx_meta_xdr()
 
     def fee_meta_xdr(self) -> bytes:
+        if self._native_fee_b is not None:
+            return self._native_fee_b
         from ..xdr import LedgerEntryChanges
         from ..xdr.codec import xdr_bytes
         return xdr_bytes(LedgerEntryChanges, self.fee_meta)
@@ -740,7 +788,10 @@ class FeeBumpTransactionFrame:
         self._sig_frozen = True
 
     def result_pair_xdr(self) -> bytes:
-        return self.contents_hash() + self.result.to_xdr()
+        rb = self._native_result_b
+        if rb is None:
+            rb = self.result.to_xdr()
+        return self.contents_hash() + rb
 
     def envelope_bytes(self) -> bytes:
         if self._sig_frozen and self._env_bytes is not None:
@@ -880,6 +931,7 @@ class FeeBumpTransactionFrame:
         from ..ledger.ledgertxn import LedgerTxn
         checker = SignatureChecker(self.contents_hash(), self.signatures,
                                    verifier or CpuSigVerifier())
+        self._native_meta_b = None   # this apply owns the meta again
         ltx = LedgerTxn(ltx_parent)
         try:
             code = self._common_valid(checker, ltx, True)

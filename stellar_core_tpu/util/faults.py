@@ -54,6 +54,8 @@ KNOWN_SITES = frozenset({
     "archive.get-fail",
     "archive.corrupt",
     "archive.short-read",
+    "apply.cluster-fail",
+    "apply.pipeline-stall",
 })
 
 

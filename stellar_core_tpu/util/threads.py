@@ -77,6 +77,15 @@ WORKER_THREAD_REGISTRY: Dict[str, str] = {
         "lived job thread per staged chunk, mirroring verify staging)",
     "crypto.hash-warmup":
         "TpuBatchHasher AOT shape warmup; touches JAX state only",
+    "catchup.prewarm-pipeline":
+        "Pipelined catchup (ISSUE 13): verifies ledger N+1's signature "
+        "triples (verifier.prewarm_many — pure crypto, GIL-releasing) "
+        "while the main thread applies ledger N; triples are collected "
+        "on the MAIN thread (no cross-thread ledger reads)",
+    "crypto.cpu-verify-shard":
+        "CPU verify sharding (crypto/keys.raw_verify_batch): one chunk "
+        "of a large ed25519 batch per thread through the native "
+        "verify_batch ctypes call (GIL released inside the call)",
 }
 
 

@@ -153,28 +153,38 @@ def test_native_path_breakdown_sums_to_wall():
 
 @pytest.mark.skipif(apply_engine() is None,
                     reason="native apply engine unavailable")
-def test_forced_bail_offer_op_classifies():
+def test_forced_bail_residual_classifies():
+    """Full op coverage (ISSUE 13) drove the op-type bails to zero —
+    offers now run natively. The residual bail taxonomy still
+    classifies: a non-ed25519 signer key keeps the whole close on the
+    Python path, metered as `signer-key-type`."""
     h = CloseHarness(native=True)
     root = h.account(root_secret_key())
     usd = Asset.credit("USD", root.account_id)
     f = root.tx([root.op_manage_sell_offer(Asset.native(), usd, 10, 1, 1)])
     h.close([f])
     stats = h.lm.apply_stats
-    # the engine named the unsupported op type; the close fell back to
-    # Python and still closed the ledger
-    assert stats.bails == {"op-manage-sell-offer": 1}
-    m = stats.metrics.to_json().get(
-        "ledger.apply.native-bail.op-manage-sell-offer")
-    assert m and m["count"] == 1
-    assert stats.closes["python"] == 1
-    assert stats.last_close["bail"] == "op-manage-sell-offer"
+    # offers are covered: the engine ran the close, nothing bailed
+    assert stats.bails == {}
+    assert stats.closes["native"] == 1
     assert op_type_name(OperationType.MANAGE_SELL_OFFER) == \
         "manage-sell-offer"
+    # residual: a pre-auth-tx signer arm is outside the engine subset
+    from stellar_core_tpu.xdr import Signer, SignerKey
+    f2 = root.tx([root.op_set_options(signer=Signer(
+        key=SignerKey.pre_auth_tx(b"\x07" * 32), weight=1))])
+    h.close([f2])
+    assert stats.bails == {"signer-key-type": 1}
+    m = stats.metrics.to_json().get(
+        "ledger.apply.native-bail.signer-key-type")
+    assert m and m["count"] == 1
+    assert stats.closes["python"] == 1
+    assert stats.last_close["bail"] == "signer-key-type"
 
 
 @pytest.mark.skipif(apply_engine() is None,
                     reason="native apply engine unavailable")
-def test_fee_bump_bails_and_counts_distinctly():
+def test_fee_bump_native_and_counts_distinctly():
     h = CloseHarness(native=True)
     root = h.account(root_secret_key())
     from stellar_core_tpu.crypto.keys import SecretKey
@@ -202,9 +212,11 @@ def test_fee_bump_bails_and_counts_distinctly():
     frame.add_signature(root.sk)
     h.close([frame])
     stats = h.lm.apply_stats
-    assert stats.bails.get("fee-bump") == 1
+    # fee bumps joined the native subset (ISSUE 13): no bail, the
+    # engine applied the close, and the mix counter still counts them
+    assert stats.bails == {}
     assert stats.tx["fee_bump"] == 1
-    assert stats.closes["python"] == 1
+    assert stats.closes["native"] == 2
 
 
 def test_failed_close_seals_window_and_sum_contract_survives():
@@ -348,6 +360,10 @@ def test_prometheus_series_roundtrip(app):
 
 
 def test_state_read_telemetry_and_prefetch(app):
+    # the bulk-prefetch cockpit serves the PYTHON apply path (the
+    # native engine does its own static-key loads; close_ledger skips
+    # the duplicate pass — ISSUE 13)
+    app.ledger_manager.use_native_apply = False
     _drive_closes(app)
     stats = app.ledger_manager.apply_stats
     r = stats.to_json()["state_reads"]

@@ -287,21 +287,41 @@ def test_native_apply_set_options_arms():
     assert h.closes_native >= 5
 
 
-def test_native_apply_unsupported_ops_bail():
-    """Closes containing ops outside the engine's subset fall back to
-    Python on the native side — and both sides still agree."""
+def test_native_apply_residual_bails():
+    """Inputs still outside the engine's subset after full op coverage
+    (ISSUE 13) fall back to Python on the native side — and both sides
+    still agree. A wire threshold over 255 is one such residual: the
+    Python oracle raises mid-close on it at apply, so the engine must
+    decline BEFORE mutating state."""
     h = DiffHarness()
     root = h.account(root_secret_key())
     a = h.account(SecretKey.from_seed(sha256(b"bail-a")))
     h.close([root.tx([root.op_create_account(a.account_id, 20 * MIN0)])])
     before = h.closes_native
+    # ops that USED to bail the close now run natively end-to-end
     Z = Asset.credit("ZZZ", root.account_id)
     frames = h.close([
-        a.tx([a.op_change_trust(Z, 100),            # unsupported op
+        a.tx([a.op_change_trust(Z, 100),
               a.op_payment(root.account_id, 5)]),
     ])
-    assert h.closes_native == before  # engine declined the mixed close
+    assert h.closes_native == before + 1  # full-coverage: no bail
     assert frames[0].result.code == TransactionResultCode.txSUCCESS
+    # residual: threshold-range stays on the Python path (the oracle
+    # RAISES applying it, so both sides must agree by both declining —
+    # the frame build itself is fine, only apply would blow up). Build
+    # the >255 threshold at the XDR layer; assert the native side
+    # classifies the bail instead of running the close.
+    from stellar_core_tpu.ledger.native_apply import native_apply_txset
+    from stellar_core_tpu.ledger.ledgertxn import LedgerTxn
+    bad = a.tx([a.op_set_options(med=300)])
+    lm = h.native
+    ltx = LedgerTxn(lm.root)
+    try:
+        header = ltx.load_header()
+        header.ledgerSeq += 1
+        assert not native_apply_txset(lm, ltx, [bad], None, None)
+    finally:
+        ltx.rollback()
 
 
 def test_native_apply_differential_randomized():
@@ -421,3 +441,495 @@ def test_native_apply_differential_randomized():
         "engine handled too few closes (%d)" % h.closes_native
     assert TransactionResultCode.txSUCCESS in seen
     assert TransactionResultCode.txFAILED in seen
+
+
+# ---------------------------------------------------------------------------
+# Full op-type coverage (ISSUE 13): every wire op, fee bumps, muxed
+# accounts — the native engine must agree with the Python oracle on all
+# of them, entry for entry.
+
+def _muxed(pk, sub_id=7):
+    from stellar_core_tpu.xdr import CryptoKeyType, MuxedAccount
+    from stellar_core_tpu.xdr.basic import MuxedAccountMed25519
+    return MuxedAccount(CryptoKeyType.KEY_TYPE_MUXED_ED25519,
+                        MuxedAccountMed25519(id=sub_id,
+                                             ed25519=pk.key_bytes))
+
+
+def _fee_bump(h, sponsor, inner_frame, fee=2000, signers=None,
+              muxed_source=False):
+    from stellar_core_tpu.transactions.transaction_frame import (
+        FeeBumpTransactionFrame,
+    )
+    from stellar_core_tpu.xdr import (
+        EnvelopeType, FeeBumpTransaction, FeeBumpTransactionEnvelope,
+        TransactionEnvelope, _Ext,
+    )
+    from stellar_core_tpu.xdr.transaction import _InnerTxEnvelope
+    fb = FeeBumpTransaction(
+        feeSource=_muxed(sponsor.account_id) if muxed_source
+        else sponsor.muxed,
+        fee=fee,
+        innerTx=_InnerTxEnvelope(EnvelopeType.ENVELOPE_TYPE_TX,
+                                 inner_frame.envelope.value),
+        ext=_Ext.v0())
+    env = TransactionEnvelope(
+        EnvelopeType.ENVELOPE_TYPE_TX_FEE_BUMP,
+        FeeBumpTransactionEnvelope(tx=fb, signatures=[]))
+    frame = FeeBumpTransactionFrame(TESTING_NETWORK_ID, env)
+    for sk in (signers if signers is not None else [sponsor.sk]):
+        frame.add_signature(sk)
+    return frame
+
+
+def _op_muxed_payment(src, dest_pk, amount, asset=None, sub_id=9):
+    from stellar_core_tpu.xdr import OperationBody, OperationType, PaymentOp
+    return src.op(OperationBody(
+        OperationType.PAYMENT,
+        PaymentOp(destination=_muxed(dest_pk, sub_id),
+                  asset=asset or Asset.native(), amount=amount)))
+
+
+def _coverage_world(h):
+    """Accounts + trustlines + an auth-required issuer + a resting order
+    book, built through both-sides closes."""
+    root = h.account(root_secret_key())
+    users = [h.account(SecretKey.from_seed(sha256(b"cov%d" % i)))
+             for i in range(8)]
+    ix = h.account(SecretKey.from_seed(sha256(b"cov-ix")))
+    ir = h.account(SecretKey.from_seed(sha256(b"cov-ir")))  # auth required
+    h.close([root.tx(
+        [root.op_create_account(u.account_id, 50 * MIN0) for u in users] +
+        [root.op_create_account(a.account_id, 50 * MIN0)
+         for a in (ix, ir)])])
+    from stellar_core_tpu.xdr import AccountFlags
+    h.close([ir.tx([ir.op_set_options(
+        set_flags=AccountFlags.AUTH_REQUIRED_FLAG |
+        AccountFlags.AUTH_REVOCABLE_FLAG)])])
+    X = Asset.credit("USD", ix.account_id)
+    R = Asset.credit("RST", ir.account_id)
+    h.close([
+        users[0].tx([users[0].op_change_trust(X, 10 ** 12),
+                     users[0].op_change_trust(R, 10 ** 12)]),
+        users[1].tx([users[1].op_change_trust(X, 10 ** 12),
+                     users[1].op_change_trust(R, 10 ** 12)]),
+        users[2].tx([users[2].op_change_trust(X, 10 ** 12)]),
+        users[3].tx([users[3].op_change_trust(X, 10 ** 12)]),
+    ])
+    h.close([
+        ir.tx([ir.op_allow_trust(users[0].account_id, b"RST\x00"),
+               ir.op_allow_trust(users[1].account_id, b"RST\x00")]),
+        ix.tx([ix.op_payment(users[0].account_id, 10 ** 9, X),
+               ix.op_payment(users[1].account_id, 10 ** 9, X)]),
+    ])
+    return root, users, ix, ir, X, R
+
+
+def test_native_apply_all_op_types():
+    """Scripted pass over every op type the wire knows, asserted
+    entry-for-entry equal between the engine and the oracle, with the
+    engine actually running every close."""
+    h = DiffHarness()
+    root, users, ix, ir, X, R = _coverage_world(h)
+    u0, u1, u2, u3, u4, u5, u6, u7 = users
+    before = h.closes_native
+
+    # change_trust / allow_trust / manage_data / bump_seq / set_options
+    frames = h.close([
+        u4.tx([u4.op_change_trust(X, 500),          # create small line
+               u4.op_manage_data("k1", b"v1"),      # data create
+               u4.op_manage_data("k1", b"v2"),      # data update
+               u4.op_manage_data("k2", b"zz")]),
+        u5.tx([u5.op_manage_data("gone", None)]),   # NAME_NOT_FOUND
+        u6.tx([u6.op(u6.op_manage_data("tmp", b"x").body),
+               u6.op(u6.op_manage_data("tmp", None).body)]),  # delete
+        ir.tx([ir.op_allow_trust(u1.account_id, b"RST\x00",
+                                 authorize=0)]),    # full revoke
+    ])
+    assert frames[0].result.code == TransactionResultCode.txSUCCESS
+    # bump_sequence: up, then a no-op bump (lower target)
+    cur = u7.next_seq()
+    from stellar_core_tpu.xdr import OperationBody, OperationType
+    from stellar_core_tpu.xdr.transaction import BumpSequenceOp
+    h.close([
+        u7.tx([u7.op(OperationBody(OperationType.BUMP_SEQUENCE,
+                                   BumpSequenceOp(bumpTo=cur + 50))),
+               u7.op(OperationBody(OperationType.BUMP_SEQUENCE,
+                                   BumpSequenceOp(bumpTo=3)))]),
+    ])
+
+    # offers: resting book, crossing, passive, buy offers, update/delete
+    h.close([
+        u0.tx([u0.op_manage_sell_offer(X, Asset.native(), 1000, 2, 1),
+               u0.op_manage_sell_offer(X, Asset.native(), 500, 3, 1)]),
+        u1.tx([u1.op_create_passive_sell_offer(Asset.native(), X, 100,
+                                               1, 2)]),
+    ])
+    frames = h.close([
+        u2.tx([u2.op_manage_sell_offer(Asset.native(), X, 600, 1, 1)]),
+        u3.tx([u3.op_manage_buy_offer(Asset.native(), X, 300, 1, 2)]),
+    ])
+    for f in frames:
+        assert f.result.code == TransactionResultCode.txSUCCESS, \
+            f.result.to_xdr()
+    # offer update + delete by id (ids are deterministic: idPool order)
+    hdr = h.native.root.get_header()
+    assert hdr.idPool >= 3
+    h.close([
+        u0.tx([u0.op_manage_sell_offer(X, Asset.native(), 700, 2, 1,
+                                       offer_id=1),
+               u0.op_manage_sell_offer(X, Asset.native(), 0, 2, 1,
+                                       offer_id=2)]),
+    ])
+
+    # path payments: strict receive + strict send through X
+    frames = h.close([
+        u0.tx([u0.op(OperationBody(
+            OperationType.PATH_PAYMENT_STRICT_RECEIVE,
+            __import__("stellar_core_tpu.xdr.transaction",
+                       fromlist=["PathPaymentStrictReceiveOp"])
+            .PathPaymentStrictReceiveOp(
+                sendAsset=X, sendMax=10 ** 9,
+                destination=u3.muxed, destAsset=Asset.native(),
+                destAmount=50, path=[])))]),
+    ])
+    # inflation at protocol 13: opNOT_SUPPORTED -> txFAILED (native)
+    frames = h.close([
+        u5.tx([u5.op(OperationBody(OperationType.INFLATION, None))]),
+    ])
+    assert frames[0].result.code == TransactionResultCode.txFAILED
+
+    # account merge: fresh account merges into its funder
+    fresh = h.account(SecretKey.from_seed(sha256(b"cov-merge")))
+    h.close([root.tx([root.op_create_account(fresh.account_id,
+                                             3 * MIN0)])])
+    from stellar_core_tpu.xdr import MuxedAccount
+    frames = h.close([
+        fresh.tx([fresh.op(OperationBody(
+            OperationType.ACCOUNT_MERGE,
+            MuxedAccount.from_account_id(root.account_id)))]),
+    ])
+    assert frames[0].result.code == TransactionResultCode.txSUCCESS
+
+    # fee bumps + muxed accounts
+    sponsor = u6
+    inner = u5.tx([u5.op_payment(root.account_id, 11)])
+    frames = h.close([
+        _fee_bump(h, sponsor, inner),
+        u4.tx([_op_muxed_payment(u4, root.account_id, 5)]),
+    ])
+    codes = sorted(f.result.code for f in frames)
+    assert TransactionResultCode.txFEE_BUMP_INNER_SUCCESS in codes
+    # muxed fee source + failing inner (bad seq)
+    inner_bad = u5.tx([u5.op_payment(root.account_id, 1)],
+                      seq=u5.next_seq() + 9)
+    frames = h.close([
+        _fee_bump(h, sponsor, inner_bad, muxed_source=True),
+    ])
+    assert frames[0].result.code == \
+        TransactionResultCode.txFEE_BUMP_INNER_FAILED
+
+    assert h.closes_native - before >= 9, \
+        "engine skipped closes (%d)" % (h.closes_native - before)
+
+
+def test_native_apply_revoke_pulls_offers():
+    """AllowTrust full revoke releases the trustor's offer liabilities
+    and erases the offers (the order-book walk through the engine's
+    acct_offers callback) — asserted against the oracle."""
+    h = DiffHarness()
+    root, users, ix, ir, X, R = _coverage_world(h)
+    u0 = users[0]
+    # u0 posts offers selling R (the auth-required asset) and buying R
+    h.close([
+        u0.tx([u0.op_manage_sell_offer(R, Asset.native(), 50, 1, 1),
+               u0.op_manage_sell_offer(Asset.native(), R, 40, 1, 1)]),
+        ix.tx([ix.op_payment(u0.account_id, 0, X)]),  # keep close mixed
+    ])
+    before = h.closes_native
+    frames = h.close([
+        ir.tx([ir.op_allow_trust(u0.account_id, b"RST\x00",
+                                 authorize=0)]),
+    ])
+    assert frames[0].result.code == TransactionResultCode.txSUCCESS
+    assert h.closes_native == before + 1  # revoke ran natively
+
+
+class ParallelDiffHarness:
+    """Three managers over identical genesis: native forced-parallel,
+    native forced-serial, and the Python oracle. Every close must agree
+    across all three — the serial-equivalence contract of the
+    conflict-graph parallel close."""
+
+    def __init__(self):
+        self.parallel = DiffHarness._mk(True)
+        self.parallel.native_force_mode = "parallel"
+        self.serial = DiffHarness._mk(True)
+        self.serial.native_force_mode = "serial"
+        self.python = DiffHarness._mk(False)
+        self.shim = _Shim(self.parallel)
+
+    def account(self, sk):
+        return TestAccount(self.shim, sk)
+
+    def close(self, frames):
+        blobs = [f.envelope_bytes() for f in frames]
+        outs = []
+        for lm in (self.parallel, self.serial, self.python):
+            fr = [TransactionFrame.make_from_wire(
+                TESTING_NETWORK_ID, TransactionEnvelope.from_xdr(b))
+                for b in blobs]
+            header = lm.root.get_header()
+            ts = TxSetFrame(TESTING_NETWORK_ID, lm.lcl_hash, fr)
+            value = StellarValue(
+                txSetHash=ts.get_contents_hash(),
+                closeTime=header.scpValue.closeTime + 5,
+                upgrades=[], ext=StellarValueExt(0, None))
+            lm.close_ledger(
+                LedgerCloseData(header.ledgerSeq + 1, ts, value))
+            outs.append(ts.sort_for_apply())
+        assert self.parallel.lcl_hash == self.serial.lcl_hash, \
+            "parallel schedule diverged from serial native"
+        assert self.parallel.lcl_hash == self.python.lcl_hash, \
+            "native diverged from oracle"
+        par, ser, _py = outs
+        for fp, fs in zip(par, ser):
+            assert fp.result.to_xdr() == fs.result.to_xdr()
+            assert fp.tx_meta().to_xdr() == fs.tx_meta().to_xdr()
+            assert xdr_bytes(LedgerEntryChanges, fp.fee_meta) == \
+                xdr_bytes(LedgerEntryChanges, fs.fee_meta)
+        return par
+
+
+def test_native_apply_parallel_equality():
+    """Forced-parallel vs forced-serial vs Python: a conflict-light
+    txset (disjoint account pairs) must close identically whatever the
+    schedule, and the parallel manager must actually have run clusters
+    concurrently."""
+    h = ParallelDiffHarness()
+    root = h.account(root_secret_key())
+    pairs = [(h.account(SecretKey.from_seed(sha256(b"pA%d" % i))),
+              h.account(SecretKey.from_seed(sha256(b"pB%d" % i))))
+             for i in range(12)]
+    h.close([root.tx(
+        [root.op_create_account(a.account_id, 30 * MIN0)
+         for a, b in pairs] +
+        [root.op_create_account(b.account_id, 30 * MIN0)
+         for a, b in pairs])])
+    # disjoint pairs: 12 independent clusters
+    for _round in range(3):
+        h.close([a.tx([a.op_payment(b.account_id, 1000 + _round)])
+                 for a, b in pairs])
+    # conflict-heavy mix (shared hub) + a multi-op cluster chain still
+    # produce identical output — clusters just collapse
+    hub = h.account(SecretKey.from_seed(sha256(b"pHub")))
+    h.close([root.tx([root.op_create_account(hub.account_id,
+                                             30 * MIN0)])])
+    h.close([a.tx([a.op_payment(hub.account_id, 7)])
+             for a, b in pairs[:6]] +
+            [b.tx([b.op_payment(a.account_id, 3)])
+             for a, b in pairs[6:]])
+    st = h.parallel.apply_stats.clusters
+    assert st["parallel_closes"] >= 3, st
+    assert h.serial.apply_stats.clusters["parallel_closes"] == 0
+    # width telemetry saw the disjoint rounds (clusters of 2 accounts)
+    assert st["last_count"] >= 1
+
+
+def _random_full_frames(rng, h, world, fresh_counter):
+    """One close worth of random frames over ALL op types."""
+    root, users, ix, ir, X, R = world
+    frames = []
+    sources = list(users) + [ix]
+    rng.shuffle(sources)
+    for src in sources:
+        if rng.random() < 0.3:
+            continue
+        kind = rng.random()
+        if kind < 0.18:   # payments (native/credit/muxed)
+            dest = rng.choice(users + [root])
+            if rng.random() < 0.3:
+                ops = [_op_muxed_payment(src, dest.account_id,
+                                         rng.choice([1, 999]))]
+            else:
+                asset = rng.choice([None, X])
+                ops = [src.op_payment(dest.account_id,
+                                      rng.choice([1, 10 ** 7]), asset)]
+        elif kind < 0.30:  # offers
+            if rng.random() < 0.5:
+                ops = [src.op_manage_sell_offer(
+                    rng.choice([X, Asset.native()]),
+                    rng.choice([Asset.native(), X]),
+                    rng.choice([0, 10, 500]),
+                    rng.randrange(1, 4), rng.randrange(1, 4),
+                    offer_id=rng.choice([0, 0, rng.randrange(1, 9)]))]
+            else:
+                ops = [src.op_manage_buy_offer(
+                    Asset.native(), X, rng.choice([0, 25, 400]),
+                    rng.randrange(1, 4), rng.randrange(1, 4),
+                    offer_id=rng.choice([0, 0, rng.randrange(1, 9)]))]
+            if ops[0].body.value.selling == ops[0].body.value.buying:
+                continue
+        elif kind < 0.40:  # path payments
+            from stellar_core_tpu.xdr.transaction import (
+                PathPaymentStrictReceiveOp, PathPaymentStrictSendOp,
+            )
+            from stellar_core_tpu.xdr import OperationBody, OperationType
+            dest = rng.choice(users)
+            if rng.random() < 0.5:
+                body = PathPaymentStrictReceiveOp(
+                    sendAsset=rng.choice([X, Asset.native()]),
+                    sendMax=rng.choice([10, 10 ** 9]),
+                    destination=dest.muxed,
+                    destAsset=rng.choice([Asset.native(), X]),
+                    destAmount=rng.choice([5, 120]), path=[])
+                ops = [src.op(OperationBody(
+                    OperationType.PATH_PAYMENT_STRICT_RECEIVE, body))]
+            else:
+                body = PathPaymentStrictSendOp(
+                    sendAsset=rng.choice([X, Asset.native()]),
+                    sendAmount=rng.choice([5, 80]),
+                    destination=dest.muxed,
+                    destAsset=rng.choice([Asset.native(), X]),
+                    destMin=rng.choice([1, 10 ** 8]), path=[])
+                ops = [src.op(OperationBody(
+                    OperationType.PATH_PAYMENT_STRICT_SEND, body))]
+            if body.sendAsset == body.destAsset:
+                continue
+        elif kind < 0.52:  # change_trust arms
+            ops = [src.op_change_trust(
+                rng.choice([X, R]),
+                rng.choice([0, 400, 10 ** 12]))]
+        elif kind < 0.60:  # allow_trust (incl. revokes)
+            if src is not ir:
+                continue
+            ops = [ir.op_allow_trust(
+                rng.choice(users).account_id, b"RST\x00",
+                authorize=rng.choice([0, 1, 2]))]
+        elif kind < 0.70:  # manage_data
+            name = rng.choice(["d1", "d2", "x" * 64])
+            val = rng.choice([None, b"", b"payload", b"z" * 64])
+            ops = [src.op_manage_data(name, val)]
+        elif kind < 0.76:  # bump sequence
+            from stellar_core_tpu.xdr import OperationBody, OperationType
+            from stellar_core_tpu.xdr.transaction import BumpSequenceOp
+            ops = [src.op(OperationBody(
+                OperationType.BUMP_SEQUENCE,
+                BumpSequenceOp(bumpTo=rng.choice([0, src.next_seq() + 40,
+                                                  2 ** 40]))))]
+        elif kind < 0.82:  # set_options
+            ops = [src.op_set_options(
+                home_domain=rng.choice(["", "cov.example"]),
+                low=rng.choice([None, 0, 1]))]
+        elif kind < 0.90:  # account merge of a throwaway
+            fresh_counter[0] += 1
+            fresh = h.account(SecretKey.from_seed(
+                sha256(b"rfresh%d" % fresh_counter[0])))
+            frames.append(src.tx([src.op_create_account(
+                fresh.account_id, rng.choice([2 * MIN0, 3 * MIN0]))]))
+            continue
+        elif kind < 0.94:  # inflation (opNOT_SUPPORTED at v13)
+            from stellar_core_tpu.xdr import OperationBody, OperationType
+            ops = [src.op(OperationBody(OperationType.INFLATION, None))]
+        else:              # fee bump (random sponsor)
+            sponsor = rng.choice(users)
+            if sponsor is src:
+                continue
+            inner = src.tx([src.op_payment(root.account_id,
+                                           rng.choice([1, 17]))])
+            frames.append(_fee_bump(h, sponsor, inner,
+                                    fee=rng.choice([300, 5000]),
+                                    muxed_source=rng.random() < 0.3))
+            continue
+        frames.append(src.tx(ops))
+    return frames
+
+
+def _run_randomized_full(rounds, seed):
+    rng = random.Random(seed)
+    h = DiffHarness()
+    world = _coverage_world(h)
+    fresh_counter = [0]
+    native_before = h.closes_native
+    for _ in range(rounds):
+        frames = _random_full_frames(rng, h, world, fresh_counter)
+        if frames:
+            h.close(frames)
+    assert h.closes_native > native_before
+
+
+def test_native_apply_randomized_full_matrix():
+    """Seeded randomized differential matrix over ALL op types, fee
+    bumps, and muxed accounts (tier-1 fast variant)."""
+    _run_randomized_full(6, 0xC0FFEE)
+
+
+@pytest.mark.slow
+@pytest.mark.parametrize("seed", [1, 2, 3])
+def test_native_apply_randomized_full_matrix_soak(seed):
+    """The slow soak: more rounds, independent seeds."""
+    _run_randomized_full(20, seed)
+
+
+def test_cluster_fail_fault_degrades_to_serial():
+    """`apply.cluster-fail` (util.faults): a would-be-parallel close
+    runs the SAME close serially — never the Python path — and the
+    cockpit counts the degrade. The oracle still agrees."""
+    from stellar_core_tpu.util.faults import FaultInjector
+
+    h = DiffHarness()
+    h.native.app.faults = FaultInjector(seed=1)
+    h.native.app.faults.configure("apply.cluster-fail", probability=1.0)
+    root = h.account(root_secret_key())
+    pairs = [(h.account(SecretKey.from_seed(sha256(b"cfA%d" % i))),
+              h.account(SecretKey.from_seed(sha256(b"cfB%d" % i))))
+             for i in range(6)]
+    h.close([root.tx(
+        [root.op_create_account(a.account_id, 20 * MIN0)
+         for a, b in pairs] +
+        [root.op_create_account(b.account_id, 20 * MIN0)
+         for a, b in pairs])])
+    before = h.closes_native
+    h.close([a.tx([a.op_payment(b.account_id, 100)]) for a, b in pairs])
+    st = h.native.apply_stats.clusters
+    assert h.closes_native == before + 1      # still native
+    assert st["degraded"] >= 1                # the fault fired
+    assert st["parallel_closes"] == 0         # and the close ran serial
+    # clean up the class-level stub app attribute
+    del h.native.app.faults
+
+
+def test_pipeline_stall_fault_runs_prewarm_inline():
+    """`apply.pipeline-stall` (util.faults): the catchup prewarm
+    pipeline degrades to sequential — triples verify inline on the
+    main thread, no worker is spawned, and the stall meter marks."""
+    from stellar_core_tpu.historywork.apply_works import (
+        ApplyCheckpointWork,
+    )
+    from stellar_core_tpu.util.faults import FaultInjector
+    from stellar_core_tpu.util.metrics import MetricsRegistry
+
+    calls = []
+
+    class _Verifier:
+        name = "cpu"
+
+        def prewarm_many(self, triples):
+            calls.append(len(triples))
+
+    class _App:
+        faults = FaultInjector(seed=2)
+        metrics = MetricsRegistry()
+        sig_verifier = _Verifier()
+
+    app = _App()
+    app.faults.configure("apply.pipeline-stall", probability=1.0)
+    work = ApplyCheckpointWork.__new__(ApplyCheckpointWork)
+    work.app = app
+    work._pipeline = None
+    work._range_triples = lambda first, last: [(b"k" * 32, b"s", b"m")]
+    work._pipeline_submit(8, 15)
+    assert calls == [1]                       # verified INLINE
+    assert work._pipeline is None             # no worker spawned
+    m = app.metrics.to_json().get("catchup.pipeline.stall")
+    assert m and m["count"] == 1

@@ -85,3 +85,26 @@ def test_native_differential_oracles_pass_under_asan_ubsan():
     assert r.returncode == 0, tail
     assert "ERROR: AddressSanitizer" not in r.stderr, r.stderr[-4000:]
     assert "runtime error:" not in r.stderr, r.stderr[-4000:]
+
+
+def test_threaded_parallel_close_under_asan_ubsan():
+    """ISSUE 13: the conflict-graph parallel close runs worker pthreads
+    inside the C engine — data races and heap misuse there are exactly
+    what ASan/TSan-class tooling exists to catch. Drive the
+    forced-parallel differential legs (parallel-vs-serial-vs-oracle
+    equality + the full randomized matrix) under the sanitized build,
+    repeatedly enough that the persistent worker pool recycles across
+    closes."""
+    env = _sanitizer_env()
+    r = subprocess.run(
+        [sys.executable, "-m", "pytest",
+         "tests/test_native_apply.py::test_native_apply_parallel_equality",
+         "tests/test_native_apply.py::"
+         "test_native_apply_randomized_full_matrix",
+         "tests/test_native_apply.py::test_native_apply_all_op_types",
+         "-q", "-p", "no:cacheprovider"],
+        capture_output=True, text=True, cwd=REPO, env=env, timeout=1200)
+    tail = (r.stdout or "")[-4000:] + (r.stderr or "")[-4000:]
+    assert r.returncode == 0, tail
+    assert "ERROR: AddressSanitizer" not in r.stderr, r.stderr[-4000:]
+    assert "runtime error:" not in r.stderr, r.stderr[-4000:]

@@ -675,6 +675,32 @@ def check_artifact(path: str) -> List[str]:
     return errs
 
 
+def validate_parallel_close(pc, where: str = "") -> List[str]:
+    """Schema check for a `parallel_close` block (ISSUE 13: the
+    conflict-graph parallel-close gate leg): both apply walls must be
+    finite positives and the recorded speedup must actually be their
+    ratio — a speedup that drifts from its own numerator/denominator is
+    a broken artifact, not a measurement."""
+    errs: List[str] = []
+    if not isinstance(pc, dict):
+        return ["%s: parallel_close is not an object" % where]
+    ser = _num(pc, "serial_apply_ms")
+    par = _num(pc, "parallel_apply_ms")
+    spd = _num(pc, "parallel_apply_speedup")
+    for key, v in (("serial_apply_ms", ser), ("parallel_apply_ms", par),
+                   ("parallel_apply_speedup", spd)):
+        if v is None or v <= 0:
+            errs.append("%s: parallel_close.%s must be a finite number "
+                        "> 0, got %r" % (where, key, pc.get(key)))
+    if not isinstance(pc.get("clusters"), int) or pc.get("clusters", 0) < 1:
+        errs.append("%s: parallel_close.clusters must be a positive int"
+                    % where)
+    if not errs and abs(spd - ser / par) > max(0.01, 0.01 * spd):
+        errs.append("%s: parallel_close.parallel_apply_speedup %.3f != "
+                    "serial/parallel ratio %.3f" % (where, spd, ser / par))
+    return errs
+
+
 def _walk_breakdowns(blob, name: str, errs: List[str],
                      depth: int = 0) -> None:
     if depth > 6:
@@ -687,6 +713,8 @@ def _walk_breakdowns(blob, name: str, errs: List[str],
         return
     if "apply_breakdown" in blob:
         errs.extend(validate_apply_breakdown(blob["apply_breakdown"], name))
+    if "parallel_close" in blob:
+        errs.extend(validate_parallel_close(blob["parallel_close"], name))
     if "overlay_breakdown" in blob:
         errs.extend(validate_overlay_breakdown(blob["overlay_breakdown"],
                                                name))
