@@ -1142,6 +1142,300 @@ def hash_main(argv) -> int:
     return 1 if report["regressions"] else 0
 
 
+def _bucketdb_seed_state(app, n_accounts: int, seed: int,
+                         level: int = 6) -> list:
+    """Seeded cold-state generator (ISSUE 14): install `n_accounts`
+    deterministic accounts as one deep-level bucket WITHOUT closing
+    ledgers — the bucket file, its content hash, the sorted key index
+    and the bloom filter are all built in one streamed pass, so 10^6
+    accounts never sit in memory as Python entry objects. The installed
+    bucket is file-backed only (a slim Bucket with no resident
+    entries): every later read exercises the sidecar-index + pread
+    path for real. Returns the 32-byte account key list (payment
+    destinations for the traffic legs)."""
+    import hashlib as _hashlib
+
+    from stellar_core_tpu.bucket.bucket import Bucket, entry_record
+    from stellar_core_tpu.bucket.bucket_index import (
+        BloomFilter, BucketIndex, key_fingerprint, sidecar_path,
+    )
+    from stellar_core_tpu.transactions.account_helpers import (
+        make_account_entry,
+    )
+    from stellar_core_tpu.xdr import (
+        BucketEntry, PublicKey, ledger_entry_key,
+    )
+
+    bm = app.bucket_manager
+    proto = app.ledger_manager.lcl_header.ledgerVersion
+    # account ids sorted up front: LIVE bucket entries order by
+    # (type, accountID XDR), which for same-type keys is raw pubkey order
+    keys = sorted(
+        _hashlib.sha256(b"bucketdb-bench:%d:%d" % (seed, i)).digest()
+        for i in range(n_accounts))
+    h = _hashlib.sha256()
+    tmp_path = os.path.join(bm.bucket_dir, ".seed-%d.tmp" % n_accounts)
+    idx_keys, ordinals, offsets, lengths = [], [], [], []
+    bloom = BloomFilter.for_capacity(
+        n_accounts, app.config.BUCKETDB_BLOOM_BITS_PER_KEY)
+    off = 0
+    with open(tmp_path, "wb") as fh:
+        meta = entry_record(BucketEntry.meta(proto))
+        fh.write(meta)
+        h.update(meta)
+        off += len(meta)
+        for ordinal, kb32 in enumerate(keys, start=1):
+            e = make_account_entry(PublicKey.ed25519(kb32), 10**9, 0, 1)
+            rec = entry_record(BucketEntry.live(e))
+            fh.write(rec)
+            h.update(rec)
+            lk = ledger_entry_key(e).to_xdr()
+            idx_keys.append(lk)
+            ordinals.append(ordinal)
+            offsets.append(off + 8)        # 4B record mark + 4B union disc
+            lengths.append(len(rec) - 8)
+            bloom.add(key_fingerprint(lk))
+            off += len(rec)
+    bucket_hash = h.digest()
+    path = bm.bucket_filename(bucket_hash)
+    os.replace(tmp_path, path)
+    slim = Bucket((), hash_=bucket_hash, path=path)
+    BucketIndex(bucket_hash, idx_keys, ordinals, offsets, lengths,
+                bloom).save(sidecar_path(path))
+    with bm._lock:
+        bm._shared[bucket_hash] = slim
+    # deep level: nothing spills into (or merges) level 6 within the
+    # bench's few dozen closes, so the cold state stays put while the
+    # close path hashes the list over it every close
+    bm.bucket_list.levels[level].curr = slim
+    return keys
+
+
+def _bucketdb_leg(n_accounts: int, senders: int, closes: int,
+                  surge_closes: int, seed: int) -> dict:
+    """One scale point of the --bucketdb latency-flatness gate: a
+    standalone node over `n_accounts` of seeded bucket-backed cold
+    state, closing `closes` ledgers of uniform-random payments into the
+    cold set (every destination read is a bloom-filtered index probe)
+    and `surge_closes` of hot-key-skewed traffic for the prefetch
+    hit-rate gate."""
+    import random as _random
+    import shutil
+    import tempfile
+
+    from stellar_core_tpu.main.application import Application
+    from stellar_core_tpu.main.config import Config
+    from stellar_core_tpu.testing import AppLedgerAdapter, TestAccount
+    from stellar_core_tpu.crypto.keys import SecretKey
+    from stellar_core_tpu.util.timer import ClockMode, VirtualClock
+    from stellar_core_tpu.xdr import PublicKey
+
+    tmp = tempfile.mkdtemp(prefix="sct-bucketdb-")
+    try:
+        cfg = Config.test_config(0)
+        cfg.DATABASE = "sqlite3://:memory:"
+        cfg.INVARIANT_CHECKS = []
+        cfg.TESTING_UPGRADE_MAX_TX_SET_SIZE = 10_000
+        app = Application(VirtualClock(ClockMode.VIRTUAL_TIME), cfg)
+        app.enable_buckets(os.path.join(tmp, "buckets"))
+        app.start()
+        # the commitment engine would Merkle an empty root over the
+        # slim (non-resident) seeded bucket — disabled for the leg
+        # (docs/perf-replay.md#million-account-methodology)
+        app.state_commitment = None
+        assert app.ledger_manager.root.bucket_backed()
+        cold_keys = _bucketdb_seed_state(app, n_accounts, seed)
+
+        adapter = AppLedgerAdapter(app)
+        root = adapter.root_account()
+        sender_sks = [SecretKey.from_seed(
+            bytes([13, i & 0xFF, (i >> 8) & 0xFF, seed & 0xFF] + [29] * 28))
+            for i in range(senders)]
+        for lo in range(0, senders, 100):
+            app.submit_transaction(root.tx(
+                [root.op_create_account(sk.public_key, 10**10)
+                 for sk in sender_sks[lo:lo + 100]]))
+            app.manual_close()
+        sender_accs = [TestAccount(adapter, sk) for sk in sender_sks]
+
+        lm = app.ledger_manager
+        bdb = app.bucket_manager.bucketdb
+        rnd = _random.Random(seed)
+        # warm pass: the big sidecar loads ONCE here (index load cost is
+        # startup, not steady-state close latency)
+        bdb.lookup(_cold_account_key_xdr(cold_keys[0]))
+
+        lm.apply_stats.reset()
+        bdb.stats.reset()
+        walls = []
+        for c in range(closes):
+            app.clock.set_virtual_time(app.clock.now() + 1)
+            for s in sender_accs:
+                dest = PublicKey.ed25519(
+                    cold_keys[rnd.randrange(n_accounts)])
+                app.submit_transaction(
+                    s.tx([s.op_payment(dest, 100)]))
+            t0 = time.perf_counter()
+            app.manual_close()
+            walls.append((time.perf_counter() - t0) * 1e3)
+        uniform_reads = lm.apply_stats.to_json()["state_reads"]
+        sql_lookups = sum(uniform_reads["lookups"].values())
+
+        # surge: hot-key skew — 80% of payments hammer one destination,
+        # 20% still land in the cold set (the prefetch bulk-warm must
+        # keep covering both)
+        lm.apply_stats.reset()
+        hot = PublicKey.ed25519(cold_keys[0])
+        for c in range(surge_closes):
+            app.clock.set_virtual_time(app.clock.now() + 1)
+            for i, s in enumerate(sender_accs):
+                dest = hot if i % 5 else PublicKey.ed25519(
+                    cold_keys[rnd.randrange(n_accounts)])
+                app.submit_transaction(s.tx([s.op_payment(dest, 100)]))
+            app.manual_close()
+        surge_stats = lm.apply_stats.to_json()
+        sql_lookups += sum(
+            surge_stats["state_reads"]["lookups"].values())
+
+        walls_sorted = sorted(walls)
+        p50 = walls_sorted[len(walls_sorted) // 2]
+        bstats = bdb.stats
+        out = {
+            "accounts": n_accounts,
+            "senders": senders,
+            "closes": closes,
+            "close_ms_p50": round(p50, 3),
+            "close_ms_mean": round(sum(walls) / len(walls), 3),
+            "close_ms_max": round(max(walls), 3),
+            "surge": {
+                "closes": surge_closes,
+                "prefetch_hit_rate_pct": round(
+                    100.0 * surge_stats["prefetch_hit_rate"], 2),
+            },
+            "bloom_fp_pct": round(
+                100.0 * bstats.false_positive_rate(), 4),
+            "bucketdb": bdb.stats.to_json(),
+            "sql_point_lookups": sql_lookups,
+        }
+        app.stop()
+        app.bucket_manager.shutdown()
+        return out
+    finally:
+        shutil.rmtree(tmp, ignore_errors=True)
+
+
+def _cold_account_key_xdr(kb32: bytes) -> bytes:
+    from stellar_core_tpu.xdr import LedgerKey, PublicKey
+    return LedgerKey.account(PublicKey.ed25519(kb32)).to_xdr()
+
+
+def bucketdb_bench(small: int = 10**4, large: int = 10**6,
+                   senders: int = 40, closes: int = 16,
+                   surge_closes: int = 8, seed: int = 4242,
+                   progress=None) -> dict:
+    """`bench.py --bucketdb` (ISSUE 14): close-latency flatness from
+    `small` to `large` seeded accounts with bucket-backed reads, plus
+    the surge prefetch-hit-rate and bloom false-positive gates. Pure
+    CPU/IO — safe to run inline (no jax import)."""
+    legs = {}
+    for name, n in (("small", small), ("large", large)):
+        legs[name] = _bucketdb_leg(n, senders, closes, surge_closes, seed)
+        if progress is not None:
+            progress(name)
+    ratio = legs["large"]["close_ms_p50"] / \
+        max(1e-9, legs["small"]["close_ms_p50"])
+    return {
+        "small": legs["small"],
+        "large": legs["large"],
+        "latency_ratio": round(ratio, 4),
+        "prefetch_hit_rate_pct":
+            legs["large"]["surge"]["prefetch_hit_rate_pct"],
+        "bloom_fp_pct": legs["large"]["bloom_fp_pct"],
+        "sql_point_lookups": legs["small"]["sql_point_lookups"] +
+            legs["large"]["sql_point_lookups"],
+    }
+
+
+def bucketdb_main(argv) -> int:
+    """`bench.py --bucketdb [--small N] [--large N] [--record]
+    [--history PATH] [--tolerance T] [--out FILE]`: the million-account
+    BucketDB gate (ISSUE 14). Hard gates (exit 1): close-latency p50
+    within 1.25x from --small to --large accounts, surge prefetch
+    hit-rate >= 95%, bloom false positives <= 5%, and ZERO apply-path
+    SQL point lookups across every measured close (cockpit-asserted).
+    Records gate against bench/history.jsonl like every other leg."""
+    import argparse
+    bc = _bench_compare_mod()
+    ap = argparse.ArgumentParser(prog="bench.py --bucketdb")
+    ap.add_argument("--bucketdb", action="store_true")
+    ap.add_argument("--small", type=int, default=10**4)
+    ap.add_argument("--large", type=int, default=10**6)
+    ap.add_argument("--senders", type=int, default=40)
+    ap.add_argument("--closes", type=int, default=16)
+    ap.add_argument("--record", action="store_true")
+    ap.add_argument("--history",
+                    default=os.path.join(_REPO, "bench", "history.jsonl"))
+    ap.add_argument("--tolerance", type=float, default=0.25)
+    ap.add_argument("--out", help="also write the block to this file")
+    args = ap.parse_args(argv)
+
+    t0 = time.time()
+    bd = bucketdb_bench(small=args.small, large=args.large,
+                        senders=args.senders, closes=args.closes,
+                        progress=lambda s: print(
+                            "# bucketdb leg %s done (%.0fs)"
+                            % (s, time.time() - t0), file=sys.stderr))
+    errors = {}
+    if bd["latency_ratio"] > 1.25:
+        errors["latency_flatness"] = \
+            "close p50 grew %.2fx from %d to %d accounts (gate 1.25x)" \
+            % (bd["latency_ratio"], args.small, args.large)
+    if bd["prefetch_hit_rate_pct"] < 95.0:
+        errors["prefetch_hit_rate"] = \
+            "surge prefetch hit-rate %.2f%% < 95%%" \
+            % bd["prefetch_hit_rate_pct"]
+    if bd["bloom_fp_pct"] > 5.0:
+        errors["bloom_fp"] = "bloom false-positive rate %.3f%% > 5%%" \
+            % bd["bloom_fp_pct"]
+    if bd["sql_point_lookups"] != 0:
+        errors["sql_point_lookups"] = \
+            "%d apply-path SQL point lookups leaked (gate: zero)" \
+            % bd["sql_point_lookups"]
+
+    src = "bench.py --bucketdb"
+    records = bc.bucketdb_records(bd, src)
+    out = {
+        "metric": "bucketdb_latency_ratio",
+        "unit": "x",
+        "value": bd["latency_ratio"],
+        "platform": "bucketdb-cpu",
+        "at_unix": int(t0),
+        "bucketdb_bench": bd,
+        "records": records,
+    }
+    history = bc.load_history(args.history)
+    report = bc.compare(records, history, tolerance=args.tolerance)
+    if args.record and not errors:
+        commit = _git_commit()
+        now = int(time.time())
+        for rec in records:
+            if rec.get("at_unix") is None:
+                rec["at_unix"] = now
+            if rec.get("commit") is None:
+                rec["commit"] = commit
+        report["recorded"] = bc.append_history(args.history, records)
+    out["compare"] = report
+    if errors:
+        out["errors"] = errors
+    if args.out:
+        with open(args.out, "w") as fh:
+            json.dump(out, fh, indent=1, sort_keys=True)
+    print(json.dumps(out, indent=1, sort_keys=True))
+    if errors:
+        return 1
+    return 1 if report["regressions"] else 0
+
+
 def _bench_compare_mod():
     """The perf-regression ledger module (tools/bench_compare.py) —
     stdlib-only, never imports jax."""
@@ -1542,6 +1836,14 @@ def replay_full_main(argv) -> int:
                    if op not in per_op]
         if missing:
             errors["missing_op_coverage"] = missing
+        # the zero-SQL acceptance (ISSUE 14): with BucketDB routing the
+        # standard mix must close with NO apply-path SQL point lookups
+        # (bulk order-book scans are the write-behind index's job and
+        # are counted separately)
+        sql_lookups = std.get("apply_breakdown", {}) \
+            .get("state_reads", {}).get("lookups", {})
+        if sql_lookups:
+            errors["sql_point_lookups"] = sql_lookups
     try:
         pcb = parallel_close_bench()
         out["parallel_close"] = pcb
@@ -1914,6 +2216,12 @@ if __name__ == "__main__":
         # replay + legacy multisig replay + the parallel-close gate;
         # scrubbed CPU children only — never touches the device relay
         sys.exit(replay_full_main(sys.argv[1:]))
+    elif "--bucketdb" in sys.argv:
+        # million-account BucketDB leg (ISSUE 14): close-latency
+        # flatness from 10^4 to 10^6 seeded accounts over bucket-backed
+        # reads, surge prefetch hit-rate, bloom FP rate, zero-SQL gate;
+        # pure CPU/IO — does not touch jax or the device relay
+        sys.exit(bucketdb_main(sys.argv[1:]))
     elif "--scenario" in sys.argv:
         # scenario lab (ISSUE 8): churn / flood / partition / surge
         # robustness scenarios emitting fleet bench blocks gated against

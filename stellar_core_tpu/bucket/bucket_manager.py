@@ -55,7 +55,10 @@ def calculate_skip_values(header) -> None:
 class BucketManager:
     def __init__(self, bucket_dir: Optional[str] = None,
                  background_merges: bool = True,
-                 num_workers: int = 2, stats=None) -> None:
+                 num_workers: int = 2, stats=None,
+                 bucketdb_stats=None, faults=None,
+                 bloom_bits_per_key: int = 10,
+                 eager_index: bool = True) -> None:
         self.bucket_dir = bucket_dir
         if bucket_dir:
             os.makedirs(bucket_dir, exist_ok=True)
@@ -69,6 +72,14 @@ class BucketManager:
         self._stats = stats
         self.bucket_list = BucketList(self._executor, adopt=self.adopt_bucket,
                                       stats=stats)
+        # BucketDB (ISSUE 14): bloom-filtered per-bucket indexes over the
+        # live list, built at adopt time (close path + merge workers),
+        # sidecars persisted beside the bucket files; LedgerTxnRoot
+        # point reads route through it (bucket/bucket_index.py)
+        from .bucket_index import BucketDB
+        self.bucketdb = BucketDB(self, stats=bucketdb_stats, faults=faults,
+                                 bits_per_key=bloom_bits_per_key,
+                                 eager_index=eager_index)
 
     # -- store ---------------------------------------------------------------
     def bucket_filename(self, hash_: bytes) -> Optional[str]:
@@ -78,7 +89,10 @@ class BucketManager:
 
     def adopt_bucket(self, b: Bucket) -> Bucket:
         """Deduplicate by hash and persist to the bucket dir (reference
-        BucketManagerImpl::adoptFileAsBucket)."""
+        BucketManagerImpl::adoptFileAsBucket). Adoption also indexes the
+        bucket for BucketDB (load the persisted sidecar, else build and
+        persist one) — OUTSIDE the store lock, so a large merge output's
+        index build never blocks concurrent bucket lookups."""
         h = b.get_hash()
         if h == ZERO_HASH:
             return b
@@ -91,8 +105,13 @@ class BucketManager:
                 b.write_to(path + ".tmp")
                 os.replace(path + ".tmp", path)
                 b.path = path
+            elif path:
+                # bucket file already on disk (restart / catchup
+                # re-download): serve reads from it
+                b.path = path
             self._shared[h] = b
-            return b
+        self.bucketdb.on_adopt(b)
+        return b
 
     def get_bucket_by_hash(self, hash_: bytes) -> Optional[Bucket]:
         if hash_ == ZERO_HASH:
@@ -155,13 +174,22 @@ class BucketManager:
         BucketManagerImpl::forgetUnreferencedBuckets."""
         keep = set(self.get_referenced_hashes()) | set(extra_refs)
         dropped = 0
+        victims = []
         with self._lock:
             for h in list(self._shared):
                 if h not in keep:
                     b = self._shared.pop(h)
                     if b.path and os.path.exists(b.path):
                         os.remove(b.path)
+                    victims.append((h, b.path))
                     dropped += 1
+        # BucketDB index lifetime follows the bucket's (ISSUE 14
+        # satellite): a GC'd bucket's in-memory index, cached fd and
+        # persisted sidecar all go with it — a stale sidecar left behind
+        # would be adopted verbatim if the same content hash ever
+        # returns, which is exactly why it must match the file's fate
+        for h, path in victims:
+            self.bucketdb.invalidate(h, path)
         return dropped
 
     # -- state restore (catchup / restart) -----------------------------------
@@ -225,3 +253,4 @@ class BucketManager:
     def shutdown(self) -> None:
         if self._executor is not None:
             self._executor.shutdown(wait=True)
+        self.bucketdb.close()

@@ -167,6 +167,12 @@ class ApplyStats:
         self._m_chit = m.new_meter("ledger.apply.state.cache-hit")
         self._m_cmiss = m.new_meter("ledger.apply.state.cache-miss")
         self._m_rows = m.new_meter("ledger.apply.state.bulk-scan-rows")
+        # BucketDB routing (ISSUE 14): cache misses served from the
+        # bucket list (never SQL), and the root entry cache's real-LRU
+        # eviction count — silent coverage loss at 10^6 accounts is a
+        # visible meter, not a mystery miss rate
+        self._m_bucket_read = m.new_meter("ledger.apply.state.bucket-read")
+        self._m_evict = m.new_meter("ledger.apply.entry-cache.evicted")
         self._m_feebump = m.new_meter("ledger.apply.tx.fee-bump")
         self._m_muxed = m.new_meter("ledger.apply.tx.muxed")
         self._h_merge = m.new_histogram("bucket.merge.seconds")
@@ -201,6 +207,8 @@ class ApplyStats:
             self.reads = {
                 "lookups": {},          # entry type -> SQL point lookups
                 "cache_hits": 0, "cache_misses": 0,
+                "bucket_reads": 0,      # misses served by BucketDB
+                "cache_evictions": 0,
                 "bulk_scans": 0, "bulk_scan_rows": 0,
                 "prefetch": {"calls": 0, "requested": 0, "cached": 0,
                              "hits": 0, "misses": 0},
@@ -247,6 +255,7 @@ class ApplyStats:
         return {"lookups": dict(r["lookups"]),
                 "cache_hits": r["cache_hits"],
                 "cache_misses": r["cache_misses"],
+                "bucket_reads": r["bucket_reads"],
                 "bulk_scan_rows": r["bulk_scan_rows"]}
 
     def end_close(self, path: str, wall_s: float,
@@ -273,7 +282,8 @@ class ApplyStats:
             lookups = {t: n - base["lookups"].get(t, 0)
                        for t, n in cur["lookups"].items()
                        if n - base["lookups"].get(t, 0)}
-            read_set = sum(lookups.values()) + \
+            bucket_reads = cur["bucket_reads"] - base["bucket_reads"]
+            read_set = sum(lookups.values()) + bucket_reads + \
                 (cur["cache_hits"] - base["cache_hits"])
             blob = {
                 "seq": c["seq"], "path": path, "bail": c["bail"],
@@ -286,6 +296,7 @@ class ApplyStats:
                     "cache_hits": cur["cache_hits"] - base["cache_hits"],
                     "cache_misses":
                         cur["cache_misses"] - base["cache_misses"],
+                    "bucket_reads": bucket_reads,
                     "bulk_scan_rows":
                         cur["bulk_scan_rows"] - base["bulk_scan_rows"],
                     "read_set": read_set,
@@ -407,13 +418,19 @@ class ApplyStats:
         return m
 
     def record_read(self, hit: bool, prefetched: bool,
-                    entry_type: Optional[str] = None) -> None:
+                    entry_type: Optional[str] = None,
+                    source: str = "sql") -> None:
         """One root entry read, folded into a single lock acquisition —
         this hook sits inside the exact path the cockpit measures.
         Covers the cache hit/miss counters, the getPrefetchHitRate-parity
         prefetch hit/miss (a warm cache hit on a never-prefetched key
         records neither; every miss counts as a prefetch miss), and — on
-        a miss — the SQL point lookup by entry type."""
+        a miss — the point lookup by entry type, attributed to its
+        serving `source`: "sql" feeds the per-type SQL lookup meters the
+        ISSUE-14 zero-SQL gate asserts on; "bucket" (BucketDB-served)
+        feeds the separate bucket-read counter, so routing state reads
+        off SQL visibly DRAINS `ledger.apply.state.lookup.*` instead of
+        inflating it."""
         if hit:
             self._m_chit.mark()
             if prefetched:
@@ -425,14 +442,25 @@ class ApplyStats:
         else:
             self._m_cmiss.mark()
             self._m_pmiss.mark()
-            if entry_type is not None:
+            if entry_type is not None and source == "sql":
                 self._lookup_meter(entry_type).mark()
+            elif source == "bucket":
+                self._m_bucket_read.mark()
             with self._lock:
                 self.reads["cache_misses"] += 1
                 self.reads["prefetch"]["misses"] += 1
-                if entry_type is not None:
+                if source == "bucket":
+                    self.reads["bucket_reads"] += 1
+                elif entry_type is not None:
                     lk = self.reads["lookups"]
                     lk[entry_type] = lk.get(entry_type, 0) + 1
+
+    def record_cache_evictions(self, n: int = 1) -> None:
+        """Root entry-cache LRU evictions (the bounded-cache coverage
+        signal the ISSUE-14 satellite makes observable)."""
+        self._m_evict.mark(n)
+        with self._lock:
+            self.reads["cache_evictions"] += n
 
     def record_bulk_scan(self, rows: int) -> None:
         self._m_rows.mark(rows)
@@ -441,23 +469,28 @@ class ApplyStats:
             self.reads["bulk_scan_rows"] += rows
 
     def record_prefetch(self, requested: int, cached: int,
-                        lookups: Optional[Dict[str, int]] = None) -> None:
+                        lookups: Optional[Dict[str, int]] = None,
+                        bucket_loads: int = 0) -> None:
         """One prefetch() pass: `requested` keys asked for, `cached`
         resident in the entry cache afterwards (already-warm + newly
         loaded). Coverage = cached/requested — the per-txset number the
-        ISSUE's bucket-read refactor (ROADMAP item 4) will be gated on.
-        `lookups` carries the pass's SQL point loads by entry type,
-        batched into this one acquisition."""
+        bucket-read refactor (ROADMAP item 4 / ISSUE 14) gates on.
+        `lookups` carries the pass's SQL point loads by entry type;
+        `bucket_loads` counts keys the BucketDB batched pass resolved
+        instead — both batched into this one acquisition."""
         cov = 100.0 * cached / requested if requested else 100.0
         self._h_pcov.update(cov)
         if lookups:
             for entry_type, n in lookups.items():
                 self._lookup_meter(entry_type).mark(n)
+        if bucket_loads:
+            self._m_bucket_read.mark(bucket_loads)
         with self._lock:
             p = self.reads["prefetch"]
             p["calls"] += 1
             p["requested"] += requested
             p["cached"] += cached
+            self.reads["bucket_reads"] += bucket_loads
             if lookups:
                 lk = self.reads["lookups"]
                 for entry_type, n in lookups.items():
@@ -526,6 +559,8 @@ class ApplyStats:
                         self.reads["lookups"].items())),
                     "cache_hits": self.reads["cache_hits"],
                     "cache_misses": self.reads["cache_misses"],
+                    "bucket_reads": self.reads["bucket_reads"],
+                    "cache_evictions": self.reads["cache_evictions"],
                     "bulk_scans": self.reads["bulk_scans"],
                     "bulk_scan_rows": self.reads["bulk_scan_rows"],
                     "prefetch": dict(self.reads["prefetch"]),
@@ -574,6 +609,8 @@ class ApplyStats:
                         self.reads["lookups"].items())),
                     "cache_hits": self.reads["cache_hits"],
                     "cache_misses": self.reads["cache_misses"],
+                    "bucket_reads": self.reads["bucket_reads"],
+                    "cache_evictions": self.reads["cache_evictions"],
                     "bulk_scan_rows": self.reads["bulk_scan_rows"],
                     "prefetch": dict(self.reads["prefetch"]),
                     "prefetch_hit_rate": round(self._hit_rate_locked(), 4),

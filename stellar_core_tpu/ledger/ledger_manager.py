@@ -108,9 +108,23 @@ class LedgerManager:
         root_acc = self.app.network_root_key().public_key
         ltx.create(make_account_entry(
             root_acc, cfg.GENESIS_TOTAL_COINS, 0, GENESIS_LEDGER_SEQ))
+        genesis_entries = [cur for (_k, _prev, cur) in ltx.get_delta()]
         ltx.commit()
         self.lcl_hash = sha256(genesis.to_xdr())
         self._store_header(genesis)
+        # seed the bucket list with the genesis delta (reference
+        # startNewLedger → ledgerClosed does the same addBatch): without
+        # it the root account exists in SQL but in NO bucket, so
+        # BucketDB-routed reads (ISSUE 14) and bucket-apply catchup both
+        # miss it. The genesis HEADER keeps bucketListHash = zero — it
+        # was hashed before this batch, and every node (and every
+        # catchup replay) seeds identically, so the chain from ledger 2
+        # onward agrees fleet-wide.
+        bm = self._bucket_manager()
+        if bm is not None:
+            bm.add_batch(GENESIS_LEDGER_SEQ, genesis.ledgerVersion,
+                         genesis_entries, [], [])
+            self._store_local_has()
         self.state = LedgerManagerState.LM_SYNCED_STATE
         log.info("started new ledger: genesis %s",
                  self.lcl_hash.hex()[:8])
@@ -130,7 +144,42 @@ class LedgerManager:
         self.lcl_hash = bytes.fromhex(row[0])
         self.state = LedgerManagerState.LM_SYNCED_STATE
         self._restore_bucket_list()
+        self._check_bucket_coverage()
         return True
+
+    def _check_bucket_coverage(self) -> None:
+        """BucketDB may only serve authoritative reads when the bucket
+        list covers the root's whole SQL state. Two restart shapes
+        break that: a data dir written before genesis seeding (ISSUE
+        14) whose headers legitimately match an unseeded list, and a
+        dir whose buckets were enabled mid-life (no HAS at all, list
+        empty over populated SQL). The root account is the sentinel:
+        it is the only entry ever created outside a close delta —
+        everything else entered a bucket with the close that touched
+        it — so if SQL has it and the bucket list disagrees, the list
+        does not cover this state: detach (SQL point reads carry the
+        node; a bucket-apply catchup re-attaches)."""
+        root = self.root
+        if not getattr(root, "bucket_backed", lambda: False)():
+            return
+        from ..xdr import LedgerKey
+        key = LedgerKey.account(self.app.network_root_key().public_key)
+        sql_blob = root._select_blob(key)
+        if sql_blob is None:
+            return
+        served, blob = root._bucketdb.lookup(key.to_xdr())
+        if not served:
+            # a bucketdb.read-fail degrade during the sentinel proves
+            # nothing about coverage — don't detach on a fault
+            return
+        if blob != sql_blob:
+            root.detach_bucketdb()
+            log.warning(
+                "bucket list does not cover SQL state (root-account "
+                "sentinel: bucket says %s, SQL has it) — bucket-backed "
+                "reads disabled, SQL point reads in effect until a "
+                "bucket-apply catchup heals the list",
+                "absent" if blob is None else "a different entry")
 
     def set_last_closed_ledger(self, header: LedgerHeader,
                                ledger_hash: bytes) -> None:
@@ -142,6 +191,17 @@ class LedgerManager:
         self.lcl_hash = ledger_hash
         self._store_header(header)
         self.entries_invalidated = False
+        # a bucket-apply catchup rebuilt SQL state FROM the bucket list,
+        # so the two are in sync again: (re-)attach BucketDB reads if
+        # the adopted list matches what this header committed to
+        # (heals a startup-time detach — ISSUE 14). Respects the
+        # operator's BUCKETDB_READS=False pin.
+        bm = self._bucket_manager()
+        cfg = getattr(self.app, "config", None)
+        if bm is not None and hasattr(self.root, "attach_bucketdb") and \
+                getattr(cfg, "BUCKETDB_READS", True) and \
+                bm.get_hash() == header.bucketListHash:
+            self.root.attach_bucketdb(bm.bucketdb)
         log.info("LCL set to %d (%s) from catchup", header.ledgerSeq,
                  ledger_hash.hex()[:8])
 
@@ -292,14 +352,21 @@ class LedgerManager:
         # close (ISSUE 13: ~9ms/close on the replay leg). When the
         # engine is expected to run, the prefetch is DEFERRED, not
         # dropped: a bailing close still warms the cache before the
-        # Python phases (below).
+        # Python phases (below). EXCEPT with a BucketDB-backed root
+        # (ISSUE 14): there the batched prefetch resolves the whole
+        # txset in one bloom-filtered pass per bucket level — cheaper
+        # than the engine's per-key multi-level walks — and feeds the
+        # engine its entry blobs directly as cache hits.
         def _bulk_prefetch() -> None:
             with app_span(self.app, "close.prefetch", cat="ledger") as psp:
                 psp.set_tag("cached",
                             self.root.prefetch(txset_prefetch_keys(frames)))
 
+        bucket_backed = getattr(self.root, "bucket_backed",
+                                lambda: False)()
         can_prefetch = bool(frames) and hasattr(self.root, "prefetch")
-        if can_prefetch and not self._native_covers_prefetch():
+        if can_prefetch and (bucket_backed or
+                             not self._native_covers_prefetch()):
             _bulk_prefetch()
             can_prefetch = False   # done; don't repeat on a native bail
 
@@ -598,8 +665,14 @@ class LedgerManager:
                             header.ledgerSeq, header.ledgerVersion)
             # the adopted list must hash to what the LCL header committed
             # to — a stale HAS (e.g. written before a bucket-apply catchup
-            # fast-forwarded the LCL) silently forks the chain otherwise
-            if bm.get_hash() != header.bucketListHash:
+            # fast-forwarded the LCL) silently forks the chain otherwise.
+            # Exception: a node restarted AT genesis — the genesis header
+            # predates the seeded genesis batch by construction (its
+            # bucketListHash is the zero hash), so the seeded list is the
+            # expected state, not a fork.
+            at_genesis = (header.ledgerSeq == GENESIS_LEDGER_SEQ and
+                          header.bucketListHash == b"\x00" * 32)
+            if not at_genesis and bm.get_hash() != header.bucketListHash:
                 raise ValueError(
                     "restored bucket list hash %s != header %s" %
                     (bm.get_hash().hex()[:16],
@@ -613,7 +686,14 @@ class LedgerManager:
             bm.bucket_list = BucketList(bm._executor,
                                         adopt=bm.adopt_bucket,
                                         stats=bm._stats)
-            log.warning("bucket-list restore failed: %s", e)
+            # the empty list no longer covers this root's SQL state, so
+            # BucketDB must NOT serve authoritative reads over it —
+            # detach; SQL point reads carry the node until catchup heals
+            # the list (ISSUE 14)
+            if hasattr(self.root, "detach_bucketdb"):
+                self.root.detach_bucketdb()
+            log.warning("bucket-list restore failed: %s — bucket-backed "
+                        "reads disabled, SQL point reads in effect", e)
 
     def _store_upgrade_history(self, ledger_seq: int, up, changes,
                                index: int) -> None:

@@ -13,10 +13,11 @@ generation, and entries are owned by the innermost open txn.
 
 from __future__ import annotations
 
+from collections import OrderedDict
 from typing import Dict, Iterator, List, Optional, Tuple
 
 from ..database.database import Database
-from ..util.cache import RandomEvictionCache
+from ..util.cache import LRUCache
 from ..xdr import (
     Asset, LedgerEntry, LedgerEntryType, LedgerHeader, LedgerKey, OfferEntry,
     ledger_entry_key,
@@ -608,11 +609,23 @@ class LedgerTxnRoot(AbstractLedgerTxnParent):
     """SQL-backed root with an entry cache and per-type bulk writers
     (reference LedgerTxnRoot + LedgerTxn{Account,Offer,TrustLine,Data}SQL).
 
+    BucketDB routing (ISSUE 14, ROADMAP item 4): with a BucketDB
+    attached (`attach_bucketdb`), point reads that miss the entry cache
+    are served from the bloom-filtered bucket indexes instead of SQL —
+    SQL stays the write-behind query index (bulk order-book scans,
+    history, operator queries) and is only consulted for point reads
+    when a `bucketdb.read-fail` degrade makes a bucket read
+    non-authoritative. The entry cache itself is a true-LRU bound
+    (ISSUE 14 satellite) whose evictions are metered, and the prefetch
+    bulk-warm resolves a whole txset's keys in one batched pass per
+    bucket level.
+
     `stats` (ledger/apply_stats.py ApplyStats) is the close cockpit's
     state-read telemetry: per-type SQL point lookups, entry-cache
-    hit/miss, prefetch coverage and hit-rate (reference
-    getPrefetchHitRate parity), bulk-scan row counts. Every hook is a
-    no-op when no stats object is wired (tests, standalone tools)."""
+    hit/miss/eviction, bucket-served reads, prefetch coverage and
+    hit-rate (reference getPrefetchHitRate parity), bulk-scan row
+    counts. Every hook is a no-op when no stats object is wired (tests,
+    standalone tools)."""
 
     ENTRY_CACHE_SIZE = 4096
 
@@ -621,14 +634,18 @@ class LedgerTxnRoot(AbstractLedgerTxnParent):
                  stats=None) -> None:
         self._db = db
         self._header = header
-        self._cache: RandomEvictionCache = RandomEvictionCache(
-            self.ENTRY_CACHE_SIZE)
+        self._cache: LRUCache = LRUCache(self.ENTRY_CACHE_SIZE,
+                                         on_evict=self._on_cache_evict)
         self._stats = stats
+        self._bucketdb = None
         # keys warmed by prefetch(): a later cache-hit on one counts as a
-        # prefetch hit, a SQL fetch counts as a prefetch miss (reference
-        # LedgerTxnRoot::getPrefetchHitRate). Bounded: cleared when it
-        # outgrows the cache it describes several times over.
-        self._prefetched: set = set()
+        # prefetch hit, a fallthrough load counts as a prefetch miss
+        # (reference LedgerTxnRoot::getPrefetchHitRate). LRU-bounded at a
+        # few multiples of the cache it describes — evicting the oldest
+        # keys one by one instead of clearing wholesale (the old
+        # bounded-set half-cache budget degraded to silent coverage loss
+        # exactly when hot state outgrew it).
+        self._prefetched: "OrderedDict[bytes, bool]" = OrderedDict()
 
     def set_header(self, header: LedgerHeader) -> None:
         self._header = header
@@ -637,11 +654,51 @@ class LedgerTxnRoot(AbstractLedgerTxnParent):
         assert self._header is not None
         return self._header
 
+    # -- BucketDB attachment -------------------------------------------------
+    def attach_bucketdb(self, bucketdb) -> None:
+        """Route point reads through `bucketdb` (bucket/bucket_index.py).
+        Only valid while the bucket list covers this root's entire
+        entry state (enabled-before-genesis, or restored from a HAS
+        that matches the LCL header) — Application.enable_buckets and
+        LedgerManager enforce that."""
+        self._bucketdb = bucketdb
+
+    def detach_bucketdb(self) -> None:
+        """Fall back to SQL point reads (bucket-list restore failed or
+        the list is otherwise not authoritative for this state)."""
+        self._bucketdb = None
+
+    def bucket_backed(self) -> bool:
+        return self._bucketdb is not None
+
     # -- reads --------------------------------------------------------------
+    def _on_cache_evict(self, kb: bytes) -> None:
+        if self._stats is not None:
+            self._stats.record_cache_evictions()
+
     def _note_prefetched(self, kb: bytes) -> None:
-        if len(self._prefetched) > 4 * self.ENTRY_CACHE_SIZE:
-            self._prefetched.clear()
-        self._prefetched.add(kb)
+        pf = self._prefetched
+        pf[kb] = True
+        pf.move_to_end(kb)
+        while len(pf) > 4 * self.ENTRY_CACHE_SIZE:
+            pf.popitem(last=False)
+
+    def _load_blob(self, key: Optional[LedgerKey], kb: bytes
+                   ) -> Tuple[Optional[bytes], str, Optional[LedgerKey]]:
+        """One cache-missing point read: (blob|None, serving source,
+        parsed key | None). BucketDB first when attached; SQL only when
+        no BucketDB is attached or the bucket read degraded
+        (`bucketdb.read-fail`). The key is parsed at most once — it is
+        returned so the caller can name the entry type for the SQL
+        lookup meters without re-parsing."""
+        bdb = self._bucketdb
+        if bdb is not None:
+            served, blob = bdb.lookup(kb)
+            if served:
+                return blob, "bucket", key
+        if key is None:
+            key = LedgerKey.from_xdr(kb)
+        return self._select_blob(key), "sql", key
 
     def get_entry(self, key: LedgerKey) -> Optional[LedgerEntry]:
         kb = _kb(key)
@@ -652,11 +709,12 @@ class LedgerTxnRoot(AbstractLedgerTxnParent):
             if st is not None:
                 st.record_read(True, kb in self._prefetched)
         else:
-            blob = self._select_blob(key)
+            blob, source, _key = self._load_blob(key, kb)
             self._cache.put(kb, blob if blob is not None else b"")
             if st is not None:
                 st.record_read(False, False,
-                               _ENTRY_TYPE_NAMES.get(key.disc, "unknown"))
+                               _ENTRY_TYPE_NAMES.get(key.disc, "unknown"),
+                               source=source)
         if not blob:
             return None
         return LedgerEntry.from_xdr(blob)
@@ -670,12 +728,16 @@ class LedgerTxnRoot(AbstractLedgerTxnParent):
             if st is not None:
                 st.record_read(True, kb in self._prefetched)
             return hit or None
-        key = LedgerKey.from_xdr(kb)
-        blob = self._select_blob(key)
+        blob, source, pkey = self._load_blob(None, kb)
         self._cache.put(kb, blob if blob is not None else b"")
         if st is not None:
-            st.record_read(False, False,
-                           _ENTRY_TYPE_NAMES.get(key.disc, "unknown"))
+            # the key parse is only needed to NAME a SQL lookup's entry
+            # type; bucket-served reads never parse it at all (this is
+            # the native engine's per-entry hot path), and the SQL path
+            # reuses _load_blob's parse
+            etype = None if pkey is None else \
+                _ENTRY_TYPE_NAMES.get(pkey.disc, "unknown")
+            st.record_read(False, False, etype, source=source)
         return blob
 
     def offers_for_book_blobs(self, selling_xdr: bytes,
@@ -773,13 +835,25 @@ class LedgerTxnRoot(AbstractLedgerTxnParent):
         afterwards (already warm + newly loaded) over keys requested —
         feeds `ledger.apply.prefetch.coverage-pct`; later root reads of
         prefetched keys count into the getPrefetchHitRate-parity
-        hit/miss meters."""
+        hit/miss meters.
+
+        With a BucketDB attached, the cold keys resolve in ONE batched
+        pass per bucket level (bloom-filtered, newest-level-first —
+        bucket/bucket_index.py prefetch_batch) instead of one multi-level
+        walk per key; the warmed cache then feeds the native engine its
+        entry blobs directly through `get_entry_blob`."""
         budget = self._cache._max // 2
         n = 0
         requested = 0
         covered = 0
         note = self._stats is not None
         loads: Dict[str, int] = {}
+        bucket_loads = 0
+        # pass 1: split warm keys from cold ones; cold collection stops
+        # at the half-cache budget (remaining keys only count coverage,
+        # exactly like the old per-key walk)
+        room = max(0, budget - len(self._cache))
+        cold: List[Tuple[LedgerKey, bytes]] = []
         for key in keys:
             requested += 1
             kb = _kb(key)
@@ -788,18 +862,34 @@ class LedgerTxnRoot(AbstractLedgerTxnParent):
                 if note:
                     self._note_prefetched(kb)
                 continue
-            if len(self._cache) >= budget:
+            if len(cold) >= room:
                 continue   # over budget: keep counting coverage only
-            blob = self._select_blob(key)
+            cold.append((key, kb))
+        # pass 2: resolve every cold key — one batched BucketDB pass per
+        # level when attached, per-key SQL otherwise (or on degrade)
+        resolved: Dict[bytes, Optional[bytes]] = {}
+        bdb = self._bucketdb
+        if bdb is not None and cold:
+            served, resolved = bdb.prefetch_batch([kb for _k, kb in cold])
+            if not served:
+                resolved = {}   # degraded: fall back to per-key SQL
+        for key, kb in cold:
+            if kb in resolved:
+                blob = resolved[kb]
+                bucket_loads += 1
+            else:
+                blob = self._select_blob(key)
+                if note:
+                    name = _ENTRY_TYPE_NAMES.get(key.disc, "unknown")
+                    loads[name] = loads.get(name, 0) + 1
             self._cache.put(kb, blob if blob is not None else b"")
             if note:
                 self._note_prefetched(kb)
-                name = _ENTRY_TYPE_NAMES.get(key.disc, "unknown")
-                loads[name] = loads.get(name, 0) + 1
             n += 1
             covered += 1
         if self._stats is not None:
-            self._stats.record_prefetch(requested, covered, loads)
+            self._stats.record_prefetch(requested, covered, loads,
+                                        bucket_loads=bucket_loads)
         return n
 
     def clear_entries(self) -> None:

@@ -266,10 +266,29 @@ class Application:
         return status
 
     def enable_buckets(self, bucket_dir: Optional[str] = None) -> None:
+        from ..bucket.bucket_index import BucketDbStats
         from ..bucket.bucket_manager import BucketManager
+        lm = self.ledger_manager
         self.bucket_manager = BucketManager(
             bucket_dir or self.config.BUCKET_DIR_PATH,
-            stats=self.ledger_manager.apply_stats)
+            stats=lm.apply_stats,
+            bucketdb_stats=BucketDbStats(metrics=self.metrics,
+                                         tracer=self.tracer,
+                                         now_fn=self.clock.now),
+            faults=self.faults,
+            bloom_bits_per_key=self.config.BUCKETDB_BLOOM_BITS_PER_KEY,
+            # with reads pinned off nothing consumes the indexes: skip
+            # the per-adopt build + sidecar write (lazy build remains)
+            eager_index=self.config.BUCKETDB_READS)
+        # route SQL-root point reads through BucketDB (ISSUE 14) — only
+        # when the bucket list will cover this root's whole entry state:
+        # enabled BEFORE start() (genesis seeds the list / restart
+        # restores it and detaches on mismatch). A mid-life enable over
+        # pre-existing SQL state keeps SQL point reads.
+        root = lm.root
+        if self.config.BUCKETDB_READS and \
+                hasattr(root, "attach_bucketdb") and root._header is None:
+            root.attach_bucketdb(self.bucket_manager.bucketdb)
 
     # -- info ----------------------------------------------------------------
     def get_info(self) -> dict:

@@ -298,6 +298,38 @@ class CommandHandler:
                 "parameter 'action' must be status|reset, got %r" % action)
         return stats.to_json()
 
+    def cmd_bucketdb(self, params) -> dict:
+        """BucketDB cockpit (ISSUE 14 tentpole;
+        docs/observability.md#bucketdb-cockpit): the bucket-backed read
+        path's operational state in one JSON blob — point-read
+        hit/miss/tombstone counts, per-level probe attribution (bloom
+        skips, index hits, bloom false positives), index build/load
+        timing and sidecar load failures, bloom bit density, bytes read
+        from bucket files, batched-prefetch shape, and SQL-fallback
+        degrades. `bucketdb?action=reset` zeroes the cumulative
+        aggregates (registry metrics keep their monotonic histories).
+        The same data is scrapeable as `sct_bucketdb_*` series via
+        `metrics?format=prometheus`."""
+        bm = getattr(self.app, "bucket_manager", None)
+        bdb = getattr(bm, "bucketdb", None)
+        if bdb is None:
+            return {"error": "buckets not enabled"}
+        action = params.get("action", "status")
+        if action not in ("status", "reset"):
+            raise CommandParamError(
+                "parameter 'action' must be status|reset, got %r" % action)
+        if action == "reset":
+            bdb.stats.reset()
+        root = self.app.ledger_manager.root
+        out = {
+            "attached": bool(getattr(root, "bucket_backed",
+                                     lambda: False)()),
+            **bdb.to_json(),
+        }
+        if action == "reset":
+            out["status"] = "reset"
+        return out
+
     def cmd_overlaystats(self, params) -> dict:
         """Wire cockpit (ISSUE 10 tentpole;
         docs/observability.md#overlay-cockpit): the overlay's

@@ -478,10 +478,15 @@ def cmd_upgrade_db(args) -> int:
 
 
 def cmd_load_xdr(args) -> int:
-    """Load an XDR bucket file directly into the ledger DB, for debugging
-    (reference `load-xdr`)."""
+    """Load an XDR bucket file directly into the ledger state, for
+    debugging (reference `load-xdr`). Since SQL is a write-behind query
+    index (ISSUE 14, docs/db-schema.md), the entries are applied BOTH
+    into the DB tables and into the bucket list (+ persisted local HAS)
+    — an SQL-only injection would be invisible to BucketDB-routed point
+    reads."""
     from ..bucket.applicator import BucketApplicator
     from ..bucket.bucket import Bucket
+    from ..xdr import BucketEntryType
 
     cfg = _load_config(args)
     app = _make_app(cfg, real_time=False)
@@ -491,6 +496,28 @@ def cmd_load_xdr(args) -> int:
     n = 0
     while applicator:
         n += applicator.advance()
+    bm = app.bucket_manager
+    if bm is not None:
+        from ..crypto.hashing import sha256
+        lm = app.ledger_manager
+        live = [e.value for e in b.payload_entries()
+                if e.disc in (BucketEntryType.LIVEENTRY,
+                              BucketEntryType.INITENTRY)]
+        dead = [e.value for e in b.payload_entries()
+                if e.disc == BucketEntryType.DEADENTRY]
+        hdr = lm.lcl_header
+        bm.add_batch(hdr.ledgerSeq, hdr.ledgerVersion, [], live, dead)
+        # restamp the stored LCL header's bucketListHash over the
+        # mutated list and re-derive the LCL hash: otherwise the next
+        # start's restore check (list hash != header) would wipe the
+        # bucket list and the injected entries would be invisible to
+        # bucket-backed reads. Offline state surgery already forks this
+        # node from any network; the restamp just keeps it locally
+        # coherent.
+        hdr.bucketListHash = bm.get_hash()
+        lm.lcl_hash = sha256(hdr.to_xdr())
+        lm._store_header(hdr)
+        lm._store_local_has()
     print("applied %d entr%s from %s (bucket hash %s)"
           % (n, "y" if n == 1 else "ies", args.file,
              b.get_hash().hex()[:16]))

@@ -50,6 +50,13 @@ class Config:
         self.DATABASE = "sqlite3://:memory:"
         self.BUCKET_DIR_PATH = "buckets"
         self.TMP_DIR_PATH = "tmp"
+        # BucketDB (bucket/bucket_index.py, ISSUE 14): serve SQL-root
+        # point reads from bloom-filtered bucket indexes (SQL stays the
+        # write-behind query index). False pins the legacy SQL read
+        # path; BLOOM_BITS_PER_KEY sizes the per-bucket filters (10 ≈
+        # 1% false-positive rate at optimal k).
+        self.BUCKETDB_READS = True
+        self.BUCKETDB_BLOOM_BITS_PER_KEY = 10
 
         # overlay
         self.PEER_PORT = 11625
@@ -239,6 +246,7 @@ class Config:
             "SIG_VERIFY_BREAKER_THRESHOLD", "SIG_VERIFY_BREAKER_COOLDOWN",
             "HASH_BACKEND", "STATE_CHECKPOINT_INTERVAL",
             "FAULTS_SEED",
+            "BUCKETDB_READS", "BUCKETDB_BLOOM_BITS_PER_KEY",
         ]
         for k in simple_keys:
             if k in data:

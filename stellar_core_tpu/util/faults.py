@@ -56,6 +56,8 @@ KNOWN_SITES = frozenset({
     "archive.short-read",
     "apply.cluster-fail",
     "apply.pipeline-stall",
+    "bucketdb.index-corrupt",
+    "bucketdb.read-fail",
 })
 
 

@@ -243,6 +243,84 @@ def test_overlay_breakdown_sum_contract_enforced(tmp_path):
                for e in bc.check_artifact(str(bad)))
 
 
+def _good_bucketdb():
+    return {
+        "small": {"accounts": 10**4, "close_ms_p50": 50.0,
+                  "close_ms_mean": 52.0},
+        "large": {"accounts": 10**6, "close_ms_p50": 55.0,
+                  "close_ms_mean": 57.0},
+        "latency_ratio": 1.1,
+        "prefetch_hit_rate_pct": 99.5,
+        "bloom_fp_pct": 1.2,
+        "sql_point_lookups": 0,
+    }
+
+
+def test_bucketdb_block_normalizes_and_checks(tmp_path):
+    """A `bench.py --bucketdb` artifact (ISSUE 14) derives the
+    direction-aware flatness/hit-rate/FP records, and check_artifact
+    enforces the block's own acceptance gates."""
+    import json
+    blob = {"metric": "bucketdb_latency_ratio", "unit": "x",
+            "value": 1.1, "platform": "bucketdb-cpu",
+            "bucketdb_bench": _good_bucketdb()}
+    recs = bc.records_from_bench(blob, "BENCH_r98.json")
+    by = {r["metric"]: r for r in recs}
+    assert by["bucketdb_latency_ratio"]["direction"] == "lower"
+    assert by["bucketdb_prefetch_hit_rate_pct"]["direction"] == "higher"
+    assert by["bucketdb_bloom_fp_pct"]["direction"] == "lower"
+    assert by["bucketdb_close_large_p50_ms"]["value"] == 55.0
+    p = tmp_path / "BENCH_r98.json"
+    p.write_text(json.dumps(blob))
+    assert bc.check_artifact(str(p)) == []
+
+
+def test_validate_bucketdb_enforces_the_gates():
+    # ratio must match the legs AND stay under the 1.25x gate
+    bd = _good_bucketdb()
+    bd["latency_ratio"] = 0.5
+    assert any("!= large/small" in e for e in bc.validate_bucketdb(bd, "t"))
+    bd = _good_bucketdb()
+    bd["large"]["close_ms_p50"] = 100.0
+    bd["latency_ratio"] = 2.0
+    assert any("1.25x" in e for e in bc.validate_bucketdb(bd, "t"))
+    # the zero-SQL gate: a leaked point lookup fails the artifact
+    bd = _good_bucketdb()
+    bd["sql_point_lookups"] = 3
+    assert any("sql_point_lookups" in e
+               for e in bc.validate_bucketdb(bd, "t"))
+    # prefetch hit-rate and bloom FP bands
+    bd = _good_bucketdb()
+    bd["prefetch_hit_rate_pct"] = 80.0
+    assert any("prefetch_hit_rate_pct" in e
+               for e in bc.validate_bucketdb(bd, "t"))
+    bd = _good_bucketdb()
+    bd["bloom_fp_pct"] = 9.0
+    assert any("bloom_fp_pct" in e for e in bc.validate_bucketdb(bd, "t"))
+    # scale ordering
+    bd = _good_bucketdb()
+    bd["large"]["accounts"] = 10**3
+    assert any("must exceed" in e for e in bc.validate_bucketdb(bd, "t"))
+    assert bc.validate_bucketdb(_good_bucketdb(), "t") == []
+
+
+def test_committed_bucketdb_artifact_meets_its_gates():
+    """The committed BENCH_r13 artifact must pass its own acceptance
+    gates (validate_bucketdb runs in check over every committed
+    artifact; this pins the r13 headline numbers directly)."""
+    import json
+    import os
+    path = os.path.join(os.path.dirname(bc.__file__), os.pardir,
+                        "BENCH_r13_bucketdb.json")
+    blob = json.load(open(path))
+    bd = blob["bucketdb_bench"]
+    assert bc.validate_bucketdb(bd, "r13") == []
+    assert bd["latency_ratio"] <= 1.25
+    assert bd["prefetch_hit_rate_pct"] >= 95.0
+    assert bd["sql_point_lookups"] == 0
+    assert bd["large"]["accounts"] == 10**6
+
+
 # ------------------------------------------------------------ comparator
 
 def _rec(metric, value, platform="p", direction="higher", **kw):

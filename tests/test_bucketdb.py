@@ -1,0 +1,567 @@
+"""BucketDB: bloom-filtered bucket-backed ledger reads (ISSUE 14).
+
+Covers the tentpole contracts — index correctness over the on-disk
+record layout, bloom behavior, newest-level-first reads with tombstone
+short-circuit, batched prefetch, the zero-apply-path-SQL gate, the
+differential SQL-vs-bucket read oracle over randomized closes — and
+the satellites: sidecar persistence across restart (no rebuild),
+corrupted/truncated/missing sidecar rebuild, GC vs index lifetime, LRU
+entry-cache eviction accounting, fault-site degrades, the admin
+endpoint and Prometheus exposition.
+"""
+
+import glob
+import os
+import random
+
+import pytest
+
+from stellar_core_tpu.bucket.bucket import Bucket
+from stellar_core_tpu.bucket.bucket_index import (
+    BloomFilter, BucketDB, BucketIndex, IndexLoadError, key_fingerprint,
+    sidecar_path,
+)
+from stellar_core_tpu.crypto.hashing import sha256
+from stellar_core_tpu.crypto.keys import SecretKey
+from stellar_core_tpu.main.application import Application
+from stellar_core_tpu.main.config import Config
+from stellar_core_tpu.testing import AppLedgerAdapter
+from stellar_core_tpu.transactions.account_helpers import make_account_entry
+from stellar_core_tpu.util.timer import ClockMode, VirtualClock
+from stellar_core_tpu.xdr import LedgerKey, PublicKey, ledger_entry_key
+
+
+def _acct_entry(i: int, balance: int = 10**9):
+    kb = sha256(b"bucketdb-test-%d" % i)
+    return make_account_entry(PublicKey.ed25519(kb), balance, 0, 1)
+
+
+def _mk_bucket(n: int, dead: int = 0, protocol: int = 13) -> Bucket:
+    entries = [_acct_entry(i) for i in range(n)]
+    dead_keys = [ledger_entry_key(_acct_entry(1000 + i))
+                 for i in range(dead)]
+    return Bucket.fresh(protocol, entries, [], dead_keys)
+
+
+# ---------------------------------------------------------------------------
+# BloomFilter + BucketIndex units
+
+def test_bloom_contains_every_added_key_and_reports_density():
+    bf = BloomFilter.for_capacity(100, bits_per_key=10)
+    fps = [key_fingerprint(b"key-%d" % i) for i in range(100)]
+    for fp in fps:
+        bf.add(fp)
+    assert all(bf.might_contain(fp) for fp in fps)
+    assert 0.0 < bf.bit_density() < 1.0
+    # false-positive rate at design load is around 1%, certainly not 20%
+    misses = sum(bf.might_contain(key_fingerprint(b"other-%d" % i))
+                 for i in range(2000))
+    assert misses < 400
+
+
+def test_index_build_lookup_and_tombstones():
+    b = _mk_bucket(50, dead=5)
+    idx = BucketIndex.build(b)
+    assert len(idx) == 55
+    # every live key resolves to its own LedgerEntry XDR via the
+    # recorded (ordinal, offset, length); dead keys carry length 0
+    from stellar_core_tpu.bucket.bucket import entry_record
+    for i in range(50):
+        e = _acct_entry(i)
+        kb = ledger_entry_key(e).to_xdr()
+        pos = idx.lookup(kb)
+        assert pos is not None
+        ordinal, _off, length = pos
+        assert length > 0
+        assert entry_record(b.entries[ordinal])[8:] == e.to_xdr()
+    for i in range(5):
+        kb = ledger_entry_key(_acct_entry(1000 + i)).to_xdr()
+        ordinal, _off, length = idx.lookup(kb)
+        assert length == 0
+    assert idx.lookup(b"\x00" * 8) is None
+
+
+def test_index_offsets_match_the_on_disk_file(tmp_path):
+    b = _mk_bucket(20, dead=3)
+    path = str(tmp_path / "b.xdr")
+    b.write_to(path)
+    idx = BucketIndex.build(b)
+    raw = open(path, "rb").read()
+    for i in range(20):
+        e = _acct_entry(i)
+        kb = ledger_entry_key(e).to_xdr()
+        _ordinal, off, length = idx.lookup(kb)
+        assert raw[off:off + length] == e.to_xdr()
+
+
+def test_index_sidecar_roundtrip_and_corruption(tmp_path):
+    b = _mk_bucket(30, dead=2)
+    idx = BucketIndex.build(b)
+    side = str(tmp_path / "b.idx")
+    idx.save(side)
+    loaded = BucketIndex.load(side, expected_hash=b.get_hash())
+    assert loaded.keys == idx.keys
+    assert loaded.offsets == idx.offsets
+    assert loaded.lengths == idx.lengths
+    assert bytes(loaded.bloom.bits) == bytes(idx.bloom.bits)
+    # wrong expected hash is a load error, never a wrong read
+    with pytest.raises(IndexLoadError):
+        BucketIndex.load(side, expected_hash=b"\x11" * 32)
+    # flipped byte -> checksum mismatch
+    raw = bytearray(open(side, "rb").read())
+    raw[len(raw) // 2] ^= 0xFF
+    open(side, "wb").write(bytes(raw))
+    with pytest.raises(IndexLoadError):
+        BucketIndex.load(side, expected_hash=b.get_hash())
+    # truncation -> load error
+    open(side, "wb").write(bytes(raw[: len(raw) // 3]))
+    with pytest.raises(IndexLoadError):
+        BucketIndex.load(side, expected_hash=b.get_hash())
+    with pytest.raises(IndexLoadError):
+        BucketIndex.load(str(tmp_path / "missing.idx"))
+
+
+# ---------------------------------------------------------------------------
+# app-level fixtures
+
+def _mk_app(tmp_path, n=0, db=None):
+    cfg = Config.test_config(n)
+    cfg.NODE_SEED = SecretKey.from_seed(sha256(b"bucketdb-node-%d" % n))
+    cfg.DATABASE = db or ("sqlite3://%s" % (tmp_path / ("node-%d.db" % n)))
+    cfg.QUORUM_SET = cfg.self_qset()
+    app = Application(VirtualClock(ClockMode.VIRTUAL_TIME), cfg)
+    app.enable_buckets(str(tmp_path / ("buckets-%d" % n)))
+    app.start()
+    return app
+
+
+def _close_with_traffic(app, senders, dests, n=1):
+    for _ in range(n):
+        app.clock.set_virtual_time(app.clock.now() + 1)
+        for s, d in zip(senders, dests):
+            app.submit_transaction(
+                s.tx([s.op_payment(d.account_id, 100)]))
+        app.manual_close()
+
+
+def test_zero_apply_path_sql_point_lookups(tmp_path):
+    """The ISSUE-14 acceptance gate, cockpit-asserted: with BucketDB
+    attached, closes perform ZERO SQL point lookups — every cache miss
+    is served by the bucket list. Mixed op types ride along (trustline,
+    account-data and offer entries exercise every point-read table;
+    order-book BULK scans legitimately stay SQL and are counted
+    separately)."""
+    app = _mk_app(tmp_path)
+    assert app.ledger_manager.root.bucket_backed()
+    ad = AppLedgerAdapter(app)
+    root = ad.root_account()
+    alice = root.create(10**10)
+    bob = root.create(10**10)
+    _close_with_traffic(app, [alice, bob], [bob, alice], n=4)
+    from stellar_core_tpu.xdr import Asset
+    usd = Asset.credit("USD", root.account_id)
+    app.clock.set_virtual_time(app.clock.now() + 1)
+    app.submit_transaction(alice.tx([alice.op_change_trust(usd, 10**12)]))
+    app.submit_transaction(bob.tx([bob.op_manage_data("k", b"v")]))
+    app.manual_close()
+    app.clock.set_virtual_time(app.clock.now() + 1)
+    app.submit_transaction(root.tx([root.op_payment(alice.account_id,
+                                                    10**6, asset=usd)]))
+    app.submit_transaction(bob.tx([bob.op_manage_sell_offer(
+        Asset.native(), usd, 100, 1, 1)]))
+    app.manual_close()
+    st = app.ledger_manager.apply_stats.to_json()["state_reads"]
+    assert st["lookups"] == {}, "apply-path SQL point lookups leaked"
+    assert st["bucket_reads"] > 0
+    assert st["cache_hits"] > 0
+    app.stop()
+
+
+def test_differential_oracle_sql_vs_bucket_reads(tmp_path):
+    """Entry-for-entry equality between the SQL-read and bucket-read
+    worlds across randomized closes: two identical nodes run the same
+    seeded traffic, one with BucketDB routing and one pinned to SQL
+    point reads; headers and full entry state must match, and every SQL
+    row must equal the bucket-served blob."""
+    rnd = random.Random(1234)
+    apps = []
+    for n, bucket_reads in ((0, True), (1, False)):
+        cfg = Config.test_config(n)
+        cfg.NODE_SEED = SecretKey.from_seed(sha256(b"oracle-node"))
+        cfg.DATABASE = "sqlite3://%s" % (tmp_path / ("o-%d.db" % n))
+        cfg.QUORUM_SET = cfg.self_qset()
+        cfg.BUCKETDB_READS = bucket_reads
+        app = Application(VirtualClock(ClockMode.VIRTUAL_TIME), cfg)
+        app.enable_buckets(str(tmp_path / ("o-buckets-%d" % n)))
+        app.start()
+        apps.append(app)
+    bdb_app, sql_app = apps
+    assert bdb_app.ledger_manager.root.bucket_backed()
+    assert not sql_app.ledger_manager.root.bucket_backed()
+
+    # both nodes must create the SAME accounts: derive the keys
+    # deterministically (create() defaults to a process-global
+    # pseudo-random stream)
+    sks = [SecretKey.from_seed(sha256(b"oracle-acc-%d" % i))
+           for i in range(6)]
+    accounts = []
+    for app in apps:
+        ad = AppLedgerAdapter(app)
+        root = ad.root_account()
+        accs = [root.create(10**10, sk=sk) for sk in sks]
+        accounts.append([root] + accs)
+    for i in range(10):
+        ops = [(rnd.randrange(7), rnd.randrange(7), rnd.randint(1, 10**6))
+               for _ in range(rnd.randint(1, 5))]
+        for app, accs in zip(apps, accounts):
+            app.clock.set_virtual_time(app.clock.now() + 1)
+            for a, b, amt in ops:
+                if a == b:
+                    continue
+                s = accs[a]
+                app.submit_transaction(
+                    s.tx([s.op_payment(accs[b].account_id, amt)]))
+            app.manual_close()
+    lm0, lm1 = bdb_app.ledger_manager, sql_app.ledger_manager
+    assert lm0.lcl_hash == lm1.lcl_hash
+    state0 = sorted(e.to_xdr() for e in lm0.root.all_entries())
+    state1 = sorted(e.to_xdr() for e in lm1.root.all_entries())
+    assert state0 == state1
+    # and within the bucket-backed node: every SQL row == bucket read
+    bdb = bdb_app.bucket_manager.bucketdb
+    for e in lm0.root.all_entries():
+        kb = ledger_entry_key(e).to_xdr()
+        served, blob = bdb.lookup(kb)
+        assert served and blob == e.to_xdr()
+    # absent keys answer None on both worlds
+    for i in range(20):
+        kb = LedgerKey.account(
+            PublicKey.ed25519(sha256(b"absent-%d" % i))).to_xdr()
+        served, blob = bdb.lookup(kb)
+        assert served and blob is None
+        assert lm1.root.get_entry(LedgerKey.from_xdr(kb)) is None
+    for app in apps:
+        app.stop()
+
+
+def test_deleted_entry_tombstone_short_circuits(tmp_path):
+    """An account deleted by merge reads as authoritatively absent via
+    the DEADENTRY tombstone (no SQL fallthrough)."""
+    app = _mk_app(tmp_path)
+    ad = AppLedgerAdapter(app)
+    root = ad.root_account()
+    alice = root.create(10**10)
+    doomed = root.create(10**9)
+    _close_with_traffic(app, [alice], [root], n=1)
+    key = LedgerKey.account(doomed.account_id)
+    kb = key.to_xdr()
+    app.clock.set_virtual_time(app.clock.now() + 1)
+    from stellar_core_tpu.xdr import OperationBody, OperationType
+    app.submit_transaction(doomed.tx([doomed.op(
+        OperationBody(OperationType.ACCOUNT_MERGE, root.muxed))]))
+    app.manual_close()
+    bdb = app.bucket_manager.bucketdb
+    served, blob = bdb.lookup(kb)
+    assert served and blob is None
+    assert bdb.stats.to_json()["reads"]["tombstones"] >= 1
+    assert app.ledger_manager.root.get_entry(key) is None
+    app.stop()
+
+
+def test_restart_cold_start_hits_persisted_indexes(tmp_path):
+    """ISSUE-14 satellite: restart over the same bucket dir loads the
+    persisted sidecars (no rebuild) and serves correct reads."""
+    app = _mk_app(tmp_path)
+    ad = AppLedgerAdapter(app)
+    root = ad.root_account()
+    alice = root.create(10**10)
+    _close_with_traffic(app, [root], [alice], n=8)
+    alice_balance = 10**10 + 8 * 100
+    app.stop()
+
+    app2 = _mk_app(tmp_path)
+    # the HAS restore re-adopts every live bucket, which loads its
+    # persisted sidecar — no builds
+    e = app2.ledger_manager.root.get_entry(
+        LedgerKey.account(alice.account_id))
+    assert e is not None and e.data.value.balance == alice_balance
+    st = app2.bucket_manager.bucketdb.stats.to_json()["index"]
+    assert st["loads"] > 0, "cold-start reads must hit persisted indexes"
+    assert st["builds"] == 0, "no index rebuild over an intact bucket dir"
+    assert st["load_failures"] == 0
+    lookups = app2.ledger_manager.apply_stats.to_json()["state_reads"]
+    assert lookups["lookups"] == {}
+    app2.stop()
+
+
+def test_uncovered_bucket_list_detaches_on_restart(tmp_path):
+    """Coverage sentinel: a data dir whose bucket list does NOT cover
+    SQL state (pre-BucketDB dirs, or buckets enabled mid-life with no
+    local HAS) must detach bucket-backed reads at startup — SQL point
+    reads carry the node instead of BucketDB answering 'authoritatively
+    absent' for uncovered entries."""
+    import sqlite3
+    app = _mk_app(tmp_path)
+    ad = AppLedgerAdapter(app)
+    root = ad.root_account()
+    alice = root.create(10**10)
+    _close_with_traffic(app, [root], [alice], n=3)
+    app.stop()
+    # simulate the pre-upgrade shape: drop the local HAS so the restart
+    # restores an EMPTY bucket list over populated SQL
+    con = sqlite3.connect(str(tmp_path / "node-0.db"))
+    con.execute("DELETE FROM storestate WHERE statename=?",
+                ("historyarchivestate",))
+    con.commit()
+    con.close()
+    app2 = _mk_app(tmp_path)
+    assert not app2.ledger_manager.root.bucket_backed(), \
+        "uncovered bucket list must not serve authoritative reads"
+    e = app2.ledger_manager.root.get_entry(
+        LedgerKey.account(alice.account_id))
+    assert e is not None   # SQL point reads carry the node
+    reads = app2.ledger_manager.apply_stats.to_json()["state_reads"]
+    assert reads["lookups"].get("account", 0) >= 1
+    app2.stop()
+
+
+def test_corrupted_sidecar_triggers_rebuild_not_wrong_reads(tmp_path):
+    app = _mk_app(tmp_path)
+    ad = AppLedgerAdapter(app)
+    root = ad.root_account()
+    alice = root.create(10**10)
+    _close_with_traffic(app, [root], [alice], n=4)
+    expected = {}
+    for e in app.ledger_manager.root.all_entries():
+        expected[ledger_entry_key(e).to_xdr()] = e.to_xdr()
+    app.stop()
+
+    # corrupt EVERY sidecar: flip a byte in each
+    sides = glob.glob(str(tmp_path / "buckets-0" / "*.idx"))
+    assert sides
+    for side in sides:
+        raw = bytearray(open(side, "rb").read())
+        raw[len(raw) // 2] ^= 0xFF
+        open(side, "wb").write(bytes(raw))
+
+    app2 = _mk_app(tmp_path)
+    bdb = app2.bucket_manager.bucketdb
+    for kb, blob in expected.items():
+        served, got = bdb.lookup(kb)
+        assert served and got == blob   # rebuilt, never wrong
+    st = bdb.stats.to_json()["index"]
+    assert st["load_failures"] > 0
+    assert st["builds"] >= st["load_failures"]
+    app2.stop()
+
+
+def test_missing_and_truncated_sidecars_tolerated(tmp_path):
+    app = _mk_app(tmp_path)
+    ad = AppLedgerAdapter(app)
+    root = ad.root_account()
+    alice = root.create(10**10)
+    _close_with_traffic(app, [root], [alice], n=4)
+    app.stop()
+    sides = sorted(glob.glob(str(tmp_path / "buckets-0" / "*.idx")))
+    os.remove(sides[0])                            # missing
+    with open(sides[1], "r+b") as fh:              # truncated
+        fh.truncate(10)
+    app2 = _mk_app(tmp_path)
+    e = app2.ledger_manager.root.get_entry(
+        LedgerKey.account(alice.account_id))
+    assert e is not None
+    app2.stop()
+
+
+def test_gc_drops_index_and_sidecar_with_the_bucket(tmp_path):
+    """ISSUE-14 satellite: forget_unreferenced_buckets invalidates the
+    in-memory index AND removes the persisted sidecar."""
+    app = _mk_app(tmp_path)
+    ad = AppLedgerAdapter(app)
+    root = ad.root_account()
+    alice = root.create(10**10)
+    _close_with_traffic(app, [root], [alice], n=6)
+    bm = app.bucket_manager
+    bdir = str(tmp_path / "buckets-0")
+    # warm every live index so the memo is populated
+    for e in app.ledger_manager.root.all_entries():
+        bm.bucketdb.lookup(ledger_entry_key(e).to_xdr())
+    # every close replaced level-0 buckets; several are now unreferenced
+    dropped = bm.forget_unreferenced_buckets()
+    assert dropped > 0
+    xdrs = {os.path.basename(p)[:-4]
+            for p in glob.glob(os.path.join(bdir, "*.xdr"))}
+    idxs = {os.path.basename(p)[:-8]
+            for p in glob.glob(os.path.join(bdir, "*.idx"))}
+    assert idxs <= xdrs, "sidecars must not outlive their bucket files"
+    # memoized indexes only for live buckets
+    live = {b.get_hash() for b in
+            (bm.get_bucket_by_hash(h)
+             for h in bm.get_referenced_hashes()) if b is not None}
+    with bm.bucketdb._lock:
+        memo = set(bm.bucketdb._indexes)
+    assert memo <= live | {h for h in memo if h in live} or memo <= live
+    app.stop()
+
+
+def test_read_fail_fault_degrades_to_sql(tmp_path):
+    """`bucketdb.read-fail` makes reads non-authoritative: the root
+    falls back to SQL (correct answers, `bucketdb.fallback.sql` and the
+    per-type SQL lookup meters tick)."""
+    app = _mk_app(tmp_path)
+    ad = AppLedgerAdapter(app)
+    root = ad.root_account()
+    alice = root.create(10**10)
+    _close_with_traffic(app, [root], [alice], n=2)
+    app.faults.configure("bucketdb.read-fail", probability=1.0)
+    # evict the cache so reads must go to the (degraded) backend
+    app.ledger_manager.root._cache.clear()
+    e = app.ledger_manager.root.get_entry(
+        LedgerKey.account(alice.account_id))
+    assert e is not None and e.data.value.balance > 10**10
+    st = app.bucket_manager.bucketdb.stats.to_json()
+    assert st["sql_fallbacks"] >= 1
+    reads = app.ledger_manager.apply_stats.to_json()["state_reads"]
+    assert reads["lookups"].get("account", 0) >= 1
+    app.faults.clear()
+    app.stop()
+
+
+def test_index_corrupt_fault_exercises_rebuild(tmp_path):
+    app = _mk_app(tmp_path)
+    ad = AppLedgerAdapter(app)
+    root = ad.root_account()
+    alice = root.create(10**10)
+    _close_with_traffic(app, [root], [alice], n=2)
+    app.stop()
+    # arm via Config.FAULTS so the fault is live BEFORE the restart's
+    # HAS restore loads the sidecars
+    cfg = Config.test_config(0)
+    cfg.NODE_SEED = SecretKey.from_seed(sha256(b"bucketdb-node-0"))
+    cfg.DATABASE = "sqlite3://%s" % (tmp_path / "node-0.db")
+    cfg.QUORUM_SET = cfg.self_qset()
+    cfg.FAULTS = {"bucketdb.index-corrupt": {"p": 1.0, "n": 2}}
+    app2 = Application(VirtualClock(ClockMode.VIRTUAL_TIME), cfg)
+    app2.enable_buckets(str(tmp_path / "buckets-0"))
+    app2.start()
+    e = app2.ledger_manager.root.get_entry(
+        LedgerKey.account(alice.account_id))
+    assert e is not None
+    st = app2.bucket_manager.bucketdb.stats.to_json()["index"]
+    assert st["load_failures"] >= 1 and st["builds"] >= 1
+    app2.faults.clear()
+    app2.stop()
+
+
+def test_entry_cache_lru_eviction_is_metered(tmp_path):
+    from stellar_core_tpu.ledger.ledgertxn import LedgerTxnRoot
+    app = _mk_app(tmp_path)
+    root_txn = app.ledger_manager.root
+    old = LedgerTxnRoot.ENTRY_CACHE_SIZE
+    try:
+        # shrink the live cache: rebuild it tiny with the same hook
+        from stellar_core_tpu.util.cache import LRUCache
+        root_txn._cache = LRUCache(4, on_evict=root_txn._on_cache_evict)
+        ad = AppLedgerAdapter(app)
+        root = ad.root_account()
+        accs = [root.create(10**9) for _ in range(6)]
+        for a in accs:
+            root_txn.get_entry(LedgerKey.account(a.account_id))
+        st = app.ledger_manager.apply_stats.to_json()["state_reads"]
+        assert st["cache_evictions"] > 0
+        m = app.metrics.to_json().get("ledger.apply.entry-cache.evicted")
+        assert m is not None and m["count"] > 0
+        # LRU order: the most recently read keys are still resident
+        assert LedgerKey.account(accs[-1].account_id).to_xdr() \
+            in root_txn._cache
+    finally:
+        LedgerTxnRoot.ENTRY_CACHE_SIZE = old
+    app.stop()
+
+
+def test_prefetched_set_is_lru_bounded():
+    from stellar_core_tpu.ledger.ledgertxn import LedgerTxnRoot
+    r = LedgerTxnRoot.__new__(LedgerTxnRoot)
+    from collections import OrderedDict
+    r._prefetched = OrderedDict()
+    bound = 4 * LedgerTxnRoot.ENTRY_CACHE_SIZE
+    for i in range(bound + 100):
+        r._note_prefetched(b"k%d" % i)
+    assert len(r._prefetched) == bound
+    assert b"k0" not in r._prefetched          # oldest evicted
+    assert b"k%d" % (bound + 99) in r._prefetched
+
+
+def test_batched_prefetch_resolves_txset_keys(tmp_path):
+    app = _mk_app(tmp_path)
+    ad = AppLedgerAdapter(app)
+    root = ad.root_account()
+    accs = [root.create(10**9) for _ in range(8)]
+    _close_with_traffic(app, [root], [accs[0]], n=1)
+    rt = app.ledger_manager.root
+    rt._cache.clear()
+    keys = [LedgerKey.account(a.account_id) for a in accs]
+    n = rt.prefetch(keys)
+    assert n == len(keys)
+    st = app.bucket_manager.bucketdb.stats.to_json()
+    assert st["prefetch"]["batches"] >= 1
+    assert st["prefetch"]["resolved"] >= len(keys)
+    # all now cache hits, counted as prefetch hits
+    before = app.ledger_manager.apply_stats.prefetch_totals()["hits"]
+    for k in keys:
+        assert rt.get_entry(k) is not None
+    after = app.ledger_manager.apply_stats.prefetch_totals()["hits"]
+    assert after - before == len(keys)
+    app.stop()
+
+
+def test_admin_endpoint_and_prometheus(tmp_path):
+    app = _mk_app(tmp_path)
+    ad = AppLedgerAdapter(app)
+    root = ad.root_account()
+    alice = root.create(10**10)
+    _close_with_traffic(app, [root], [alice], n=2)
+    status, body = app.command_handler.handle_command("bucketdb", {})
+    assert status == 200
+    assert body["attached"] is True
+    assert body["indexes"] > 0
+    assert body["reads"]["total"] > 0
+    assert "levels" in body and "bloom" in body and "index" in body
+    # reset zeroes aggregates
+    status, body = app.command_handler.handle_command(
+        "bucketdb", {"action": "reset"})
+    assert status == 200 and body["status"] == "reset"
+    assert body["reads"]["total"] == 0
+    # bad action -> 400
+    status, body = app.command_handler.handle_command(
+        "bucketdb", {"action": "bogus"})
+    assert status == 400
+    # Prometheus exposition carries sct_bucketdb_* series
+    status, text = app.command_handler.handle_command(
+        "metrics", {"format": "prometheus"})
+    assert status == 200 and isinstance(text, str)
+    assert "sct_bucketdb_reads" in text
+    app.stop()
+
+
+def test_endpoint_without_buckets():
+    cfg = Config.test_config(0)
+    app = Application(VirtualClock(ClockMode.VIRTUAL_TIME), cfg)
+    app.start()
+    status, body = app.command_handler.handle_command("bucketdb", {})
+    assert status == 200 and "error" in body
+    app.stop()
+
+
+def test_in_memory_db_root_not_attached(tmp_path):
+    """In-memory roots have no SQL to demote; BucketDB indexing still
+    runs (cockpit live) but the dict root serves reads directly."""
+    app = _mk_app(tmp_path, db="in-memory")
+    assert not hasattr(app.ledger_manager.root, "bucket_backed") or \
+        not app.ledger_manager.root.bucket_backed()
+    ad = AppLedgerAdapter(app)
+    root = ad.root_account()
+    alice = root.create(10**10)
+    _close_with_traffic(app, [root], [alice], n=2)
+    assert app.bucket_manager.bucketdb.to_json()["indexes"] > 0
+    app.stop()

@@ -459,6 +459,102 @@ def validate_hash_bench(hb, where: str = "") -> List[str]:
     return errs
 
 
+def bucketdb_records(bd: dict, source: str, round_no=None,
+                     at_unix=None) -> List[dict]:
+    """Normalize a `bucketdb_bench` block (ISSUE 14: the
+    million-account bucket-backed read gate) into direction-aware
+    records under the `bucketdb-cpu` platform: the latency-flatness
+    ratio and large-scale close p50 (lower is better), the surge
+    prefetch hit-rate (higher), and the bloom false-positive rate
+    (lower)."""
+    out: List[dict] = []
+    if not isinstance(bd, dict):
+        return out
+    for key, metric, unit, direction in (
+            ("latency_ratio", "bucketdb_latency_ratio", "x", "lower"),
+            ("prefetch_hit_rate_pct", "bucketdb_prefetch_hit_rate_pct",
+             "pct", "higher"),
+            ("bloom_fp_pct", "bucketdb_bloom_fp_pct", "pct", "lower")):
+        v = _num(bd, key)
+        if v is not None:
+            out.append(make_record(metric, unit, v, "bucketdb-cpu",
+                                   direction, source, round_no, at_unix))
+    large = bd.get("large")
+    if isinstance(large, dict):
+        v = _num(large, "close_ms_p50")
+        if v is not None:
+            out.append(make_record("bucketdb_close_large_p50_ms", "ms",
+                                   v, "bucketdb-cpu", "lower", source,
+                                   round_no, at_unix))
+    return out
+
+
+def validate_bucketdb(bd, where: str = "") -> List[str]:
+    """Schema check for one `bucketdb_bench` block (`check`/`--check`):
+    both scale legs must exist with finite positive close latencies and
+    a strictly larger `large` account count; the recorded
+    latency-flatness ratio must actually be the legs' p50 ratio AND
+    within the 1.25x acceptance gate; the surge prefetch hit-rate must
+    hold >= 95%, the bloom false-positive rate <= 5%, and the
+    cockpit-asserted apply-path SQL point-lookup count must be ZERO — a
+    committed million-account artifact that fails its own gates is a
+    broken baseline, not a measurement."""
+    errs: List[str] = []
+    if not isinstance(bd, dict):
+        return ["%s: bucketdb_bench is not an object: %r" % (where, bd)]
+    legs = {}
+    for name in ("small", "large"):
+        leg = bd.get(name)
+        if not isinstance(leg, dict):
+            errs.append("%s: bucketdb_bench.%s must be an object"
+                        % (where, name))
+            continue
+        acc = _num(leg, "accounts")
+        p50 = _num(leg, "close_ms_p50")
+        if acc is None or acc <= 0:
+            errs.append("%s: bucketdb_bench.%s.accounts must be a finite "
+                        "number > 0, got %r" % (where, name,
+                                                leg.get("accounts")))
+        if p50 is None or p50 <= 0:
+            errs.append("%s: bucketdb_bench.%s.close_ms_p50 must be a "
+                        "finite number > 0, got %r"
+                        % (where, name, leg.get("close_ms_p50")))
+        legs[name] = leg
+    if len(legs) == 2 and not errs:
+        if legs["large"]["accounts"] <= legs["small"]["accounts"]:
+            errs.append("%s: bucketdb_bench.large.accounts must exceed "
+                        "small.accounts" % where)
+        ratio = _num(bd, "latency_ratio")
+        want = legs["large"]["close_ms_p50"] / legs["small"]["close_ms_p50"]
+        if ratio is None:
+            errs.append("%s: bucketdb_bench.latency_ratio must be a "
+                        "finite number" % where)
+        else:
+            if abs(ratio - want) > max(0.01, 0.01 * want):
+                errs.append("%s: bucketdb_bench.latency_ratio %.4f != "
+                            "large/small p50 ratio %.4f"
+                            % (where, ratio, want))
+            if ratio > 1.25:
+                errs.append("%s: bucketdb_bench.latency_ratio %.4f "
+                            "exceeds the 1.25x flatness gate"
+                            % (where, ratio))
+    hit = _num(bd, "prefetch_hit_rate_pct")
+    if hit is None or hit < 95.0 or hit > 100.0:
+        errs.append("%s: bucketdb_bench.prefetch_hit_rate_pct must be in "
+                    "[95, 100], got %r"
+                    % (where, bd.get("prefetch_hit_rate_pct")))
+    fp = _num(bd, "bloom_fp_pct")
+    if fp is None or fp < 0.0 or fp > 5.0:
+        errs.append("%s: bucketdb_bench.bloom_fp_pct must be in [0, 5], "
+                    "got %r" % (where, bd.get("bloom_fp_pct")))
+    sql = bd.get("sql_point_lookups")
+    if sql != 0:
+        errs.append("%s: bucketdb_bench.sql_point_lookups must be 0 "
+                    "(the zero-SQL apply-path gate), got %r"
+                    % (where, sql))
+    return errs
+
+
 def _replay_leg_records(leg: dict, platform: str, source: str,
                         round_no, at_unix) -> List[dict]:
     out = []
@@ -568,6 +664,12 @@ def _payload_records(p: dict, source: str, round_no,
     hb = p.get("hash_bench")
     if isinstance(hb, dict):
         out.extend(hash_bench_records(hb, source, round_no, at_unix))
+    # million-account BucketDB leg (`bench.py --bucketdb`; the artifact
+    # also carries an explicit `records` list, which normalize_any
+    # prefers — this path keeps nested/legacy blobs normalizable)
+    bd = p.get("bucketdb_bench")
+    if isinstance(bd, dict):
+        out.extend(bucketdb_records(bd, source, round_no, at_unix))
     # device history survives device-less rounds via the cached block
     for nest in (p.get("last_device"),
                  (p.get("errors") or {}).get("last_real_device_result")):
@@ -722,6 +824,8 @@ def _walk_breakdowns(blob, name: str, errs: List[str],
         errs.extend(validate_fleet_verify(blob["fleet_verify"], name))
     if "hash_bench" in blob:
         errs.extend(validate_hash_bench(blob["hash_bench"], name))
+    if "bucketdb_bench" in blob:
+        errs.extend(validate_bucketdb(blob["bucketdb_bench"], name))
     for v in blob.values():
         if isinstance(v, (dict, list)):
             _walk_breakdowns(v, name, errs, depth + 1)
