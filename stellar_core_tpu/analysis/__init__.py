@@ -27,11 +27,30 @@ mechanically, as an AST pass with project-specific rules:
 - **M1** every literal metric name registered via `new_counter` /
   `new_meter` / `new_timer` / `new_histogram` must appear in
   docs/metrics.md (dynamic `%s` names by their literal prefix).
+- **A1** every `cmd_*` handler in main/command_handler.py has a row in
+  the docs/admin.md endpoint table and vice versa.
+
+Plus the native C rules (crules.py — a purpose-built C tokenizer +
+call-graph pass over `native/*.c`, since the GIL-released pthread
+engine is invisible to `ast`):
+
+- **N1** no CPython API (`Py*`/`_Py*`) calls reachable with the GIL
+  released (pthread worker entries + ALLOW_THREADS brackets; the
+  returning `if (...->nopy)` guard idiom honored and required).
+- **N2** no `malloc`/`free` family on the cluster-apply hot path —
+  per-op buffers go through the per-context bump arenas.
+- **N3** every `pthread_mutex_lock` balanced by an unlock on every
+  return path (branch-aware structured path analysis).
+- **N4** cross-boundary registries: C/Python bail-reason literals ⇄
+  the docs/observability.md taxonomy table ⇄ test_apply_cockpit.py,
+  and the C `OP_*` table ⇄ the Python `ledger.apply.op.<type>` names.
 
 Intentional exceptions live in `analysis/allowlist.txt`, one line per
 (rule, file) with a mandatory justification; stale entries fail the
 build. The whole pass runs as tier-1 test `tests/test_static_analysis.py`
-and standalone as `python -m stellar_core_tpu.analysis` (`tools/sctlint`).
+and standalone as `python -m stellar_core_tpu.analysis` (`tools/sctlint`;
+`--native` for the N-rules-only fast gate). The runtime twin of the N
+rules is the ThreadSanitizer leg (tests/test_native_sanitized.py).
 See docs/static-analysis.md.
 """
 

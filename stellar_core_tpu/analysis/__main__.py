@@ -16,7 +16,9 @@ from .engine import default_config, run_analysis
 
 
 def _changed_files(repo_root: str) -> list:
-    """Working-tree .py files changed vs HEAD, plus untracked ones."""
+    """Working-tree .py AND .c files changed vs HEAD, plus untracked
+    ones — the native sources ride the same pre-commit fast path as the
+    Python ones."""
     def git(*args):
         r = subprocess.run(["git", "-C", repo_root] + list(args),
                            capture_output=True, text=True)
@@ -24,31 +26,47 @@ def _changed_files(repo_root: str) -> list:
 
     names = set(git("diff", "--name-only", "HEAD")) | \
         set(git("ls-files", "--others", "--exclude-standard"))
-    return sorted(n for n in names if n.endswith(".py"))
+    return sorted(n for n in names
+                  if n.endswith(".py") or n.endswith(".c"))
 
 
 def main(argv=None) -> int:
     ap = argparse.ArgumentParser(
         prog="sctlint",
-        description="Determinism & thread-discipline analyzer "
-                    "(rules D1/D2/T1/E1/F1/M1 — docs/static-analysis.md)")
+        description="Determinism & thread-discipline analyzer: Python "
+                    "rules D1/D2/T1/E1/F1/M1, native C rules N1-N4, "
+                    "admin-surface rule A1 — docs/static-analysis.md")
     ap.add_argument("files", nargs="*",
-                    help="restrict per-module rules to these files "
-                         "(default: whole package)")
+                    help="restrict per-module rules to these .py/.c "
+                         "files (default: whole package)")
     ap.add_argument("--changed", action="store_true",
-                    help="lint only .py files changed vs HEAD "
+                    help="lint only .py/.c files changed vs HEAD "
                          "(plus untracked)")
+    ap.add_argument("--native", action="store_true",
+                    help="run only the native C rules (N1-N4) over "
+                         "native/*.c — the fast pre-commit gate for "
+                         "engine changes")
     ap.add_argument("--repo-root", default=None)
     ap.add_argument("--list", action="store_true", dest="list_all",
-                    help="print every finding including allowlisted ones")
+                    help="print every finding (all rules, N/A ones "
+                         "included) before allowlist filtering")
     args = ap.parse_args(argv)
 
     cfg = default_config(args.repo_root)
+    if args.native:
+        cfg.enabled_rules = tuple(
+            r for r in cfg.enabled_rules if r.startswith("N"))
+        if not cfg.enabled_rules:
+            print("sctlint: --native but no N rules enabled "
+                  "(pyproject [tool.sctlint] rules)")
+            return 2
     files = args.files or None
     if args.changed:
         files = _changed_files(cfg.repo_root)
+        if args.native:
+            files = [f for f in files if f.endswith(".c")]
         if not files:
-            print("sctlint: no changed .py files")
+            print("sctlint: no changed files")
             return 0
 
     res = run_analysis(cfg, files=files)

@@ -65,7 +65,15 @@ class LintConfig:
     fault_registry: Optional[Set[str]]   # None = skip F1
     fault_registry_path: str = ""
     e1_dirs: Tuple[str, ...] = ("scp", "herder", "ledger", "bucket")
-    enabled_rules: Tuple[str, ...] = ("D1", "D2", "T1", "E1", "F1", "M1")
+    enabled_rules: Tuple[str, ...] = ("D1", "D2", "T1", "E1", "F1", "M1",
+                                      "N1", "N2", "N3", "N4", "A1")
+    # -- C-side (N1-N4) and admin-surface (A1) extensions ------------------
+    native_dir: Optional[str] = None     # *.c scanned here; None = skip N*
+    docs_observability_path: Optional[str] = None
+    docs_admin_path: Optional[str] = None  # None = skip A1
+    command_handler_path: str = ""       # repo-relative .py with cmd_*
+    bail_test_path: Optional[str] = None  # test tied to the N4 taxonomy
+    op_type_names: Optional[Dict[int, str]] = None  # None = skip op check
 
 
 @dataclass
@@ -126,6 +134,10 @@ def default_config(repo_root: Optional[str] = None) -> LintConfig:
     # explicitly opt out of F1)
     from ..util.faults import KNOWN_SITES
     registry: Optional[Set[str]] = set(KNOWN_SITES)
+    # same no-fallback stance as KNOWN_SITES: if the op-name table
+    # import breaks, the lint run dies loudly rather than silently
+    # skipping N4's op-type leg
+    from ..ledger.apply_stats import OP_TYPE_NAMES
     cfg = LintConfig(
         repo_root=repo_root,
         package_dir=pkg,
@@ -135,6 +147,13 @@ def default_config(repo_root: Optional[str] = None) -> LintConfig:
         docs_robustness_path=os.path.join(docs, "robustness.md"),
         fault_registry=registry,
         fault_registry_path="stellar_core_tpu/util/faults.py",
+        native_dir=os.path.join(pkg, "native"),
+        docs_observability_path=os.path.join(docs, "observability.md"),
+        docs_admin_path=os.path.join(docs, "admin.md"),
+        command_handler_path="stellar_core_tpu/main/command_handler.py",
+        bail_test_path=os.path.join(repo_root, "tests",
+                                    "test_apply_cockpit.py"),
+        op_type_names=dict(OP_TYPE_NAMES),
     )
     _apply_pyproject(cfg)
     return cfg
@@ -192,12 +211,27 @@ def _py_files(package_dir: str) -> List[str]:
     return out
 
 
+def _c_files(native_dir: Optional[str]) -> List[str]:
+    if native_dir is None or not os.path.isdir(native_dir):
+        return []
+    out = []
+    for dirpath, dirnames, filenames in os.walk(native_dir):
+        dirnames[:] = [d for d in dirnames
+                       if d not in ("__pycache__", "build")]
+        for fn in sorted(filenames):
+            if fn.endswith(".c"):
+                out.append(os.path.join(dirpath, fn))
+    return out
+
+
 def run_analysis(config: Optional[LintConfig] = None,
                  files: Optional[Sequence[str]] = None) -> AnalysisResult:
     """Run every enabled rule. `files` (absolute or repo-relative)
-    restricts the per-module rules (D1/D2/E1) to those files — the
-    `--changed` fast path; tree-wide rules (T1/F1/M1) always scan the
-    whole package, since their facts are cross-module."""
+    restricts the per-module rules (D1/D2/E1 for .py, N1/N2/N3 for .c)
+    to those files — the `--changed` fast path; tree-wide rules
+    (T1/F1/M1/N4/A1) always scan the whole package, since their facts
+    are cross-module (and cross-language)."""
+    from . import crules as C
     from . import rules as R
 
     cfg = config or default_config()
@@ -214,6 +248,19 @@ def run_analysis(config: Optional[LintConfig] = None,
             res.parse_errors.append("%s: %s" % (rel, e))
             continue
         facts_by_path[rel] = R.ModuleFacts(rel, tree)
+
+    n_rules_on = any(r in cfg.enabled_rules
+                     for r in ("N1", "N2", "N3", "N4"))
+    cfacts_by_path: Dict[str, "C.CFileFacts"] = {}
+    if n_rules_on:
+        for abspath in _c_files(cfg.native_dir):
+            rel = os.path.relpath(abspath, cfg.repo_root) \
+                .replace(os.sep, "/")
+            try:
+                with open(abspath, encoding="utf-8") as fh:
+                    cfacts_by_path[rel] = C.CFileFacts(rel, fh.read())
+            except ValueError as e:
+                res.parse_errors.append("%s: %s" % (rel, e))
 
     restrict: Optional[Set[str]] = None
     if files is not None:
@@ -235,6 +282,16 @@ def run_analysis(config: Optional[LintConfig] = None,
             res.findings.extend(
                 R.rule_e1_swallow(facts, cfg.e1_dirs, cfg.package_name))
 
+    for rel, cfacts in sorted(cfacts_by_path.items()):
+        if restrict is not None and rel not in restrict:
+            continue
+        if "N1" in cfg.enabled_rules:
+            res.findings.extend(C.rule_n1_nogil_python(cfacts))
+        if "N2" in cfg.enabled_rules:
+            res.findings.extend(C.rule_n2_alloc_discipline(cfacts))
+        if "N3" in cfg.enabled_rules:
+            res.findings.extend(C.rule_n3_lock_balance(cfacts))
+
     if "T1" in cfg.enabled_rules:
         res.findings.extend(R.rule_t1_thread_discipline(all_facts))
     if "F1" in cfg.enabled_rules and cfg.fault_registry is not None:
@@ -244,6 +301,25 @@ def run_analysis(config: Optional[LintConfig] = None,
     if "M1" in cfg.enabled_rules:
         res.findings.extend(R.rule_m1_metric_catalog(
             all_facts, _read(cfg.docs_metrics_path), "docs/metrics.md"))
+    if "N4" in cfg.enabled_rules and cfacts_by_path:
+        py_bails = [(facts.path, line, reason, qual)
+                    for facts in all_facts
+                    for (line, reason, qual) in facts.bail_literals]
+        res.findings.extend(C.rule_n4_cross_boundary(
+            [cfacts_by_path[k] for k in sorted(cfacts_by_path)],
+            py_bails,
+            _read(cfg.docs_observability_path), "docs/observability.md",
+            _read(cfg.docs_metrics_path), "docs/metrics.md",
+            _read(cfg.bail_test_path) if cfg.bail_test_path else None,
+            "tests/test_apply_cockpit.py",
+            cfg.op_type_names))
+    if "A1" in cfg.enabled_rules and cfg.docs_admin_path:
+        # a MISSING admin doc reads as "" and flags every handler —
+        # fail-safe, same stance as M1's missing metrics catalog
+        # (docs_admin_path=None is the explicit fixture opt-out)
+        res.findings.extend(R.rule_a1_admin_endpoints(
+            all_facts, cfg.command_handler_path,
+            _read(cfg.docs_admin_path), "docs/admin.md"))
 
     entries: List[AllowEntry] = []
     if cfg.allowlist_path and os.path.exists(cfg.allowlist_path):

@@ -13,6 +13,7 @@ findings.
 from __future__ import annotations
 
 import ast
+import re
 from typing import Dict, List, Optional, Sequence, Set, Tuple
 
 from .engine import Finding
@@ -107,6 +108,7 @@ class ModuleFacts(ast.NodeVisitor):
         self.except_passes: List[Tuple[int, str, str]] = []  # line, kind, qual
         self.fault_literals: List[Tuple[int, str, str]] = []  # line, site, qual
         self.metric_literals: List[Tuple[int, str, str]] = []  # line, name, qual
+        self.bail_literals: List[Tuple[int, str, str]] = []  # line, reason, qual
         self.functions: List[FuncInfo] = []
         self.thread_entries: List[ThreadEntry] = []
 
@@ -242,6 +244,20 @@ class ModuleFacts(ast.NodeVisitor):
             if isinstance(a, ast.Constant) and isinstance(a.value, str):
                 self.fault_literals.append(
                     (node.lineno, "overlay." + a.value, self._qual()))
+
+        # native-bail classification literals (N4's Python side):
+        # `_bail(stats, "reason")` gates in ledger/native_apply.py and
+        # direct `record_bail("reason")` calls
+        if callee == "_bail" and len(node.args) >= 2:
+            a = node.args[1]
+            if isinstance(a, ast.Constant) and isinstance(a.value, str):
+                self.bail_literals.append(
+                    (node.lineno, a.value, self._qual()))
+        elif callee == "record_bail" and node.args:
+            a = node.args[0]
+            if isinstance(a, ast.Constant) and isinstance(a.value, str):
+                self.bail_literals.append(
+                    (node.lineno, a.value, self._qual()))
 
         # metric registrations
         if callee in _METRIC_CALLS and node.args:
@@ -435,6 +451,62 @@ def rule_m1_metric_catalog(all_facts: Sequence[ModuleFacts],
                     "M1", facts.path, line, qual,
                     "metric %r is registered in code but absent from %s "
                     "— add it to the catalog table" % (name, docs_name)))
+    return out
+
+
+_A1_ROW_TOKEN = re.compile(r"`([a-zA-Z][\w-]*)")
+
+
+def rule_a1_admin_endpoints(all_facts: Sequence[ModuleFacts],
+                            handler_path: str, docs_text: str,
+                            docs_name: str) -> List[Finding]:
+    """A1: every `cmd_*` handler in main/command_handler.py has a row in
+    the docs/admin.md endpoint table, and every endpoint the table names
+    still has a handler — the operator surface and its documentation
+    move together (M1's pattern applied to the admin API).
+
+    Endpoint names come from the first cell of each table row: every
+    backtick-opened token's leading word (`bans[?action=...]` -> `bans`;
+    combined rows like `` `setcursor`, `getcursor` `` yield each)."""
+    handlers: Dict[str, Tuple[str, int]] = {}
+    for facts in all_facts:
+        if facts.path != handler_path:
+            continue
+        for fi in facts.functions:
+            if fi.name.startswith("cmd_") and len(fi.name) > 4:
+                handlers[fi.name[4:].replace("_", "-")] = \
+                    (facts.path, fi.line)
+    doc_rows: Dict[str, int] = {}
+    in_table = False
+    for lineno, line in enumerate(docs_text.splitlines(), 1):
+        s = line.strip()
+        if not s.startswith("|"):
+            in_table = False
+            continue
+        cells = s.split("|")
+        first = cells[1] if len(cells) > 1 else ""
+        if "Endpoint" in first:
+            in_table = True
+            continue
+        if not in_table or set(first.strip()) <= {"-", " ", ":"}:
+            continue
+        for tok in _A1_ROW_TOKEN.findall(first):
+            doc_rows.setdefault(tok, lineno)
+    out: List[Finding] = []
+    for name, (path, line) in sorted(handlers.items()):
+        if name not in doc_rows:
+            out.append(Finding(
+                "A1", path, line, "cmd_" + name.replace("-", "_"),
+                "admin endpoint `%s` has no row in the %s endpoint "
+                "table — document it (purpose + params) in the same "
+                "change" % (name, docs_name)))
+    for name, lineno in sorted(doc_rows.items()):
+        if name not in handlers:
+            out.append(Finding(
+                "A1", docs_name, lineno, "",
+                "%s documents endpoint `%s` but main/command_handler.py "
+                "has no cmd_%s handler — remove or fix the row"
+                % (docs_name, name, name.replace("-", "_"))))
     return out
 
 

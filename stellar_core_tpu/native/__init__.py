@@ -23,18 +23,37 @@ from typing import Optional
 import numpy as np
 
 _DIR = os.path.dirname(os.path.abspath(__file__))
-# SCT_SANITIZE=1 reroutes every native build into build/sanitized/ with
-# -fsanitize=address,undefined: tools/build_native_sanitized.sh compiles
-# all three extensions there, and the `sanitize`-marked differential
-# tests run under them with libasan preloaded (docs/static-analysis.md
+# SCT_SANITIZE reroutes every native build into a sanitizer-specific
+# build dir: "1" (or "address") -> build/sanitized/ with
+# -fsanitize=address,undefined, "thread" -> build/tsan/ with
+# -fsanitize=thread. tools/build_native_sanitized.sh compiles all the
+# extensions there, and the `sanitize`-marked differential tests run
+# under them with libasan/libtsan preloaded (docs/static-analysis.md
 # "Sanitized native builds"). Read at import so one process is wholly
-# sanitized or wholly not — mixing ASan and non-ASan libs in-process is
-# UB.
-SANITIZE = os.environ.get("SCT_SANITIZE") == "1"
-_BUILD = os.path.join(_DIR, "build", "sanitized") if SANITIZE \
-    else os.path.join(_DIR, "build")
-_SANITIZE_FLAGS = ["-fsanitize=address,undefined",
-                   "-fno-omit-frame-pointer", "-g"]
+# sanitized or wholly not — mixing sanitized and plain libs in-process
+# is UB, and ASan and TSan are mutually exclusive per process.
+_SAN_RAW = os.environ.get("SCT_SANITIZE", "")
+_SAN_MODES = {"": "", "0": "", "1": "address", "address": "address",
+              "thread": "thread"}
+if _SAN_RAW not in _SAN_MODES:
+    # fail LOUDLY: a typo ('tsan', 'asan') silently producing a plain
+    # build would make the sanitizer run vacuously clean
+    raise RuntimeError(
+        "SCT_SANITIZE=%r is not a sanitize mode (use 1/address for "
+        "ASan+UBSan, thread for TSan, 0/unset for none)" % _SAN_RAW)
+SANITIZE_MODE = _SAN_MODES[_SAN_RAW]
+SANITIZE = SANITIZE_MODE != ""   # truthy back-compat alias
+if SANITIZE_MODE == "thread":
+    _BUILD = os.path.join(_DIR, "build", "tsan")
+    _SANITIZE_FLAGS = ["-fsanitize=thread",
+                       "-fno-omit-frame-pointer", "-g"]
+elif SANITIZE_MODE == "address":
+    _BUILD = os.path.join(_DIR, "build", "sanitized")
+    _SANITIZE_FLAGS = ["-fsanitize=address,undefined",
+                       "-fno-omit-frame-pointer", "-g"]
+else:
+    _BUILD = os.path.join(_DIR, "build")
+    _SANITIZE_FLAGS = []
 _LOCK = threading.Lock()
 _LIB: Optional[ctypes.CDLL] = None
 _TRIED = False
@@ -44,7 +63,12 @@ def _cc_build(src_path: str, so_path: str, include_dir: str) -> bool:
     """Try cc/gcc/g++ -O2 -shared -fPIC; atomic-rename into so_path.
     Shared by the prep library and the XDR extension builds."""
     import tempfile
-    extra = _SANITIZE_FLAGS if SANITIZE else []
+    extra = list(_SANITIZE_FLAGS)
+    # the compiler must NOT inherit a sanitizer-runtime LD_PRELOAD: the
+    # preload is for loading the built .so into THIS process, and a
+    # TSan-preloaded python forking gcc can deadlock in the runtime's
+    # fork interceptor (observed: 5-minute wedge under SCT_SANITIZE=thread)
+    cc_env = {k: v for k, v in os.environ.items() if k != "LD_PRELOAD"}
     for cc in ("cc", "gcc", "g++"):
         tmp = tempfile.NamedTemporaryFile(
             dir=_BUILD, suffix=".so", delete=False)
@@ -55,7 +79,7 @@ def _cc_build(src_path: str, so_path: str, include_dir: str) -> bool:
             r = subprocess.run(
                 [cc, "-O2", "-shared", "-fPIC", "-pthread"] + extra +
                 ["-I", include_dir, "-o", tmp.name, src_path],
-                capture_output=True, text=True, timeout=300)
+                capture_output=True, text=True, timeout=300, env=cc_env)
         except (OSError, subprocess.TimeoutExpired):
             os.unlink(tmp.name)
             continue

@@ -596,7 +596,11 @@ typedef struct {
     StaticSigner *sadds;
     int nsadds, capsadds;
     int nopy; /* GIL released: any Python need is an engine bug -> bail */
-    volatile int abort_flag; /* parallel: some cluster bailed/oomed */
+    int abort_flag; /* parallel: some cluster bailed/oomed. Written by
+        any worker, polled by the rest with no lock in between, so
+        access goes through ctx_abort/ctx_aborted (__atomic) ONLY: a
+        plain — even volatile — access racing an atomic one is a data
+        race under ThreadSanitizer and UB per the C11 memory model. */
 } Ctx;
 
 /* per-apply-context view: the journal + attribution one tx stream (the
@@ -616,13 +620,26 @@ typedef struct {
     Arena ar; /* owns every deferred-output buffer this context built */
 } AEnv;
 
+/* cross-thread abort latch: relaxed is enough — the flag only asks
+   workers to stop early; the authoritative bail/oom state merges after
+   the pool join (which is the synchronization point). */
+static void ctx_abort(Ctx *c)
+{
+    __atomic_store_n(&c->abort_flag, 1, __ATOMIC_RELAXED);
+}
+
+static int ctx_aborted(Ctx *c)
+{
+    return __atomic_load_n(&c->abort_flag, __ATOMIC_RELAXED);
+}
+
 static void env_bail(AEnv *env, const char *msg)
 {
     if (!env->bail) {
         env->bail = 1;
         env->bailmsg = msg;
     }
-    env->c->abort_flag = 1;
+    ctx_abort(env->c);
 }
 
 static void ctx_bail(Ctx *c, const char *msg)
@@ -1028,7 +1045,7 @@ static int entry_adopt_blob(AEnv *env, Entry *e, const uint8_t *blob,
             ctx_bail(c, "entry-kind");
         env->bail = 1;
         env->bailmsg = c->bailmsg;
-        c->abort_flag = 1;
+        ctx_abort(c);
         return -1;
     }
     return 0;
@@ -1138,7 +1155,7 @@ static int touch(AEnv *env, Entry *e, int lv)
     mut_copy(&e->save[lv].st, &e->st);
     if (elist_push(&env->lv[lv], e) < 0) {
         env->oom = 1;
-        env->c->abort_flag = 1;
+        ctx_abort(env->c);
         return -1;
     }
     return 0;
@@ -1163,20 +1180,20 @@ static int commit_level(AEnv *env, int lv)
                                 (int64_t)env->ord0++;
                     if (elist_push(&env->lv[0], e) < 0) {
                         env->oom = 1;
-                        env->c->abort_flag = 1;
+                        ctx_abort(env->c);
                         return -1;
                     }
                 } else {
                     if (elist_push(&env->c->closed0, e) < 0) {
                         env->oom = 1;
-                        env->c->abort_flag = 1;
+                        ctx_abort(env->c);
                         return -1;
                     }
                 }
             } else {
                 if (elist_push(&env->lv[lv - 1], e) < 0) {
                     env->oom = 1;
-                    env->c->abort_flag = 1;
+                    ctx_abort(env->c);
                     return -1;
                 }
             }
@@ -1334,7 +1351,7 @@ static int delta_changes_buf(AEnv *env, int lv, Buf *b)
     return 0;
 oom:
     env->oom = 1;
-    env->c->abort_flag = 1;
+    ctx_abort(env->c);
     return -1;
 }
 
@@ -3487,6 +3504,7 @@ static int apply_allow_trust(AEnv *env, Op *op, const uint8_t *src_id,
                 continue;
             if (elist_push(&matched, e) < 0) {
                 env->oom = 1;
+                free(matched.v);
                 return -1;
             }
         }
@@ -3501,6 +3519,7 @@ static int apply_allow_trust(AEnv *env, Op *op, const uint8_t *src_id,
                 continue;
             if (elist_push(&matched, e) < 0) {
                 env->oom = 1;
+                free(matched.v);
                 return -1;
             }
         }
@@ -4803,14 +4822,14 @@ static void *worker_main(void *arg)
        HEAD is copied back into w->env below and only ever freed through
        it — buf_free never dereferences the stale pointer */
     for (k = 0; k < w->n; k++) {
-        if (env.c->abort_flag)
+        if (ctx_aborted(env.c))
             break;
         int ti = w->order[k];
         env.txidx = ti;
         env.ord0 = 0;
         if (apply_tx(&env, w->txs[ti]) < 0) {
             w->failed = 1;
-            env.c->abort_flag = 1;
+            ctx_abort(env.c);
             break;
         }
     }
@@ -4931,7 +4950,11 @@ static int pool_run(Worker *ws, int n)
     POOL.n = n;
     POOL.next = 0;
     POOL.done = 0;
-    POOL.gen++;
+    /* pool threads spin on gen OUTSIDE the mutex (atomic acquire
+       loads); the publishing store must be atomic too — a plain
+       increment racing those loads is a TSan-reportable data race.
+       The mutex still orders the plain gen reads in pool_thread. */
+    __atomic_store_n(&POOL.gen, POOL.gen + 1, __ATOMIC_RELEASE);
     pthread_cond_broadcast(&POOL.work_cv);
     while (POOL.done < POOL.n)
         pthread_cond_wait(&POOL.done_cv, &POOL.mu);

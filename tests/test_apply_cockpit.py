@@ -487,3 +487,45 @@ def test_traced_replay_breakdown_both_paths(tmp_path):
         assert spans
         app.stop()
     pub.stop()
+
+
+# --------------------------------------------------- native-bail taxonomy
+
+
+def test_native_bail_taxonomy_is_metric_safe_and_classify_stable():
+    """The registry side of sctlint rule N4: the taxonomy table in
+    docs/observability.md (parsed by the same
+    `analysis.crules.native_bail_taxonomy` the lint rule uses) is what
+    every C `ctx_bail`/`env_bail` literal and Python `_bail` gate must
+    classify into. Here the table itself is held to the cockpit's
+    contracts: every reason is a valid metric-name segment for
+    `ledger.apply.native-bail.<reason>`, `_classify_engine_bail` is
+    idempotent on the already-classified exact reasons (only the
+    numeric `op-<n>` family rewrites), and each dynamic `op-<n>` the C
+    engine can emit classifies INTO the dynamic row's family."""
+    import os
+    import re
+
+    from stellar_core_tpu.analysis.crules import native_bail_taxonomy
+    from stellar_core_tpu.ledger.apply_stats import OP_TYPE_NAMES
+    from stellar_core_tpu.ledger.native_apply import _classify_engine_bail
+
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    with open(os.path.join(repo, "docs", "observability.md"),
+              encoding="utf-8") as fh:
+        taxonomy = native_bail_taxonomy(fh.read())
+    assert len(taxonomy) >= 25, "taxonomy table went missing or short"
+    assert set(taxonomy.values()) <= {"c", "python"}, taxonomy
+    seg = re.compile(r"^[a-z0-9<>-]+$")
+    for reason in taxonomy:
+        assert seg.match(reason), \
+            "taxonomy reason %r is not metric-name safe" % reason
+        if "<" not in reason:
+            assert _classify_engine_bail(reason) == reason, \
+                "classifier rewrites exact reason %r" % reason
+    # the C engine's dynamic family: op-<n> classifies to op-<name>,
+    # which the `op-<type>` row covers
+    dyn = [r for r in taxonomy if "<" in r]
+    assert "op-<type>" in dyn
+    for v, name in OP_TYPE_NAMES.items():
+        assert _classify_engine_bail("op-%d" % v) == "op-" + name

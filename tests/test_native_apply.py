@@ -736,6 +736,34 @@ def test_native_apply_parallel_equality():
     assert st["last_count"] >= 1
 
 
+@pytest.mark.parametrize("seed", [7, 11])
+def test_native_apply_parallel_seeded(seed):
+    """Seeded randomized conflict mixes over the forced-parallel vs
+    forced-serial vs oracle triple. These are the ParallelDiffHarness
+    legs the ThreadSanitizer runtime gate re-drives under a
+    `-fsanitize=thread` build (tests/test_native_sanitized.py,
+    docs/static-analysis.md) — every schedule the seeds produce must
+    close identically AND race-free."""
+    rng = random.Random(seed)
+    h = ParallelDiffHarness()
+    root = h.account(root_secret_key())
+    accs = [h.account(SecretKey.from_seed(sha256(b"ps%d-%d" % (seed, i))))
+            for i in range(10)]
+    h.close([root.tx([root.op_create_account(a.account_id, 40 * MIN0)
+                      for a in accs])])
+    for _round in range(4):
+        frames = []
+        for a in accs:
+            if rng.random() < 0.25:
+                continue
+            dest = rng.choice([x for x in accs if x is not a])
+            frames.append(a.tx([a.op_payment(dest.account_id,
+                                             rng.randrange(1, 5000))]))
+        if frames:
+            h.close(frames)
+    assert h.parallel.apply_stats.clusters["parallel_closes"] >= 1
+
+
 def _random_full_frames(rng, h, world, fresh_counter):
     """One close worth of random frames over ALL op types."""
     root, users, ix, ir, X, R = world

@@ -1,13 +1,23 @@
-"""Sanitized native builds (ISSUE 5): compile all three C extensions
-(plus the xdrc serializer) with -fsanitize=address,undefined and run the
-native differential-oracle tests under ASan/UBSan in a subprocess.
+"""Sanitized native builds (ISSUE 5 + ISSUE 15): compile the C
+extensions (prep/ed25519c/applyc + the xdrc serializer) with
+-fsanitize=address,undefined and run the native differential-oracle
+tests under ASan/UBSan in a subprocess; plus the ThreadSanitizer twin —
+a `-fsanitize=thread` build under which the ParallelDiffHarness legs
+(forced-parallel vs forced-serial vs oracle, seeded) race-check the
+GIL-released cluster pthread pool.
 
 Marked `slow` + `sanitize`: tier-1 skips it (the sanitized compile alone
 is ~20s, the oracle run minutes); run explicitly with
 
     python -m pytest tests/test_native_sanitized.py -m sanitize
 
-or via `tools/build_native_sanitized.sh --check` (same machinery).
+or via `tools/build_native_sanitized.sh --check` (same machinery; ASan
+and TSan builds live in separate dirs — build/sanitized/ vs build/tsan/
+— and separate PROCESSES: the runtimes cannot coexist in one).
+
+TSan quirk the helpers encode: the instrumented .so files are BUILT
+without LD_PRELOAD (a TSan-preloaded python forking gcc can deadlock in
+the runtime's fork interceptor) and only RUN with libtsan preloaded.
 """
 
 import os
@@ -108,3 +118,104 @@ def test_threaded_parallel_close_under_asan_ubsan():
     assert r.returncode == 0, tail
     assert "ERROR: AddressSanitizer" not in r.stderr, r.stderr[-4000:]
     assert "runtime error:" not in r.stderr, r.stderr[-4000:]
+
+
+# ------------------------------------------------------ ThreadSanitizer leg
+
+
+def _tsan_lib(name):
+    cc = shutil.which("cc")
+    if cc is None:
+        pytest.skip("no C compiler")
+    path = subprocess.run(
+        [cc, "-print-file-name=%s" % name],
+        capture_output=True, text=True).stdout.strip()
+    if not path or not os.path.exists(path):
+        pytest.skip("cc has no %s runtime" % name)
+    return path
+
+
+def _tsan_build_env():
+    """Environment for BUILDING the TSan extensions: SCT_SANITIZE=thread
+    routes native/__init__.py into build/tsan/ with -fsanitize=thread;
+    deliberately NO LD_PRELOAD (see module docstring)."""
+    env = dict(os.environ)
+    env.pop("LD_PRELOAD", None)
+    env.update({"SCT_SANITIZE": "thread", "JAX_PLATFORMS": "cpu"})
+    return env
+
+
+def _tsan_run_env():
+    """Environment for RUNNING against the prebuilt TSan extensions."""
+    libtsan = _tsan_lib("libtsan.so")
+    libstdcpp = _tsan_lib("libstdc++.so")
+    env = _tsan_build_env()
+    env.update({
+        "LD_PRELOAD": "%s %s" % (libtsan, libstdcpp),
+        # print every report (don't stop at the first); the default
+        # nonzero exitcode (66) still fails the subprocess on any
+        "TSAN_OPTIONS": "halt_on_error=0",
+    })
+    return env
+
+
+def _tsan_prebuild():
+    """Build all four TSan-instrumented artifacts without the preload.
+    Loading them in THIS (unpreloaded) build step fails by design — the
+    artifacts landing in build/tsan/ is the contract."""
+    r = subprocess.run(
+        [sys.executable, "-c",
+         "from stellar_core_tpu import native\n"
+         "assert native.SANITIZE_MODE == 'thread', native.SANITIZE_MODE\n"
+         "assert native._BUILD.endswith('tsan'), native._BUILD\n"
+         "native.available()\n"
+         "native.ed25519_native()\n"
+         "native.apply_engine()\n"
+         "native._compile_xdr_ext()\n"
+         "import glob, os\n"
+         "for pat in ('libsctprep-*.so', 'libscted25519-*.so',\n"
+         "            '_sctapply-*.so', '_sctxdr-*.so'):\n"
+         "    assert glob.glob(os.path.join(native._BUILD, pat)), pat\n"
+         "print('TSAN-BUILD-OK')"],
+        capture_output=True, text=True, cwd=REPO, env=_tsan_build_env(),
+        timeout=600)
+    assert r.returncode == 0, (r.stdout, r.stderr)
+    assert "TSAN-BUILD-OK" in r.stdout
+
+
+def test_tsan_build_compiles_and_loads_under_preload():
+    _tsan_run_env()          # skip early when no libtsan
+    _tsan_prebuild()
+    r = subprocess.run(
+        [sys.executable, "-c",
+         "from stellar_core_tpu import native\n"
+         "assert native.apply_engine() is not None, 'applyc failed'\n"
+         "assert native.available(), 'prep failed'\n"
+         "assert native.ed25519_native() is not None, 'ed25519c failed'\n"
+         "print('TSAN-LOAD-OK')"],
+        capture_output=True, text=True, cwd=REPO, env=_tsan_run_env(),
+        timeout=600)
+    assert r.returncode == 0, (r.stdout, r.stderr)
+    assert "TSAN-LOAD-OK" in r.stdout
+    assert "WARNING: ThreadSanitizer" not in r.stderr, r.stderr[-4000:]
+
+
+def test_threaded_parallel_close_under_tsan():
+    """THE race gate (ISSUE 15 acceptance): the ParallelDiffHarness —
+    forced-parallel vs forced-serial vs Python-oracle equality plus the
+    seeded randomized conflict mixes (2 seeds) — runs with the
+    GIL-released cluster pthread pool fully TSan-instrumented, with
+    zero unsuppressed ThreadSanitizer reports. TSan's own nonzero exit
+    (66) on any report fails the run even if pytest passed."""
+    env = _tsan_run_env()
+    _tsan_prebuild()
+    r = subprocess.run(
+        [sys.executable, "-m", "pytest",
+         "tests/test_native_apply.py::test_native_apply_parallel_equality",
+         "tests/test_native_apply.py::test_native_apply_parallel_seeded",
+         "-q", "-p", "no:cacheprovider"],
+        capture_output=True, text=True, cwd=REPO, env=env, timeout=1800)
+    tail = (r.stdout or "")[-4000:] + (r.stderr or "")[-4000:]
+    assert r.returncode == 0, tail
+    assert "WARNING: ThreadSanitizer" not in r.stderr, r.stderr[-6000:]
+    assert "3 passed" in r.stdout, tail

@@ -386,3 +386,593 @@ def test_changed_mode_restricts_per_module_rules():
     assert not res.stale_entries       # suppressed on partial runs
     d1_paths = {f.path for f in res.findings if f.rule == "D1"}
     assert d1_paths == {"stellar_core_tpu/util/timer.py"}
+
+
+# -- native C rules (N1-N4) -------------------------------------------------
+
+
+OBS_DOC_OK = """
+### Native bail taxonomy
+
+| reason | origin | meaning |
+|---|---|---|
+| `prefetch-miss` | C | worker needed an entry the prefetch missed |
+| `op-<type>` | C | unsupported op, named |
+| `disabled` | python | gate off |
+"""
+
+
+def _native_fixture(tmp_path, c_files, py_files=None, obs_doc=OBS_DOC_OK,
+                    metrics_doc="| `ledger.apply.op.<type>.count` | m | x |",
+                    admin_doc=None, op_types=None, rules=None):
+    """Fake repo with native/*.c sources + the docs the N/A rules
+    cross-check. Python rules stay enabled so cross-language facts
+    (py-side bail literals) flow into N4."""
+    pkg = tmp_path / "fakepkg"
+    native = pkg / "native"
+    native.mkdir(parents=True, exist_ok=True)
+    for rel, src in (py_files or {}).items():
+        p = pkg / rel
+        p.parent.mkdir(parents=True, exist_ok=True)
+        p.write_text(textwrap.dedent(src))
+    for name, src in c_files.items():
+        (native / name).write_text(textwrap.dedent(src))
+    docs = tmp_path / "docs"
+    docs.mkdir(exist_ok=True)
+    (docs / "observability.md").write_text(obs_doc)
+    (docs / "metrics.md").write_text(metrics_doc)
+    (docs / "robustness.md").write_text("")
+    if admin_doc is not None:
+        (docs / "admin.md").write_text(admin_doc)
+    cfg = LintConfig(
+        repo_root=str(tmp_path), package_dir=str(pkg),
+        package_name="fakepkg", allowlist_path=None,
+        docs_metrics_path=str(docs / "metrics.md"),
+        docs_robustness_path=str(docs / "robustness.md"),
+        fault_registry=None,
+        native_dir=str(native),
+        docs_observability_path=str(docs / "observability.md"),
+        docs_admin_path=str(docs / "admin.md") if admin_doc is not None
+        else None,
+        command_handler_path="fakepkg/main/command_handler.py",
+        bail_test_path=None,
+        op_type_names=op_types)
+    if rules:
+        cfg.enabled_rules = rules
+    return cfg
+
+
+def test_n1_python_call_in_worker_path_without_guard(tmp_path):
+    cfg = _native_fixture(tmp_path, {"eng.c": """
+        #include <Python.h>
+        #include <pthread.h>
+
+        static void helper(void *p) {
+            PyErr_SetString(PyExc_RuntimeError, "boom");
+        }
+
+        static void *worker(void *arg) {
+            helper(arg);
+            return 0;
+        }
+
+        static void spawn(void) {
+            pthread_t t;
+            pthread_create(&t, 0, worker, 0);
+        }
+    """})
+    res = run_analysis(cfg)
+    n1 = [f for f in res.violations if f.rule == "N1"]
+    assert len(n1) == 1
+    assert n1[0].qualname == "helper"
+    assert "PyErr_SetString" in n1[0].message
+    assert "worker -> helper" in n1[0].message
+
+
+def test_n1_gil_bracket_and_the_returning_nopy_guard(tmp_path):
+    """Py* inside a Py_BEGIN/END_ALLOW_THREADS bracket fires; a
+    reachable function whose Python use sits behind the engine's
+    returning `if (c->nopy)` guard is clean — and the guard only
+    counts when it RETURNS."""
+    cfg = _native_fixture(tmp_path, {"eng.c": """
+        #include <Python.h>
+
+        typedef struct { int nopy; } Ctx;
+
+        static void *guarded(Ctx *c) {
+            if (c->nopy) {
+                return 0;
+            }
+            return PyLong_FromLong(1);     /* GIL-held territory */
+        }
+
+        static void *unguarded(Ctx *c) {
+            if (c->nopy) { c->nopy = 2; }  /* falls through: no guard */
+            return PyLong_FromLong(1);
+        }
+
+        static void *inverted(Ctx *c) {
+            if (!c->nopy) {
+                return 0;                  /* returns when GIL HELD */
+            }
+            return PyLong_FromLong(1);     /* runs exactly nogil */
+        }
+
+        static void *compound(Ctx *c, int x) {
+            if (c->nopy && x) {
+                return 0;                  /* may fall through nogil */
+            }
+            return PyLong_FromLong(1);
+        }
+
+        static void *yoda(Ctx *c) {
+            if (0 == c->nopy) {
+                return 0;                  /* returns when GIL HELD */
+            }
+            return PyLong_FromLong(1);     /* runs exactly nogil */
+        }
+
+        static int flip(int v) { return v ? 0 : 1; }
+
+        static void *wrapped(Ctx *c) {
+            if (flip(c->nopy)) {
+                return 0;                  /* call may invert: no guard */
+            }
+            return PyLong_FromLong(1);
+        }
+
+        static void close_it(Ctx *c) {
+            Py_BEGIN_ALLOW_THREADS
+            guarded(c);
+            unguarded(c);
+            inverted(c);
+            compound(c, 1);
+            yoda(c);
+            wrapped(c);
+            PyErr_Clear();                 /* direct violation */
+            Py_END_ALLOW_THREADS
+        }
+    """})
+    res = run_analysis(cfg)
+    n1 = [f for f in res.violations if f.rule == "N1"]
+    quals = sorted(f.qualname for f in n1)
+    assert quals == ["close_it", "compound", "inverted", "unguarded",
+                     "wrapped", "yoda"], \
+        "\n".join(f.format() for f in n1)
+
+
+def test_n2_hot_path_malloc_and_the_arena_exemption(tmp_path):
+    cfg = _native_fixture(tmp_path, {"eng.c": """
+        #include <pthread.h>
+        #include <stdlib.h>
+
+        static void *arena_alloc(void *a, long n) {
+            return malloc(n);              /* the sanctioned allocator */
+        }
+
+        static int apply_op(void *env) {
+            char *buf = malloc(64);        /* stray hot-path malloc */
+            arena_alloc(env, 64);
+            free(buf);
+            return 0;
+        }
+
+        static void *worker(void *arg) {
+            apply_op(arg);
+            return 0;
+        }
+
+        static void spawn(void) {
+            pthread_t t;
+            pthread_create(&t, 0, worker, 0);
+        }
+    """})
+    res = run_analysis(cfg)
+    n2 = [f for f in res.violations if f.rule == "N2"]
+    assert len(n2) == 2                     # malloc + free in apply_op
+    assert all(f.qualname == "apply_op" for f in n2)
+    assert {"malloc", "free"} == \
+        {f.message.split("`")[1] for f in n2}
+
+
+def test_n3_unbalanced_early_return_and_loop_imbalance(tmp_path):
+    cfg = _native_fixture(tmp_path, {"pool.c": """
+        #include <pthread.h>
+
+        static pthread_mutex_t MU = PTHREAD_MUTEX_INITIALIZER;
+
+        static int pop_leaky(int *q) {
+            pthread_mutex_lock(&MU);
+            if (!*q) {
+                return -1;                 /* forgot the unlock */
+            }
+            int v = *q;
+            pthread_mutex_unlock(&MU);
+            return v;
+        }
+
+        static int braceless(int *q) {
+            if (*q)
+                while (*q > 1) { (*q)--; } /* no trailing semicolon */
+            pthread_mutex_lock(&MU);
+            pthread_mutex_unlock(&MU);
+            return 0;
+        }
+
+        static int pop_ok(int *q) {
+            pthread_mutex_lock(&MU);
+            if (!*q) {
+                pthread_mutex_unlock(&MU);
+                return -1;
+            }
+            int v = *q;
+            pthread_mutex_unlock(&MU);
+            return v;
+        }
+
+        static void drain(int *q) {
+            while (*q) {
+                pthread_mutex_lock(&MU);   /* net +1 per iteration */
+            }
+        }
+
+        static void skipper(int *q) {
+            while (*q) {
+                pthread_mutex_lock(&MU);
+                if (*q == 2)
+                    continue;              /* leaks MU every skip */
+                pthread_mutex_unlock(&MU);
+            }
+        }
+
+        static void dispatcher(int *q) {
+            while (*q) {
+                pthread_mutex_lock(&MU);
+                switch (*q) {
+                case 1:
+                    continue;              /* leaks MU through switch */
+                default:
+                    break;                 /* binds to the switch */
+                }
+                pthread_mutex_unlock(&MU);
+            }
+        }
+
+        static int casefold(int *q) {
+            pthread_mutex_lock(&MU);
+            switch (*q) {
+            case 1:
+                return -1;        /* exits a lock-free switch held */
+            }
+            pthread_mutex_unlock(&MU);
+            return 0;
+        }
+
+        static int settle(int *q) {
+            pthread_mutex_lock(&MU);
+            switch (*q) {
+            case 1:
+                pthread_mutex_unlock(&MU);
+                return 0;
+            default:
+                pthread_mutex_unlock(&MU);
+                break;
+            }
+            return 1;       /* balanced — but cases hide the proof */
+        }
+
+        static void *svc(void *arg) {
+            pthread_mutex_lock(&MU);
+            for (;;) {
+                pthread_mutex_unlock(&MU);
+                pthread_mutex_lock(&MU);
+            }
+            return 0;                      /* unreachable: not flagged */
+        }
+    """})
+    res = run_analysis(cfg)
+    n3 = [f for f in res.violations if f.rule == "N3"]
+    by_qual = {}
+    for f in n3:
+        by_qual.setdefault(f.qualname, []).append(f.message)
+    assert "pop_leaky" in by_qual and "MU" in by_qual["pop_leaky"][0]
+    assert "drain" in by_qual
+    assert any("loop" in m for m in by_qual["drain"])
+    assert "skipper" in by_qual           # continue path leaks too
+    assert any("loop" in m for m in by_qual["skipper"])
+    assert "dispatcher" in by_qual        # continue THROUGH a switch
+    assert any("loop" in m for m in by_qual["dispatcher"])
+    # a switch mixing locks with return is declared unanalyzable (the
+    # goto stance) instead of false-positive-guessed
+    assert "settle" in by_qual
+    assert all("switch" in m for m in by_qual["settle"])
+    assert "casefold" in by_qual          # return inside lock-free case
+    assert any("still holds" in m for m in by_qual["casefold"])
+    assert "pop_ok" not in by_qual
+    assert "braceless" not in by_qual, by_qual
+    assert "svc" not in by_qual, by_qual
+
+
+def test_n4_bail_registry_and_op_table_drift(tmp_path):
+    cfg = _native_fixture(tmp_path, {"eng.c": """
+        #define OP_CREATE_ACCOUNT 0
+        #define MAX_OPTYPES 4
+
+        typedef struct { int x; } Ctx;
+        static void ctx_bail(Ctx *c, const char *m) { c->x = 1; }
+
+        static void parse(Ctx *c) {
+            ctx_bail(c, "mystery-reason");
+            ctx_bail(c, "prefetch-miss");
+        }
+    """}, obs_doc=OBS_DOC_OK + "| `ghost-reason` | C | never fired |\n",
+        py_files={"ledger/native_apply.py": """
+        def _bail(stats, reason):
+            return False
+
+        def gate(stats):
+            return _bail(stats, "disabled")
+    """}, op_types={0: "create-account", 1: "payment"},
+        metrics_doc="nothing documented here")
+    res = run_analysis(cfg)
+    n4 = [f for f in res.violations if f.rule == "N4"]
+    msgs = "\n".join(f.message for f in n4)
+    assert "'mystery-reason' has no row" in msgs
+    assert "`ghost-reason` has no ctx_bail" in msgs
+    assert "op type 1 (`payment`) has no OP_* define" in msgs
+    assert "ledger.apply.op.<type>" in msgs      # metrics prefix missing
+    assert "prefetch-miss" not in msgs           # registered: clean
+    assert "'disabled'" not in msgs              # py literal registered
+    # no snprintf producer in this fixture: the dynamic row is stale too
+    assert "`op-<type>` matches no dynamic bail producer" in msgs
+    assert len(n4) == 5, msgs
+
+
+def test_n4_dynamic_bailbuf_family(tmp_path):
+    """The snprintf-into-bailbuf idiom (`op-%d`) must be covered by a
+    dynamic `op-<...>` taxonomy row — and keeps that row live."""
+    src = """
+        typedef struct { int x; char bailbuf[48]; } Ctx;
+        static void ctx_bail(Ctx *c, const char *m) { c->x = 1; }
+
+        static void parse(Ctx *c, int t) {
+            snprintf(c->bailbuf, sizeof(c->bailbuf), "op-%d", t);
+            ctx_bail(c, c->bailbuf);
+            ctx_bail(c, "prefetch-miss");
+        }
+    """
+    bare = """
+### Native bail taxonomy
+
+| reason | origin | meaning |
+|---|---|---|
+| `prefetch-miss` | C | worker miss |
+"""
+    cfg = _native_fixture(tmp_path, {"eng.c": src}, obs_doc=bare)
+    res = run_analysis(cfg)
+    n4 = [f for f in res.violations if f.rule == "N4"]
+    assert len(n4) == 1 and "dynamic C bail family 'op-'" in n4[0].message
+    cfg = _native_fixture(tmp_path, {"eng.c": src},
+                          obs_doc=bare + "| `op-<type>` | C | dyn |\n")
+    res = run_analysis(cfg)
+    assert not [f for f in res.violations if f.rule == "N4"]
+
+
+def test_a1_admin_endpoint_doc_drift(tmp_path):
+    admin = """
+| Endpoint | Purpose |
+|---|---|
+| `info` | Node summary |
+| `bans[?action=list\\|unban&node=...]` | Ban surface |
+| `setcursor`, `getcursor` | Cursors |
+| `phantom?x=1` | Documented but unimplemented |
+"""
+    cfg = _native_fixture(tmp_path, {}, admin_doc=admin, py_files={
+        "main/command_handler.py": """
+        class CommandHandler:
+            def cmd_info(self, params):
+                return {}
+
+            def cmd_bans(self, params):
+                return {}
+
+            def cmd_setcursor(self, params):
+                return {}
+
+            def cmd_getcursor(self, params):
+                return {}
+
+            def cmd_ghost(self, params):
+                return {}
+    """})
+    res = run_analysis(cfg)
+    a1 = [f for f in res.violations if f.rule == "A1"]
+    msgs = "\n".join(f.message for f in a1)
+    assert len(a1) == 2, msgs
+    assert "`ghost` has no row" in msgs
+    assert "endpoint `phantom`" in msgs and "cmd_phantom" in msgs
+
+
+def test_c_allowlist_scopes_by_function_and_goes_stale(tmp_path):
+    cfg = _native_fixture(tmp_path, {"eng.c": """
+        #include <pthread.h>
+        #include <stdlib.h>
+
+        static int apply_op(void *env) {
+            free(env);
+            return 0;
+        }
+
+        static int other_op(void *env) {
+            free(env);
+            return 0;
+        }
+
+        static void *worker(void *arg) {
+            apply_op(arg);
+            other_op(arg);
+            return 0;
+        }
+
+        static void spawn(void) {
+            pthread_t t;
+            pthread_create(&t, 0, worker, 0);
+        }
+    """})
+    allow = tmp_path / "allow.txt"
+    allow.write_text(
+        "N2 fakepkg/native/eng.c#apply_op -- companion free, measured\n"
+        "N3 fakepkg/native/eng.c -- never matches: stale\n")
+    cfg.allowlist_path = str(allow)
+    res = run_analysis(cfg)
+    n2 = [f for f in res.violations if f.rule == "N2"]
+    assert len(n2) == 1 and n2[0].qualname == "other_op"
+    assert len(res.stale_entries) == 1
+    assert res.stale_entries[0].rule == "N3"
+
+
+def test_real_tree_native_findings_behind_allowlist():
+    """The C rules must actually bite on the real engine: the arena
+    machinery's amortized heap use is found (and allowlisted), the
+    nogil walk reaches the apply hot path from BOTH region kinds, and
+    N1 finds zero violations — the nopy discipline is load-bearing."""
+    res = run_analysis(default_config())
+    n2 = [f for f in res.findings if f.rule == "N2"]
+    assert len(n2) >= 4
+    assert {f.qualname for f in n2} >= {"elist_push", "buf_put"}
+    assert any("GIL-released bracket" in f.message for f in n2)
+    assert not [f for f in res.findings if f.rule == "N1"]
+    # the nogil walk reaches the apply hot path from BOTH region kinds
+    import os
+
+    from stellar_core_tpu.analysis import crules
+    cpath = os.path.join(REPO, "stellar_core_tpu", "native", "applyc.c")
+    with open(cpath, encoding="utf-8") as fh:
+        cfacts = crules.CFileFacts("stellar_core_tpu/native/applyc.c",
+                                   fh.read())
+    reached = crules._walk_nogil(cfacts)
+    assert "worker_main" in reached
+    assert "pthread worker entry" in reached["worker_main"][0]
+    assert "apply_tx" in reached and "apply_one_op" in reached
+    # and the engine's guard idiom is seen where it matters
+    assert cfacts.functions["get_entry"].nopy_guard_end is not None
+    assert not [f for f in res.violations if f.rule in
+                ("N1", "N2", "N3", "N4", "A1")]
+
+
+def test_cli_native_flag(tmp_path):
+    """`sctlint --native` is the fast engine-change gate: N rules only,
+    exit 0 on the clean tree."""
+    import subprocess
+    import sys
+    r = subprocess.run(
+        [sys.executable, "-m", "stellar_core_tpu.analysis", "--native"],
+        capture_output=True, text=True, cwd=REPO, timeout=120)
+    assert r.returncode == 0, r.stdout + r.stderr
+    assert "clean" in r.stdout
+
+
+def test_n2_direct_alloc_inside_gil_bracket(tmp_path):
+    """Heap churn written lexically inside a Py_BEGIN/END_ALLOW_THREADS
+    bracket is the hot path even when its host function is no worker
+    entry — N2's direct-bracket scan (N1's twin) must flag it."""
+    cfg = _native_fixture(tmp_path, {"eng.c": """
+        #include <Python.h>
+        #include <stdlib.h>
+
+        static void close_it(void) {
+            char *p;
+            Py_BEGIN_ALLOW_THREADS
+            p = malloc(64);
+            free(p);
+            Py_END_ALLOW_THREADS
+        }
+    """})
+    res = run_analysis(cfg)
+    n2 = [f for f in res.violations if f.rule == "N2"]
+    assert len(n2) == 2
+    assert all(f.qualname == "close_it" for f in n2)
+    assert all("GIL-released bracket" in f.message for f in n2)
+
+
+def test_n4_adjacent_string_concatenation_literal(tmp_path):
+    """C adjacent-string concatenation (`"liab-" "release"`) is one
+    literal to the compiler and must be one to the registry scan."""
+    cfg = _native_fixture(tmp_path, {"eng.c": """
+        typedef struct { int x; } Ctx;
+        static void ctx_bail(Ctx *c, const char *m) { c->x = 1; }
+
+        static void parse(Ctx *c) {
+            ctx_bail(c, "prefetch" "-miss");
+            ctx_bail(c, "mys" "tery");
+        }
+    """})
+    res = run_analysis(cfg)
+    n4 = [f for f in res.violations if f.rule == "N4"]
+    msgs = "\n".join(f.message for f in n4)
+    assert "'mystery' has no row" in msgs
+    assert "prefetch-miss" not in msgs   # concatenated AND registered
+
+
+def test_n4_dynamic_row_does_not_shadow_exact_namespace(tmp_path):
+    """The `op-<type>` dynamic row covers the snprintf-BUILT family
+    only: a new exact `op-foo` literal still needs its own row, and
+    exact rows under the prefix still go stale independently."""
+    src = """
+        typedef struct { int x; char bailbuf[48]; } Ctx;
+        static void ctx_bail(Ctx *c, const char *m) { c->x = 1; }
+
+        static void parse(Ctx *c, int t) {
+            snprintf(c->bailbuf, sizeof(c->bailbuf), "op-%d", t);
+            ctx_bail(c, c->bailbuf);
+            ctx_bail(c, "op-fresh-reason");
+        }
+    """
+    doc = """
+### Native bail taxonomy
+
+| reason | origin | meaning |
+|---|---|---|
+| `op-<type>` | C | dyn family |
+| `op-stale-exact` | C | exact row under the prefix |
+"""
+    cfg = _native_fixture(tmp_path, {"eng.c": src}, obs_doc=doc)
+    res = run_analysis(cfg)
+    n4 = [f for f in res.violations if f.rule == "N4"]
+    msgs = "\n".join(f.message for f in n4)
+    assert "'op-fresh-reason' has no row" in msgs
+    assert "`op-stale-exact` has no ctx_bail" in msgs
+    assert len(n4) == 2, msgs
+
+
+def test_n4_stray_op_define_elsewhere_is_not_the_op_table(tmp_path):
+    """Only the TU hosting the op table (largest OP_* set) is held to
+    full wire coverage — an unrelated OP_-prefixed constant in another
+    file must not demand all op types there."""
+    cfg = _native_fixture(tmp_path, {"eng.c": """
+        #define OP_CREATE_ACCOUNT 0
+        #define OP_PAYMENT 1
+        #define MAX_OPTYPES 4
+        typedef struct { int x; } Ctx;
+        static void parse(Ctx *c) { c->x = 1; }
+    """, "prep.c": """
+        #define OP_NEON 1
+        static int prep(void) { return OP_NEON; }
+    """}, op_types={0: "create-account", 1: "payment"})
+    res = run_analysis(cfg)
+    n4 = [f for f in res.violations if f.rule == "N4"]
+    assert not [f for f in n4 if "prep.c" in f.path], \
+        "\n".join(f.format() for f in n4)
+
+
+def test_unknown_sct_sanitize_value_fails_loudly():
+    """A typo'd SCT_SANITIZE must never silently produce an
+    uninstrumented build (a vacuously clean race check)."""
+    import subprocess
+    import sys
+    r = subprocess.run(
+        [sys.executable, "-c", "import stellar_core_tpu.native"],
+        capture_output=True, text=True, cwd=REPO, timeout=120,
+        env={**os.environ, "SCT_SANITIZE": "tsan"})
+    assert r.returncode != 0
+    assert "not a sanitize mode" in r.stderr

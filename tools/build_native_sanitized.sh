@@ -1,33 +1,45 @@
 #!/usr/bin/env bash
-# Build all three native C extensions (prep / ed25519c / applyc, plus
-# the xdrc serializer) with AddressSanitizer + UndefinedBehaviorSanitizer
-# into stellar_core_tpu/native/build/sanitized/, and print the LD_PRELOAD
-# line needed to run Python against them.
+# Build the native C extensions (prep / ed25519c / applyc, plus the xdrc
+# serializer) with sanitizers and print the LD_PRELOAD line needed to
+# run Python against them.
 #
-#   tools/build_native_sanitized.sh          # build
-#   tools/build_native_sanitized.sh --check  # build + run the native
-#                                            # differential oracles under ASan
+#   tools/build_native_sanitized.sh          # ASan/UBSan build -> build/sanitized/
+#   tools/build_native_sanitized.sh --tsan   # ThreadSanitizer build -> build/tsan/
+#   tools/build_native_sanitized.sh --check  # build BOTH + run the native
+#                                            # differential oracles under
+#                                            # ASan/UBSan AND the
+#                                            # ParallelDiffHarness legs
+#                                            # under TSan
 #
 # The pytest equivalent of --check is the `sanitize` marker:
 #   python -m pytest tests/test_native_sanitized.py -m sanitize
+#
+# ASan and TSan runtimes cannot coexist in one process: each leg is its
+# own build dir (SCT_SANITIZE=1 vs SCT_SANITIZE=thread) and its own
+# python invocation.
 set -euo pipefail
 cd "$(dirname "$0")/.."
 
-LIBASAN="$(cc -print-file-name=libasan.so)"
-if [ ! -e "$LIBASAN" ]; then
-    echo "error: cc has no libasan.so — install gcc's sanitizer runtime" >&2
-    exit 2
-fi
-# libstdc++ must be resolvable when ASan's interceptors initialize, or
-# the first C++ throw (JAX/XLA) dies with "real___cxa_throw != 0"
-PRELOAD="$LIBASAN $(cc -print-file-name=libstdc++.so)"
+MODE="${1:-}"
 
-# build phase needs no preload (the compiler links the runtime); loading
-# the resulting .so does, so the import probes run under LD_PRELOAD.
-# detect_leaks=0: CPython intentionally leaks at exit and would drown
-# real reports.
-SCT_SANITIZE=1 LD_PRELOAD="$PRELOAD" ASAN_OPTIONS=detect_leaks=0 \
-python - <<'EOF'
+LIBSTDCPP="$(cc -print-file-name=libstdc++.so)"
+
+build_asan() {
+    local LIBASAN
+    LIBASAN="$(cc -print-file-name=libasan.so)"
+    if [ ! -e "$LIBASAN" ]; then
+        echo "error: cc has no libasan.so — install gcc's sanitizer runtime" >&2
+        exit 2
+    fi
+    # libstdc++ must be resolvable when ASan's interceptors initialize, or
+    # the first C++ throw (JAX/XLA) dies with "real___cxa_throw != 0"
+    ASAN_PRELOAD="$LIBASAN $LIBSTDCPP"
+    # build phase needs no preload (the compiler links the runtime); loading
+    # the resulting .so does, so the import probes run under LD_PRELOAD.
+    # detect_leaks=0: CPython intentionally leaks at exit and would drown
+    # real reports.
+    SCT_SANITIZE=1 LD_PRELOAD="$ASAN_PRELOAD" ASAN_OPTIONS=detect_leaks=0 \
+    python - <<'EOF'
 from stellar_core_tpu import native
 
 built = {
@@ -41,16 +53,81 @@ for name, ok in built.items():
     print("%-28s %s" % (name, "OK" if ok else "FAILED"))
 if not all(built.values()):
     raise SystemExit(1)
-print("sanitized build dir:", native._BUILD)
+print("ASan/UBSan build dir:", native._BUILD)
 EOF
 
-echo
-echo "run the differential oracles under ASan/UBSan with:"
-echo "  SCT_SANITIZE=1 LD_PRELOAD=\"$PRELOAD\" ASAN_OPTIONS=detect_leaks=0 \\"
-echo "    python -m pytest tests/test_native_prep.py tests/test_native_apply.py tests/test_native_xdr.py -q"
+    echo
+    echo "run the differential oracles under ASan/UBSan with:"
+    echo "  SCT_SANITIZE=1 LD_PRELOAD=\"$ASAN_PRELOAD\" ASAN_OPTIONS=detect_leaks=0 \\"
+    echo "    python -m pytest tests/test_native_prep.py tests/test_native_apply.py tests/test_native_xdr.py -q"
+}
 
-if [ "${1:-}" = "--check" ]; then
-    SCT_SANITIZE=1 LD_PRELOAD="$PRELOAD" ASAN_OPTIONS=detect_leaks=0 \
+build_tsan() {
+    local LIBTSAN
+    LIBTSAN="$(cc -print-file-name=libtsan.so)"
+    if [ ! -e "$LIBTSAN" ]; then
+        echo "error: cc has no libtsan.so — install gcc's sanitizer runtime" >&2
+        exit 2
+    fi
+    TSAN_PRELOAD="$LIBTSAN $LIBSTDCPP"
+    # TSan build runs WITHOUT the preload: a TSan-preloaded python
+    # forking gcc can deadlock in the runtime's fork interceptor. The
+    # .so files land in build/tsan/ (loading them here fails by design);
+    # the run phase preloads libtsan against the cached artifacts.
+    SCT_SANITIZE=thread python - <<'EOF'
+import glob
+import os
+
+from stellar_core_tpu import native
+
+assert native.SANITIZE_MODE == "thread" and native._BUILD.endswith("tsan")
+native.available()
+native.ed25519_native()
+native.apply_engine()
+native._compile_xdr_ext()
+for pat in ("libsctprep-*.so", "libscted25519-*.so",
+            "_sctapply-*.so", "_sctxdr-*.so"):
+    hits = glob.glob(os.path.join(native._BUILD, pat))
+    print("%-24s %s" % (pat, "OK" if hits else "FAILED"))
+    if not hits:
+        raise SystemExit(1)
+print("TSan build dir:", native._BUILD)
+EOF
+
+    echo
+    echo "race-check the GIL-released cluster pool under TSan with:"
+    echo "  SCT_SANITIZE=thread LD_PRELOAD=\"$TSAN_PRELOAD\" TSAN_OPTIONS=halt_on_error=0 \\"
+    echo "    python -m pytest 'tests/test_native_apply.py::test_native_apply_parallel_equality' \\"
+    echo "      'tests/test_native_apply.py::test_native_apply_parallel_seeded' -q"
+}
+
+case "$MODE" in
+--tsan)
+    build_tsan
+    ;;
+--check)
+    build_asan
+    echo
+    build_tsan
+    echo
+    echo "== ASan/UBSan leg: native differential oracles =="
+    SCT_SANITIZE=1 LD_PRELOAD="$ASAN_PRELOAD" ASAN_OPTIONS=detect_leaks=0 \
     python -m pytest tests/test_native_prep.py tests/test_native_apply.py \
         tests/test_native_xdr.py -q -p no:cacheprovider
-fi
+    echo
+    echo "== TSan leg: ParallelDiffHarness (forced-parallel, seeded) =="
+    SCT_SANITIZE=thread LD_PRELOAD="$TSAN_PRELOAD" \
+        TSAN_OPTIONS=halt_on_error=0 \
+    python -m pytest \
+        'tests/test_native_apply.py::test_native_apply_parallel_equality' \
+        'tests/test_native_apply.py::test_native_apply_parallel_seeded' \
+        -q -p no:cacheprovider
+    ;;
+"")
+    build_asan
+    ;;
+*)
+    echo "usage: tools/build_native_sanitized.sh [--tsan|--check]" >&2
+    exit 2
+    ;;
+esac
