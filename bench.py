@@ -717,6 +717,12 @@ def fleet_bench(n_nodes: int = 3, n_ledgers: int = 12) -> dict:
             overlay["tx_latency_ms"]["p50"]
         out["fleet"]["tx_latency_p95_ms"] = \
             overlay["tx_latency_ms"]["p95"]
+    # propagation cockpit (ISSUE 17): relay-tree percentiles + the
+    # redundant bandwidth share that must reconcile with the flood
+    # duplication ratio (validated by bench_compare.validate_propagation)
+    prop = agg.propagation_summary()
+    if prop is not None:
+        out["propagation"] = prop
     sim.stop_all_nodes()
     return out
 

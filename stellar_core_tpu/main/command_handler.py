@@ -373,6 +373,116 @@ class CommandHandler:
             out["status"] = "reset"
         return out
 
+    def cmd_propagation(self, params) -> dict:
+        """Propagation cockpit (ISSUE 17 tentpole;
+        docs/observability.md#propagation-cockpit): causal flood tracing
+        in one JSON blob — per-peer usefulness rankings (first-delivery
+        vs redundant-edge counts, wasted bytes, top-K/bottom-K), hop-
+        ring occupancy, and the fleet-wide redundant bandwidth share.
+        `propagation?hash=H` returns one message's full hop trace (H a
+        unique hash-hex prefix); `?peer=P` one peer's score (P a node-id
+        hex prefix); `?action=reset` zeroes the aggregates (registry
+        metrics keep their monotonic histories). The same data is
+        scrapeable as `sct_overlay_prop_*` series via
+        `metrics?format=prometheus`; the `fleet` field is the compact
+        shape util/fleet.py merges into relay trees."""
+        om = self.app.overlay_manager
+        prop = getattr(om, "prop_stats", None) if om is not None else None
+        if prop is None:
+            return {"error": "propagation stats disabled "
+                             "(PROPAGATION_STATS_ENABLED=false)"}
+        action = params.get("action", "status")
+        if action not in ("status", "reset"):
+            raise CommandParamError(
+                "parameter 'action' must be status|reset, got %r" % action)
+        h = params.get("hash")
+        if h:
+            trace = prop.hash_trace(h)
+            if trace is None:
+                raise CommandParamError(
+                    "no hop record for hash prefix %r" % h)
+            return trace
+        p = params.get("peer")
+        if p:
+            detail = prop.peer_detail(p)
+            if detail is None:
+                raise CommandParamError(
+                    "no usefulness record for peer prefix %r" % p)
+            return detail
+        if action == "reset":
+            prop.reset()
+        out = prop.to_json()
+        out["fleet"] = prop.fleet_json()
+        if action == "reset":
+            out["status"] = "reset"
+        return out
+
+    def cmd_health(self, params) -> dict:
+        """Six-cockpit health rollup (ISSUE 17 satellite;
+        docs/observability.md#propagation-cockpit): the single scrape a
+        fleet operator watches — device breaker states (verify + hash)
+        with their recovery episodes, flood duplication ratio, native
+        apply bails, bucketdb SQL fallbacks, and the worst peer's
+        propagation usefulness — condensed to a coarse
+        `status: ok|degraded|critical`. Degraded = a breaker not
+        closed, SQL-fallback degrades, or the node out of sync;
+        critical = every wired device breaker open."""
+        app = self.app
+        problems: list = []
+        out: dict = {}
+        breakers: dict = {}
+        open_states = []
+        for name, owner in (("verifier",
+                             getattr(app, "sig_verifier", None)),
+                            ("hasher", getattr(app, "batch_hasher", None))):
+            b = getattr(owner, "breaker", None)
+            if b is None:
+                continue
+            j = b.to_json()
+            breakers[name] = {"state": j["state"], "trips": j["trips"],
+                              "recoveries": j["recoveries"]}
+            open_states.append(j["state"])
+            if j["state"] != "closed":
+                problems.append("%s breaker %s" % (name, j["state"]))
+        out["breakers"] = breakers
+        out["recovery_episodes"] = sum(
+            b["recoveries"] for b in breakers.values())
+        st = getattr(app.ledger_manager, "apply_stats", None)
+        out["native_bails"] = sum(
+            getattr(st, "bails", {}).values()) if st is not None else 0
+        bdb = getattr(getattr(app, "bucket_manager", None),
+                      "bucketdb", None)
+        sql = getattr(getattr(bdb, "stats", None), "sql_fallbacks", 0) \
+            if bdb is not None else 0
+        out["bucketdb_sql_fallbacks"] = sql
+        if sql:
+            problems.append("bucketdb degraded to SQL (%d reads)" % sql)
+        om = app.overlay_manager
+        ostats = getattr(om, "stats", None) if om is not None else None
+        if ostats is not None:
+            fl = ostats.to_json()["flood"]
+            out["flood_duplication_ratio"] = fl["duplication_ratio"]
+        prop = getattr(om, "prop_stats", None) if om is not None else None
+        if prop is not None:
+            pj = prop.to_json()
+            out["worst_peer_usefulness"] = \
+                pj["peers"]["worst_usefulness"]
+            out["redundant_bandwidth_share"] = \
+                pj["redundant_bandwidth_share"]
+        synced = app.ledger_manager.is_synced()
+        out["synced"] = synced
+        if not synced:
+            problems.append("ledger out of sync")
+        if open_states and all(s == "open" for s in open_states):
+            status = "critical"
+        elif problems:
+            status = "degraded"
+        else:
+            status = "ok"
+        out["status"] = status
+        out["problems"] = problems
+        return out
+
     def cmd_trace(self, params) -> dict:
         """Span-tracer control + export (ISSUE 2 tentpole):
         `trace?action=status|start|stop|clear|dump|flight`.

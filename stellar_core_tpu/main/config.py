@@ -177,6 +177,11 @@ class Config:
         # per-slot consensus event journal (util/slot_timeline.py):
         # always on; bounds how many recent slots are retained
         self.SLOT_TIMELINE_SLOTS = 64
+        # propagation cockpit (overlay/propagation_stats.py): causal
+        # hop records + per-peer usefulness. On by default; False is the
+        # control leg the flood scenario's overhead guard compares
+        # against (ISSUE 17 acceptance)
+        self.PROPAGATION_STATS_ENABLED = True
         # flight-recorder dump directory ("" = the SCT_FLIGHT_DIR env
         # override, else the system tempdir); dumps fire on unhandled
         # close exceptions and SCP-stall / slow-close watchdog triggers
@@ -235,7 +240,8 @@ class Config:
             "INVARIANT_CHECKS", "WORKER_THREADS",
             "MAX_CONCURRENT_SUBPROCESSES", "SIG_VERIFY_BACKEND",
             "SIG_VERIFY_MAX_BATCH", "TRACE_ENABLED", "TRACE_CAPACITY",
-            "SLOT_TIMELINE_SLOTS", "NODE_NAME",
+            "SLOT_TIMELINE_SLOTS", "PROPAGATION_STATS_ENABLED",
+            "NODE_NAME",
             "FLIGHT_RECORDER_DIR", "CHECKPOINT_FREQUENCY",
             "CATCHUP_COMPLETE", "CATCHUP_RECENT",
             "PEER_TIMEOUT", "PEER_STRAGGLER_TIMEOUT",

@@ -35,31 +35,40 @@ class Floodgate:
         # duplicate receipts feed the flood duplication ratio, broadcast
         # fanout feeds its histogram (installed by OverlayManager)
         self.stats = None
+        # propagation cockpit (ISSUE 17): causal hop records — recv
+        # hops stamped per add_record receipt (first vs redundant edge,
+        # in lockstep with record_flood so the two cockpits reconcile),
+        # send hops per broadcast fanout, origin markers when this node
+        # is the broadcaster (installed by OverlayManager; None = off)
+        self.prop = None
 
     @staticmethod
     def msg_id(msg: StellarMessage) -> bytes:
         return sha256(msg.to_xdr())
 
     def add_record(self, msg: StellarMessage, from_peer_id: str,
-                   ledger_seq: int) -> bool:
+                   ledger_seq: int, from_hex: str = "") -> bool:
         """Note an incoming flooded message; returns False if seen before
-        (reference Floodgate::addRecord)."""
+        (reference Floodgate::addRecord). `from_hex` (sender node-id
+        hex) attributes the receipt as a propagation hop."""
         if self._shutting_down:
             return False
-        h = self.msg_id(msg)
+        raw = msg.to_xdr()
+        h = sha256(raw)
         rec = self._map.get(h)
-        if rec is None:
+        unique = rec is None
+        if unique:
             rec = _FloodRecord(ledger_seq, msg)
             self._map[h] = rec
-            rec.peers_told.add(from_peer_id)
-            if self.stats is not None:
-                self.stats.record_flood(unique=True)
-            return True
+        else:
+            rec.dupes += 1
         rec.peers_told.add(from_peer_id)
-        rec.dupes += 1
         if self.stats is not None:
-            self.stats.record_flood(unique=False)
-        return False
+            self.stats.record_flood(unique=unique)
+        if self.prop is not None and from_hex:
+            self.prop.record_recv_hop(h, from_hex, len(raw), msg.disc,
+                                      unique, ledger_seq)
+        return unique
 
     def broadcast(self, msg: StellarMessage, force: bool, peers: Dict,
                   ledger_seq: int) -> int:
@@ -67,11 +76,16 @@ class Floodgate:
         number sent (reference Floodgate::broadcast, Floodgate.cpp:81-107)."""
         if self._shutting_down:
             return 0
-        h = self.msg_id(msg)
+        raw = msg.to_xdr()
+        h = sha256(raw)
         rec = self._map.get(h)
         if rec is None:
+            # no receipt preceded this broadcast: this node originated
+            # the message — the relay tree's root (ISSUE 17)
             rec = _FloodRecord(ledger_seq, msg)
             self._map[h] = rec
+            if self.prop is not None:
+                self.prop.record_origin(h, len(raw), msg.disc, ledger_seq)
         n = 0
         for pid, peer in list(peers.items()):
             if pid in rec.peers_told:
@@ -79,6 +93,10 @@ class Floodgate:
             peer.send_message(msg)
             rec.peers_told.add(pid)
             n += 1
+            if self.prop is not None and peer.peer_id is not None:
+                self.prop.record_send_hop(
+                    h, peer.peer_id.key_bytes.hex(), len(raw), msg.disc,
+                    ledger_seq)
         if self.stats is not None:
             self.stats.record_broadcast(n)
         return n

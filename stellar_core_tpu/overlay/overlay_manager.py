@@ -45,8 +45,22 @@ class OverlayManager:
             metrics=getattr(app, "metrics", None),
             tracer=getattr(app, "tracer", None),
             now_fn=app.clock.now)
+        # propagation cockpit (ISSUE 17): causal hop records + per-peer
+        # usefulness, fed by the Floodgate (recv/send hops, origins) and
+        # the Peer MAC-layer duplicate branch; None when the operator
+        # runs the propagation-disabled control leg
+        # (docs/observability.md#propagation-cockpit)
+        self.prop_stats = None
+        if getattr(app.config, "PROPAGATION_STATS_ENABLED", True):
+            from .propagation_stats import PropagationStats
+            self.prop_stats = PropagationStats(
+                metrics=getattr(app, "metrics", None),
+                tracer=getattr(app, "tracer", None),
+                now_fn=app.clock.now,
+                self_id=app.config.node_id().key_bytes.hex())
         self.floodgate = Floodgate()
         self.floodgate.stats = self.stats
+        self.floodgate.prop = self.prop_stats
         from .flood_control import FloodControl
         self.flood_control = FloodControl(app)
         # hash-keyed peer registry: id_key (nodeid xdr) -> Peer
@@ -376,8 +390,9 @@ class OverlayManager:
 
     def recv_flooded_msg(self, msg: StellarMessage, peer: Peer) -> bool:
         """Returns False if this flooded message was seen before."""
-        return self.floodgate.add_record(msg, peer.peer_id.to_xdr(),
-                                         self._current_ledger_seq())
+        return self.floodgate.add_record(
+            msg, peer.peer_id.to_xdr(), self._current_ledger_seq(),
+            from_hex=peer.peer_id.key_bytes.hex())
 
     def broadcast_message(self, msg: StellarMessage,
                           force: bool = False) -> int:
@@ -395,6 +410,10 @@ class OverlayManager:
         # per-slot bandwidth attribution: bytes moved since the previous
         # close belong to this slot (fleet view sums them across nodes)
         self.stats.slot_closed(ledger_seq)
+        if self.prop_stats is not None:
+            # prune propagation hop rings below the checkpoint window
+            # (ISSUE 17 satellite: explicit memory bound)
+            self.prop_stats.slot_closed(ledger_seq)
         self.floodgate.clear_below(ledger_seq)
         self.flood_control.ledger_closed()
         self.tx_set_fetcher.stop_fetching_below(ledger_seq)

@@ -69,6 +69,11 @@ class Peer:
         # wire cockpit (ISSUE 10): per-message-type byte accounting on
         # both directions (docs/observability.md#overlay-cockpit)
         self._stats = getattr(overlay, "stats", None)
+        # propagation cockpit (ISSUE 17): MAC-layer duplicate frames of
+        # flooded types are redundant edges too — recorded here so
+        # injected transport duplicates land in the same edge class the
+        # Floodgate attributes (docs/observability.md#propagation-cockpit)
+        self._prop = getattr(overlay, "prop_stats", None)
         # the last authenticated frame, for MAC-layer duplicate
         # detection (ChaosTransport overlay.duplicate injection)
         self._last_frame_seq: Optional[int] = None
@@ -212,10 +217,22 @@ class Peer:
                 if v0.sequence == self._last_frame_seq and \
                         v0.mac == self._last_frame_mac and \
                         hmac_sha256_verify(self.recv_mac_key, data, v0.mac):
+                    flooded = t in (MessageType.TRANSACTION,
+                                    MessageType.SCP_MESSAGE)
                     if self._stats is not None:
                         self._stats.record_duplicate_frame(
-                            t, flooded=t in (MessageType.TRANSACTION,
-                                             MessageType.SCP_MESSAGE))
+                            t, flooded=flooded)
+                    if self._prop is not None and flooded and \
+                            self.peer_id is not None:
+                        # the duplicate never reaches the Floodgate (the
+                        # frame is dropped here), so stamp its redundant
+                        # edge directly — wasted bytes attributed to the
+                        # replaying peer
+                        raw_msg = msg.to_xdr()
+                        self._prop.record_recv_hop(
+                            sha256(raw_msg), self.peer_id.key_bytes.hex(),
+                            len(raw_msg), t, False,
+                            self.app.ledger_manager.last_closed_ledger_num())
                     return
                 self.drop("unexpected MAC/sequence",
                           send_error=ErrorCode.ERR_AUTH)

@@ -346,7 +346,7 @@ def run_flood(seed: int, scale: str, workdir: str) -> dict:
     slots = 6 if scale == "tier1" else 20
     burst_msgs = 60 if scale == "tier1" else 200
 
-    def leg(flood_on: bool) -> dict:
+    def leg(flood_on: bool, prop_on: bool = True) -> dict:
         rnd.reseed(seed)
         _clear_verify_cache()
         sim = Simulation(Simulation.OVER_PEERS)
@@ -369,6 +369,10 @@ def run_flood(seed: int, scale: str, workdir: str) -> dict:
             # closes would make EVERY peer look like a flooder
             cfg.ARTIFICIALLY_ACCELERATE_TIME_FOR_TESTING = False
             cfg.EXPECTED_LEDGER_CLOSE_TIME = 1.0
+            # the propagation-disabled control leg measures the hop-
+            # recording overhead (ISSUE 17 acceptance: close-latency
+            # p95 within 5% of this leg)
+            cfg.PROPAGATION_STATS_ENABLED = prop_on
         honest = [sim.add_node(k, qset, name="h%d" % i, cfg_tweak=tweak)
                   for i, k in enumerate(hkeys)]
         flooder = sim.add_node(fkey, qset, name="adv", cfg_tweak=tweak)
@@ -447,25 +451,51 @@ def run_flood(seed: int, scale: str, workdir: str) -> dict:
             agg.add_app(n.name, n.app)
         fleet = _fleet_block(agg)
         overlay = agg.overlay_breakdown()
+        propagation = agg.propagation_summary()
         sim.stop_all_nodes()
         return {"fleet": fleet, "flood": flood_stats,
-                "overlay_breakdown": overlay}
+                "overlay_breakdown": overlay,
+                "propagation": propagation}
 
     off = leg(False)
     on = leg(True)
+    # propagation-disabled control: same flood, hop recording off —
+    # the ISSUE 17 overhead guard compares honest slot p95 against it
+    ctrl = leg(True, prop_on=False)
+    assert ctrl["propagation"] is None, \
+        "control leg still recorded propagation hops"
     p95_off = max(off["fleet"]["slot_latency_p95_ms"], 0.001)
     ratio = round(on["fleet"]["slot_latency_p95_ms"] / p95_off, 3)
+    p95_ctrl = max(ctrl["fleet"]["slot_latency_p95_ms"], 0.001)
+    prop_overhead = round(on["fleet"]["slot_latency_p95_ms"] / p95_ctrl, 3)
     source = "bench.py --scenario flood"
     records = _common_records("flood", on["fleet"], source)
     records.append(_record("scenario_flood_latency_ratio", "x", ratio,
                            "scenario-flood", "lower", source))
+    records.append(_record("scenario_flood_prop_overhead_ratio", "x",
+                           prop_overhead, "scenario-flood", "lower",
+                           source))
     # wire-cockpit gates (ISSUE 10): flood duplication ratio + honest
     # tx latency under flood
     records.extend(_overlay_records("flood", on["overlay_breakdown"],
                                     source))
+    # propagation cockpit gates (ISSUE 17): hop latency, tree depth,
+    # redundant bandwidth share — and the cross-cockpit reconciliation
+    # (duplicates/firsts over merged hop records IS the flood
+    # duplication ratio; both cockpits count at Floodgate.add_record)
+    bc = _bench_compare()
+    records.extend(bc.propagation_records(
+        on["propagation"], "scenario-flood", source))
+    errs = bc.validate_propagation(
+        on["propagation"], where="flood",
+        flood=(on["overlay_breakdown"] or {}).get("flood"))
+    assert not errs, "propagation block failed validation: %r" % errs
     assert on["overlay_breakdown"] is not None
     assert on["overlay_breakdown"]["flood"]["unique"] > 0
     assert on["overlay_breakdown"]["tx_latency_ms"]["count"] >= 3
+    assert on["propagation"] is not None
+    assert on["propagation"]["trees"] > 0
+    assert on["propagation"]["redundant_bandwidth_share"] > 0
     return {
         "metric": "scenario_flood", "unit": "ms",
         "value": on["fleet"]["slot_latency_p95_ms"],
@@ -481,11 +511,14 @@ def run_flood(seed: int, scale: str, workdir: str) -> dict:
             "bans": on["flood"]["bans"],
             "junk_sent": on["flood"]["junk_sent"],
             "p95_ratio_on_vs_off": ratio,
+            "prop_overhead_ratio": prop_overhead,
         },
         "fleet": on["fleet"],
         "baseline_fleet": off["fleet"],
+        "control_fleet": ctrl["fleet"],
         "overlay_breakdown": on["overlay_breakdown"],
         "baseline_overlay_breakdown": off["overlay_breakdown"],
+        "propagation": on["propagation"],
         "records": records,
     }
 

@@ -73,6 +73,9 @@ class FleetAggregator:
                 if ostats is not None else None,
                 "tx": lc.fleet_json() if lc is not None else None,
             }
+        # propagation cockpit (ISSUE 17): per-node causal hop records,
+        # merged by msg_hash into relay trees below
+        prop = getattr(om, "prop_stats", None)
         self.nodes.append({
             "name": name,
             "node_id": app.config.node_id().key_bytes.hex(),
@@ -80,6 +83,7 @@ class FleetAggregator:
             "timeline": app.slot_timeline.to_json(),
             "survey": survey,
             "overlay": overlay,
+            "propagation": prop.fleet_json() if prop is not None else None,
         })
 
     def add_http(self, base_url: str, name: Optional[str] = None,
@@ -113,6 +117,7 @@ class FleetAggregator:
             # same compact shape as add_app stores (the endpoint carries
             # it under "fleet" precisely for this intake path)
             "overlay": (get("/overlaystats") or {}).get("fleet"),
+            "propagation": (get("/propagation") or {}).get("fleet"),
         })
 
     # -- cross-host alignment ------------------------------------------------
@@ -154,6 +159,11 @@ class FleetAggregator:
             for ev in trace.get("traceEvents", ()):
                 if "ts" in ev:
                     ev["ts"] -= off * 1e6
+            # propagation hop stamps ride the same per-node epoch
+            prop = node.get("propagation") or {}
+            for rec in (prop.get("hashes") or {}).values():
+                for hop in rec.get("hops", ()):
+                    hop["pc"] -= off
         return True
 
     # -- name resolution -----------------------------------------------------
@@ -201,6 +211,31 @@ class FleetAggregator:
                         "cat": "slot", "ph": "i", "s": "t",
                         "ts": round(ev["pc"] * 1e6, 1),
                         "pid": i, "tid": 0, "args": args})
+        # propagation flow events (ISSUE 17): every reconstructed
+        # first-delivery edge becomes a Chrome flow arrow from the
+        # sender's lane to the receiver's — one envelope's fan-out reads
+        # as connected arrows across node lanes in Perfetto
+        pid_of = {node["name"]: i for i, node in enumerate(self.nodes)}
+        flow_id = 1
+        for hh, tree in sorted(self.propagation_trees().items()):
+            for e in tree["first_edges"]:
+                if e["latency_s"] is None:
+                    continue
+                fp, tp = pid_of.get(e["from"]), pid_of.get(e["to"])
+                if fp is None or tp is None:
+                    continue
+                args = {"hash": hh[:16], "slot": tree["ledger_seq"],
+                        "from": e["from"], "to": e["to"]}
+                name = "prop.%s" % tree["type"]
+                events.append({
+                    "name": name, "cat": "prop", "ph": "s", "id": flow_id,
+                    "pid": fp, "tid": 0, "args": args,
+                    "ts": round((e["pc"] - e["latency_s"]) * 1e6, 1)})
+                events.append({
+                    "name": name, "cat": "prop", "ph": "f", "bp": "e",
+                    "id": flow_id, "pid": tp, "tid": 0, "args": args,
+                    "ts": round(e["pc"] * 1e6, 1)})
+                flow_id += 1
         return {"traceEvents": events, "displayTimeUnit": "ms",
                 "dropped_spans": dropped,
                 "nodes": [n["name"] for n in self.nodes]}
@@ -300,6 +335,32 @@ class FleetAggregator:
                                   "recv_msgs": 0, "send_msgs": 0})
                 for k in bw:
                     bw[k] += delta.get(k, 0)
+        # per-slot propagation percentiles (ISSUE 17): hop records stamp
+        # the LCL at receipt, so messages flooding slot N carry seq N-1
+        prop = self.propagation_summary()
+        if prop is not None:
+            by_ledger: Dict[int, List[dict]] = {}
+            for tree in self.propagation_trees().values():
+                by_ledger.setdefault(
+                    int(tree["ledger_seq"]), []).append(tree)
+            for seq, ts_list in by_ledger.items():
+                entry = slots.get(str(seq + 1))
+                if entry is None:
+                    continue
+                lat = [e["latency_s"] for t in ts_list
+                       for e in t["first_edges"]
+                       if e["latency_s"] is not None]
+                b = sum(t["bytes"] for t in ts_list)
+                w = sum(t["wasted_bytes"] for t in ts_list)
+                entry["propagation"] = {
+                    "trees": len(ts_list),
+                    "hop_latency_p50_ms": round(
+                        _percentile(lat, 0.50) * 1e3, 3),
+                    "hop_latency_p95_ms": round(
+                        _percentile(lat, 0.95) * 1e3, 3),
+                    "depth_max": max(t["depth"] for t in ts_list),
+                    "redundant_share": round(w / b, 4) if b else 0.0,
+                }
         out = {
             "nodes": [n["name"] for n in self.nodes],
             "slots": slots,
@@ -328,7 +389,174 @@ class FleetAggregator:
                 ob["flood"]["duplication_ratio"]
             out["summary"]["tx_latency_p50_ms"] = ob["tx_latency_ms"]["p50"]
             out["summary"]["tx_latency_p95_ms"] = ob["tx_latency_ms"]["p95"]
+        if prop is not None:
+            out["propagation"] = prop
+            out["summary"]["hop_latency_p95_ms"] = \
+                prop["hop_latency_p95_ms"]
+            out["summary"]["redundant_bandwidth_share"] = \
+                prop["redundant_bandwidth_share"]
         return out
+
+    # -- propagation trees (ISSUE 17) ----------------------------------------
+    MIN_USEFULNESS_SAMPLES = 4
+
+    def propagation_trees(self) -> Dict[str, dict]:
+        """Merge every node's causal hop records by msg_hash into relay
+        trees: the origin node (the broadcaster), the first-delivery
+        spanning tree (each node's parent = the peer that delivered the
+        message first), per-edge hop latency (child first-delivery `pc`
+        minus the parent's own first-delivery/origin `pc` — rebase the
+        fleet first against live hosts), and the redundant-edge overlay
+        (every duplicate receipt, with its wasted bytes). Keys are hash
+        hex; `spanning` is True when every receiving node is reachable
+        from the origin over first edges."""
+        id2name = self._id_to_name()
+        merged: Dict[str, dict] = {}
+        for node in self.nodes:
+            prop = node.get("propagation")
+            if not prop:
+                continue
+            for hh, rec in (prop.get("hashes") or {}).items():
+                m = merged.setdefault(hh, {
+                    "per_node": {}, "type": rec.get("type"),
+                    "ledger_seq": rec.get("ledger_seq", 0)})
+                m["per_node"][node["name"]] = rec
+        trees: Dict[str, dict] = {}
+        for hh, m in merged.items():
+            origin = None
+            origin_pc = None
+            first_pc: Dict[str, float] = {}
+            first_parent: Dict[str, str] = {}
+            red_edges: List[dict] = []
+            firsts = dupes = 0
+            bytes_total = wasted = 0
+            for name, rec in m["per_node"].items():
+                if rec.get("origin"):
+                    origin = name
+                for hop in rec.get("hops", ()):
+                    d = hop.get("dir")
+                    if d == "origin":
+                        origin_pc = hop["pc"]
+                    elif d == "recv":
+                        src = id2name.get(hop.get("peer"),
+                                          (hop.get("peer") or "?")[:8])
+                        bytes_total += hop.get("bytes", 0)
+                        if hop.get("first"):
+                            firsts += 1
+                            if name not in first_pc or \
+                                    hop["pc"] < first_pc[name]:
+                                first_pc[name] = hop["pc"]
+                                first_parent[name] = src
+                        else:
+                            dupes += 1
+                            wasted += hop.get("bytes", 0)
+                            red_edges.append({
+                                "from": src, "to": name,
+                                "bytes": hop.get("bytes", 0)})
+            first_edges = []
+            for name in sorted(first_pc):
+                parent = first_parent[name]
+                ppc = origin_pc if parent == origin \
+                    else first_pc.get(parent)
+                first_edges.append({
+                    "from": parent, "to": name,
+                    "pc": first_pc[name],
+                    "latency_s": (round(first_pc[name] - ppc, 9)
+                                  if ppc is not None else None)})
+            # BFS from the origin over first edges: per-node depth; the
+            # tree depth IS the root's eccentricity
+            children: Dict[str, list] = {}
+            for e in first_edges:
+                children.setdefault(e["from"], []).append(e["to"])
+            depths = {origin: 0} if origin is not None else {}
+            frontier = [origin] if origin is not None else []
+            while frontier:
+                nxt = []
+                for p in frontier:
+                    for c in children.get(p, ()):
+                        if c not in depths:
+                            depths[c] = depths[p] + 1
+                            nxt.append(c)
+                frontier = nxt
+            depth = max(depths.values()) if depths else 0
+            trees[hh] = {
+                "type": m["type"], "ledger_seq": m["ledger_seq"],
+                "origin": origin,
+                "nodes": len(m["per_node"]),
+                "firsts": firsts, "duplicates": dupes,
+                "bytes": bytes_total, "wasted_bytes": wasted,
+                "first_edges": first_edges,
+                "redundant_edges": red_edges,
+                "depth": depth,
+                "spanning": origin is not None and
+                len(depths) == len(first_pc) + 1,
+            }
+        return trees
+
+    def propagation_summary(self) -> Optional[dict]:
+        """Fleet-wide `propagation` block for bench/scenario artifacts
+        (normalized by tools/bench_compare.py): hop-latency and
+        tree-depth percentiles over the reconstructed trees, the
+        redundant bandwidth share (wasted bytes / all flooded bytes —
+        must reconcile with the flood duplication ratio), and the
+        merged per-peer usefulness ranking whose bottom entries are the
+        structured relay's first candidates to stop listening to. None
+        when no node exported propagation data."""
+        trees = self.propagation_trees()
+        peers: Dict[str, dict] = {}
+        flood_bytes = wasted_total = 0
+        firsts_total = dupes_total = 0
+        any_data = False
+        for node in self.nodes:
+            prop = node.get("propagation")
+            if not prop:
+                continue
+            any_data = True
+            t = prop.get("totals") or {}
+            flood_bytes += t.get("flood_bytes", 0)
+            wasted_total += t.get("wasted_bytes", 0)
+            firsts_total += t.get("firsts", 0)
+            dupes_total += t.get("duplicates", 0)
+            for pid, s in (prop.get("peers") or {}).items():
+                p = peers.setdefault(pid, {"firsts": 0, "duplicates": 0,
+                                           "wasted_bytes": 0})
+                for k in p:
+                    p[k] += s.get(k, 0)
+        if not any_data:
+            return None
+        id2name = self._id_to_name()
+        ranked = []
+        for pid, s in peers.items():
+            n = s["firsts"] + s["duplicates"]
+            ranked.append({
+                "peer": id2name.get(pid, pid[:8]),
+                "firsts": s["firsts"], "duplicates": s["duplicates"],
+                "wasted_bytes": s["wasted_bytes"], "deliveries": n,
+                "usefulness": round(s["firsts"] / n, 4) if n else 1.0})
+        ranked.sort(key=lambda e: (-e["usefulness"], e["peer"]))
+        scored = [e["usefulness"] for e in ranked
+                  if e["deliveries"] >= self.MIN_USEFULNESS_SAMPLES]
+        lat = [e["latency_s"] for t in trees.values()
+               for e in t["first_edges"] if e["latency_s"] is not None]
+        depths = [float(t["depth"]) for t in trees.values()
+                  if t["origin"] is not None]
+        return {
+            "trees": len(trees),
+            "hop_latency_p50_ms": round(_percentile(lat, 0.50) * 1e3, 3),
+            "hop_latency_p95_ms": round(_percentile(lat, 0.95) * 1e3, 3),
+            "tree_depth_p95": round(_percentile(depths, 0.95), 3),
+            "firsts": firsts_total,
+            "duplicates": dupes_total,
+            "flood_bytes": flood_bytes,
+            "wasted_bytes": wasted_total,
+            "redundant_bandwidth_share": round(
+                wasted_total / flood_bytes, 4) if flood_bytes else 0.0,
+            "peers": {
+                "worst_usefulness": (round(min(scored), 4)
+                                     if scored else None),
+                "bottom": ranked[-8:][::-1],
+            },
+        }
 
     # -- overlay breakdown (ISSUE 10) ----------------------------------------
     def overlay_breakdown(self) -> Optional[dict]:

@@ -272,6 +272,43 @@ def test_prometheus_endpoint_serves_whole_registry(fleet_sim):
     assert all(n.startswith("sct_crypto_") for n in s2)
 
 
+def test_prometheus_exposition_is_fully_typed_and_helped(fleet_sim):
+    """0.0.4 compliance satellite (ISSUE 17): every emitted series
+    carries a `# TYPE` line with a `# HELP` line for the same series —
+    no orphan samples — and the propagation cockpit's dynamic
+    `overlay.prop.*` names ride along like every eagerly-registered
+    metric."""
+    app = next(iter(fleet_sim.nodes.values())).app
+    st, body = app.command_handler.handle_command(
+        "metrics", {"format": "prometheus"})
+    assert st == 200
+    lines = body.splitlines()
+    helped = {l.split()[2] for l in lines if l.startswith("# HELP ")}
+    typed = {l.split()[2] for l in lines if l.startswith("# TYPE ")}
+    assert typed == helped, typed ^ helped
+    samples, types = parse_exposition(body)
+    for name in samples:
+        if name in types:
+            assert types[name] in ("counter", "gauge", "summary"), name
+            continue
+        # _count/_sum are implicit members of their summary family
+        base = next((name[:-len(s)] for s in ("_count", "_sum")
+                     if name.endswith(s)), name)
+        assert types.get(base) == "summary", \
+            "sample series %s has no # TYPE" % name
+    # counters end in _total per the exposition-format convention
+    for name, t in types.items():
+        if t == "counter":
+            assert name.endswith("_total"), name
+    prop = {n for n in samples if n.startswith("sct_overlay_prop_")}
+    assert {"sct_overlay_prop_edge_first_total",
+            "sct_overlay_prop_edge_duplicate_total",
+            "sct_overlay_prop_wasted_bytes",
+            "sct_overlay_prop_pruned_total",
+            "sct_overlay_prop_hashes",
+            "sct_overlay_prop_usefulness_worst"} <= prop
+
+
 def test_prometheus_name_mangling_rules():
     assert prometheus_name("ledger.ledger.close") == \
         "sct_ledger_ledger_close"

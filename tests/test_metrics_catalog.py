@@ -76,7 +76,15 @@ def test_call_site_scan_finds_the_known_core_metrics():
                      "overlay.send-queue.depth",
                      "herder.tx.latency.%s",
                      "herder.tx.latency.total",
-                     "herder.tx.outcome.%s"):
+                     "herder.tx.outcome.%s",
+                     # ISSUE 17 propagation cockpit: the dynamic
+                     # per-edge-class meters plus the fixed ring/score
+                     # gauges must stay under the drift guard
+                     "overlay.prop.edge.%s",
+                     "overlay.prop.wasted-bytes",
+                     "overlay.prop.pruned",
+                     "overlay.prop.hashes",
+                     "overlay.prop.usefulness.worst"):
         assert expected in names
 
 

@@ -908,6 +908,12 @@ def test_cluster_fail_fault_degrades_to_serial():
     h = DiffHarness()
     h.native.app.faults = FaultInjector(seed=1)
     h.native.app.faults.configure("apply.cluster-fail", probability=1.0)
+    # pin the pool width: auto sizing is min(16, cpu_count), so on a
+    # 1-core host the close would never attempt parallel and the fault
+    # would have nothing to degrade (instance attr — the class-level
+    # config is shared with the python side)
+    h.native.app.config = _StubConfig()
+    h.native.app.config.NATIVE_PARALLEL_WORKERS = 4
     root = h.account(root_secret_key())
     pairs = [(h.account(SecretKey.from_seed(sha256(b"cfA%d" % i))),
               h.account(SecretKey.from_seed(sha256(b"cfB%d" % i))))

@@ -1,0 +1,445 @@
+"""Propagation cockpit (ISSUE 17): causal flood tracing, relay-tree
+reconstruction, and per-peer usefulness scoring.
+
+Covers the tentpole acceptance criteria — hop records stamped in
+lockstep with Floodgate dedup (so firsts/duplicates reconcile with the
+flood duplication ratio), bounded per-hash hop rings with checkpoint
+pruning (the 200-slot soak satellite), the per-hash relay-tree
+invariants over a seeded 5-node OVER_PEERS net (exactly one origin,
+firsts form a spanning tree, edges = firsts + duplicates), ChaosTransport
+fault injection landing in the redundant edge class, Chrome-trace flow
+events, and the admin `propagation` / `health` endpoints.
+"""
+
+import json
+import os
+import sys
+import threading
+import urllib.request
+
+import pytest
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+if REPO not in sys.path:
+    sys.path.insert(0, REPO)
+
+from stellar_core_tpu.crypto.hashing import sha256
+from stellar_core_tpu.crypto.keys import SecretKey
+from stellar_core_tpu.overlay.floodgate import Floodgate
+from stellar_core_tpu.overlay.propagation_stats import PropagationStats
+from stellar_core_tpu.simulation.simulation import Simulation
+from stellar_core_tpu.xdr import MessageType, SCPQuorumSet, StellarMessage
+
+
+def _clock():
+    t = [0.0]
+
+    def now():
+        return t[0]
+    now.advance = lambda dt: t.__setitem__(0, t[0] + dt)
+    return now
+
+
+def _h(i):
+    return sha256(b"prop-test-%d" % i)
+
+
+def _peer_sim(n, threshold, cfg_tweak=None, chaos=False):
+    sim = Simulation(Simulation.OVER_PEERS)
+    keys = [SecretKey.from_seed(bytes([70 + i]) * 32) for i in range(n)]
+    qset = SCPQuorumSet(threshold=threshold,
+                        validators=[k.public_key for k in keys],
+                        innerSets=[])
+    names = [sim.add_node(k, qset, name="p%d" % i,
+                          cfg_tweak=cfg_tweak).name
+             for i, k in enumerate(keys)]
+    for i in range(n):
+        for j in range(i + 1, n):
+            sim.connect_peers(names[i], names[j], chaos=chaos)
+    return sim, names
+
+
+def _tweak(cfg):
+    cfg.DATABASE = "sqlite3://:memory:"
+
+
+# ---------------------------------------------------------------- unit layer
+
+def test_floodgate_stamps_hops_in_lockstep_with_dedup():
+    """Every Floodgate.add_record receipt produces exactly one recv hop
+    with the same first/duplicate classification the flood dedup
+    counted — the invariant the cross-cockpit reconciliation gate in
+    tools/bench_compare.py validate_propagation rests on."""
+    fg = Floodgate()
+    prop = PropagationStats(self_id="ff" * 32)   # private registry
+    fg.prop = prop
+    msg = StellarMessage(MessageType.GET_SCP_STATE, 9)
+    assert fg.add_record(msg, "peer-a", 5, from_hex="aa" * 32) is True
+    assert fg.add_record(msg, "peer-b", 5, from_hex="bb" * 32) is False
+    assert fg.add_record(msg, "peer-c", 5, from_hex="cc" * 32) is False
+    assert prop.totals["firsts"] == 1
+    assert prop.totals["duplicates"] == 2
+    assert prop.totals["wasted_bytes"] == 2 * len(msg.to_xdr())
+    trace = prop.hash_trace(Floodgate.msg_id(msg).hex()[:12])
+    assert trace is not None and trace["type"] == "get-scp-state"
+    recv = [h for h in trace["hops"] if h["dir"] == "recv"]
+    assert [h["first"] for h in recv] == [True, False, False]
+    assert recv[0]["peer"] == "aa" * 32
+    # the duplicate bytes are attributed to their senders
+    assert prop.peer_detail("bb")["duplicates"] == 1
+    assert prop.peer_detail("aa")["usefulness"] == 1.0
+
+
+def test_floodgate_broadcast_records_origin_and_send_hops():
+    """A broadcast with no prior receipt marks this node as the relay
+    tree's root and stamps one send hop per peer actually sent."""
+
+    class _FakePeer:
+        def __init__(self, hexid):
+            self.peer_id = SecretKey.from_seed(bytes.fromhex(hexid)
+                                               ).public_key
+            self.sent = []
+
+        def send_message(self, m):
+            self.sent.append(m)
+
+    fg = Floodgate()
+    prop = PropagationStats(self_id="0" * 64)
+    fg.prop = prop
+    msg = StellarMessage(MessageType.GET_SCP_STATE, 4)
+    peers = {"a": _FakePeer("11" * 32), "b": _FakePeer("22" * 32)}
+    assert fg.broadcast(msg, False, peers, 7) == 2
+    trace = prop.hash_trace(Floodgate.msg_id(msg).hex())
+    assert trace["origin"] is True
+    dirs = [h["dir"] for h in trace["hops"]]
+    assert dirs.count("origin") == 1 and dirs.count("send") == 2
+    # re-broadcast: everyone already told, no new hops
+    assert fg.broadcast(msg, False, peers, 7) == 0
+    assert len(prop.hash_trace(Floodgate.msg_id(msg).hex())["hops"]) == 3
+
+
+def test_hop_ring_and_hash_lru_are_bounded():
+    prop = PropagationStats()
+    prop.MAX_HOPS_PER_HASH = 8
+    prop.MAX_HASHES = 16
+    for i in range(prop.MAX_HOPS_PER_HASH + 5):
+        prop.record_recv_hop(_h(0), "%02x" % i * 32, 10,
+                             MessageType.GET_SCP_STATE, i == 0, 1)
+    trace = prop.hash_trace(_h(0).hex())
+    assert len(trace["hops"]) == prop.MAX_HOPS_PER_HASH
+    assert prop.totals["dropped_hops"] == 5
+    # totals still count every receipt even when the ring is full
+    assert prop.totals["firsts"] + prop.totals["duplicates"] == 13
+    for i in range(1, prop.MAX_HASHES + 10):
+        prop.record_recv_hop(_h(i), "aa" * 32, 10,
+                             MessageType.GET_SCP_STATE, True, 1)
+    assert prop.to_json()["hashes"]["tracked"] == prop.MAX_HASHES
+    # LRU: the oldest record (hash 0) was evicted, the newest kept
+    assert prop.hash_trace(_h(0).hex()) is None
+    assert prop.hash_trace(_h(prop.MAX_HASHES + 9).hex()) is not None
+
+
+def test_usefulness_ranking_min_samples_and_reset():
+    clk = _clock()
+    prop = PropagationStats(now_fn=clk)
+    # good: 4 firsts; bad: 1 first + 3 duplicates; thin: 1 first only
+    for i in range(4):
+        prop.record_recv_hop(_h(i), "aa" * 32, 10,
+                             MessageType.GET_SCP_STATE, True, 1)
+    prop.record_recv_hop(_h(4), "bb" * 32, 10,
+                         MessageType.GET_SCP_STATE, True, 1)
+    for i in range(3):
+        prop.record_recv_hop(_h(i), "bb" * 32, 10,
+                             MessageType.GET_SCP_STATE, False, 1)
+    prop.record_recv_hop(_h(5), "cc" * 32, 10,
+                         MessageType.GET_SCP_STATE, True, 1)
+    blob = prop.to_json()
+    assert blob["peers"]["top"][0]["peer"] == "aa" * 32
+    assert blob["peers"]["bottom"][0]["peer"] == "bb" * 32
+    assert blob["peers"]["bottom"][0]["usefulness"] == 0.25
+    # the thin peer (1 delivery < MIN_SAMPLES) never drives the worst
+    # gauge, so one quiet new peer can't page anyone
+    assert blob["peers"]["worst_usefulness"] == 0.25
+    assert blob["redundant_bandwidth_share"] == pytest.approx(30 / 90, 1e-3)
+    before = prop.metrics.to_json()["overlay.prop.edge.first"]["count"]
+    prop.reset()
+    empty = prop.to_json()
+    assert empty["totals"]["firsts"] == 0
+    assert empty["peers"]["tracked"] == 0
+    assert empty["hashes"]["tracked"] == 0
+    # registry metrics stay monotonic across reset
+    assert prop.metrics.to_json()[
+        "overlay.prop.edge.first"]["count"] == before
+
+
+def test_prune_soak_200_slot_flood_never_exceeds_cap():
+    """ISSUE 17 satellite: under a 200-slot flood the per-hash ring
+    stays bounded — `slot_closed` prunes records below the checkpoint
+    window (history/checkpoints.py, freq 64), metered as
+    `overlay.prop.pruned`, with `overlay.prop.hashes` tracking depth —
+    and the LRU cap holds regardless."""
+    from stellar_core_tpu.history.checkpoints import (
+        checkpoint_containing, first_in_checkpoint,
+    )
+    clk = _clock()
+    prop = PropagationStats(now_fn=clk)
+    prop.MAX_HASHES = 64
+    per_slot = 5
+    for seq in range(1, 201):
+        for i in range(per_slot):
+            prop.record_recv_hop(_h(seq * 1000 + i), "aa" * 32, 100,
+                                 MessageType.GET_SCP_STATE, True, seq)
+        prop.slot_closed(seq)
+        assert prop.to_json()["hashes"]["tracked"] <= prop.MAX_HASHES
+        clk.advance(1.0)
+    m = prop.metrics.to_json()
+    assert m["overlay.prop.pruned"]["count"] > 0
+    assert m["overlay.prop.hashes"]["value"] <= prop.MAX_HASHES
+    assert prop.totals["pruned"] > 0
+    # everything below the live checkpoint window is gone
+    cutoff = first_in_checkpoint(checkpoint_containing(200))
+    live = [rec["ledger_seq"]
+            for rec in prop.fleet_json()["hashes"].values()]
+    assert live and min(live) >= cutoff
+
+
+# ----------------------------------------------------- 5-node relay trees
+
+@pytest.fixture(scope="module")
+def tree_sim():
+    sim, names = _peer_sim(5, 3, cfg_tweak=_tweak)
+    sim.start_all_nodes()
+    assert sim.crank_until(lambda: sim.have_all_externalized(5), 200000)
+    yield sim, names
+    sim.stop_all_nodes()
+
+
+def test_relay_tree_invariants_over_5node_net(tree_sim):
+    """Acceptance: per-hash merged trees have exactly one origin, the
+    first deliveries form a spanning tree rooted there, and the edge
+    split is exactly firsts + duplicates."""
+    sim, names = tree_sim
+    agg = sim.fleet()
+    trees = agg.propagation_trees()
+    assert trees, "no propagation trees reconstructed"
+    # exactly one origin per hash, straight from the per-node exports
+    origins = {}
+    for node in agg.nodes:
+        for hx, rec in (node["propagation"]["hashes"] or {}).items():
+            if rec["origin"]:
+                origins.setdefault(hx, []).append(node["name"])
+    for hx, tree in trees.items():
+        assert len(origins.get(hx, [])) == 1, \
+            "hash %s has %r origins" % (hx[:16], origins.get(hx))
+        assert tree["origin"] == origins[hx][0]
+        assert len(tree["first_edges"]) == tree["firsts"]
+        assert len(tree["redundant_edges"]) == tree["duplicates"]
+        assert tree["spanning"], \
+            "firsts of %s do not span its receivers" % hx[:16]
+        assert 1 <= tree["depth"] <= len(names) - 1
+        for e in tree["first_edges"] + tree["redundant_edges"]:
+            assert e["from"] != e["to"]
+
+
+def test_reconstructed_share_reconciles_with_flood_ratio(tree_sim):
+    """The redundant-edge share rebuilt from hop records must agree
+    with the wire cockpit's independently-counted flood duplication
+    ratio — both count the same Floodgate.add_record receipts."""
+    sim, _names = tree_sim
+    agg = sim.fleet()
+    summary = agg.propagation_summary()
+    assert summary is not None and summary["trees"] > 0
+    ob = agg.overlay_breakdown()
+    ratio = ob["flood"]["duplication_ratio"]
+    derived = summary["duplicates"] / summary["firsts"]
+    assert derived == pytest.approx(ratio, rel=0.10)
+    share = summary["redundant_bandwidth_share"]
+    assert 0 < share < 1
+    assert share == pytest.approx(ratio / (1.0 + ratio), rel=0.10)
+    from tools.bench_compare import validate_propagation    # noqa: E402
+    assert validate_propagation(summary, "test",
+                                flood=ob["flood"]) == []
+
+
+def test_merged_trace_carries_cross_lane_flow_events(tree_sim):
+    """Acceptance: the fleet Chrome trace shows at least one flooded
+    envelope flowing between two node lanes (paired s/f flow events
+    with a shared id, `cat: "prop"`)."""
+    sim, _names = tree_sim
+    trace = sim.fleet().merged_chrome_trace()
+    flows = [ev for ev in trace["traceEvents"] if ev.get("cat") == "prop"]
+    assert flows, "no propagation flow events in the merged trace"
+    by_id = {}
+    for ev in flows:
+        assert ev["ph"] in ("s", "f")
+        by_id.setdefault(ev["id"], []).append(ev)
+    cross = 0
+    for evs in by_id.values():
+        assert len(evs) == 2
+        start = next(e for e in evs if e["ph"] == "s")
+        fin = next(e for e in evs if e["ph"] == "f")
+        assert fin["bp"] == "e"
+        assert fin["ts"] >= start["ts"]
+        if start["pid"] != fin["pid"]:
+            cross += 1
+    assert cross >= 1, "no cross-lane flow event"
+
+
+def test_per_slot_fleet_stats_attach_propagation(tree_sim):
+    sim, _names = tree_sim
+    stats = sim.fleet().fleet_stats()
+    assert stats["propagation"]["trees"] > 0
+    assert stats["summary"]["redundant_bandwidth_share"] > 0
+    slots_with_prop = [s for s in stats["slots"].values()
+                      if s.get("propagation")]
+    assert slots_with_prop, "no per-slot propagation entries"
+    entry = slots_with_prop[0]["propagation"]
+    assert entry["trees"] > 0 and entry["redundant_share"] >= 0
+
+
+# -------------------------------------------------------- chaos injection
+
+def test_chaos_duplicate_and_delay_land_in_redundant_edge_class():
+    """ChaosTransport `overlay.duplicate` frames are detected at the
+    Peer MAC layer and recorded as redundant edges attributed to the
+    duplicating sender; `overlay.delay` stretches hop latency without
+    changing edge classification."""
+    sim, names = _peer_sim(2, 1, cfg_tweak=_tweak, chaos=True)
+    sim.start_all_nodes()
+    a = sim.nodes[names[0]].app
+    b = sim.nodes[names[1]].app
+    assert sim.crank_until(lambda: sim.have_all_externalized(2), 40000)
+    a.faults.configure("overlay.duplicate", probability=1.0)
+    tip = b.ledger_manager.last_closed_ledger_num()
+    assert sim.crank_until(lambda: sim.have_all_externalized(tip + 3),
+                           120000)
+    prop = b.overlay_manager.prop_stats
+    assert prop.totals["duplicates"] > 0
+    assert prop.totals["wasted_bytes"] > 0
+    # every wasted byte is attributed to the duplicating sender
+    detail = prop.peer_detail(a.config.node_id().key_bytes.hex())
+    assert detail is not None and detail["duplicates"] > 0
+    assert detail["usefulness"] < 1.0
+    # flood dedup saw the same MAC-layer duplicates (lockstep holds
+    # under injected faults too)
+    ov = b.overlay_manager.stats.to_json()["flood"]
+    assert prop.totals["duplicates"] == ov["duplicates"]
+    # delay leg: slowed frames still classify as FIRST deliveries —
+    # latency stretches, edge class doesn't flip
+    a.faults.clear("overlay.duplicate")
+    a.faults.configure("overlay.delay", probability=1.0)
+    firsts0 = prop.totals["firsts"]
+    dups0 = prop.totals["duplicates"]
+    tip = b.ledger_manager.last_closed_ledger_num()
+    assert sim.crank_until(lambda: sim.have_all_externalized(tip + 3),
+                           120000)
+    assert prop.totals["firsts"] > firsts0
+    assert prop.totals["duplicates"] == dups0
+    sim.stop_all_nodes()
+
+
+# ------------------------------------------------------------ admin surface
+
+def test_propagation_and_health_endpoints_on_live_net(tree_sim):
+    """Acceptance: the admin `propagation` endpoint returns per-peer
+    usefulness rankings and a per-hash hop trace on a live multi-node
+    net; `health` rolls all six cockpits into one blob."""
+    sim, names = tree_sim
+    app = sim.nodes[names[0]].app
+
+    def cmd(name, **params):
+        return app.command_handler.handle_command(
+            name, {k: str(v) for k, v in params.items()})
+
+    st, blob = cmd("propagation")
+    assert st == 200
+    assert blob["totals"]["firsts"] > 0
+    assert blob["peers"]["top"] and blob["peers"]["bottom"]
+    assert 0 < blob["redundant_bandwidth_share"] < 1
+    assert set(blob["fleet"]) == {"self", "totals", "peers", "hashes"}
+    # per-hash hop trace by (prefix of) hash
+    some_hash = next(iter(blob["fleet"]["hashes"]))
+    st, trace = cmd("propagation", hash=some_hash[:12])
+    assert st == 200 and trace["hash"] == some_hash
+    assert trace["hops"] and {"dir", "peer", "t", "pc"} <= set(
+        trace["hops"][0])
+    # per-peer detail by node-id prefix
+    peer_hex = blob["peers"]["top"][0]["peer"]
+    st, det = cmd("propagation", peer=peer_hex[:16])
+    assert st == 200 and det["peer"] == peer_hex
+    # unknown selectors and actions are 400s, not stack traces
+    assert cmd("propagation", hash="zz")[0] == 400
+    assert cmd("propagation", peer="zz")[0] == 400
+    assert cmd("propagation", action="bogus")[0] == 400
+
+    st, health = cmd("health")
+    assert st == 200
+    assert health["status"] in ("ok", "degraded", "critical")
+    assert set(health["breakers"]) <= {"verifier", "hasher"}
+    for b in health["breakers"].values():
+        assert b["state"] in ("closed", "open", "half-open")
+        assert b["trips"] >= 0 and b["recoveries"] >= 0
+    assert health["flood_duplication_ratio"] >= 0
+    assert health["worst_peer_usefulness"] is None or \
+        0 <= health["worst_peer_usefulness"] <= 1
+    assert "native_bails" in health
+    assert "bucketdb_sql_fallbacks" in health
+    assert "recovery_episodes" in health
+
+    # reset zeroes the aggregates (registry metrics stay monotonic)
+    st, blob = cmd("propagation", action="reset")
+    assert st == 200 and blob["status"] == "reset"
+    assert blob["totals"]["firsts"] == 0
+
+
+def test_propagation_endpoint_over_http():
+    """util/fleet.py add_http feeds from GET /propagation: the fleet
+    block rides the same admin blob over a real socket."""
+    from stellar_core_tpu.main.application import Application
+    from stellar_core_tpu.main.config import Config
+    from stellar_core_tpu.util.timer import ClockMode, VirtualClock
+
+    cfg = Config.test_config(0)
+    cfg.DATABASE = "sqlite3://:memory:"
+    app = Application(VirtualClock(ClockMode.VIRTUAL_TIME), cfg)
+    app.start()
+    app.manual_close()
+    port = app.command_handler.start_http(port=0)
+    got = {}
+
+    def fetch():
+        for path in ("propagation", "health"):
+            with urllib.request.urlopen(
+                    "http://127.0.0.1:%d/%s" % (port, path)) as resp:
+                got[path] = json.loads(resp.read().decode())
+
+    t = threading.Thread(target=fetch)
+    t.start()
+    app.crank_until(lambda: len(got) == 2, max_cranks=500000)
+    t.join(timeout=10)
+    app.command_handler.stop_http()
+    app.stop()
+    assert set(got["propagation"]["fleet"]) == {"self", "totals",
+                                                "peers", "hashes"}
+    assert got["health"]["status"] == "ok"
+
+
+def test_propagation_disabled_by_config():
+    """PROPAGATION_STATS_ENABLED=False is the bench control leg: no
+    cockpit, no hop recording, endpoint says so."""
+    sim, names = _peer_sim(
+        2, 1, cfg_tweak=lambda c: (_tweak(c), setattr(
+            c, "PROPAGATION_STATS_ENABLED", False)))
+    sim.start_all_nodes()
+    a = sim.nodes[names[0]].app
+    assert sim.crank_until(lambda: sim.have_all_externalized(3), 60000)
+    assert a.overlay_manager.prop_stats is None
+    assert a.overlay_manager.floodgate.prop is None
+    st, body = a.command_handler.handle_command("propagation", {})
+    assert st == 200 and "disabled" in body["error"]
+    # the fleet summary degrades to None, and health still answers
+    agg = sim.fleet()
+    assert agg.propagation_summary() is None
+    st, health = a.command_handler.handle_command("health", {})
+    assert st == 200 and health.get("worst_peer_usefulness") is None
+    sim.stop_all_nodes()

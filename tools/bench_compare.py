@@ -555,6 +555,108 @@ def validate_bucketdb(bd, where: str = "") -> List[str]:
     return errs
 
 
+def propagation_records(pb: dict, platform: str, source: str,
+                        round_no=None, at_unix=None) -> List[dict]:
+    """Normalize a `propagation` block (ISSUE 17: the propagation
+    cockpit's fleet-merged relay trees) into direction-aware records:
+    hop latency and tree depth percentiles over the reconstructed
+    first-delivery spanning trees (lower), the redundant bandwidth
+    share — the fraction of flooded bytes that arrived as duplicate
+    edges, the O(n²) waste a structured relay would reclaim (lower) —
+    and the worst per-peer usefulness score (higher; a peer that only
+    ever sends duplicates is pure overhead)."""
+    out: List[dict] = []
+    if not isinstance(pb, dict) or not _num(pb, "trees"):
+        return out
+    for key, metric, unit in (
+            ("hop_latency_p50_ms", "prop_hop_latency_p50_ms", "ms"),
+            ("hop_latency_p95_ms", "prop_hop_latency_p95_ms", "ms"),
+            ("tree_depth_p95", "prop_tree_depth_p95", "hops"),
+            ("redundant_bandwidth_share",
+             "prop_redundant_bandwidth_share", "share")):
+        v = _num(pb, key)
+        if v is not None:
+            out.append(make_record(metric, unit, v, platform, "lower",
+                                   source, round_no, at_unix))
+    peers = pb.get("peers")
+    if isinstance(peers, dict):
+        v = _num(peers, "worst_usefulness")
+        if v is not None:
+            out.append(make_record("prop_worst_peer_usefulness", "share",
+                                   v, platform, "higher", source,
+                                   round_no, at_unix))
+    return out
+
+
+def validate_propagation(pb, where: str = "", flood=None) -> List[str]:
+    """Schema check for one `propagation` block (`check`/`--check`):
+    hop/byte totals must be finite and non-negative, the recorded
+    redundant share must actually be wasted/flooded bytes, percentiles
+    must be ordered — and when the sibling wire cockpit's `flood` block
+    is available, duplicates/firsts over the merged hop records must
+    reconcile with its duplication ratio within 10% relative tolerance
+    (both cockpits count the same Floodgate.add_record receipts, so a
+    drift between them means hop attribution lost edges)."""
+    errs: List[str] = []
+    if not isinstance(pb, dict):
+        return ["%s: propagation is not an object: %r" % (where, pb)]
+    trees = pb.get("trees")
+    if not isinstance(trees, int) or isinstance(trees, bool) or trees < 0:
+        errs.append("%s: propagation.trees must be an int >= 0, got %r"
+                    % (where, trees))
+    vals = {}
+    for key in ("firsts", "duplicates", "flood_bytes", "wasted_bytes"):
+        v = _num(pb, key)
+        if v is None or v < 0:
+            errs.append("%s: propagation.%s must be a finite number "
+                        ">= 0, got %r" % (where, key, pb.get(key)))
+        vals[key] = v
+    share = _num(pb, "redundant_bandwidth_share")
+    if share is None or share < 0 or share > 1:
+        errs.append("%s: propagation.redundant_bandwidth_share must be "
+                    "in [0, 1], got %r"
+                    % (where, pb.get("redundant_bandwidth_share")))
+    elif vals.get("flood_bytes"):
+        want = vals["wasted_bytes"] / vals["flood_bytes"]
+        if abs(share - want) > max(1e-3, 0.01 * want):
+            errs.append("%s: propagation.redundant_bandwidth_share %.4f "
+                        "!= wasted/flooded bytes %.4f" % (where, share,
+                                                          want))
+    p50 = _num(pb, "hop_latency_p50_ms")
+    p95 = _num(pb, "hop_latency_p95_ms")
+    if p50 is None or p95 is None or p50 < 0 or p95 + 1e-9 < p50:
+        errs.append("%s: propagation needs finite "
+                    "0 <= hop_latency_p50_ms <= hop_latency_p95_ms, "
+                    "got p50=%r p95=%r" % (where,
+                                           pb.get("hop_latency_p50_ms"),
+                                           pb.get("hop_latency_p95_ms")))
+    depth = _num(pb, "tree_depth_p95")
+    if depth is None or depth < 0:
+        errs.append("%s: propagation.tree_depth_p95 must be a finite "
+                    "number >= 0, got %r"
+                    % (where, pb.get("tree_depth_p95")))
+    peers = pb.get("peers")
+    if isinstance(peers, dict):
+        wu = peers.get("worst_usefulness")
+        if wu is not None and (_num(peers, "worst_usefulness") is None or
+                               wu < 0 or wu > 1):
+            errs.append("%s: propagation.peers.worst_usefulness must be "
+                        "in [0, 1] or null, got %r" % (where, wu))
+    # cross-cockpit reconciliation against the wire cockpit's dedup
+    # accounting (ISSUE 17 acceptance gate)
+    if isinstance(flood, dict) and vals.get("firsts"):
+        r = _num(flood, "duplication_ratio")
+        if r is not None and r >= 0:
+            derived = vals["duplicates"] / vals["firsts"]
+            if abs(derived - r) > max(0.05, 0.10 * r):
+                errs.append(
+                    "%s: propagation duplicates/firsts %.4f does not "
+                    "reconcile with flood duplication_ratio %.4f within "
+                    "10%% — hop records and flood dedup have drifted "
+                    "apart" % (where, derived, r))
+    return errs
+
+
 def _replay_leg_records(leg: dict, platform: str, source: str,
                         round_no, at_unix) -> List[dict]:
     out = []
@@ -648,6 +750,13 @@ def _payload_records(p: dict, source: str, round_no,
     if isinstance(ob, dict):
         out.extend(overlay_breakdown_records(ob, platform, source,
                                              round_no, at_unix))
+    # propagation-cockpit records from a payload-level `propagation`
+    # block (`bench.py --fleet`; scenario artifacts embed theirs in an
+    # explicit `records` list, which normalize_any prefers)
+    pb = p.get("propagation")
+    if isinstance(pb, dict):
+        out.extend(propagation_records(pb, platform, source, round_no,
+                                       at_unix))
     # multi-device verify legs (`bench.py --fleet-verify`; the artifact
     # also carries an explicit `records` list, which normalize_any
     # prefers — this path keeps nested/legacy blobs normalizable)
@@ -820,6 +929,11 @@ def _walk_breakdowns(blob, name: str, errs: List[str],
     if "overlay_breakdown" in blob:
         errs.extend(validate_overlay_breakdown(blob["overlay_breakdown"],
                                                name))
+    if blob.get("propagation") is not None:
+        ob = blob.get("overlay_breakdown")
+        errs.extend(validate_propagation(
+            blob["propagation"], name,
+            flood=ob.get("flood") if isinstance(ob, dict) else None))
     if "fleet_verify" in blob:
         errs.extend(validate_fleet_verify(blob["fleet_verify"], name))
     if "hash_bench" in blob:
