@@ -1534,7 +1534,8 @@ def scenario_main(argv) -> int:
     """`bench.py --scenario NAME [--seed N] [--scale tier1|soak]
     [--record] [--history PATH] [--tolerance T] [--out FILE]`: run one
     scenario from the scenario lab (stellar_core_tpu/testing/scenarios.py
-    — churn / flood / partition / surge, or `suite` for all) and emit its
+    — churn / flood / partition / surge / overload / checkpoint, or
+    `suite` for all) and emit its
     fleet bench block. The block's normalized `records` (platform keys
     `scenario-<name>`) are gated against bench/history.jsonl exactly like
     perf records: exit 1 on any regression beyond tolerance (default 0.5
@@ -1545,7 +1546,8 @@ def scenario_main(argv) -> int:
     bc = _bench_compare_mod()
     ap = argparse.ArgumentParser(prog="bench.py --scenario")
     ap.add_argument("--scenario", required=True,
-                    help="churn|flood|partition|surge|suite")
+                    help="churn|flood|partition|surge|overload|"
+                         "checkpoint|suite")
     ap.add_argument("--seed", type=int, default=1)
     ap.add_argument("--scale", choices=("tier1", "soak"), default="tier1")
     ap.add_argument("--record", action="store_true")

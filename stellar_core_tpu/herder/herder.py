@@ -282,6 +282,28 @@ class Herder:
             cfg.TRANSACTION_QUEUE_BAN_DEPTH, cfg.POOL_LEDGER_MULTIPLIER,
             self.verifier, metrics=getattr(app, "metrics", None),
             lifecycle=self.tx_lifecycle)
+        # ingress admission tier (ISSUE 18): per-source rate classes +
+        # bounded intake in FRONT of the queue, so overload sheds before
+        # paying signature validation (docs/robustness.md#ingress--overload)
+        self.ingress = None
+        self.last_retry_after: Optional[float] = None
+        if cfg.INGRESS_ENABLED:
+            from ..crypto import strkey
+            from .ingress import TxIngress
+            self.ingress = TxIngress(
+                metrics=getattr(app, "metrics", None),
+                now_fn=app.clock.now,
+                faults=getattr(app, "faults", None),
+                classes=cfg.INGRESS_CLASSES,
+                priority=[strkey.decode_public_key(a)
+                          for a in cfg.INGRESS_PRIORITY_ACCOUNTS],
+                untrusted=[strkey.decode_public_key(a)
+                           for a in cfg.INGRESS_UNTRUSTED_ACCOUNTS],
+                intake_depth=cfg.INGRESS_INTAKE_DEPTH,
+                max_sources=cfg.INGRESS_MAX_SOURCES,
+                async_intake=cfg.INGRESS_ASYNC_INTAKE,
+                sink=self._queue_tx,
+                shed_cb=lambda h: self.tx_lifecycle.outcome(h, "shed"))
         self.upgrades = Upgrades()
         self.state = HerderState.HERDER_SYNCING_STATE
         self.tracking_slot: Optional[int] = None
@@ -577,7 +599,11 @@ class Herder:
         return getattr(self.app, "metrics", None)
 
     def recv_transaction(self, frame) -> int:
-        """HOT CALLER #2 via TransactionQueue.try_add → checkValid."""
+        """HOT CALLER #2 via TransactionQueue.try_add → checkValid.
+        The ingress tier (ISSUE 18) decides first: a throttled or shed
+        tx returns TRY_AGAIN_LATER *before* any signature validation is
+        paid, with `last_retry_after` carrying the hint `cmd_tx`
+        surfaces to the submitter."""
         m = self._metrics()
         if m is not None:
             m.new_meter("herder.tx.received").mark()
@@ -586,13 +612,44 @@ class Herder:
         # re-flooded duplicate must not clobber the original's stamps.
         h = frame.full_hash()
         fresh = self.tx_lifecycle.submit(h)
+        self.last_retry_after = None
+        ing = self.ingress
+        if ing is not None:
+            from . import ingress as _ing
+            decision, retry_after = ing.admit(frame, tx_hash=h,
+                                              fresh=fresh)
+            if decision in (_ing.THROTTLE, _ing.SHED):
+                if fresh:
+                    self.tx_lifecycle.outcome(
+                        h, "shed" if decision == _ing.SHED
+                        else "throttled")
+                self.last_retry_after = retry_after
+                return TxQueueResult.ADD_STATUS_TRY_AGAIN_LATER
+            if decision == _ing.PARKED:
+                # accepted into the bounded intake; the pump delivers it
+                # to the queue at the next trigger (optimistic PENDING —
+                # open-loop submitters treat it as accepted)
+                return TxQueueResult.ADD_STATUS_PENDING
+        status = self._queue_tx(frame, h, fresh)
+        if status == TxQueueResult.ADD_STATUS_TRY_AGAIN_LATER:
+            # pool-side backpressure (source limit / fee floor): a close
+            # drains the pool, so that is the honest retry horizon
+            self.last_retry_after = \
+                self.app.config.EXPECTED_LEDGER_CLOSE_TIME
+        return status
+
+    def _queue_tx(self, frame, h: bytes, fresh: bool) -> int:
+        """Queue-admission tail shared by the direct path and the
+        ingress intake pump."""
         status = self.tx_queue.try_add(frame)
         if status == TxQueueResult.ADD_STATUS_PENDING:
             self.tx_lifecycle.queued(h)
         elif fresh and status != TxQueueResult.ADD_STATUS_DUPLICATE:
             self.tx_lifecycle.outcome(h, "rejected")
-        if m is not None and status == 0:
-            m.new_meter("herder.tx.accepted").mark()
+        if status == 0:
+            m = self._metrics()
+            if m is not None:
+                m.new_meter("herder.tx.accepted").mark()
         return status
 
     # -- SCP envelope intake -------------------------------------------------
@@ -833,6 +890,10 @@ class Herder:
             return
         with app_span(self.app, "herder.trigger", cat="scp",
                       slot=slot) as tsp:
+            if self.ingress is not None:
+                # drain the bounded intake (priority class first) into
+                # the queue so this trigger's txset sees parked txs
+                self.ingress.pump()
             txset = self.tx_queue.to_txset(lm.lcl_hash, cfg.network_id)
             removed = txset.trim_invalid(lm.ltx_root(), self.verifier)
             if removed:
@@ -941,6 +1002,10 @@ class Herder:
         # tx queue maintenance
         self.tx_queue.remove_applied(list(txset.frames))
         self.tx_queue.shift()
+        if self.ingress is not None:
+            # a close drains the pool: reset per-source inflight windows
+            # and reap fully-refilled bucket states
+            self.ingress.ledger_closed()
         if m is not None:
             m.new_counter("herder.pending-ops.count").set_count(
                 self.tx_queue.size_ops())

@@ -28,8 +28,10 @@ The funnel completes with per-tx outcomes (`herder.tx.outcome.<kind>`):
 `evicted` (surge eviction), `expired` (aged out of the pool), `banned`
 (trimmed invalid), `dropped` (chain-mate invalidated by an applied tx),
 `deferred` (externalized into a catchup gap), `untracked` (tracking-map
-overflow). Only locally-observed transactions are tracked, and the map
-is bounded at MAX_TRACKED entries.
+overflow), `shed` / `throttled` (the ingress tier refused it before
+queue admission — herder/ingress.py, ISSUE 18). Only locally-observed
+transactions are tracked, and the map is bounded at MAX_TRACKED
+entries.
 """
 
 from __future__ import annotations

@@ -265,6 +265,16 @@ class Application:
                 False)
         return status
 
+    @property
+    def load_generator(self):
+        """Lazy singleton LoadGenerator (admin `generateload`, overload
+        scenarios); constructed on first use so apps that never generate
+        load pay nothing."""
+        if not hasattr(self, "_load_generator"):
+            from ..simulation.load_generator import LoadGenerator
+            self._load_generator = LoadGenerator(self)
+        return self._load_generator
+
     def enable_buckets(self, bucket_dir: Optional[str] = None) -> None:
         from ..bucket.bucket_index import BucketDbStats
         from ..bucket.bucket_manager import BucketManager

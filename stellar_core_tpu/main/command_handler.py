@@ -621,7 +621,10 @@ class CommandHandler:
     # -- transactions --------------------------------------------------------
     def cmd_tx(self, params) -> dict:
         """Submit a hex- (or base64-) encoded TransactionEnvelope
-        (reference CommandHandler.cpp:543-578)."""
+        (reference CommandHandler.cpp:543-578). A TRY_AGAIN_LATER
+        answer carries `retry_after` (seconds) — the ingress tier's
+        backpressure hint (docs/robustness.md#ingress--overload).
+        Malformed blobs are 400s, not 500s out of the HTTP thread."""
         from ..transactions.transaction_frame import TransactionFrame
         from ..xdr import TransactionEnvelope
         blob = params.get("blob")
@@ -631,16 +634,72 @@ class CommandHandler:
             raw = bytes.fromhex(blob)
         except ValueError:
             import base64
-            raw = base64.b64decode(blob)
-        env = TransactionEnvelope.from_xdr(raw)
-        frame = TransactionFrame.make_from_wire(
-            self.app.config.network_id, env)
+            import binascii
+            try:
+                raw = base64.b64decode(blob, validate=True)
+            except (ValueError, binascii.Error):
+                raise CommandParamError(
+                    "parameter 'blob' is neither hex nor base64")
+        try:
+            env = TransactionEnvelope.from_xdr(raw)
+            frame = TransactionFrame.make_from_wire(
+                self.app.config.network_id, env)
+        except Exception:
+            raise CommandParamError(
+                "parameter 'blob' does not decode to a "
+                "TransactionEnvelope")
         status = self.app.submit_transaction(frame)
         names = {0: "PENDING", 1: "DUPLICATE", 2: "ERROR", 3: "TRY_AGAIN_LATER"}
         out = {"status": names.get(status, str(status))}
         if status == 2 and frame.result is not None:
             out["detail"] = str(frame.result.code)
+        if status == 3:
+            herder = self.app.herder
+            retry = getattr(herder, "last_retry_after", None)
+            out["retry_after"] = round(
+                retry if retry is not None
+                else self.app.config.EXPECTED_LEDGER_CLOSE_TIME, 3)
         return out
+
+    def cmd_ingress(self, params) -> dict:
+        """`ingress[?action=status|set-class|reset]` — the admission
+        tier's cockpit (docs/robustness.md#ingress--overload):
+        `status` (default) dumps the class table, bounded-intake depth,
+        tracked sources and per-class admit/throttle/shed counters;
+        `set-class&account=<strkey>&class=priority|default|untrusted`
+        re-pins a source account at runtime; `reset` zeroes the
+        counters. 400 on unknown actions/classes/accounts."""
+        ing = getattr(self.app.herder, "ingress", None)
+        if ing is None:
+            return {"enabled": False}
+        action = params.get("action", "status")
+        if action == "status":
+            out = ing.to_json()
+            out["enabled"] = True
+            return out
+        if action == "set-class":
+            from ..crypto import strkey
+            acct = params.get("account")
+            cls = params.get("class")
+            if not acct or not cls:
+                raise CommandParamError(
+                    "set-class needs 'account' and 'class' params")
+            try:
+                raw = strkey.decode_public_key(acct)
+            except Exception:
+                raise CommandParamError(
+                    "parameter 'account' is not a valid strkey "
+                    "account id")
+            try:
+                ing.set_class(raw, cls)
+            except ValueError as e:
+                raise CommandParamError(str(e))
+            return {"status": "ok", "account": acct, "class": cls}
+        if action == "reset":
+            ing.reset_counters()
+            return {"status": "reset"}
+        raise CommandParamError(
+            "action must be status|set-class|reset, got %r" % action)
 
     def cmd_manualclose(self, params) -> dict:
         self.app.manual_close()
@@ -891,10 +950,7 @@ class CommandHandler:
         gated = self._require_test_mode()
         if gated is not None:
             return gated
-        from ..simulation.load_generator import LoadGenerator
-        if not hasattr(self.app, "_load_generator"):
-            self.app._load_generator = LoadGenerator(self.app)
-        lg = self.app._load_generator
+        lg = self.app.load_generator
         accounts = int(params.get("accounts", 10))
         txs = int(params.get("txs", 10))
         if accounts:

@@ -102,6 +102,23 @@ class Config:
         self.TRANSACTION_QUEUE_PENDING_DEPTH = 4
         self.TRANSACTION_QUEUE_BAN_DEPTH = 10
         self.POOL_LEDGER_MULTIPLIER = 2
+        # ingress admission tier (herder/ingress.py, ISSUE 18,
+        # docs/robustness.md#ingress--overload): per-source token-bucket
+        # rate classes in front of the TransactionQueue. INGRESS_CLASSES
+        # is a TOML table of class name -> {rate, burst, max_inflight}
+        # overrides merged onto herder.ingress.DEFAULT_CLASSES; the
+        # *_ACCOUNTS lists pin strkey account ids to the priority /
+        # untrusted classes. INGRESS_ASYNC_INTAKE parks admitted frames
+        # in a bounded intake (INGRESS_INTAKE_DEPTH) drained
+        # priority-first at each trigger; per-source bucket states are
+        # capped at INGRESS_MAX_SOURCES (bounded under 10^6 submitters).
+        self.INGRESS_ENABLED = True
+        self.INGRESS_ASYNC_INTAKE = False
+        self.INGRESS_INTAKE_DEPTH = 512
+        self.INGRESS_MAX_SOURCES = 65536
+        self.INGRESS_CLASSES: Dict[str, dict] = {}
+        self.INGRESS_PRIORITY_ACCOUNTS: List[str] = []
+        self.INGRESS_UNTRUSTED_ACCOUNTS: List[str] = []
 
         # genesis / testing upgrades
         self.GENESIS_TOTAL_COINS = 10**17
@@ -253,6 +270,9 @@ class Config:
             "HASH_BACKEND", "STATE_CHECKPOINT_INTERVAL",
             "FAULTS_SEED",
             "BUCKETDB_READS", "BUCKETDB_BLOOM_BITS_PER_KEY",
+            "INGRESS_ENABLED", "INGRESS_ASYNC_INTAKE",
+            "INGRESS_INTAKE_DEPTH", "INGRESS_MAX_SOURCES",
+            "INGRESS_PRIORITY_ACCOUNTS", "INGRESS_UNTRUSTED_ACCOUNTS",
         ]
         for k in simple_keys:
             if k in data:
@@ -265,6 +285,8 @@ class Config:
             cfg.HISTORY = data["HISTORY"]
         if "FAULTS" in data:
             cfg.FAULTS = data["FAULTS"]
+        if "INGRESS_CLASSES" in data:
+            cfg.INGRESS_CLASSES = data["INGRESS_CLASSES"]
         cfg.validate()
         return cfg
 

@@ -92,18 +92,40 @@ class FloodControl:
         m = self._metrics()
         if m is not None:
             m.new_meter("overlay.flood.rate-limited").mark()
-        if not st.banned and self.ban_threshold > 0 and \
-                st.ban_score >= self.ban_threshold:
-            st.banned = True
-            if m is not None:
-                m.new_meter("overlay.flood.ban").mark()
-            log.warning("peer %s exceeded flood ban score (%d limited "
-                        "messages): banning", peer.id_str(), st.limited)
-            overlay = getattr(self.app, "overlay_manager", None)
-            if overlay is not None:
-                overlay.ban_manager.ban_node(peer.peer_id)
-            peer.drop("flooding (rate limit exceeded)")
+        self._maybe_ban(st, peer)
         return True
+
+    def note_backpressure(self, peer) -> None:
+        """A relayed tx the ingress tier threw back (ISSUE 18,
+        TRY_AGAIN_LATER): the peer is pushing load past our admission
+        capacity. Scores a fraction of a ban point, so a peer that
+        relays nothing but sheddable load escalates exactly like a
+        flooder — while an occasional backpressured relay decays away
+        at the per-close halving."""
+        if peer.peer_id is None:
+            return
+        now = self.app.clock.now()
+        st = self._state(peer.peer_id.to_xdr(), now)
+        st.ban_score += 0.25
+        m = self._metrics()
+        if m is not None:
+            m.new_meter("overlay.flood.backpressure").mark()
+        self._maybe_ban(st, peer)
+
+    def _maybe_ban(self, st: _PeerFloodState, peer) -> None:
+        if st.banned or self.ban_threshold <= 0 or \
+                st.ban_score < self.ban_threshold:
+            return
+        st.banned = True
+        m = self._metrics()
+        if m is not None:
+            m.new_meter("overlay.flood.ban").mark()
+        log.warning("peer %s exceeded flood ban score (%d limited "
+                    "messages): banning", peer.id_str(), st.limited)
+        overlay = getattr(self.app, "overlay_manager", None)
+        if overlay is not None:
+            overlay.ban_manager.ban_node(peer.peer_id)
+        peer.drop("flooding (rate limit exceeded)")
 
     def ledger_closed(self) -> None:
         """Decay: ban scores halve per close, idle states are reaped."""

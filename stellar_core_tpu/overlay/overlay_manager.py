@@ -388,6 +388,12 @@ class OverlayManager:
         inside the flood controller."""
         return self.flood_control.limited(peer)
 
+    def flood_backpressure(self, peer: Peer) -> None:
+        """The ingress tier shed/throttled a tx this peer relayed
+        (ISSUE 18): score it fractionally toward the flood ban so
+        sustained useless relay escalates, without punishing one-offs."""
+        self.flood_control.note_backpressure(peer)
+
     def recv_flooded_msg(self, msg: StellarMessage, peer: Peer) -> bool:
         """Returns False if this flooded message was seen before."""
         return self.floodgate.add_record(

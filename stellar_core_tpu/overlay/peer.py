@@ -302,6 +302,11 @@ class Peer:
             status = herder.recv_transaction(frame)
             if status == 0:
                 self.overlay.broadcast_message(msg)
+            elif status == 3:
+                # ingress backpressure on a relayed tx: not relayed
+                # further, and the sender scores a fractional flood-ban
+                # point (docs/robustness.md#ingress--overload)
+                self.overlay.flood_backpressure(self)
         elif t == MessageType.GET_SCP_QUORUMSET:
             q = self._lookup_qset(msg.value)
             if q is not None:

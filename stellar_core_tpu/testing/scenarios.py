@@ -943,6 +943,298 @@ def run_checkpoint(seed: int, scale: str, workdir: str) -> dict:
 
 
 # --------------------------------------------------------------------------
+# overload: 5x open-loop oversubscription vs the ingress admission tier
+
+def run_overload(seed: int, scale: str, workdir: str) -> dict:
+    """Million-submitter overload (ISSUE 18): a 3-node loopback fleet
+    whose pool capacity is deliberately small is oversubscribed 5x —
+    untrusted-class flooder accounts spraying chained payments, plus a
+    seeded open-loop Zipf flood from a 10^6-key submitter keyspace —
+    while a handful of priority-class accounts submit honest traffic.
+    Three legs: `unloaded` (priority only — the latency baseline),
+    `control` (full overload, INGRESS_ENABLED=False — the pool absorbs
+    everything and degrades), `ingress` (full overload through the
+    admission tier). Gates: the ingress leg keeps applied-tx p95 within
+    2x the unloaded baseline with priority goodput >= 90%, while the
+    control leg visibly degrades on both axes; every ingress queue/map
+    stays bounded."""
+    slots = 6 if scale == "tier1" else 12
+    txset_cap = 20
+    n_pri, n_flood = 4, 10
+    pri_per_slot, flood_per_slot = 2, 10     # 100/slot vs 20 capacity
+    junk_rate = 30.0                          # open-loop keyspace flood
+    oversub = round(
+        (n_flood * flood_per_slot + junk_rate + n_pri * pri_per_slot)
+        / float(txset_cap), 1)
+
+    pri_keys = _keys(n_pri, b"overload-pri", seed)
+    flood_keys = _keys(n_flood, b"overload-flood", seed)
+
+    def leg(name: str, ingress_on: bool, loaded: bool) -> dict:
+        rnd.reseed(seed)
+        _clear_verify_cache()
+        from ..crypto import strkey as _strkey
+        sim = Simulation(Simulation.OVER_LOOPBACK)
+        vkeys = _keys(3, b"overload-val", seed)
+        qset = SCPQuorumSet(threshold=2,
+                            validators=[k.public_key for k in vkeys],
+                            innerSets=[])
+
+        def tweak(cfg: Config) -> None:
+            cfg.DATABASE = "sqlite3://:memory:"
+            cfg.TESTING_UPGRADE_MAX_TX_SET_SIZE = txset_cap
+            cfg.POOL_LEDGER_MULTIPLIER = 3
+            cfg.ARTIFICIALLY_ACCELERATE_TIME_FOR_TESTING = False
+            cfg.EXPECTED_LEDGER_CLOSE_TIME = 1.0
+            cfg.INGRESS_ENABLED = ingress_on
+            if ingress_on:
+                cfg.INGRESS_ASYNC_INTAKE = True
+                # roughly one close's worth of intake plus slack: the
+                # Zipf keyspace flood must overflow it every slot so
+                # shed-lowest-class-first is actually exercised
+                cfg.INGRESS_INTAKE_DEPTH = txset_cap + 4
+                cfg.INGRESS_MAX_SOURCES = 4096
+                # tight classes so 5x oversubscription throttles and
+                # sheds within a short run: priority unlimited, the
+                # junk keyspace (default) and the untrusted flooders
+                # capped far below their spray rates
+                cfg.INGRESS_CLASSES = {
+                    "default": {"rate": 0.5, "burst": 2.0,
+                                "max_inflight": 2},
+                    "untrusted": {"rate": 0.3, "burst": 1.0,
+                                  "max_inflight": 2},
+                }
+                # the genesis root (account factory) rides the priority
+                # class too — the operator pins its own keys
+                cfg.INGRESS_PRIORITY_ACCOUNTS = [
+                    _strkey.encode_public_key(k.public_key.key_bytes)
+                    for k in pri_keys] + [
+                    SecretKey.from_seed(
+                        sha256(cfg.network_id)).strkey_public()]
+                cfg.INGRESS_UNTRUSTED_ACCOUNTS = [
+                    _strkey.encode_public_key(k.public_key.key_bytes)
+                    for k in flood_keys]
+        names = [sim.add_node(k, qset, name="o%d" % i,
+                              cfg_tweak=tweak).name
+                 for i, k in enumerate(vkeys)]
+        for i in range(3):
+            for j in range(i + 1, 3):
+                sim.connect(names[i], names[j])
+        sim.start_all_nodes()
+        n0 = sim.nodes[names[0]].app
+        _crank_until(sim, lambda: sim.have_all_externalized(2), 40000,
+                     "overload start (%s)" % name)
+
+        adapter = AppLedgerAdapter(n0)
+        root = adapter.root_account()
+        # chunked creates: a tx wider than maxTxSetSize ops could never
+        # fit a txset with this deliberately tiny capacity
+        all_keys = pri_keys + flood_keys
+        rseq = root.next_seq() - 1
+        for i in range(0, len(all_keys), 10):
+            rseq += 1
+            st = n0.submit_transaction(root.tx(
+                [root.op_create_account(k.public_key, 10**10)
+                 for k in all_keys[i:i + 10]], seq=rseq))
+            assert st == 0, "account-creation tx refused at submit"
+
+        def accounts_exist() -> bool:
+            return adapter.account_exists(pri_keys[0].public_key) and \
+                adapter.account_exists(flood_keys[-1].public_key)
+        _crank_until(sim, accounts_exist, 80000, "overload accounts")
+
+        pris = [TestAccount(adapter, k) for k in pri_keys]
+        floods = [TestAccount(adapter, k) for k in flood_keys]
+        seqs: Dict[bytes, int] = {}
+        dest = root.account_id
+
+        def burst(accts, per_acct, counters, amt, hashes=None) -> None:
+            """One per-slot submission burst of chained payments; local
+            seq tracking resyncs from the ledger after hard rejects so
+            bounced chains resume instead of gapping forever. `amt`
+            varies per slot so a bounced-then-retried payment is a
+            distinct tx, not a lifecycle duplicate."""
+            for acc in accts:
+                k = acc.sk.seed
+                seq = seqs.get(k)
+                if seq is None:
+                    seq = acc.next_seq() - 1
+                for _ in range(per_acct):
+                    frame = acc.tx([acc.op_payment(dest, amt)],
+                                   seq=seq + 1, fee=100)
+                    status = n0.submit_transaction(frame)
+                    counters["submitted"] += 1
+                    if status == 0:
+                        seq += 1
+                        counters["accepted"] += 1
+                        if hashes is not None:
+                            hashes.add(frame.contents_hash().hex())
+                    elif status == 3:
+                        counters["backpressured"] += 1
+                    else:
+                        counters["rejected"] += 1
+                        seq = acc.next_seq() - 1
+                seqs[k] = seq
+
+        pri_counts = {"submitted": 0, "accepted": 0, "backpressured": 0,
+                      "rejected": 0}
+        flood_counts = dict(pri_counts)
+        pri_hashes: set = set()
+        if loaded:
+            # the open-loop 10^6-keyspace junk flood rides app-clock
+            # timers for the whole measurement window
+            n0.load_generator.start_open_loop(
+                junk_rate, duration_s=float(slots), submitters=10**6,
+                zipf_s=1.1, seed=seed, tick=0.5)
+        base = n0.ledger_manager.last_closed_ledger_num()
+        for s in range(slots):
+            # flooders race ahead of honest traffic every slot — in the
+            # control leg they fill the pool before priority arrives
+            if loaded:
+                burst(floods, flood_per_slot, flood_counts, 1 + s)
+            burst(pris, pri_per_slot, pri_counts, 1 + s, pri_hashes)
+            _crank_until(sim,
+                         lambda: sim.have_all_externalized(base + s + 1),
+                         200000, "overload slot %d (%s)" % (s, name))
+        ol = n0.load_generator.open_loop_status()
+        n0.load_generator.stop_open_loop()
+        # drain: a few unloaded closes so in-flight priority txs land
+        _crank_until(sim,
+                     lambda: sim.have_all_externalized(base + slots + 3),
+                     200000, "overload drain (%s)" % name)
+
+        applied = {row[0] for row in n0.database.execute(
+            "SELECT txid FROM txhistory").fetchall()}
+        pri_applied = len(pri_hashes & applied)
+        _assert_header_equality([v.app for v in sim.nodes.values()],
+                                min_common=2)
+        agg = sim.fleet()
+        fleet = _fleet_block(agg)
+        overlay = agg.overlay_breakdown()
+        ing = n0.herder.ingress
+        ing_json = ing.to_json() if ing is not None else None
+        lc = n0.herder.tx_lifecycle.to_json()
+        sim.stop_all_nodes()
+        return {"fleet": fleet, "overlay_breakdown": overlay,
+                "ingress_json": ing_json, "lifecycle": lc,
+                "open_loop": ol, "pri": pri_counts,
+                "pri_applied": pri_applied, "flood": flood_counts}
+
+    unloaded = leg("unloaded", ingress_on=True, loaded=False)
+    control = leg("control", ingress_on=False, loaded=True)
+    on = leg("ingress", ingress_on=True, loaded=True)
+
+    def p95(legb: dict) -> float:
+        ob = legb["overlay_breakdown"]
+        assert ob is not None and ob["tx_latency_ms"]["count"] > 0
+        return max(ob["tx_latency_ms"]["p95"], 0.001)
+
+    p95_unloaded, p95_control, p95_on = p95(unloaded), p95(control), \
+        p95(on)
+    p95_ratio = round(p95_on / p95_unloaded, 3)
+    control_ratio = round(p95_control / p95_unloaded, 3)
+    goodput = round(on["pri_applied"] /
+                    max(1, on["pri"]["submitted"]), 6)
+    goodput_control = round(control["pri_applied"] /
+                            max(1, control["pri"]["submitted"]), 6)
+    cj = on["ingress_json"]["counters"]
+    admitted = sum(c["admitted"] for c in cj.values())
+    throttled = sum(c["throttled"] for c in cj.values())
+    shed = sum(c["shed"] for c in cj.values())
+    decided = admitted + throttled + shed
+    shed_ratio = round(shed / max(1, decided), 6)
+    ingress_block = {
+        "oversubscription": oversub,
+        "decided": decided, "admitted": admitted,
+        "throttled": throttled, "shed": shed,
+        "shed_ratio": shed_ratio,
+        "priority": {"submitted": on["pri"]["submitted"],
+                     "applied": on["pri_applied"],
+                     "goodput": goodput},
+        "intake": on["ingress_json"]["intake"],
+        "sources": on["ingress_json"]["sources"],
+        "outcomes": on["lifecycle"]["outcomes"],
+        "tx_latency_p95_ms": round(p95_on, 3),
+        "unloaded_p95_ms": round(p95_unloaded, 3),
+        "p95_ratio": p95_ratio,
+    }
+
+    # acceptance gates (ISSUE 18): bounded latency + priority goodput
+    # through the admission tier, visible degradation without it
+    assert p95_ratio <= 2.0, \
+        "ingress leg p95 %.1fms exceeds 2x unloaded %.1fms" \
+        % (p95_on, p95_unloaded)
+    assert goodput >= 0.9, \
+        "priority goodput %.3f under overload with ingress on" % goodput
+    assert goodput_control < goodput, \
+        "control leg did not degrade priority goodput (%.3f vs %.3f)" \
+        % (goodput_control, goodput)
+    assert p95_control > p95_on, \
+        "control leg p95 %.1fms not worse than ingress leg %.1fms" \
+        % (p95_control, p95_on)
+    assert shed > 0 and throttled > 0, (shed, throttled)
+    # bounded memory: intake and per-source maps never exceed their caps
+    assert on["ingress_json"]["intake"]["depth"] <= \
+        on["ingress_json"]["intake"]["cap"]
+    assert on["ingress_json"]["sources"]["tracked"] <= \
+        on["ingress_json"]["sources"]["cap"]
+    # the lifecycle funnel counted the sheds (sum contract: funnel
+    # outcomes are a subset of ingress decisions — duplicates decided
+    # more than once are tracked once)
+    oc = on["lifecycle"]["outcomes"]
+    assert oc.get("shed", 0) + oc.get("throttled", 0) > 0
+    assert oc.get("shed", 0) <= shed
+    assert oc.get("throttled", 0) <= throttled
+    # the open-loop flood actually spanned a wide keyspace and was
+    # backpressured rather than absorbed
+    assert on["open_loop"]["distinct_submitters"] > 50
+    assert on["open_loop"]["backpressured"] > 0
+    assert on["open_loop"]["last_retry_after"] is not None
+
+    source = "bench.py --scenario overload"
+    plat = "scenario-overload"
+    records = _common_records("overload", on["fleet"], source)
+    bc = _bench_compare()
+    records.extend(bc.ingress_records(ingress_block, plat, source))
+    records.append(_record("overload_control_p95_ratio", "x",
+                           control_ratio, plat, "higher", source))
+    errs = bc.validate_ingress(ingress_block, where="overload")
+    assert not errs, "ingress block failed validation: %r" % errs
+    return {
+        "metric": "scenario_overload", "unit": "ms",
+        "value": p95_on,
+        "platform": plat,
+        "scenario": "overload", "seed": seed, "scale": scale,
+        "topology": {"nodes": 3, "threshold": 2, "mode": "loopback",
+                     "txset_cap": txset_cap, "pool_multiplier": 3,
+                     "priority_accounts": n_pri,
+                     "flooder_accounts": n_flood,
+                     "junk_keyspace": 10**6},
+        "fault_schedule": [
+            "%d untrusted flooders x%d chained payments per slot + "
+            "%.0f tx/s Zipf(1.1) open-loop junk from a 10^6-key "
+            "keyspace (%.1fx oversubscribed) for %d slots"
+            % (n_flood, flood_per_slot, junk_rate, oversub, slots)],
+        "assertions": {
+            "p95_ratio_vs_unloaded": p95_ratio,
+            "control_p95_ratio_vs_unloaded": control_ratio,
+            "priority_goodput": goodput,
+            "control_priority_goodput": goodput_control,
+            "shed": shed, "throttled": throttled,
+            "intake_bounded": True, "sources_bounded": True,
+            "open_loop_distinct_submitters":
+                on["open_loop"]["distinct_submitters"],
+        },
+        "fleet": on["fleet"],
+        "baseline_fleet": unloaded["fleet"],
+        "control_fleet": control["fleet"],
+        "ingress": ingress_block,
+        "overlay_breakdown": on["overlay_breakdown"],
+        "records": records,
+    }
+
+
+# --------------------------------------------------------------------------
 # registry + runner
 
 SCENARIOS: Dict[str, dict] = {
@@ -968,6 +1260,13 @@ SCENARIOS: Dict[str, dict] = {
         "fn": run_surge,
         "description": "tx-pool saturation with hot-account contention; "
                        "fee-bid surge eviction keeps the pool bounded",
+    },
+    "overload": {
+        "fn": run_overload,
+        "description": "5x+ open-loop oversubscription from a 10^6-key "
+                       "Zipf submitter keyspace vs the ingress admission "
+                       "tier; priority goodput + bounded p95 gated "
+                       "against an ingress-off control leg",
     },
     "checkpoint": {
         "fn": run_checkpoint,

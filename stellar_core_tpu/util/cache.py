@@ -66,6 +66,10 @@ class RandomEvictionCache(Generic[K, V]):
 
     def _evict_one(self) -> None:
         j = self._rng.randrange(len(self._keys))
+        self._remove_at(j)
+        self.evictions += 1
+
+    def _remove_at(self, j: int) -> None:
         last = len(self._keys) - 1
         victim = self._keys[j]
         if j != last:
@@ -75,7 +79,18 @@ class RandomEvictionCache(Generic[K, V]):
         self._keys.pop()
         self._vals.pop()
         del self._map[victim]
-        self.evictions += 1
+
+    def erase(self, k: K) -> bool:
+        """Explicit O(1) removal (swap-remove); not counted as an
+        eviction. Returns False when the key is absent."""
+        j = self._map.get(k)
+        if j is None:
+            return False
+        self._remove_at(j)
+        return True
+
+    def keys(self) -> List[K]:
+        return list(self._keys)
 
     def clear(self) -> None:
         self._map.clear()

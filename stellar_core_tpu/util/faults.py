@@ -58,6 +58,8 @@ KNOWN_SITES = frozenset({
     "apply.pipeline-stall",
     "bucketdb.index-corrupt",
     "bucketdb.read-fail",
+    "ingress.admit-stall",
+    "ingress.shed-storm",
 })
 
 

@@ -436,3 +436,120 @@ def test_bans_list_unban_unban_all(app):
     assert st == 200 and body["unbanned"] == 1 and body["bans"] == []
     assert app.database.execute(
         "SELECT COUNT(*) FROM bans").fetchone()[0] == 0
+
+
+# ------------------------------------------- tx hardening + ingress (ISSUE 18)
+
+def test_tx_malformed_blob_is_400(app):
+    """A blob that is neither hex nor base64, or that decodes to
+    garbage, must come back as a 400 CommandParamError — never a 500
+    out of the HTTP thread."""
+    for blob in ("zzzz-not-hex-not-b64!!", "deadbeef",  # bad XDR bytes
+                 "3q2+7w=="):                           # b64 of bad XDR
+        st, body = cmd(app, "tx", blob=blob)
+        assert st == 400, (blob, st, body)
+        assert "blob" in body["error"]
+
+
+def test_tx_base64_blob_accepted(app):
+    """The reference handler accepts base64 envelopes too."""
+    import base64
+    adapter = AppLedgerAdapter(app)
+    root = adapter.root_account()
+    alice = root.create(10**9)
+    frame = alice.tx([alice.op_payment(root.account_id, 5)])
+    b64 = base64.b64encode(frame.envelope.to_xdr()).decode()
+    st, body = cmd(app, "tx", blob=b64)
+    assert st == 200 and body["status"] == "PENDING"
+
+
+def test_tx_all_statuses_and_retry_after(app):
+    """PENDING / DUPLICATE / ERROR / TRY_AGAIN_LATER all surface, and
+    the TRY_AGAIN_LATER answer carries the ingress tier's retry-after
+    hint (seconds)."""
+    adapter = AppLedgerAdapter(app)
+    root = adapter.root_account()
+    alice = root.create(10**9)
+    frame = alice.tx([alice.op_payment(root.account_id, 7)])
+    blob = frame.envelope.to_xdr().hex()
+    st, body = cmd(app, "tx", blob=blob)
+    assert st == 200 and body["status"] == "PENDING"
+    st, body = cmd(app, "tx", blob=blob)
+    assert body["status"] == "DUPLICATE"
+    # a broken seqnum fails check_valid -> ERROR with a result detail
+    bad = alice.tx([alice.op_payment(root.account_id, 7)], seq=10**9)
+    st, body = cmd(app, "tx", blob=bad.envelope.to_xdr().hex())
+    assert body["status"] == "ERROR"
+    # arm the admission-stall fault site: the next submission is
+    # throttled with an explicit backpressure hint (F1 chaos leg)
+    st, body = cmd(app, "faults", action="set", site="ingress.admit-stall",
+                   p="1.0", n="1")
+    assert st == 200
+    fresh = alice.tx([alice.op_payment(root.account_id, 8)],
+                     seq=alice.next_seq() + 1)
+    st, body = cmd(app, "tx", blob=fresh.envelope.to_xdr().hex())
+    assert st == 200 and body["status"] == "TRY_AGAIN_LATER"
+    assert body["retry_after"] > 0
+    cmd(app, "faults", action="clear")
+
+
+def test_ingress_status_set_class_reset(app):
+    """`ingress[?action=status|set-class|reset]` (A1 row): status dumps
+    the class table + counters, set-class re-pins an account at runtime,
+    reset zeroes the counters, and every bad param is a 400."""
+    adapter = AppLedgerAdapter(app)
+    root = adapter.root_account()
+    alice = root.create(10**9)
+    strkey = alice.sk.strkey_public()
+    st, body = cmd(app, "ingress")
+    assert st == 200 and body["enabled"] is True
+    assert set(body["classes"]) == {"priority", "default", "untrusted"}
+    assert body["intake"]["depth"] <= body["intake"]["cap"]
+    # runtime re-pin: alice joins the untrusted class
+    st, body = cmd(app, "ingress", action="set-class",
+                   account=strkey, **{"class": "untrusted"})
+    assert st == 200 and body["class"] == "untrusted"
+    ing = app.herder.ingress
+    assert ing.class_of(alice.sk.public_key.key_bytes).name == "untrusted"
+    st, body = cmd(app, "ingress")
+    assert body["overrides"] == 1
+    # back to default removes the override
+    st, body = cmd(app, "ingress", action="set-class",
+                   account=strkey, **{"class": "default"})
+    assert st == 200
+    assert cmd(app, "ingress")[1]["overrides"] == 0
+    # a submission bumps the admitted counter; reset zeroes it
+    frame = alice.tx([alice.op_payment(root.account_id, 9)])
+    cmd(app, "tx", blob=frame.envelope.to_xdr().hex())
+    st, body = cmd(app, "ingress")
+    assert body["counters"]["default"]["admitted"] >= 1
+    st, body = cmd(app, "ingress", action="reset")
+    assert st == 200
+    assert cmd(app, "ingress")[1]["counters"]["default"]["admitted"] == 0
+    # 400s: unknown class, bad strkey, missing params, unknown action
+    for params in ({"action": "set-class", "account": strkey,
+                    "class": "vip"},
+                   {"action": "set-class", "account": "not-a-key",
+                    "class": "priority"},
+                   {"action": "set-class"},
+                   {"action": "frobnicate"}):
+        st, body = cmd(app, "ingress", **params)
+        assert st == 400, (params, st, body)
+        assert "error" in body
+
+
+def test_ingress_endpoint_when_disabled():
+    """INGRESS_ENABLED=False nodes answer {"enabled": false} instead of
+    404ing operators probing a mixed fleet."""
+    cfg = Config.test_config(0)
+    cfg.DATABASE = "sqlite3://:memory:"
+    cfg.INGRESS_ENABLED = False
+    clock = VirtualClock(ClockMode.VIRTUAL_TIME)
+    a = Application(clock, cfg)
+    a.start()
+    try:
+        assert a.herder.ingress is None
+        st, body = cmd(a, "ingress")
+        assert st == 200 and body == {"enabled": False}
+    finally:
+        a.stop()

@@ -321,6 +321,95 @@ def test_committed_bucketdb_artifact_meets_its_gates():
     assert bd["large"]["accounts"] == 10**6
 
 
+# ------------------------------------------------------------- ingress
+
+def _good_ingress():
+    return {
+        "oversubscription": 6.9,
+        "decided": 800, "admitted": 160, "throttled": 520, "shed": 120,
+        "shed_ratio": 120 / 800,
+        "priority": {"submitted": 48, "applied": 46,
+                     "goodput": 46 / 48},
+        "intake": {"depth": 3, "cap": 24},
+        "sources": {"tracked": 512, "cap": 4096},
+        "outcomes": {"applied": 50, "rejected": 10,
+                     "shed": 120, "throttled": 520},
+        "tx_latency_p95_ms": 4000.0, "unloaded_p95_ms": 6000.0,
+        "p95_ratio": 4000.0 / 6000.0,
+    }
+
+
+def test_ingress_block_validates_and_normalizes():
+    """An `overload` scenario ingress block (ISSUE 18) passes the
+    schema gate and derives the four direction-aware records."""
+    ib = _good_ingress()
+    assert bc.validate_ingress(ib, "t") == []
+    recs = bc.ingress_records(ib, "scenario-overload", "src")
+    by = {r["metric"]: r for r in recs}
+    assert by["ingress_priority_goodput"]["direction"] == "higher"
+    assert by["ingress_priority_goodput"]["value"] == pytest.approx(46 / 48)
+    assert by["ingress_shed_ratio"]["direction"] == "higher"
+    assert by["ingress_tx_latency_p95_ms"]["direction"] == "lower"
+    assert by["ingress_p95_vs_unloaded_ratio"]["direction"] == "lower"
+    assert by["ingress_p95_vs_unloaded_ratio"]["value"] == \
+        pytest.approx(2 / 3)
+    for r in recs:
+        assert bc.validate_record(r, "t") == []
+    # an idle/empty block emits nothing (never commit a 0-baseline)
+    assert bc.ingress_records({"decided": 0}, "p", "s") == []
+
+
+def test_validate_ingress_enforces_the_gates():
+    # decision counters must reconcile
+    ib = _good_ingress()
+    ib["admitted"] = 200
+    assert any("admitted+throttled+shed" in e
+               for e in bc.validate_ingress(ib, "t"))
+    # shed_ratio must be shed/decided
+    ib = _good_ingress()
+    ib["shed_ratio"] = 0.5
+    assert any("shed/decided" in e for e in bc.validate_ingress(ib, "t"))
+    # goodput must be applied/submitted, applied <= submitted
+    ib = _good_ingress()
+    ib["priority"]["goodput"] = 0.1
+    assert any("applied/submitted" in e
+               for e in bc.validate_ingress(ib, "t"))
+    ib = _good_ingress()
+    ib["priority"]["applied"] = 99
+    assert any("applied <= submitted" in e
+               for e in bc.validate_ingress(ib, "t"))
+    # p95 ratio must be its own numerator/denominator
+    ib = _good_ingress()
+    ib["p95_ratio"] = 3.0
+    assert any("p95/unloaded" in e for e in bc.validate_ingress(ib, "t"))
+    # the bounded-memory gate travels with the artifact
+    ib = _good_ingress()
+    ib["intake"]["depth"] = 100
+    assert any("exceeds its cap" in e for e in bc.validate_ingress(ib, "t"))
+    ib = _good_ingress()
+    ib["sources"]["tracked"] = 10**6
+    assert any("exceeds its cap" in e for e in bc.validate_ingress(ib, "t"))
+    # the funnel can never report more sheds than the tier decided
+    ib = _good_ingress()
+    ib["outcomes"]["shed"] = 10**6
+    assert any("exceeds the ingress" in e
+               for e in bc.validate_ingress(ib, "t"))
+    assert bc.validate_ingress(_good_ingress(), "t") == []
+
+
+def test_check_artifact_walks_ingress_blocks(tmp_path):
+    """`check` rejects a committed artifact whose ingress block violates
+    the boundedness gate — the schema travels with the file."""
+    blob = {"metric": "scenario_overload", "unit": "count", "value": 1.0,
+            "platform": "scenario-overload", "ingress": _good_ingress()}
+    p = tmp_path / "BENCH_r97.json"
+    p.write_text(json.dumps(blob))
+    assert bc.check_artifact(str(p)) == []
+    blob["ingress"]["intake"]["depth"] = 999
+    p.write_text(json.dumps(blob))
+    assert any("exceeds its cap" in e for e in bc.check_artifact(str(p)))
+
+
 # ------------------------------------------------------------ comparator
 
 def _rec(metric, value, platform="p", direction="higher", **kw):

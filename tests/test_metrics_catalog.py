@@ -84,7 +84,18 @@ def test_call_site_scan_finds_the_known_core_metrics():
                      "overlay.prop.wasted-bytes",
                      "overlay.prop.pruned",
                      "overlay.prop.hashes",
-                     "overlay.prop.usefulness.worst"):
+                     "overlay.prop.usefulness.worst",
+                     # ISSUE 18 ingress tier: the admission funnel meters
+                     # + boundedness gauges, and the overlay-side
+                     # backpressure signal, must stay under the guard
+                     "herder.ingress.admitted",
+                     "herder.ingress.parked",
+                     "herder.ingress.throttled",
+                     "herder.ingress.shed",
+                     "herder.ingress.pumped",
+                     "herder.ingress.intake-depth",
+                     "herder.ingress.sources",
+                     "overlay.flood.backpressure"):
         assert expected in names
 
 

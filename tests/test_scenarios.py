@@ -1,5 +1,6 @@
 """Scenario lab (ISSUE 8 capstone): tier-1 runs the small seeded
-variants of every scenario (churn / flood / partition / surge), full
+variants of every scenario (churn / flood / partition / surge /
+overload / checkpoint), full
 soaks ride the `slow` marker, and `bench.py --scenario` is driven end to
 end with its bench block schema checked by tools/bench_compare.py.
 
@@ -94,6 +95,34 @@ def test_surge_scenario_evicts_by_fee_bid(tmp_path):
     a = block["assertions"]
     assert a["surge_evicted"] >= 5
     assert a["pool_bounded"] is True
+
+
+@pytest.mark.scenario
+def test_overload_scenario_ingress_holds_the_line(tmp_path):
+    """Acceptance (ISSUE 18): under 5x+ open-loop oversubscription from
+    a 10^6-key Zipf submitter keyspace, the ingress leg keeps priority
+    goodput >= 90% with applied-tx p95 within 2x the unloaded baseline,
+    the ingress-off control leg visibly degrades, every ingress
+    queue/map stays bounded, and the emitted ingress block validates
+    against the committed schema checker."""
+    block = run_scenario("overload", seed=1, workdir=str(tmp_path))
+    _check_block_schema(block)
+    a = block["assertions"]
+    assert a["priority_goodput"] >= 0.9
+    assert a["p95_ratio_vs_unloaded"] <= 2.0
+    assert a["control_priority_goodput"] < a["priority_goodput"]
+    assert a["shed"] > 0 and a["throttled"] > 0
+    assert a["intake_bounded"] is True and a["sources_bounded"] is True
+    assert a["open_loop_distinct_submitters"] > 50
+    ib = block["ingress"]
+    assert bc.validate_ingress(ib, "overload-test") == []
+    # the funnel counted shed/throttled outcomes (sum-contract subset)
+    assert ib["outcomes"].get("shed", 0) > 0
+    assert ib["outcomes"].get("throttled", 0) > 0
+    for metric in ("ingress_priority_goodput", "ingress_shed_ratio",
+                   "ingress_tx_latency_p95_ms",
+                   "ingress_p95_vs_unloaded_ratio"):
+        assert any(r["metric"] == metric for r in block["records"]), metric
 
 
 @pytest.mark.scenario

@@ -657,6 +657,136 @@ def validate_propagation(pb, where: str = "", flood=None) -> List[str]:
     return errs
 
 
+def ingress_records(ib: dict, platform: str, source: str,
+                    round_no=None, at_unix=None) -> List[dict]:
+    """Normalize an `ingress` block (ISSUE 18: the admission-tier
+    overload leg) into direction-aware records: priority-class goodput
+    under overload (higher — the tier's whole point), the shed ratio
+    (higher: under a fixed oversubscription, shedding MORE junk at
+    admission is the desired behavior — a falling shed ratio means junk
+    is leaking into the pool), applied-tx latency p95 (lower), and its
+    ratio against the unloaded baseline (lower; the 2x acceptance
+    gate)."""
+    out: List[dict] = []
+    if not isinstance(ib, dict) or not _num(ib, "decided"):
+        return out
+    pri = ib.get("priority")
+    if isinstance(pri, dict):
+        v = _num(pri, "goodput")
+        if v is not None:
+            out.append(make_record("ingress_priority_goodput", "share",
+                                   v, platform, "higher", source,
+                                   round_no, at_unix))
+    for key, metric, unit, direction in (
+            ("shed_ratio", "ingress_shed_ratio", "share", "higher"),
+            ("tx_latency_p95_ms", "ingress_tx_latency_p95_ms", "ms",
+             "lower"),
+            ("p95_ratio", "ingress_p95_vs_unloaded_ratio", "x",
+             "lower")):
+        v = _num(ib, key)
+        if v is not None:
+            out.append(make_record(metric, unit, v, platform, direction,
+                                   source, round_no, at_unix))
+    return out
+
+
+def validate_ingress(ib, where: str = "") -> List[str]:
+    """Schema check for one `ingress` block (`check`/`--check`): the
+    admission counters must be non-negative ints with the shed ratio
+    actually shed/decided, priority goodput must be applied/submitted in
+    [0, 1], the p95 ratio must be its own numerator/denominator, the
+    intake/source occupancies must respect their declared caps (the
+    bounded-memory acceptance gate travels with the artifact), and the
+    lifecycle funnel's shed/throttled outcomes can never exceed the
+    ingress tier's own decision counts (the funnel tracks first-seen
+    txs only)."""
+    errs: List[str] = []
+    if not isinstance(ib, dict):
+        return ["%s: ingress is not an object: %r" % (where, ib)]
+    vals = {}
+    for key in ("decided", "admitted", "throttled", "shed"):
+        v = ib.get(key)
+        if not isinstance(v, int) or isinstance(v, bool) or v < 0:
+            errs.append("%s: ingress.%s must be an int >= 0, got %r"
+                        % (where, key, v))
+            v = None
+        vals[key] = v
+    if None not in vals.values() and \
+            vals["decided"] != vals["admitted"] + vals["throttled"] + \
+            vals["shed"]:
+        errs.append("%s: ingress.decided %d != admitted+throttled+shed %d"
+                    % (where, vals["decided"],
+                       vals["admitted"] + vals["throttled"] + vals["shed"]))
+    ratio = _num(ib, "shed_ratio")
+    if ratio is None or ratio < 0 or ratio > 1:
+        errs.append("%s: ingress.shed_ratio must be in [0, 1], got %r"
+                    % (where, ib.get("shed_ratio")))
+    elif vals.get("decided"):
+        want = vals["shed"] / vals["decided"] if vals.get("shed") \
+            is not None else None
+        if want is not None and abs(ratio - want) > max(1e-3, 0.01 * want):
+            errs.append("%s: ingress.shed_ratio %.4f != shed/decided %.4f"
+                        % (where, ratio, want))
+    pri = ib.get("priority")
+    if not isinstance(pri, dict):
+        errs.append("%s: ingress.priority must be an object, got %r"
+                    % (where, pri))
+    else:
+        sub, app = pri.get("submitted"), pri.get("applied")
+        gp = _num(pri, "goodput")
+        if not isinstance(sub, int) or not isinstance(app, int) or \
+                isinstance(sub, bool) or isinstance(app, bool) or \
+                sub < 0 or app < 0 or app > sub:
+            errs.append("%s: ingress.priority needs ints "
+                        "0 <= applied <= submitted, got %r/%r"
+                        % (where, app, sub))
+        elif gp is None or gp < 0 or gp > 1:
+            errs.append("%s: ingress.priority.goodput must be in [0, 1], "
+                        "got %r" % (where, pri.get("goodput")))
+        elif sub and abs(gp - app / sub) > max(1e-3, 0.01 * (app / sub)):
+            errs.append("%s: ingress.priority.goodput %.4f != "
+                        "applied/submitted %.4f" % (where, gp, app / sub))
+    p95 = _num(ib, "tx_latency_p95_ms")
+    base = _num(ib, "unloaded_p95_ms")
+    pr = _num(ib, "p95_ratio")
+    if p95 is None or p95 < 0 or base is None or base <= 0 or \
+            pr is None or pr < 0:
+        errs.append("%s: ingress needs finite tx_latency_p95_ms >= 0, "
+                    "unloaded_p95_ms > 0, p95_ratio >= 0; got %r/%r/%r"
+                    % (where, ib.get("tx_latency_p95_ms"),
+                       ib.get("unloaded_p95_ms"), ib.get("p95_ratio")))
+    elif abs(pr - p95 / base) > max(0.01, 0.01 * pr):
+        errs.append("%s: ingress.p95_ratio %.3f != p95/unloaded %.3f"
+                    % (where, pr, p95 / base))
+    # bounded-memory gate: occupancy <= cap for the intake and the
+    # per-source tracking map
+    for blk, occ_key in (("intake", "depth"), ("sources", "tracked")):
+        sub = ib.get(blk)
+        if not isinstance(sub, dict):
+            errs.append("%s: ingress.%s must be an object, got %r"
+                        % (where, blk, sub))
+            continue
+        occ, cap = _num(sub, occ_key), _num(sub, "cap")
+        if occ is None or cap is None or occ < 0 or cap <= 0:
+            errs.append("%s: ingress.%s needs finite %s >= 0 and cap > 0,"
+                        " got %r/%r" % (where, blk, occ_key,
+                                        sub.get(occ_key), sub.get("cap")))
+        elif occ > cap:
+            errs.append("%s: ingress.%s.%s %.0f exceeds its cap %.0f — "
+                        "an unbounded queue in a committed artifact"
+                        % (where, blk, occ_key, occ, cap))
+    outcomes = ib.get("outcomes")
+    if isinstance(outcomes, dict):
+        for kind in ("shed", "throttled"):
+            oc = outcomes.get(kind, 0)
+            lim = vals.get(kind)
+            if isinstance(oc, int) and lim is not None and oc > lim:
+                errs.append("%s: lifecycle outcome %s=%d exceeds the "
+                            "ingress %s count %d" % (where, kind, oc,
+                                                     kind, lim))
+    return errs
+
+
 def _replay_leg_records(leg: dict, platform: str, source: str,
                         round_no, at_unix) -> List[dict]:
     out = []
@@ -934,6 +1064,8 @@ def _walk_breakdowns(blob, name: str, errs: List[str],
         errs.extend(validate_propagation(
             blob["propagation"], name,
             flood=ob.get("flood") if isinstance(ob, dict) else None))
+    if blob.get("ingress") is not None:
+        errs.extend(validate_ingress(blob["ingress"], name))
     if "fleet_verify" in blob:
         errs.extend(validate_fleet_verify(blob["fleet_verify"], name))
     if "hash_bench" in blob:
