@@ -727,6 +727,169 @@ def fleet_bench(n_nodes: int = 3, n_ledgers: int = 12) -> dict:
     return out
 
 
+def fleet_scale_leg(n_nodes: int, n_ledgers: int, seed: int) -> dict:
+    """One N-node consensus run for `bench.py --fleet-scale` (ISSUE 19;
+    ROADMAP item 3's 50-100-node study): an n-node quorum over loopback
+    channels with a seeded three-region latency matrix, closing
+    n_ledgers ledgers under a light payment load. Loopback (not
+    OVER_PEERS) on purpose — the scale leg measures consensus-message
+    complexity (envelopes per slot, the O(n^2) flood baseline), slot
+    convergence under geographic skew, and per-node memory; real-frame
+    wire accounting stays with `--fleet`, which this leg would make
+    O(n^2)-slow at N=50.
+
+    per_node_rss_mb is the measured process RSS delta across the run
+    divided by N: in-process nodes share one interpreter, so per-node
+    self-reports all read the same RSS (footprint_table documents the
+    same caveat). Legs run in one process, so later legs inherit the
+    allocator arena of earlier ones — the delta still tracks each N's
+    incremental growth because freed blocks are reused first."""
+    import gc
+    from stellar_core_tpu.simulation import topologies
+    from stellar_core_tpu.simulation.geography import LatencyMatrix
+    from stellar_core_tpu.simulation.simulation import Simulation
+    from stellar_core_tpu.testing import AppLedgerAdapter
+    from stellar_core_tpu.util import rnd
+    from stellar_core_tpu.util.footprint import process_stats
+
+    rnd.reseed(seed ^ n_nodes)
+    gc.collect()
+    rss0 = process_stats()["rss_mb"]
+    sim = topologies.core(
+        n_nodes, max(2, (n_nodes * 2 + 1) // 3),
+        mode=Simulation.OVER_LOOPBACK,
+        cfg_tweak=lambda c: (setattr(c, "TRACE_ENABLED", True),
+                             setattr(c, "DATABASE", "sqlite3://:memory:")))
+    matrix = LatencyMatrix(sorted(sim.nodes), "three-region", seed=seed)
+    sim.apply_latency_matrix(matrix)
+    sim.start_all_nodes()
+    sim.crank_until(lambda: sim.have_all_externalized(2), 200000)
+    first = next(iter(sim.nodes.values())).app
+    ad = AppLedgerAdapter(first)
+    root = ad.root_account()
+    base_seq = ad.seq_num(root.account_id)
+    for i in range(4):
+        first.submit_transaction(root.tx(
+            [root.op_payment(root.account_id, 1 + i)],
+            seq=base_seq + 1 + i))
+    target = 1 + n_ledgers   # genesis is seq 1; n_ledgers consensus closes
+    ok = sim.crank_until(lambda: sim.have_all_externalized(target),
+                         200000 + 20000 * n_nodes)
+    agg = sim.fleet()
+    stats = agg.fleet_stats()
+    rss1 = process_stats()["rss_mb"]
+    scp = stats.get("scp")
+    fpt = stats.get("footprint")
+    per_node_rss = round(max(0.0, rss1 - rss0) / n_nodes, 3)
+    if fpt is not None:
+        # replace the shared-interpreter self-report with the measured
+        # scaling signal (see docstring)
+        fpt["per_node_rss_mb"] = per_node_rss
+    leg = {
+        "nodes": n_nodes,
+        "platform": "fleet-n%d" % n_nodes,
+        "converged": bool(ok),
+        "ledgers_closed": min(
+            n.app.ledger_manager.last_closed_ledger_num()
+            for n in sim.nodes.values()) - 1,
+        "per_node_rss_mb": per_node_rss,
+        "rss_delta_mb": round(max(0.0, rss1 - rss0), 3),
+        "externalize_skew_p95_ms": round(
+            stats["summary"]["externalize_skew_p95_s"] * 1e3, 3),
+        "envelopes_per_slot": scp["envelopes_per_slot"]
+        if scp is not None else None,
+        "latency": {"profile": matrix.profile, "seed": matrix.seed,
+                    "regions": sorted(set(matrix.region.values()))},
+        "scp": scp,
+        "footprint": fpt,
+    }
+    sim.stop_all_nodes()
+    gc.collect()
+    return leg
+
+
+def fleet_scale_main(argv) -> int:
+    """`bench.py --fleet-scale [--sizes 10,25,50] [--ledgers 6]
+    [--record] [--history PATH] [--tolerance T] [--out FILE]`: the
+    N-vs-cost scaling leg (ISSUE 19). One in-process simulation per
+    fleet size, each emitting three gated records under its own
+    `fleet-n<N>` platform key — `per_node_rss_mb` (lower; the N-vs-RSS
+    curve), `externalize_skew_p95_ms` (lower; convergence under the
+    three-region matrix), and `envelopes_per_slot` (lower; the O(n^2)
+    flood baseline ROADMAP item 1's BLS quorum certificates must beat)
+    — plus the worst ballot round count. Pure Python (no jax import):
+    safe to run inline; never touches the device relay."""
+    import argparse
+    bc = _bench_compare_mod()
+    ap = argparse.ArgumentParser(prog="bench.py --fleet-scale")
+    ap.add_argument("--fleet-scale", action="store_true")
+    ap.add_argument("--sizes", default="10,25,50")
+    ap.add_argument("--ledgers", type=int, default=6)
+    ap.add_argument("--seed", type=int, default=0x5CA1E)
+    ap.add_argument("--record", action="store_true")
+    ap.add_argument("--history",
+                    default=os.path.join(_REPO, "bench", "history.jsonl"))
+    ap.add_argument("--tolerance", type=float, default=0.25)
+    ap.add_argument("--out", help="also write the block to this file")
+    args = ap.parse_args(argv)
+    sizes = sorted({int(x) for x in args.sizes.split(",") if x.strip()})
+
+    src = "bench.py --fleet-scale"
+    legs = {}
+    errors = {}
+    records = []
+    for n in sizes:
+        try:
+            leg = fleet_scale_leg(n, args.ledgers, args.seed)
+        except Exception as e:                      # noqa: BLE001
+            errors["n%d" % n] = "%s: %s" % (type(e).__name__, e)
+            continue
+        legs[str(n)] = leg
+        plat = leg["platform"]
+        records.append(bc.make_record(
+            "per_node_rss_mb", "MB", leg["per_node_rss_mb"], plat,
+            "lower", src))
+        records.append(bc.make_record(
+            "externalize_skew_p95_ms", "ms",
+            leg["externalize_skew_p95_ms"], plat, "lower", src))
+        records.extend(bc.scp_records(leg.get("scp"), plat, src))
+
+    out = {
+        "metric": "fleet_scale_envelopes_per_slot",
+        "unit": "envelopes",
+        "value": max((leg["envelopes_per_slot"] or 0.0
+                      for leg in legs.values()), default=0.0),
+        "platform": "fleet-scale",
+        "sizes": sizes,
+        "ledgers": args.ledgers,
+        "seed": args.seed,
+        "legs": legs,
+    }
+    if errors:
+        out["errors"] = errors
+    out["records"] = records
+    history = bc.load_history(args.history)
+    report = bc.compare(records, history, tolerance=args.tolerance)
+    if args.record:
+        commit = _git_commit()
+        now = int(time.time())
+        for rec in records:
+            if rec.get("at_unix") is None:
+                rec["at_unix"] = now
+            if rec.get("commit") is None:
+                rec["commit"] = commit
+        report["recorded"] = bc.append_history(args.history, records)
+    out["compare"] = report
+    if args.out:
+        with open(args.out, "w") as fh:
+            json.dump(out, fh, indent=1, sort_keys=True)
+    print(json.dumps(out, indent=1, sort_keys=True))
+    # a leg that produced no data is a failure, not a green gate
+    if not legs or errors:
+        return 1
+    return 1 if report["regressions"] else 0
+
+
 def fleet_verify_child(chunk: int = 8192, chunks: int = 3,
                        iters: int = 4) -> dict:
     """One fleet-verify measurement at the CURRENT process's device
@@ -2208,6 +2371,12 @@ if __name__ == "__main__":
         # the `fleet` block (slot-latency p50/p95, externalize skew);
         # does not touch jax or the device relay
         print(json.dumps(fleet_bench()))
+    elif "--fleet-scale" in sys.argv:
+        # N-vs-cost scaling leg (ISSUE 19): 10/25/50-node sims under a
+        # three-region latency matrix; per-node RSS, externalize skew
+        # p95, envelopes per slot, gated against bench/history.jsonl;
+        # does not touch jax or the device relay
+        sys.exit(fleet_scale_main(sys.argv[1:]))
     elif "--fleet-verify" in sys.argv:
         # multi-device verify leg (ISSUE 11): sharded drains on forced
         # virtual-CPU fleets, gated against bench/history.jsonl; spawns

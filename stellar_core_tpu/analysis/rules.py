@@ -265,6 +265,17 @@ class ModuleFacts(ast.NodeVisitor):
             if lit is not None:
                 self.metric_literals.append(
                     (node.lineno, lit, self._qual()))
+        # footprint-census registrations (ISSUE 19): a
+        # `track_struct("<name>", ...)` enrollment surfaces the
+        # per-struct gauge `footprint.struct.<name>` — cataloged like
+        # any metric registration, so a bounded structure cannot join
+        # the census undocumented
+        elif callee == "track_struct" and node.args:
+            lit = _literal_prefix(node.args[0])
+            if lit is not None:
+                self.metric_literals.append(
+                    (node.lineno, "footprint.struct." + lit,
+                     self._qual()))
 
         # thread entry points
         if callee == "Thread":

@@ -53,6 +53,9 @@ class HerderSCPDriver(SCPDriver):
         # progression into the per-slot timeline (always on)
         self.tracer = getattr(herder.app, "tracer", None)
         self.timeline = getattr(herder.app, "slot_timeline", None)
+        # consensus cockpit: the envelope/round hook sites in scp/
+        # read this attribute off the driver (Herder builds it first)
+        self.scp_stats = getattr(herder, "scp_stats", None)
 
     # -- envelope signing ----------------------------------------------------
     def _envelope_sign_bytes(self, st) -> bytes:
@@ -264,6 +267,20 @@ class Herder:
         self.app = app
         cfg = app.config
         self.verifier = app.sig_verifier
+        # consensus cockpit (ISSUE 19): per-slot phase/round/envelope
+        # attribution + quorum health, built BEFORE the driver so the
+        # driver's hook sites see it (docs/observability.md
+        # #consensus-cockpit)
+        from ..scp.local_node import all_nodes_of
+        from ..scp.scp_stats import ScpStats
+        self.scp_stats = ScpStats(
+            metrics=getattr(app, "metrics", None),
+            tracer=getattr(app, "tracer", None),
+            now_fn=app.clock.now,
+            self_id=cfg.node_id().key_bytes.hex(),
+            timeline=getattr(app, "slot_timeline", None))
+        self.scp_stats.set_quorum(
+            nb.hex() for nb in all_nodes_of(cfg.QUORUM_SET))
         self.scp_driver = HerderSCPDriver(self)
         self.scp = SCP(self.scp_driver, cfg.node_id(),
                        cfg.NODE_IS_VALIDATOR, cfg.QUORUM_SET)
@@ -319,6 +336,7 @@ class Herder:
         self.out_of_sync_timer = VirtualTimer(app.clock)
         self.recovery_started_at: Optional[float] = None
         self.recoveries = 0
+        self._recovery_counted = False
         self._ext_hints: Dict[int, set] = {}
         self.ledger_close_meta = None
         # register own qset
@@ -374,6 +392,7 @@ class Herder:
             # headline recovery number), and journal the moment
             dt = max(0.0, self.app.clock.now() - self.recovery_started_at)
             self.recovery_started_at = None
+            self._recovery_counted = False
             self.out_of_sync_timer.cancel()
             m = self._metrics()
             if m is not None:
@@ -411,6 +430,15 @@ class Herder:
         if tl is not None:
             tl.record(self.current_slot(), "recovery.lost-sync",
                       dedupe=True)
+        # one anchor per recovery episode (ISSUE 19 satellite): the
+        # clock stamp lands HERE, at the same moment the journal's
+        # `recovery.lost-sync` record does, so time-to-tracking and the
+        # timeline measure the same episode. The first poll used to
+        # stamp it a poll-dispatch later — the two surfaces disagreed by
+        # that skew. Episode COUNTING stays with the default poll path
+        # (an app-installed hook overrides recovery, not the anchor).
+        if self.recovery_started_at is None:
+            self.recovery_started_at = self.app.clock.now()
         # an app-installed hook still overrides (test/operator hook
         # contract); the default is the real self-healing path below
         hook = getattr(self.app, "out_of_sync_recovery", None)
@@ -491,9 +519,13 @@ class Herder:
             return
         m = self._metrics()
         clock = self.app.clock
-        first = self.recovery_started_at is None
-        if first:
+        if self.recovery_started_at is None:
+            # direct invocation (tests, operator): no _lost_sync ran, so
+            # the episode anchors at the first poll
             self.recovery_started_at = clock.now()
+        first = not self._recovery_counted
+        if first:
+            self._recovery_counted = True
             self.recoveries += 1
         if m is not None:
             m.new_meter("herder.recovery.attempt").mark()
@@ -869,6 +901,11 @@ class Herder:
         m = self._metrics()
         if m is not None:
             m.new_meter("scp.envelope.emit").mark()
+        # consensus cockpit: our half of the O(n²) flood baseline
+        from ..scp.scp_stats import STATEMENT_KIND
+        st = envelope.statement
+        self.scp_stats.envelope_sent(st.slotIndex,
+                                     STATEMENT_KIND[st.pledges.disc])
         self.persist_latest_scp_state(envelope.statement.slotIndex)
         overlay = getattr(self.app, "overlay_manager", None)
         if overlay is not None:
@@ -940,9 +977,25 @@ class Herder:
             lambda: self.trigger_next_ledger(slot))
 
     # -- externalization -----------------------------------------------------
+    def slot_latency_anchor(self, slot_index: int) -> Optional[float]:
+        """THE slot-latency anchor (ISSUE 19 satellite;
+        docs/observability.md#slot-latency-anchor): the slot's
+        `nominate.trigger` timeline stamp, falling back to the in-memory
+        nomination-start clock when no journal is attached. The
+        timeline's externalize tag, ScpStats' phase wall, and the
+        recovery telemetry all measure slot latency from this one
+        definition."""
+        tl = getattr(self.app, "slot_timeline", None)
+        if tl is not None:
+            ev = tl.first(slot_index, "nominate.trigger")
+            if ev is not None:
+                return ev["t"]
+        return self._nominate_started.get(slot_index)
+
     @main_thread_only
     def value_externalized(self, slot_index: int, value: bytes) -> None:
-        t0 = self._nominate_started.pop(slot_index, None)
+        t0 = self.slot_latency_anchor(slot_index)
+        self._nominate_started.pop(slot_index, None)
         self._nominate_started = {
             s: t for s, t in self._nominate_started.items()
             if s > slot_index}   # drop stale never-externalized slots
@@ -967,6 +1020,10 @@ class Herder:
             tl.record(slot_index, "externalize", dedupe=True,
                       **({} if lat is None else
                          {"nominate_to_externalize_s": round(lat, 6)}))
+        # consensus cockpit: derive phase latencies from the stamps the
+        # timeline just completed, latch the slot's round/envelope/lag
+        # attribution (must run AFTER the `externalize` record above)
+        self.scp_stats.slot_externalized(slot_index)
         sv = StellarValue.from_xdr(value)
         txset = self.pending.get_tx_set(sv.txSetHash)
         assert txset is not None, "externalized unknown txset"
@@ -1021,6 +1078,7 @@ class Herder:
         overlay = getattr(self.app, "overlay_manager", None)
         if overlay is not None and hasattr(overlay, "ledger_closed"):
             overlay.ledger_closed(slot_index)
+        self.scp_stats.slot_closed(slot_index)
 
         if not self.app.config.MANUAL_CLOSE:
             self._arm_trigger_timer()
@@ -1034,10 +1092,20 @@ class Herder:
             t = VirtualTimer(self.app.clock)
             self._scp_timers[key] = t
         t.cancel()
+        ss = self.scp_stats
         if cb is None:
+            ss.timer_cancelled(slot_index, timer_id)
             return
+        # consensus cockpit: attribute every fire to (timer, round) —
+        # arming over a pending schedule counts the implicit cancel
+        ss.timer_armed(slot_index, timer_id)
+
+        def fired() -> None:
+            ss.timer_fired(slot_index, timer_id)
+            cb()
+
         t.expires_from_now(timeout)
-        t.async_wait(cb)
+        t.async_wait(fired)
 
     # -- persistence ---------------------------------------------------------
     def save_scp_history(self, slot_index: int) -> None:

@@ -62,6 +62,15 @@ class Application:
         self.slot_timeline = SlotTimeline(
             now_fn=clock.now, max_slots=config.SLOT_TIMELINE_SLOTS)
 
+        # node footprint census (util/footprint.py, ISSUE 19): every
+        # bounded structure below registers and self-reports occupancy /
+        # capacity — the per-node overhead table behind the admin
+        # `footprint` endpoint and the --fleet-scale N-vs-RSS curve
+        from ..util.footprint import BoundedStructRegistry
+        self.footprint = BoundedStructRegistry(
+            metrics=self.metrics, now_fn=clock.now,
+            node_name=config.node_name())
+
         # fault injector (util/faults.py): armed from config and/or the
         # SCT_FAULTS env spec; every subsystem reaches it through
         # app.faults (or a direct reference installed below), and an
@@ -171,6 +180,69 @@ class Application:
         from .maintainer import ExternalQueue, Maintainer
         self.external_queue = ExternalQueue(self)
         self.maintainer = Maintainer(self)
+
+        self._register_footprint()
+
+    def _register_footprint(self) -> None:
+        """Enroll every bounded structure in the footprint census
+        (ISSUE 19). Names are LITERALS — sctlint's M1 scanner catalogs
+        each as `footprint.struct.<name>` against docs/metrics.md, so a
+        new bounded structure can't join the census undocumented."""
+        fp = self.footprint
+        tl = self.slot_timeline
+        fp.track_struct(
+            "slot-timeline", "ring",
+            lambda: tl.max_slots * tl.max_events_per_slot,
+            lambda: sum(len(evs) for evs in tl._slots.values()),
+            lambda: sum(len(evs) for evs in tl._slots.values()) * 160)
+        lc = self.herder.tx_lifecycle
+        fp.track_struct(
+            "tx-lifecycle", "map",
+            lambda: lc.MAX_TRACKED, lambda: len(lc._pending))
+        ss = self.herder.scp_stats
+        fp.track_struct(
+            "scp-slots", "ring",
+            lambda: ss.MAX_SLOTS, lambda: len(ss._slots))
+        fp.track_struct(
+            "scp-peers", "map",
+            lambda: ss.MAX_PEERS, lambda: len(ss.peers))
+        ing = self.herder.ingress
+        if ing is not None:
+            fp.track_struct(
+                "ingress-intake", "deque",
+                lambda: ing.intake_depth, lambda: ing._intake_total)
+            fp.track_struct(
+                "ingress-sources", "cache",
+                lambda: ing._sources._max, lambda: len(ing._sources))
+        ov = self.overlay_manager
+        ps = getattr(ov, "prop_stats", None)
+        if ps is not None:
+            fp.track_struct(
+                "prop-hashes", "lru",
+                lambda: ps.MAX_HASHES, lambda: len(ps._hashes))
+            fp.track_struct(
+                "prop-peers", "map",
+                lambda: ps.MAX_PEERS, lambda: len(ps.peers))
+        cfg = self.config
+        fp.track_struct(
+            "send-queues", "bytes",
+            lambda: cfg.PEER_SEND_QUEUE_LIMIT_BYTES *
+            max(1, ov.num_connections()),
+            lambda: ov.send_queue_depth()[0],
+            lambda: ov.send_queue_depth()[0])
+        from ..crypto import keys as _keys
+        fp.track_struct(
+            "verify-cache", "cache",
+            lambda: _keys._verify_cache._max,
+            lambda: len(_keys._verify_cache),
+            lambda: len(_keys._verify_cache) * 96)
+        root = self.ledger_manager.root
+        cache = getattr(root, "_cache", None)
+        if cache is not None:
+            fp.track_struct(
+                "entry-cache", "lru",
+                lambda: cache._max, lambda: len(cache),
+                lambda: len(cache) * 256)
 
     # -- identity ------------------------------------------------------------
     def network_root_key(self) -> SecretKey:

@@ -417,16 +417,79 @@ class CommandHandler:
             out["status"] = "reset"
         return out
 
+    def cmd_scpstats(self, params) -> dict:
+        """Consensus cockpit (ISSUE 19 tentpole;
+        docs/observability.md#consensus-cockpit): SCP's own attribution
+        in one JSON blob — per-slot phase latencies derived from the
+        slot-timeline stamps (nominate→prepare→confirm→externalize,
+        reconciling with `timeline` by construction), nomination/ballot
+        round counts, timer-fire attribution (which timer, which round,
+        fired vs cancelled), per-statement-type envelopes-per-slot
+        (sent AND received — the O(n²) flood baseline), per-peer
+        envelope lag, and quorum health. `scpstats?slot=N` returns one
+        slot's full record; `?action=reset` zeroes the aggregates
+        (registry metrics keep their monotonic histories). The same
+        data is scrapeable as `sct_scp_*` series via
+        `metrics?format=prometheus`; the `fleet` field is the compact
+        shape util/fleet.py merges into the fleet-wide
+        envelopes-per-slot baseline."""
+        herder = self.app.herder
+        ss = getattr(herder, "scp_stats", None)
+        if ss is None:
+            return {"error": "consensus cockpit unavailable"}
+        action = params.get("action", "status")
+        if action not in ("status", "reset"):
+            raise CommandParamError(
+                "parameter 'action' must be status|reset, got %r" % action)
+        slot = _int_param(params, "slot", None, minimum=0)
+        if slot is not None:
+            rep = ss.slot_report(slot)
+            if rep is None:
+                raise CommandParamError(
+                    "no consensus record for slot %d (ring retains %d "
+                    "slots)" % (slot, ss.MAX_SLOTS))
+            return rep
+        if action == "reset":
+            ss.reset()
+        from ..herder.herder import HerderState
+        out = ss.to_json()
+        out["health"] = ss.health(
+            herder.current_slot(),
+            include_open=herder.state != HerderState.HERDER_TRACKING_STATE)
+        out["fleet"] = ss.fleet_json()
+        if action == "reset":
+            out["status"] = "reset"
+        return out
+
+    def cmd_footprint(self, params) -> dict:
+        """Node footprint census (ISSUE 19 tentpole;
+        docs/observability.md#node-footprint): the per-node overhead
+        table — every registered bounded structure's occupancy /
+        capacity / approx bytes (hop rings, LRU caches, ingress intake,
+        tx-lifecycle tracker, timelines, SCP state, send queues) plus
+        process RSS / thread count / fd count. `over_capacity` is
+        always empty unless a declared bound is broken. Scrapeable as
+        `sct_footprint_*` series via `metrics?format=prometheus`; the
+        fleet aggregator consumes this endpoint on live nodes for the
+        N-vs-RSS scaling curve (`bench.py --fleet-scale`)."""
+        fp = getattr(self.app, "footprint", None)
+        if fp is None:
+            return {"error": "footprint census unavailable"}
+        return fp.to_json()
+
     def cmd_health(self, params) -> dict:
-        """Six-cockpit health rollup (ISSUE 17 satellite;
+        """Seven-cockpit health rollup (ISSUE 17 satellite, consensus
+        leg ISSUE 19;
         docs/observability.md#propagation-cockpit): the single scrape a
         fleet operator watches — device breaker states (verify + hash)
         with their recovery episodes, flood duplication ratio, native
-        apply bails, bucketdb SQL fallbacks, and the worst peer's
-        propagation usefulness — condensed to a coarse
-        `status: ok|degraded|critical`. Degraded = a breaker not
-        closed, SQL-fallback degrades, or the node out of sync;
-        critical = every wired device breaker open."""
+        apply bails, bucketdb SQL fallbacks, the worst peer's
+        propagation usefulness, and the consensus leg (stuck slots with
+        absent-member diagnosis, quorum gaps, ballot-round inflation) —
+        condensed to a coarse `status: ok|degraded|critical`.
+        Degraded = a breaker not closed, SQL-fallback degrades, the
+        node out of sync, or a consensus problem; critical = every
+        wired device breaker open."""
         app = self.app
         problems: list = []
         out: dict = {}
@@ -469,6 +532,30 @@ class CommandHandler:
                 pj["peers"]["worst_usefulness"]
             out["redundant_bandwidth_share"] = \
                 pj["redundant_bandwidth_share"]
+        # consensus leg (ISSUE 19): stuck slots name the absent
+        # quorum-slice members; the in-flight slot only counts once the
+        # herder has lost sync (mid-nomination is not stuck)
+        ss = getattr(app.herder, "scp_stats", None)
+        if ss is not None:
+            from ..herder.herder import HerderState
+            lost = app.herder.state != HerderState.HERDER_TRACKING_STATE
+            ch = ss.health(app.herder.current_slot(), include_open=lost)
+            out["consensus"] = ch
+            for s in ch["stuck_slots"]:
+                problems.append(
+                    "slot %d stuck (absent: %s)" % (
+                        s["slot"],
+                        ", ".join(a[:8] for a in s["absent"]) or "none"))
+            q = ch["quorum"]
+            if q["missing"]:
+                problems.append("%d quorum member(s) never heard from"
+                                % len(q["missing"]))
+            if q["behind"]:
+                problems.append("%d quorum member(s) behind"
+                                % len(q["behind"]))
+            if ch["ballot_inflated"]:
+                problems.append("ballot rounds inflated (worst %d)"
+                                % ch["ballot_rounds_worst"])
         synced = app.ledger_manager.is_synced()
         out["synced"] = synced
         if not synced:

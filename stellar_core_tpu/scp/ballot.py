@@ -325,6 +325,11 @@ class BallotProtocol:
         if got_bumped:
             # a new counter starts a new "heard from quorum" round
             self.heard_from_quorum = False
+            ss = getattr(self._driver(), "scp_stats", None)
+            if ss is not None:
+                # consensus cockpit (ISSUE 19): ballot-round inflation
+                # (counter climb) per slot
+                ss.ballot_bumped(self.slot.slot_index, ballot[0])
 
     def abandon_ballot(self, n: int = 0) -> bool:
         """Timer fired or v-blocking ahead: move to a higher counter with

@@ -40,6 +40,13 @@ class SCPDriver:
     # consensus progression for the fleet aggregator — always on, unlike
     # the tracer.
     timeline = None
+    # consensus cockpit (scp/scp_stats.py, ISSUE 19). When attached
+    # (Herder wires the application's), the envelope sites in
+    # scp/slot.py, the round hooks in scp/nomination.py and
+    # scp/ballot.py, and the timer plumbing in herder/herder.py feed
+    # per-slot phase/round/envelope attribution. None keeps
+    # standalone/test drivers cockpit-free.
+    scp_stats = None
 
     def _trace_instant(self, name: str, slot_index: int, **tags) -> None:
         from ..util.tracing import tracer_instant

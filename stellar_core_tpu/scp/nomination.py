@@ -197,6 +197,12 @@ class NominationProtocol:
         self.nomination_started = True
         self.previous_value = previous_value
         self.round_number += 1
+        ss = getattr(self._driver(), "scp_stats", None)
+        if ss is not None:
+            # consensus cockpit (ISSUE 19): round count per slot; a
+            # timed_out re-entry is a nomination-timer-driven round
+            ss.nomination_round(self.slot.slot_index, self.round_number,
+                                timed_out)
         self.update_round_leaders()
         modified = False
         if self._local().node_id.key_bytes in self.round_leaders:

@@ -45,12 +45,22 @@ class Slot:
                          is_self: bool = False) -> int:
         st = envelope.statement
         assert st.slotIndex == self.slot_index
+        own = is_self or \
+            st.nodeID.key_bytes == self.scp.local_node.node_id.key_bytes
         tl = getattr(self.scp.driver, "timeline", None)
-        if tl is not None and not is_self and \
-                st.nodeID.key_bytes != self.scp.local_node.node_id.key_bytes:
+        if tl is not None and not own:
             # a flood echo of our own statement is not a peer arrival
             tl.record(self.slot_index, _SEEN_EVENT[st.pledges.disc],
                       node=st.nodeID.key_bytes.hex(), dedupe=True)
+        ss = getattr(self.scp.driver, "scp_stats", None)
+        if ss is not None:
+            # consensus cockpit (ISSUE 19): count EVERY peer arrival —
+            # the timeline above dedups to first-arrivals, the cockpit's
+            # envelopes-per-slot baseline must see the full flood
+            from .scp_stats import STATEMENT_KIND
+            ss.envelope_received(self.slot_index,
+                                 STATEMENT_KIND[st.pledges.disc],
+                                 st.nodeID.key_bytes.hex(), is_self=own)
         if st.pledges.disc == SCPStatementType.SCP_ST_NOMINATE:
             return self.nomination.process_envelope(envelope)
         return self.ballot.process_envelope(envelope, is_self)

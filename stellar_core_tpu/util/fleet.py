@@ -76,6 +76,11 @@ class FleetAggregator:
         # propagation cockpit (ISSUE 17): per-node causal hop records,
         # merged by msg_hash into relay trees below
         prop = getattr(om, "prop_stats", None)
+        # consensus cockpit + footprint census (ISSUE 19): per-node
+        # envelopes/rounds/phases and the bounded-structure census,
+        # merged into the scp_summary / footprint_table blocks below
+        ss = getattr(getattr(app, "herder", None), "scp_stats", None)
+        fp = getattr(app, "footprint", None)
         self.nodes.append({
             "name": name,
             "node_id": app.config.node_id().key_bytes.hex(),
@@ -84,6 +89,8 @@ class FleetAggregator:
             "survey": survey,
             "overlay": overlay,
             "propagation": prop.fleet_json() if prop is not None else None,
+            "scp": ss.fleet_json() if ss is not None else None,
+            "footprint": fp.fleet_json() if fp is not None else None,
         })
 
     def add_http(self, base_url: str, name: Optional[str] = None,
@@ -118,6 +125,8 @@ class FleetAggregator:
             # it under "fleet" precisely for this intake path)
             "overlay": (get("/overlaystats") or {}).get("fleet"),
             "propagation": (get("/propagation") or {}).get("fleet"),
+            "scp": (get("/scpstats") or {}).get("fleet"),
+            "footprint": get("/footprint"),
         })
 
     # -- cross-host alignment ------------------------------------------------
@@ -372,6 +381,8 @@ class FleetAggregator:
                     _percentile(latencies, 0.95), 6),
                 "externalize_skew_p50_s": round(
                     _percentile(skews, 0.50), 6),
+                "externalize_skew_p95_s": round(
+                    _percentile(skews, 0.95), 6),
                 "externalize_skew_max_s": round(
                     max(skews), 6) if skews else 0.0,
                 "stragglers": stragglers,
@@ -395,7 +406,128 @@ class FleetAggregator:
                 prop["hop_latency_p95_ms"]
             out["summary"]["redundant_bandwidth_share"] = \
                 prop["redundant_bandwidth_share"]
+        scp = self.scp_summary()
+        if scp is not None:
+            out["scp"] = scp
+            out["summary"]["envelopes_per_slot"] = \
+                scp["envelopes_per_slot"]
+        fpt = self.footprint_table()
+        if fpt is not None:
+            out["footprint"] = fpt
+            out["summary"]["per_node_rss_mb"] = fpt["per_node_rss_mb"]
         return out
+
+    # -- consensus cockpit merge (ISSUE 19) ----------------------------------
+    def scp_summary(self) -> Optional[dict]:
+        """Fleet-wide `scp` block for bench/scenario artifacts: the
+        committed envelopes-per-slot baseline (fleet-wide receive count
+        per externalized slot, averaged over slots every scp-reporting
+        node observed — ROADMAP item 1's BLS quorum certificates must
+        beat this number), the per-statement-type split, per-slot
+        wall/phase latencies (the slowest node's, the fleet's real slot
+        cost), and worst round counts. None when no node exported
+        consensus-cockpit data."""
+        reporting = [n for n in self.nodes if n.get("scp")]
+        if not reporting:
+            return None
+        per_type: Dict[str, int] = {}
+        sent_total = recv_total = 0
+        slots: Dict[str, dict] = {}
+        worst_rounds = {"nomination": 0, "ballot": 0}
+        # slot -> [per-node slot records]; only slots EVERY reporting
+        # node retains feed the envelopes-per-slot mean (a slot some
+        # ring already pruned would undercount the fleet flood)
+        by_slot: Dict[int, List[dict]] = {}
+        for node in reporting:
+            scp = node["scp"]
+            t = scp.get("totals") or {}
+            sent_total += t.get("sent", 0)
+            recv_total += t.get("recv", 0)
+            for slot_str, rec in (scp.get("slots") or {}).items():
+                by_slot.setdefault(int(slot_str), []).append(rec)
+                for k, v in (rec.get("recv") or {}).items():
+                    per_type[k] = per_type.get(k, 0) + v
+                r = rec.get("rounds") or {}
+                for k in worst_rounds:
+                    worst_rounds[k] = max(worst_rounds[k], r.get(k, 0))
+        env_counts: List[float] = []
+        for slot in sorted(by_slot):
+            recs = by_slot[slot]
+            ext = [r for r in recs if r.get("externalized")]
+            if not ext:
+                continue
+            envelopes = sum(sum((r.get("recv") or {}).values())
+                            for r in recs)
+            walls = [r["phases"]["wall_s"] for r in recs
+                     if r.get("phases") and
+                     r["phases"].get("wall_s") is not None]
+            entry = {"envelopes": envelopes,
+                     "wall_s": round(max(walls), 6) if walls else None,
+                     "nodes": len(recs)}
+            phases: Dict[str, float] = {}
+            for r in recs:
+                for p, v in ((r.get("phases") or {}).get("phase_s")
+                             or {}).items():
+                    if v is not None:
+                        phases[p] = max(phases.get(p, 0.0), v)
+            if phases:
+                entry["phase_s"] = {p: round(v, 6)
+                                    for p, v in sorted(phases.items())}
+            slots[str(slot)] = entry
+            if len(recs) == len(reporting):
+                env_counts.append(float(envelopes))
+        return {
+            "nodes": len(reporting),
+            "envelopes_per_slot": round(
+                sum(env_counts) / len(env_counts), 3) if env_counts
+            else 0.0,
+            "per_type": dict(sorted(per_type.items())),
+            "sent_total": sent_total,
+            "recv_total": recv_total,
+            "rounds": worst_rounds,
+            "slots": slots,
+        }
+
+    # -- footprint census merge (ISSUE 19) -----------------------------------
+    def footprint_table(self) -> Optional[dict]:
+        """Per-node overhead table + the N-vs-RSS scaling signal for
+        `bench.py --fleet-scale`: each node's process stats and every
+        registered bounded structure's occupancy/capacity, plus fleet
+        totals (`per_node_rss_mb` is the mean — in-process simulations
+        share one process, so the sim driver overrides it with the
+        measured RSS delta / N; against live HTTP nodes the per-node
+        readings are real). None when no node exported a census."""
+        reporting = [n for n in self.nodes if n.get("footprint")]
+        if not reporting:
+            return None
+        per_node: Dict[str, dict] = {}
+        rss = []
+        over: Dict[str, list] = {}
+        bytes_total = 0
+        for node in reporting:
+            fp = node["footprint"]
+            proc = fp.get("process") or {}
+            rss.append(proc.get("rss_mb", 0.0))
+            bytes_total += fp.get("approx_bytes_total", 0)
+            if fp.get("over_capacity"):
+                over[node["name"]] = list(fp["over_capacity"])
+            per_node[node["name"]] = {
+                "process": proc,
+                "approx_bytes_total": fp.get("approx_bytes_total", 0),
+                "structs": {
+                    name: {k: v for k, v in entry.items()
+                           if k in ("kind", "occupancy", "capacity",
+                                    "approx_bytes", "error")}
+                    for name, entry in (fp.get("structs") or {}).items()},
+            }
+        return {
+            "nodes": len(reporting),
+            "per_node_rss_mb": round(sum(rss) / len(rss), 3),
+            "rss_mb_max": round(max(rss), 3) if rss else 0.0,
+            "approx_bytes_total": bytes_total,
+            "over_capacity": over,
+            "per_node": per_node,
+        }
 
     # -- propagation trees (ISSUE 17) ----------------------------------------
     MIN_USEFULNESS_SAMPLES = 4

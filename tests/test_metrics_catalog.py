@@ -95,7 +95,44 @@ def test_call_site_scan_finds_the_known_core_metrics():
                      "herder.ingress.pumped",
                      "herder.ingress.intake-depth",
                      "herder.ingress.sources",
-                     "overlay.flood.backpressure"):
+                     "overlay.flood.backpressure",
+                     # ISSUE 19 consensus cockpit: the dynamic per-phase
+                     # / per-round / per-statement-type scp.* prefixes
+                     # must stay under the drift guard
+                     "scp.phase.%s",
+                     "scp.slot.wall",
+                     "scp.rounds.%s",
+                     "scp.timer.%s.fired",
+                     "scp.timer.%s.cancelled",
+                     "scp.envelopes.sent.%s",
+                     "scp.envelopes.recv.%s",
+                     "scp.peer.lag",
+                     "scp.quorum.missing",
+                     "scp.quorum.behind",
+                     "scp.slots.tracked",
+                     "scp.slots.pruned",
+                     # ISSUE 19 footprint census: the registry's own
+                     # gauges, the dynamic per-struct gauge, AND the
+                     # track_struct enrollment pseudo-literals (the M1
+                     # scanner maps `track_struct("<name>", ...)` to
+                     # `footprint.struct.<name>`) must stay under the
+                     # guard — a census entry can't go undocumented
+                     "footprint.structs",
+                     "footprint.rss-mb",
+                     "footprint.threads",
+                     "footprint.fds",
+                     "footprint.struct.%s",
+                     "footprint.struct.slot-timeline",
+                     "footprint.struct.tx-lifecycle",
+                     "footprint.struct.scp-slots",
+                     "footprint.struct.scp-peers",
+                     "footprint.struct.ingress-intake",
+                     "footprint.struct.ingress-sources",
+                     "footprint.struct.prop-hashes",
+                     "footprint.struct.prop-peers",
+                     "footprint.struct.send-queues",
+                     "footprint.struct.verify-cache",
+                     "footprint.struct.entry-cache"):
         assert expected in names
 
 

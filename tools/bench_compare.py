@@ -787,6 +787,180 @@ def validate_ingress(ib, where: str = "") -> List[str]:
     return errs
 
 
+def scp_records(sb: dict, platform: str, source: str,
+                round_no=None, at_unix=None) -> List[dict]:
+    """Normalize an `scp` block (ISSUE 19: the consensus cockpit) into
+    direction-aware records: envelopes per externalized slot (lower —
+    the committed O(n^2) flood baseline that ROADMAP item 1's BLS
+    quorum certificates must beat) and the worst ballot round count
+    (lower — round inflation is timer retries, not progress)."""
+    out: List[dict] = []
+    if not isinstance(sb, dict):
+        return out
+    v = _num(sb, "envelopes_per_slot")
+    if v is not None:
+        out.append(make_record("envelopes_per_slot", "envelopes", v,
+                               platform, "lower", source, round_no,
+                               at_unix))
+    rounds = sb.get("rounds")
+    if isinstance(rounds, dict):
+        v = _num(rounds, "ballot")
+        if v is not None:
+            out.append(make_record("scp_ballot_rounds_worst", "rounds",
+                                   v, platform, "lower", source,
+                                   round_no, at_unix))
+    return out
+
+
+def footprint_records(fb: dict, platform: str, source: str,
+                      round_no=None, at_unix=None) -> List[dict]:
+    """Normalize a `footprint` block (ISSUE 19: the node footprint
+    census) into direction-aware records: mean per-node RSS (lower —
+    the N-vs-RSS scaling curve for the 100-node push)."""
+    out: List[dict] = []
+    if not isinstance(fb, dict):
+        return out
+    v = _num(fb, "per_node_rss_mb")
+    if v is not None:
+        out.append(make_record("per_node_rss_mb", "MB", v, platform,
+                               "lower", source, round_no, at_unix))
+    return out
+
+
+def _check_phase_sum(phase_s, wall, lw: str, errs: List[str]) -> None:
+    """Phase latencies telescope inside the slot: the sum of non-null
+    per-phase seconds can never exceed the slot wall they partition."""
+    if not isinstance(phase_s, dict):
+        return
+    total = 0.0
+    for p, v in sorted(phase_s.items()):
+        if v is None:
+            continue
+        pv = _num({"v": v}, "v")
+        if pv is None or pv < 0:
+            errs.append("%s: phase %r must be a finite number >= 0 or "
+                        "null, got %r" % (lw, p, v))
+            return
+        total += pv
+    if wall is not None and total > wall + max(1e-4, 1e-3 * wall):
+        errs.append("%s: phase latencies sum to %.6f s but the slot "
+                    "wall is %.6f s — phases cannot outlast the slot "
+                    "they partition" % (lw, total, wall))
+
+
+def validate_scp(sb, where: str = "") -> List[str]:
+    """Schema check for an `scp` block (`check`/`--check`): phase
+    latencies must telescope inside each slot wall and envelope counts
+    must be sane non-negative numbers. Accepts both the fleet-merged
+    `scp_summary()` shape and a per-node `ScpStats.fleet_json()` blob
+    (keyed by the `self`/`totals` fields only the per-node shape has).
+    The sum-vs-wall contract only binds per node: the fleet merge takes
+    the per-PHASE worst case over nodes, and a sum of maxes can exceed
+    the max wall — there the phases are only checked for sanity."""
+    errs: List[str] = []
+    if not isinstance(sb, dict):
+        return ["%s: scp is not an object: %r" % (where, sb)]
+    if "self" in sb or "totals" in sb:
+        # per-node ScpStats.fleet_json()
+        for slot_str, rec in sorted((sb.get("slots") or {}).items()):
+            lw = "%s: scp.slots[%s]" % (where, slot_str)
+            if not isinstance(rec, dict):
+                errs.append("%s must be an object" % lw)
+                continue
+            ph = rec.get("phases")
+            if isinstance(ph, dict):
+                _check_phase_sum(ph.get("phase_s"), _num(ph, "wall_s"),
+                                 lw, errs)
+        return errs
+    # fleet-merged scp_summary()
+    eps = _num(sb, "envelopes_per_slot")
+    if eps is None or eps < 0:
+        errs.append("%s: scp.envelopes_per_slot must be a finite number"
+                    " >= 0, got %r" % (where, sb.get("envelopes_per_slot")))
+    for slot_str, rec in sorted((sb.get("slots") or {}).items()):
+        lw = "%s: scp.slots[%s]" % (where, slot_str)
+        if not isinstance(rec, dict):
+            errs.append("%s must be an object" % lw)
+            continue
+        env = rec.get("envelopes")
+        if not isinstance(env, int) or isinstance(env, bool) or env < 0:
+            errs.append("%s.envelopes must be an int >= 0, got %r"
+                        % (lw, env))
+        # per-phase maxes over nodes: sanity only, no sum-vs-wall bound
+        _check_phase_sum(rec.get("phase_s"), None, lw, errs)
+        wall = _num(rec, "wall_s")
+        if rec.get("wall_s") is not None and (wall is None or wall < 0):
+            errs.append("%s.wall_s must be a finite number >= 0, got %r"
+                        % (lw, rec.get("wall_s")))
+    return errs
+
+
+def _check_footprint_structs(structs, lw: str, errs: List[str]) -> None:
+    if not isinstance(structs, dict):
+        errs.append("%s.structs must be an object, got %r"
+                    % (lw, structs))
+        return
+    for sname, entry in sorted(structs.items()):
+        if not isinstance(entry, dict):
+            errs.append("%s.structs[%s] must be an object" % (lw, sname))
+            continue
+        if entry.get("error") is not None:
+            continue    # scrape-time callback failure; occupancy unknown
+        occ, cap = _num(entry, "occupancy"), _num(entry, "capacity")
+        if occ is None or cap is None or occ < 0 or cap <= 0:
+            errs.append("%s.structs[%s] needs finite occupancy >= 0 and"
+                        " capacity > 0, got %r/%r"
+                        % (lw, sname, entry.get("occupancy"),
+                           entry.get("capacity")))
+        elif occ > cap:
+            errs.append("%s.structs[%s] occupancy %.0f exceeds its "
+                        "capacity %.0f — an unbounded structure in a "
+                        "committed artifact" % (lw, sname, occ, cap))
+
+
+def validate_footprint(fb, where: str = "") -> List[str]:
+    """Schema check for a `footprint` block (`check`/`--check`): every
+    registered bounded structure must respect its declared capacity —
+    the bounded-memory gate travels with the artifact. Accepts both the
+    fleet-merged `footprint_table()` shape and a per-node census
+    (`BoundedStructRegistry.to_json()`, keyed by its `structs` field).
+    """
+    errs: List[str] = []
+    if not isinstance(fb, dict):
+        return ["%s: footprint is not an object: %r" % (where, fb)]
+    if "structs" in fb:
+        # per-node census
+        _check_footprint_structs(fb["structs"], "%s: footprint" % where,
+                                 errs)
+        oc = fb.get("over_capacity")
+        if oc:
+            errs.append("%s: footprint.over_capacity is non-empty (%s)"
+                        % (where, ", ".join(sorted(oc))))
+        return errs
+    # fleet-merged footprint_table()
+    v = _num(fb, "per_node_rss_mb")
+    if v is None or v < 0:
+        errs.append("%s: footprint.per_node_rss_mb must be a finite "
+                    "number >= 0, got %r"
+                    % (where, fb.get("per_node_rss_mb")))
+    over = fb.get("over_capacity")
+    if isinstance(over, dict):
+        for node, names in sorted(over.items()):
+            errs.append("%s: footprint.over_capacity[%s] lists %s — a "
+                        "bounded structure overran its cap in a "
+                        "committed artifact"
+                        % (where, node, ", ".join(sorted(names))))
+    for node, nb in sorted((fb.get("per_node") or {}).items()):
+        if not isinstance(nb, dict):
+            errs.append("%s: footprint.per_node[%s] must be an object"
+                        % (where, node))
+            continue
+        _check_footprint_structs(nb.get("structs"),
+                                 "%s: footprint.per_node[%s]"
+                                 % (where, node), errs)
+    return errs
+
+
 def _replay_leg_records(leg: dict, platform: str, source: str,
                         round_no, at_unix) -> List[dict]:
     out = []
@@ -887,6 +1061,17 @@ def _payload_records(p: dict, source: str, round_no,
     if isinstance(pb, dict):
         out.extend(propagation_records(pb, platform, source, round_no,
                                        at_unix))
+    # consensus-cockpit + footprint-census records from payload-level
+    # blocks (`bench.py --fleet-scale`; scale artifacts also carry an
+    # explicit `records` list, which normalize_any prefers — this path
+    # keeps nested/legacy blobs normalizable)
+    sb = p.get("scp")
+    if isinstance(sb, dict):
+        out.extend(scp_records(sb, platform, source, round_no, at_unix))
+    fb = p.get("footprint")
+    if isinstance(fb, dict):
+        out.extend(footprint_records(fb, platform, source, round_no,
+                                     at_unix))
     # multi-device verify legs (`bench.py --fleet-verify`; the artifact
     # also carries an explicit `records` list, which normalize_any
     # prefers — this path keeps nested/legacy blobs normalizable)
@@ -1066,6 +1251,10 @@ def _walk_breakdowns(blob, name: str, errs: List[str],
             flood=ob.get("flood") if isinstance(ob, dict) else None))
     if blob.get("ingress") is not None:
         errs.extend(validate_ingress(blob["ingress"], name))
+    if blob.get("scp") is not None:
+        errs.extend(validate_scp(blob["scp"], name))
+    if blob.get("footprint") is not None:
+        errs.extend(validate_footprint(blob["footprint"], name))
     if "fleet_verify" in blob:
         errs.extend(validate_fleet_verify(blob["fleet_verify"], name))
     if "hash_bench" in blob:
