@@ -8,6 +8,7 @@ parse errors — CI-gate friendly.
 from __future__ import annotations
 
 import argparse
+import json
 import os
 import subprocess
 import sys
@@ -50,9 +51,19 @@ def main(argv=None) -> int:
     ap.add_argument("--list", action="store_true", dest="list_all",
                     help="print every finding (all rules, N/A ones "
                          "included) before allowlist filtering")
+    ap.add_argument("--json", action="store_true", dest="as_json",
+                    help="emit one machine-readable JSON object "
+                         "(findings, violations, stale entries, cache "
+                         "counters) instead of the human lines; exit "
+                         "codes unchanged")
+    ap.add_argument("--no-cache", action="store_true",
+                    help="bypass the build/sctlint-cache facts cache "
+                         "(forces a full re-parse)")
     args = ap.parse_args(argv)
 
     cfg = default_config(args.repo_root)
+    if args.no_cache:
+        cfg.cache_dir = None
     if args.native:
         cfg.enabled_rules = tuple(
             r for r in cfg.enabled_rules if r.startswith("N"))
@@ -70,6 +81,25 @@ def main(argv=None) -> int:
             return 0
 
     res = run_analysis(cfg, files=files)
+
+    if args.as_json:
+        def row(f):
+            return {"rule": f.rule, "path": f.path, "line": f.line,
+                    "qualname": f.qualname, "message": f.message}
+        print(json.dumps({
+            "ok": res.ok,
+            "findings": [row(f) for f in res.findings],
+            "violations": [row(f) for f in res.violations],
+            "parse_errors": list(res.parse_errors),
+            "stale_entries": [
+                {"rule": e.rule, "path": e.path, "qual": e.qual,
+                 "lineno": e.lineno} for e in res.stale_entries],
+            "cache": {"hits": res.cache_hits,
+                      "misses": res.cache_misses},
+        }, indent=2, sort_keys=True))
+        if res.parse_errors:
+            return 2
+        return 0 if res.ok else 1
 
     if args.list_all:
         for f in res.findings:

@@ -66,7 +66,17 @@ class LintConfig:
     fault_registry_path: str = ""
     e1_dirs: Tuple[str, ...] = ("scp", "herder", "ledger", "bucket")
     enabled_rules: Tuple[str, ...] = ("D1", "D2", "T1", "E1", "F1", "M1",
+                                      "S1", "FL1", "B1",
                                       "N1", "N2", "N3", "N4", "A1")
+    # -- dataflow rules (S1/FL1/B1, flowrules.py) --------------------------
+    s1_dirs: Tuple[str, ...] = ("scp", "herder", "ledger", "bucket",
+                                "crypto", "history")
+    fl1_dirs: Tuple[str, ...] = ("scp", "herder", "ledger")
+    b1_root_classes: Tuple[str, ...] = ("Application", "Herder",
+                                        "OverlayManager", "LedgerManager")
+    # per-file facts/results cache under build/sctlint-cache; None (the
+    # fixture default) disables caching entirely
+    cache_dir: Optional[str] = None
     # -- C-side (N1-N4) and admin-surface (A1) extensions ------------------
     native_dir: Optional[str] = None     # *.c scanned here; None = skip N*
     docs_observability_path: Optional[str] = None
@@ -82,6 +92,8 @@ class AnalysisResult:
     violations: List[Finding] = field(default_factory=list)  # post-allowlist
     stale_entries: List[AllowEntry] = field(default_factory=list)
     parse_errors: List[str] = field(default_factory=list)
+    cache_hits: int = 0
+    cache_misses: int = 0
 
     @property
     def ok(self) -> bool:
@@ -154,6 +166,7 @@ def default_config(repo_root: Optional[str] = None) -> LintConfig:
         bail_test_path=os.path.join(repo_root, "tests",
                                     "test_apply_cockpit.py"),
         op_type_names=dict(OP_TYPE_NAMES),
+        cache_dir=os.path.join(repo_root, "build", "sctlint-cache"),
     )
     _apply_pyproject(cfg)
     return cfg
@@ -198,6 +211,10 @@ def _apply_pyproject(cfg: LintConfig) -> None:
         cfg.enabled_rules = tuple(str(r) for r in data["rules"])
     if isinstance(data.get("e1-dirs"), list) and data["e1-dirs"]:
         cfg.e1_dirs = tuple(str(d) for d in data["e1-dirs"])
+    if isinstance(data.get("s1-dirs"), list) and data["s1-dirs"]:
+        cfg.s1_dirs = tuple(str(d) for d in data["s1-dirs"])
+    if isinstance(data.get("fl1-dirs"), list) and data["fl1-dirs"]:
+        cfg.fl1_dirs = tuple(str(d) for d in data["fl1-dirs"])
 
 
 def _py_files(package_dir: str) -> List[str]:
@@ -224,30 +241,79 @@ def _c_files(native_dir: Optional[str]) -> List[str]:
     return out
 
 
+def _config_digest(cfg: LintConfig) -> str:
+    """Every knob that can change a PER-FILE verdict, folded into the
+    cache key (tree-wide rules re-run every time anyway)."""
+    import hashlib
+    blob = repr((cfg.enabled_rules, cfg.e1_dirs, cfg.s1_dirs,
+                 cfg.fl1_dirs, cfg.package_name))
+    return hashlib.sha256(blob.encode("utf-8")).hexdigest()
+
+
+def _per_module_findings(cfg: LintConfig, facts, flow) -> List[Finding]:
+    """The per-module (cacheable) Python rules for one file."""
+    from . import flowrules as FR
+    from . import rules as R
+    out: List[Finding] = []
+    if "D1" in cfg.enabled_rules:
+        out.extend(R.rule_d1_wallclock(facts))
+    if "D2" in cfg.enabled_rules:
+        out.extend(R.rule_d2_randomness(facts))
+    if "E1" in cfg.enabled_rules:
+        out.extend(R.rule_e1_swallow(facts, cfg.e1_dirs,
+                                     cfg.package_name))
+    if "S1" in cfg.enabled_rules:
+        out.extend(FR.rule_s1_set_order(flow, cfg.s1_dirs,
+                                        cfg.package_name))
+    if "FL1" in cfg.enabled_rules:
+        out.extend(FR.rule_fl1_float(flow, cfg.fl1_dirs,
+                                     cfg.package_name))
+    return out
+
+
 def run_analysis(config: Optional[LintConfig] = None,
                  files: Optional[Sequence[str]] = None) -> AnalysisResult:
     """Run every enabled rule. `files` (absolute or repo-relative)
-    restricts the per-module rules (D1/D2/E1 for .py, N1/N2/N3 for .c)
-    to those files — the `--changed` fast path; tree-wide rules
-    (T1/F1/M1/N4/A1) always scan the whole package, since their facts
-    are cross-module (and cross-language)."""
+    restricts the per-module rules (D1/D2/E1/S1/FL1 for .py, N1/N2/N3
+    for .c) to those files — the `--changed` fast path; tree-wide rules
+    (T1/F1/M1/B1/N4/A1) always scan the whole package, since their
+    facts are cross-module (and cross-language). Per-file parsing and
+    per-module findings are served from the content-addressed cache
+    (cache.py) when `cfg.cache_dir` is set."""
     from . import crules as C
+    from . import flowrules as FR
     from . import rules as R
+    from .cache import SctlintCache
 
     cfg = config or default_config()
     res = AnalysisResult()
+    cache = SctlintCache(cfg.cache_dir, _config_digest(cfg))
 
     all_paths = _py_files(cfg.package_dir)
     facts_by_path: Dict[str, "R.ModuleFacts"] = {}
+    flow_by_path: Dict[str, "FR.FlowFacts"] = {}
+    findings_by_path: Dict[str, List[Finding]] = {}
     for abspath in all_paths:
         rel = os.path.relpath(abspath, cfg.repo_root).replace(os.sep, "/")
-        try:
-            with open(abspath, encoding="utf-8") as fh:
-                tree = ast.parse(fh.read(), filename=rel)
-        except SyntaxError as e:
-            res.parse_errors.append("%s: %s" % (rel, e))
-            continue
-        facts_by_path[rel] = R.ModuleFacts(rel, tree)
+        with open(abspath, "rb") as fh:
+            data = fh.read()
+        key = cache.key_for(rel, data)
+        entry = cache.get(key)
+        if entry is None:
+            try:
+                tree = ast.parse(data.decode("utf-8"), filename=rel)
+            except (SyntaxError, UnicodeDecodeError) as e:
+                res.parse_errors.append("%s: %s" % (rel, e))
+                continue
+            facts = R.ModuleFacts(rel, tree)
+            flow = FR.FlowFacts(rel, tree)
+            perfile = _per_module_findings(cfg, facts, flow)
+            cache.put(key, (facts, flow, perfile))
+        else:
+            facts, flow, perfile = entry
+        facts_by_path[rel] = facts
+        flow_by_path[rel] = flow
+        findings_by_path[rel] = perfile
 
     n_rules_on = any(r in cfg.enabled_rules
                      for r in ("N1", "N2", "N3", "N4"))
@@ -256,11 +322,28 @@ def run_analysis(config: Optional[LintConfig] = None,
         for abspath in _c_files(cfg.native_dir):
             rel = os.path.relpath(abspath, cfg.repo_root) \
                 .replace(os.sep, "/")
-            try:
-                with open(abspath, encoding="utf-8") as fh:
-                    cfacts_by_path[rel] = C.CFileFacts(rel, fh.read())
-            except ValueError as e:
-                res.parse_errors.append("%s: %s" % (rel, e))
+            with open(abspath, "rb") as fh:
+                data = fh.read()
+            key = cache.key_for(rel, data)
+            entry = cache.get(key)
+            if entry is None:
+                try:
+                    cfacts = C.CFileFacts(rel, data.decode("utf-8"))
+                except ValueError as e:
+                    res.parse_errors.append("%s: %s" % (rel, e))
+                    continue
+                cper: List[Finding] = []
+                if "N1" in cfg.enabled_rules:
+                    cper.extend(C.rule_n1_nogil_python(cfacts))
+                if "N2" in cfg.enabled_rules:
+                    cper.extend(C.rule_n2_alloc_discipline(cfacts))
+                if "N3" in cfg.enabled_rules:
+                    cper.extend(C.rule_n3_lock_balance(cfacts))
+                cache.put(key, (cfacts, cper))
+            else:
+                cfacts, cper = entry
+            cfacts_by_path[rel] = cfacts
+            findings_by_path[rel] = cper
 
     restrict: Optional[Set[str]] = None
     if files is not None:
@@ -271,27 +354,16 @@ def run_analysis(config: Optional[LintConfig] = None,
                          .replace(os.sep, "/"))
 
     all_facts = list(facts_by_path.values())
-    for rel, facts in sorted(facts_by_path.items()):
+    all_flow = list(flow_by_path.values())
+    for rel in sorted(findings_by_path):
         if restrict is not None and rel not in restrict:
             continue
-        if "D1" in cfg.enabled_rules:
-            res.findings.extend(R.rule_d1_wallclock(facts))
-        if "D2" in cfg.enabled_rules:
-            res.findings.extend(R.rule_d2_randomness(facts))
-        if "E1" in cfg.enabled_rules:
-            res.findings.extend(
-                R.rule_e1_swallow(facts, cfg.e1_dirs, cfg.package_name))
+        res.findings.extend(findings_by_path[rel])
 
-    for rel, cfacts in sorted(cfacts_by_path.items()):
-        if restrict is not None and rel not in restrict:
-            continue
-        if "N1" in cfg.enabled_rules:
-            res.findings.extend(C.rule_n1_nogil_python(cfacts))
-        if "N2" in cfg.enabled_rules:
-            res.findings.extend(C.rule_n2_alloc_discipline(cfacts))
-        if "N3" in cfg.enabled_rules:
-            res.findings.extend(C.rule_n3_lock_balance(cfacts))
-
+    if "B1" in cfg.enabled_rules:
+        res.findings.extend(FR.rule_b1_bounded_structs(
+            all_flow, cfg.b1_root_classes,
+            "%s/util/footprint.py" % cfg.package_name))
     if "T1" in cfg.enabled_rules:
         res.findings.extend(R.rule_t1_thread_discipline(all_facts))
     if "F1" in cfg.enabled_rules and cfg.fault_registry is not None:
@@ -341,4 +413,7 @@ def run_analysis(config: Optional[LintConfig] = None,
         res.stale_entries = [e for e in entries
                              if e.matched == 0 and
                              e.rule in cfg.enabled_rules]
+    res.cache_hits = cache.hits
+    res.cache_misses = cache.misses
+    cache.prune()
     return res

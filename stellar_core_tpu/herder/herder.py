@@ -242,8 +242,8 @@ class HerderSCPDriver(SCPDriver):
                     cb) -> None:
         self.herder.setup_scp_timer(slot_index, timer_id, timeout, cb)
 
-    def compute_timeout(self, round_number: int) -> float:
-        return float(min(round_number, 30 * 60))
+    def compute_timeout(self, round_number: int) -> int:
+        return min(round_number, 30 * 60)
 
     def value_externalized(self, slot_index: int, value: bytes) -> None:
         self.herder.value_externalized(slot_index, value)

@@ -189,7 +189,11 @@ class TransactionQueue:
         bid cannot fit even with eviction (nothing is shed for a tx that
         bounces anyway); a list means evicting exactly those tails frees
         `need` ops."""
-        rate_in = frame.fee_bid / max(1, frame.num_operations())
+        # fee rates compared as integer cross-products (a/b < c/d ⇔
+        # a*d < c*b for positive denominators) — eviction order is
+        # consensus-visible, so no float division here (FL1)
+        in_fee = frame.fee_bid
+        in_ops = max(1, frame.num_operations())
         own = frame.seq_account_id().key_bytes
         # per-account count of not-yet-selected tail positions: one chain
         # can donate several tails, deepest-first
@@ -197,15 +201,17 @@ class TransactionQueue:
         victims = []
         while need > 0:
             victim_acc = None
-            victim_rate = rate_in
+            victim_fee, victim_ops = in_fee, in_ops
             victim_tail = None
             for acc, chain in self._pending.items():
                 if acc == own or tails[acc] == 0:
                     continue
                 tail = chain[tails[acc] - 1]
-                r = tail.fee_bid / max(1, tail.num_operations())
-                if r < victim_rate:
-                    victim_acc, victim_rate, victim_tail = acc, r, tail
+                t_fee = tail.fee_bid
+                t_ops = max(1, tail.num_operations())
+                if t_fee * victim_ops < victim_fee * t_ops:
+                    victim_acc, victim_tail = acc, tail
+                    victim_fee, victim_ops = t_fee, t_ops
             if victim_acc is None:
                 return None
             tails[victim_acc] -= 1
@@ -219,7 +225,6 @@ class TransactionQueue:
         flush honest pending txs. Evicted txs are NOT banned: they may be
         resubmitted once the surge clears."""
         m = self.metrics
-        rate_in = frame.fee_bid / max(1, frame.num_operations())
         for acc, tail in victims:
             chain = self._pending[acc]
             popped = chain.pop()
@@ -229,10 +234,11 @@ class TransactionQueue:
             self._note_outcome(popped, "evicted")
             if m is not None:
                 m.new_meter("herder.tx-queue.surge-evicted").mark()
-            log.debug("surge-evicted tx %s (fee rate %.1f < %.1f)",
+            log.debug("surge-evicted tx %s (fee %d over %d op(s) "
+                      "underbids %d over %d)",
                       popped.full_hash().hex()[:8],
-                      popped.fee_bid / max(1, popped.num_operations()),
-                      rate_in)
+                      popped.fee_bid, max(1, popped.num_operations()),
+                      frame.fee_bid, max(1, frame.num_operations()))
             if not chain:
                 self._pending.pop(acc, None)
                 self._ages.pop(acc, None)

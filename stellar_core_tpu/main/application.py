@@ -244,6 +244,84 @@ class Application:
                 lambda: cache._max, lambda: len(cache),
                 lambda: len(cache) * 256)
 
+        # -- B1 enrollments (ISSUE 20): every long-lived container the
+        # bounded-memory dataflow rule flags is census-tracked here with
+        # a declared budget, so growth past the budget surfaces as
+        # `over_capacity` in soaks instead of silent RSS creep. Budgets
+        # are vocabulary bounds (metric/op/outcome names) or generous
+        # operational ceilings, not hard invariants of the code.
+        pe = self.herder.pending
+        fp.track_struct(
+            "pending-txsets", "map",
+            lambda: 4096, lambda: len(pe.txsets) + len(pe.qsets))
+        fp.track_struct(
+            "pending-slot-sets", "map",
+            lambda: 16384,
+            lambda: sum(len(s) for s in pe.processed.values()) +
+            sum(len(s) for s in pe.discarded.values()))
+        hd = self.herder
+        fp.track_struct(
+            "scp-timers", "map",
+            # (slot, timer_id) keys; erase_below GC plus the validity
+            # bracket bound how many slots hold live timers
+            lambda: hd.LEDGER_VALIDITY_BRACKET * 8,
+            lambda: len(hd._scp_timers))
+        qt = self.herder.quorum_tracker
+        fp.track_struct(
+            "quorum-tracker", "map",
+            lambda: 4096, lambda: len(qt._quorum))
+        lc2 = self.herder.tx_lifecycle
+        fp.track_struct(
+            "tx-outcome-meters", "map",
+            lambda: 64, lambda: len(lc2._m_outcome))
+        st = self.ledger_manager.apply_stats
+        fp.track_struct(
+            "apply-meters", "map",
+            lambda: 512,
+            lambda: len(st._m_lookup) + len(st._m_op) +
+            len(st._h_op) + len(st._g_level))
+        mreg = self.metrics
+        fp.track_struct(
+            "metrics-registry", "map",
+            lambda: 4096, lambda: len(mreg._metrics))
+        fp.track_struct(
+            "footprint-gauges", "map",
+            lambda: fp.MAX_STRUCTS, lambda: len(fp._g_occ))
+        fr = self.flight_recorder
+        fp.track_struct(
+            "flight-dump-marks", "map",
+            lambda: 64, lambda: len(fr._last_dump_at))
+        im = self.invariant_manager
+        fp.track_struct(
+            "invariants", "map",
+            lambda: 64, lambda: len(im._registered))
+        hm = self.history_manager
+        fp.track_struct(
+            "history-archives", "map",
+            lambda: 64, lambda: len(hm.archives))
+        ws = self.work_scheduler
+        fp.track_struct(
+            "work-roots", "list",
+            lambda: 1024, lambda: len(ws._roots))
+        ost = getattr(ov, "stats", None)
+        if ost is not None:
+            fp.track_struct(
+                "overlay-type-meters", "map",
+                lambda: 256,
+                lambda: len(ost._m_type) + len(ost._t_backend))
+        pm = getattr(ov, "peer_manager", None)
+        if pm is not None:
+            fp.track_struct(
+                "peer-records", "map",
+                lambda: 16384, lambda: len(pm._peers))
+        sv = getattr(ov, "survey_manager", None)
+        if sv is not None:
+            fp.track_struct(
+                "survey-state", "map",
+                lambda: 16384,
+                lambda: len(sv._limiter) + len(sv._surveyed) +
+                len(sv.results))
+
     # -- identity ------------------------------------------------------------
     def network_root_key(self) -> SecretKey:
         """Deterministic genesis root key derived from the network id."""

@@ -85,11 +85,13 @@ class SCPDriver:
                     cb) -> None:
         raise NotImplementedError
 
-    def compute_timeout(self, round_number: int) -> float:
+    def compute_timeout(self, round_number: int) -> int:
         """Linear backoff capped (reference computeTimeout: min(round, cap)
         seconds with cap 30 * 60? — reference uses 1s per round up to
-        MAX_TIMEOUT_SECONDS=30*60)."""
-        return float(min(round_number, 30 * 60))
+        MAX_TIMEOUT_SECONDS=30*60). Whole seconds, like the reference's
+        std::chrono::seconds — SCP pacing stays on integer arithmetic
+        (FL1)."""
+        return min(round_number, 30 * 60)
 
     # -- notifications (optional hooks; base emits trace instants so any
     # subclass calling super() keeps round/ballot timing visible) ----------

@@ -1,0 +1,121 @@
+"""Hash-seed differential probe: the runtime twin of sctlint's S1 rule
+(ISSUE 20; docs/static-analysis.md#hash-seed-gate).
+
+S1 statically bans set-ordered iteration from feeding consensus-visible
+values, because CPython randomizes str/bytes hashing per process
+(`PYTHONHASHSEED`) and set iteration order with it. This probe is the
+empirical check that the static net has no holes: it runs a seeded
+3-node loopback consensus simulation (buckets enabled, a funded account
+created mid-run so txsets are non-empty), records every node's
+per-height header hash, bucket-list hash and txset apply-order, and
+prints the whole record as canonical JSON on stdout.
+
+The differential gate (tests/test_hashseed_differential.py) runs this
+module in two subprocesses under DIFFERENT `PYTHONHASHSEED` values and
+asserts byte-identical output — any set-order leak into hashing, XDR
+serialization or txset ordering shows up as a diff between the two
+runs. Inside one run the three nodes must also agree height-by-height,
+which the probe asserts itself before printing.
+
+Run directly: `python -m stellar_core_tpu.testing.hashseed_probe
+[--heights N]`.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+
+
+def collect(heights: int = 4, max_rounds: int = 200000) -> dict:
+    """Drive the sim and return {node: {height: record}} where record =
+    {header, bucket_list, txs}. Hashes are hex; txs is the apply-order
+    list of full tx hashes in the externalized txset."""
+    from ..crypto.keys import SecretKey
+    from ..simulation import topologies
+    from ..testing import AppLedgerAdapter
+
+    sim = topologies.core(3, 2)
+    for node in sim.nodes.values():
+        node.app.enable_buckets()
+    sim.start_all_nodes()
+
+    records: dict = {name: {} for name in sim.nodes}
+
+    def poll() -> None:
+        for name, node in sim.nodes.items():
+            lm = node.app.ledger_manager
+            seq = lm.last_closed_ledger_num()
+            d = records[name]
+            if seq in d or seq < 1:
+                continue
+            header = lm.lcl_header
+            txs = []
+            ts = node.app.herder.pending.get_tx_set(
+                header.scpValue.txSetHash)
+            if ts is not None:
+                txs = [f.full_hash().hex() for f in ts.sort_for_apply()]
+            d[seq] = {"header": lm.lcl_hash.hex(),
+                      "bucket_list": header.bucketListHash.hex(),
+                      "txs": txs}
+
+    def done_through(target: int):
+        def pred() -> bool:
+            poll()
+            return sim.have_all_externalized(target)
+        return pred
+
+    if not sim.crank_until(done_through(2), max_rounds):
+        raise SystemExit("probe: consensus never reached height 2")
+
+    # a deterministic payment so at least one txset is non-empty (the
+    # seeded test key stream, not os.urandom — the probe's output must
+    # be identical across runs)
+    first = next(iter(sim.nodes.values()))
+    root = AppLedgerAdapter(first.app).root_account()
+    alice = SecretKey.pseudo_random_for_testing()
+    frame = root.tx([root.op_create_account(alice.public_key, 10 ** 9)])
+    if first.app.submit_transaction(frame) != 0:
+        raise SystemExit("probe: payment submission refused")
+
+    if not sim.crank_until(done_through(heights), max_rounds):
+        raise SystemExit("probe: consensus never reached height %d"
+                         % heights)
+    poll()
+    sim.stop_all_nodes()
+
+    # intra-run agreement first: the three nodes must already match
+    # height-by-height, otherwise the diff against the other hash seed
+    # would blame the wrong thing
+    names = sorted(records)
+    for h in range(1, heights + 1):
+        per = [(n, records[n].get(h)) for n in names]
+        vals = {json.dumps(r, sort_keys=True) for (_, r) in per
+                if r is not None}
+        if len(vals) > 1:
+            raise SystemExit("probe: nodes diverged at height %d: %r"
+                             % (h, per))
+    if not any(records[n].get(h, {}).get("txs")
+               for n in names for h in records[n]):
+        raise SystemExit("probe: no non-empty txset was externalized")
+
+    # heights past `heights` may differ per node (whoever closed last);
+    # trim so both subprocess runs compare a common prefix
+    return {n: {str(h): r for h, r in records[n].items()
+                if h <= heights}
+            for n in names}
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(prog="hashseed_probe")
+    ap.add_argument("--heights", type=int, default=4)
+    args = ap.parse_args(argv)
+    out = collect(args.heights)
+    json.dump(out, sys.stdout, indent=1, sort_keys=True)
+    sys.stdout.write("\n")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -976,3 +976,371 @@ def test_unknown_sct_sanitize_value_fails_loudly():
         env={**os.environ, "SCT_SANITIZE": "tsan"})
     assert r.returncode != 0
     assert "not a sanitize mode" in r.stderr
+
+
+# -- S1/FL1/B1 dataflow rules (ISSUE 20) ------------------------------------
+
+
+def test_s1_set_iteration_into_returned_collection(tmp_path):
+    """The canonical S1 violation: a set iterated (bare for) into a
+    list the function returns, in a consensus-critical dir."""
+    cfg = _fixture_repo(tmp_path, {"scp/nom.py": """
+        def leaders(nodes):
+            cand = {n.id for n in nodes}
+            out = []
+            for c in cand:
+                out.append(c)
+            return out
+    """})
+    s1 = [f for f in run_analysis(cfg).violations if f.rule == "S1"]
+    assert len(s1) == 1 and s1[0].qualname == "leaders"
+
+
+def test_s1_sorted_wrap_is_the_sanctioned_negative(tmp_path):
+    """sorted(...) at the ordering point neutralizes the taint — both
+    around the loop and as the returned value."""
+    cfg = _fixture_repo(tmp_path, {"scp/nom.py": """
+        def leaders(nodes):
+            cand = {n.id for n in nodes}
+            out = []
+            for c in sorted(cand):
+                out.append(c)
+            return out
+
+        def hashes(vals):
+            return sorted(set(vals))
+    """})
+    assert not [f for f in run_analysis(cfg).violations
+                if f.rule == "S1"]
+
+
+def test_s1_cross_function_set_propagation(tmp_path):
+    """The module-local helper hop: a helper RETURNING a set taints the
+    caller's iteration, exactly like a local set literal."""
+    cfg = _fixture_repo(tmp_path, {"herder/qs.py": """
+        def _slice_nodes(qset):
+            return {v for v in qset.validators}
+
+        def emit_order(qset):
+            acc = []
+            for v in _slice_nodes(qset):
+                acc.append(v)
+            return acc
+    """})
+    s1 = [f for f in run_analysis(cfg).violations if f.rule == "S1"]
+    assert len(s1) == 1 and s1[0].qualname == "emit_order"
+
+
+def test_s1_sinks_list_launder_star_unpack_and_hash_feed(tmp_path):
+    """list()/tuple() laundering, *-unpack and hash-feeding sinks all
+    fire; iteration confined to order-insensitive accumulation (a set)
+    stays clean; outside s1-dirs nothing fires."""
+    cfg = _fixture_repo(tmp_path, {"ledger/lx.py": """
+        def launder(s: set):
+            return list(s)
+
+        def feed(h, s: set):
+            h.hash_xdr(b"".join(s))
+
+        def spread(emit_fn, s: set):
+            emit_fn(*s)
+
+        def clean_accumulate(vals):
+            seen = set()
+            for v in {x.k for x in vals}:
+                seen.add(v)
+            return seen
+    """, "util/free.py": """
+        def anywhere(s: set):
+            return list(s)
+    """})
+    s1 = [f for f in run_analysis(cfg).violations if f.rule == "S1"]
+    quals = sorted(f.qualname for f in s1)
+    assert quals == ["feed", "launder", "spread"], \
+        "\n".join(f.format() for f in s1)
+
+
+def test_fl1_division_and_float_return_flagged(tmp_path):
+    """FL1 positives: true division, arithmetic on a float origin, and
+    a float-typed return in replicated-state dirs; integer // math and
+    out-of-scope dirs stay clean."""
+    cfg = _fixture_repo(tmp_path, {"ledger/fees.py": """
+        def fee_rate(fee, ops):
+            return fee / max(1, ops)
+
+        def scaled(fee):
+            r = 1.5
+            return int(fee * r)
+
+        def exact(fee, ops):
+            return (fee * 100) // max(1, ops)
+    """, "overlay/tele.py": """
+        def ratio(a, b):
+            return a / max(1, b)
+    """})
+    fl1 = [f for f in run_analysis(cfg).violations if f.rule == "FL1"]
+    paths = {f.path for f in fl1}
+    assert paths == {"fakepkg/ledger/fees.py"}
+    quals = {f.qualname for f in fl1}
+    assert quals == {"fee_rate", "scaled"}, \
+        "\n".join(f.format() for f in fl1)
+
+
+def test_fl1_telemetry_resolves_via_allowlist(tmp_path):
+    """The telemetry escape hatch is a justified allowlist line, not a
+    rule exemption: same finding, explicitly carried."""
+    cfg = _fixture_repo(tmp_path, {"ledger/stats.py": """
+        def close_ms(dt):
+            return dt * 1e3
+    """})
+    allow = tmp_path / "allow.txt"
+    allow.write_text("FL1 fakepkg/ledger/stats.py#close_ms -- "
+                     "wall-latency telemetry, never ledger state\n")
+    cfg.allowlist_path = str(allow)
+    res = run_analysis(cfg)
+    assert not [f for f in res.violations if f.rule == "FL1"]
+    assert [f for f in res.findings if f.rule == "FL1"]
+    assert not res.stale_entries
+
+
+def test_b1_unbounded_handler_grown_container_flagged(tmp_path):
+    """A subsystem dict reachable from Application's constructor and
+    grown from a handler with no bound, cap or enrollment → B1."""
+    cfg = _fixture_repo(tmp_path, {"main/app.py": """
+        from ..sub.thing import Thing
+
+        class Application:
+            def __init__(self):
+                self.thing = Thing()
+    """, "sub/thing.py": """
+        class Thing:
+            def __init__(self):
+                self.items = {}
+
+            def on_message(self, k, v):
+                self.items[k] = v
+    """})
+    b1 = [f for f in run_analysis(cfg).violations if f.rule == "B1"]
+    assert len(b1) == 1 and "self.items" in b1[0].message
+    assert b1[0].path == "fakepkg/sub/thing.py"
+
+
+def test_b1_bounded_capped_and_enrolled_negatives(tmp_path):
+    """The three sanctioned outs: deque(maxlen), an explicit cap check
+    in the mutating class, and track_struct enrollment."""
+    cfg = _fixture_repo(tmp_path, {"main/app.py": """
+        from ..sub.things import Ring, Capped, Tracked
+
+        class Application:
+            def __init__(self, fp):
+                self.ring = Ring()
+                self.capped = Capped()
+                self.tracked = Tracked()
+                t = self.tracked
+                fp.track_struct("tracked-rows", "map",
+                                lambda: 64, lambda: len(t.rows))
+    """, "sub/things.py": """
+        from collections import deque
+
+        class Ring:
+            def __init__(self):
+                self.buf = deque(maxlen=64)
+
+            def on_event(self, e):
+                self.buf.append(e)
+
+        class Capped:
+            MAX = 100
+
+            def __init__(self):
+                self.items = {}
+
+            def on_event(self, k, v):
+                if len(self.items) >= self.MAX:
+                    self.items.clear()
+                self.items[k] = v
+
+        class Tracked:
+            def __init__(self):
+                self.rows = {}
+
+            def on_event(self, k, v):
+                self.rows[k] = v
+    """})
+    assert not [f for f in run_analysis(cfg).violations
+                if f.rule == "B1"]
+
+
+def test_b1_stale_enrollment_reverse_parity(tmp_path):
+    """The reverse direction: a track_struct enrollment whose callbacks
+    reference no attribute that exists anywhere is drift (the structure
+    was removed or renamed) and must fail."""
+    cfg = _fixture_repo(tmp_path, {"main/app.py": """
+        class Application:
+            def __init__(self, fp):
+                fp.track_struct("ghost-rows", "map",
+                                lambda: 10,
+                                lambda: _gone.vanished_rows)
+    """})
+    b1 = [f for f in run_analysis(cfg).violations if f.rule == "B1"]
+    assert len(b1) == 1 and "ghost-rows" in b1[0].message
+
+
+def test_b1_census_runtime_parity_drift_guard():
+    """Two-way census parity on the REAL tree (the ISSUE 20 drift
+    guard): a real Application registers exactly the track_struct names
+    the static scanner sees (config-gated ones may be absent but never
+    unknown), and B1 itself is silent — every discovered long-lived
+    container is bounded or enrolled, and no enrollment is stale."""
+    import ast as _ast
+
+    from stellar_core_tpu.analysis import flowrules as FR
+    from stellar_core_tpu.main.application import Application
+    from stellar_core_tpu.main.config import Config
+    from stellar_core_tpu.util.timer import ClockMode, VirtualClock
+
+    app_py = os.path.join(REPO, "stellar_core_tpu", "main",
+                          "application.py")
+    with open(app_py, encoding="utf-8") as fh:
+        flow = FR.FlowFacts("stellar_core_tpu/main/application.py",
+                            _ast.parse(fh.read()))
+    static_names = {name for (_ln, qual, name, _refs) in flow.track_calls
+                    if qual.startswith("Application._register_footprint")}
+    assert len(static_names) >= 20
+
+    cfg = Config.test_config(0)
+    cfg.DATABASE = "sqlite3://:memory:"
+    app = Application(VirtualClock(ClockMode.VIRTUAL_TIME), cfg)
+    runtime_names = set(app.footprint._structs)
+
+    conditional = {"ingress-intake", "ingress-sources", "prop-hashes",
+                   "prop-peers", "entry-cache", "overlay-type-meters",
+                   "peer-records", "survey-state"}
+    assert runtime_names <= static_names
+    assert static_names - runtime_names <= conditional, \
+        static_names - runtime_names
+
+    res = run_analysis(default_config())
+    assert not [f for f in res.violations if f.rule == "B1"], \
+        "\n".join(f.format() for f in res.violations if f.rule == "B1")
+
+
+# -- facts/results cache (ISSUE 20 satellite) -------------------------------
+
+
+def _cached_cfg(tmp_path, cache_name="cache"):
+    cfg = _fixture_repo(tmp_path, {"scp/a.py": """
+        def f():
+            return 1
+    """, "util/b.py": """
+        def g():
+            return 2
+    """})
+    cfg.cache_dir = str(tmp_path / cache_name)
+    return cfg
+
+
+def test_cache_cold_then_warm_hit_counters(tmp_path):
+    """The ≤50%-wall-time acceptance criterion, asserted structurally:
+    a warm run re-parses NOTHING (hits == files, misses == 0), so its
+    per-file cost is a read+sha against parse+facts+rules cold."""
+    cfg = _cached_cfg(tmp_path)
+    cold = run_analysis(cfg)
+    nfiles = len([1 for _, _, fns in os.walk(cfg.package_dir)
+                  for f in fns if f.endswith(".py")])
+    assert cold.cache_misses == nfiles and cold.cache_hits == 0
+    warm = run_analysis(cfg)
+    assert warm.cache_hits == nfiles and warm.cache_misses == 0
+    assert [f.rule for f in warm.findings] == \
+        [f.rule for f in cold.findings]
+
+
+def test_cache_invalidates_on_content_change_only(tmp_path):
+    """Editing one file misses exactly that file; the rest stay hot —
+    and the edited file's findings change accordingly."""
+    cfg = _cached_cfg(tmp_path)
+    run_analysis(cfg)
+    target = os.path.join(cfg.package_dir, "scp", "a.py")
+    with open(target, "a", encoding="utf-8") as fh:
+        fh.write("\ndef h(s: set):\n    return list(s)\n")
+    res = run_analysis(cfg)
+    assert res.cache_misses == 1
+    assert [f for f in res.violations if f.rule == "S1"]
+    warm = run_analysis(cfg)
+    assert warm.cache_misses == 0
+
+
+def test_cache_invalidates_on_config_change(tmp_path):
+    """The config digest keys the cache: flipping a per-module knob
+    (e1-dirs here) must re-lint, not serve stale verdicts."""
+    cfg = _cached_cfg(tmp_path)
+    run_analysis(cfg)
+    cfg.e1_dirs = ("scp",)
+    res = run_analysis(cfg)
+    assert res.cache_hits == 0 and res.cache_misses > 0
+
+
+def test_cache_disabled_for_fixture_default(tmp_path):
+    """cache_dir=None (every fixture config) bypasses the cache
+    entirely: counters stay zero, nothing is written."""
+    cfg = _fixture_repo(tmp_path, {"util/x.py": "def f():\n    return 1\n"})
+    assert cfg.cache_dir is None
+    res = run_analysis(cfg)
+    assert res.cache_hits == 0 and res.cache_misses == 0
+
+
+def test_cache_survives_corrupt_entry(tmp_path):
+    """A truncated pickle is a miss (recomputed and re-stored), never a
+    crash or a wrong verdict."""
+    cfg = _cached_cfg(tmp_path)
+    run_analysis(cfg)
+    pkls = [os.path.join(cfg.cache_dir, n)
+            for n in os.listdir(cfg.cache_dir) if n.endswith(".pkl")]
+    assert pkls
+    with open(pkls[0], "wb") as fh:
+        fh.write(b"\x80\x04not a pickle")
+    res = run_analysis(cfg)
+    assert res.cache_misses == 1
+    assert not res.parse_errors
+
+
+def test_cli_json_output_roundtrip():
+    """--json emits one machine-readable object with findings and cache
+    counters; exit codes match the text mode."""
+    import json
+    import subprocess
+    import sys
+    r = subprocess.run(
+        [sys.executable, "-m", "stellar_core_tpu.analysis", "--json"],
+        capture_output=True, text=True, cwd=REPO, timeout=120)
+    assert r.returncode == 0, r.stdout + r.stderr
+    data = json.loads(r.stdout)
+    assert data["ok"] is True
+    assert data["violations"] == [] and data["stale_entries"] == []
+    rules_seen = {f["rule"] for f in data["findings"]}
+    assert "FL1" in rules_seen          # allowlisted telemetry sites
+    assert data["cache"]["hits"] + data["cache"]["misses"] > 0
+
+
+def test_cli_list_covers_new_rules():
+    """`--list` (the tools/sctlint round-trip) surfaces the new rules'
+    findings before allowlist filtering on the real tree."""
+    import subprocess
+    import sys
+    r = subprocess.run(
+        [sys.executable, "-m", "stellar_core_tpu.analysis", "--list"],
+        capture_output=True, text=True, cwd=REPO, timeout=120)
+    assert r.returncode == 0, r.stdout + r.stderr
+    assert "FL1 " in r.stdout
+    assert "clean" in r.stdout
+
+
+def test_changed_mode_covers_new_rules_per_module():
+    """--changed's engine path: restricting to one consensus file still
+    runs S1/FL1 on it (per-module rules), keeps B1 tree-wide, and skips
+    stale-entry checks."""
+    cfg = default_config()
+    res = run_analysis(cfg, files=["stellar_core_tpu/herder/tx_queue.py"])
+    assert not res.violations, \
+        "\n".join(f.format() for f in res.violations)
+    assert not res.stale_entries
